@@ -1,0 +1,70 @@
+"""paddle_tpu — a TPU-native deep learning framework.
+
+Ground-up rebuild of the PaddlePaddle reference (/root/reference, see
+SURVEY.md) on JAX/XLA/Pallas/pjit idioms. Top-level namespace mirrors
+``paddle.*`` (reference: python/paddle/__init__.py): tensor functional API
+re-exported flat, plus nn/optimizer/amp/io/metric/hapi/parallel
+subpackages.
+"""
+
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .core import dtype as _dtype_mod
+from .core import flags as _flags_mod
+from .core import rng as _rng_mod
+
+# dtype aliases (paddle.float32 etc.)
+from .core.dtype import (bfloat16, bool_, complex64, complex128,  # noqa
+                         float16, float32, float64, int8, int16, int32,
+                         int64, uint8, dtype, get_default_dtype,
+                         set_default_dtype)
+
+# flags / seed
+get_flags = _flags_mod.get_flags
+set_flags = _flags_mod.set_flags
+seed = _rng_mod.seed
+
+# flat tensor API (paddle.add, paddle.reshape, ... as in the reference)
+from .tensor import *  # noqa: F401,F403
+from . import tensor  # noqa: F401
+
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+
+# late imports that depend on the above
+from . import amp  # noqa: F401
+from . import io  # noqa: F401
+from . import metric  # noqa: F401
+from .hapi.model import Model  # noqa: F401
+from . import hapi  # noqa: F401
+from . import parallel  # noqa: F401
+from . import models  # noqa: F401
+
+from .framework import (grad, jit, no_grad, save, load,  # noqa: F401
+                        value_and_grad)
+
+
+def is_compiled_with_cuda() -> bool:  # API parity helper
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    import jax
+    try:
+        return any(d.platform in ("tpu", "axon") for d in jax.devices())
+    except RuntimeError:
+        return False
+
+
+def device_count() -> int:
+    import jax
+    return jax.device_count()
+
+
+def set_device(spec: str = "tpu") -> None:
+    """Analog of ``paddle.set_device`` (ref: python/paddle/device/__init__.py).
+    Under JAX devices are implicit; this validates the spec only."""
+    if spec.split(":")[0] not in ("tpu", "cpu", "gpu", "axon"):
+        raise ValueError(f"unknown device {spec!r}")

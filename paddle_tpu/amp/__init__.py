@@ -1,0 +1,189 @@
+"""Automatic mixed precision.
+
+Rebuild of the reference's AMP stack
+(reference: python/paddle/amp/auto_cast.py:21 ``auto_cast``; level O1/O2
+machinery in python/paddle/fluid/dygraph/amp/auto_cast.py:210 ``amp_guard``
+with white/black op lists; dynamic loss scaling in
+python/paddle/amp/grad_scaler.py:26 over fluid loss_scaler.py:40; CUDA
+check_finite_and_unscale + update_loss_scaling ops in
+paddle/fluid/operators/amp/).
+
+TPU-native design: **bf16-first**. bfloat16 shares fp32's exponent range,
+so the loss-scaling machinery the reference needs for fp16 is unnecessary
+in the default path — ``auto_cast`` simply routes MXU ops (matmul/conv/
+attention) to bf16 while keeping reductions, normalization statistics and
+losses in fp32 (the white/black list collapses to "matmul-like vs rest").
+``GradScaler`` is still provided, fully functional under jit, for fp16
+parity and for users who want inf/nan skip behavior.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core import flags
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = jnp.bfloat16
+        self.level = "O1"
+
+
+_state = _AmpState()
+
+
+def is_enabled() -> bool:
+    return _state.enabled
+
+
+def compute_dtype():
+    return _state.dtype if _state.enabled else None
+
+
+@contextlib.contextmanager
+def auto_cast(enable: bool = True, dtype: str | None = None,
+              level: str = "O1", custom_white_list=None,
+              custom_black_list=None):
+    """ref: python/paddle/amp/auto_cast.py:21. ``level``:
+    O1 = cast per-op (matmul-like ops run in ``dtype``);
+    O2 = the caller keeps params in bf16 (see Layer.astype) and O1 casting
+    also applies."""
+    prev = (_state.enabled, _state.dtype, _state.level)
+    _state.enabled = enable
+    _state.dtype = jnp.dtype(dtype) if dtype is not None else \
+        jnp.dtype(flags.get_flag("amp_dtype"))
+    _state.level = level
+    try:
+        yield
+    finally:
+        _state.enabled, _state.dtype, _state.level = prev
+
+
+amp_guard = auto_cast  # legacy alias (ref: fluid/dygraph/amp/auto_cast.py)
+
+
+def white_cast(*xs):
+    """Cast matmul-like operands to the AMP compute dtype when enabled.
+    Called by nn.functional matmul/conv/attention entry points."""
+    if not _state.enabled:
+        return xs if len(xs) > 1 else xs[0]
+    dt = _state.dtype
+    out = tuple(x.astype(dt) if x is not None and
+                jnp.issubdtype(x.dtype, jnp.floating) else x for x in xs)
+    return out if len(out) > 1 else out[0]
+
+
+def decorate(model, optimizer=None, level: str = "O2", dtype=None):
+    """O2 decoration: cast model params to the AMP dtype
+    (ref: paddle.amp.decorate)."""
+    dt = dtype or flags.get_flag("amp_dtype")
+    model.astype(dt)
+    if optimizer is not None:
+        return model, optimizer
+    return model
+
+
+# ---------------------------------------------------------------------------
+# Dynamic loss scaling (fp16 path)
+# ---------------------------------------------------------------------------
+
+def _all_finite(grads) -> jax.Array:
+    leaves = jax.tree_util.tree_leaves(grads)
+    oks = [jnp.all(jnp.isfinite(g)) for g in leaves]
+    out = oks[0]
+    for o in oks[1:]:
+        out = jnp.logical_and(out, o)
+    return out
+
+
+class GradScaler:
+    """Dynamic loss scaler (ref: python/paddle/amp/grad_scaler.py:26;
+    semantics of update: *2 after ``incr_every_n_steps`` good steps,
+    *0.5 on inf/nan, matching update_loss_scaling op).
+
+    Functional core for jitted steps:
+        state = scaler.init_state()
+        scaled_loss = scaler.scale_loss(loss, state)
+        grads, ok = scaler.unscale(grads, state)
+        state = scaler.update_state(state, ok)
+    """
+
+    def __init__(self, enable: bool = True,
+                 init_loss_scaling: float = 2.0 ** 15,
+                 incr_ratio: float = 2.0, decr_ratio: float = 0.5,
+                 incr_every_n_steps: int = 1000,
+                 decr_every_n_nan_or_inf: int = 2,
+                 use_dynamic_loss_scaling: bool = True):
+        self.enable = enable
+        self.init_scale = init_loss_scaling
+        self.incr_ratio = incr_ratio
+        self.decr_ratio = decr_ratio
+        self.incr_every_n_steps = incr_every_n_steps
+        self.decr_every_n = decr_every_n_nan_or_inf
+        self.dynamic = use_dynamic_loss_scaling
+        self._state = self.init_state()
+
+    # functional core --------------------------------------------------------
+    def init_state(self) -> Dict[str, jax.Array]:
+        return {"scale": jnp.asarray(self.init_scale, jnp.float32),
+                "good": jnp.zeros([], jnp.int32),
+                "bad": jnp.zeros([], jnp.int32)}
+
+    def scale_loss(self, loss, state=None):
+        if not self.enable:
+            return loss
+        state = state or self._state
+        return loss * state["scale"].astype(loss.dtype)
+
+    def unscale(self, grads, state=None) -> Tuple[Any, jax.Array]:
+        if not self.enable:
+            return grads, jnp.asarray(True)
+        state = state or self._state
+        inv = 1.0 / state["scale"]
+        grads = jax.tree_util.tree_map(
+            lambda g: (g.astype(jnp.float32) * inv).astype(g.dtype), grads)
+        return grads, _all_finite(grads)
+
+    def update_state(self, state, all_finite) -> Dict[str, jax.Array]:
+        if not (self.enable and self.dynamic):
+            return state
+        good = jnp.where(all_finite, state["good"] + 1, 0)
+        bad = jnp.where(all_finite, 0, state["bad"] + 1)
+        grow = good >= self.incr_every_n_steps
+        shrink = bad >= self.decr_every_n
+        scale = jnp.where(grow, state["scale"] * self.incr_ratio,
+                          state["scale"])
+        scale = jnp.where(shrink, scale * self.decr_ratio, scale)
+        scale = jnp.clip(scale, 1.0, 2.0 ** 31)
+        return {"scale": scale,
+                "good": jnp.where(grow, 0, good),
+                "bad": jnp.where(shrink, 0, bad)}
+
+    # stateful wrappers (eager path) ----------------------------------------
+    def scale(self, loss):
+        return self.scale_loss(loss, self._state)
+
+    def step(self, optimizer, grads):
+        grads, ok = self.unscale(grads, self._state)
+        if bool(ok):
+            optimizer.step(grads)
+        self._state = jax.tree_util.tree_map(
+            lambda x: x, self.update_state(self._state, ok))
+
+    def is_enable(self):
+        return self.enable
+
+    def state_dict(self):
+        return {k: float(v) for k, v in self._state.items()}
+
+    def load_state_dict(self, sd):
+        self._state = {"scale": jnp.asarray(sd["scale"], jnp.float32),
+                       "good": jnp.asarray(int(sd["good"]), jnp.int32),
+                       "bad": jnp.asarray(int(sd["bad"]), jnp.int32)}

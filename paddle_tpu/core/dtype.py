@@ -1,0 +1,79 @@
+"""Dtype registry and default-dtype policy.
+
+Analog of the reference's VarType/proto dtype enum + default dtype handling
+(reference: paddle/fluid/framework/framework.proto VarType.Type,
+python/paddle/framework/dtype.py). On TPU the canonical float is bfloat16
+for compute and float32 for accumulation; this module centralizes those
+choices.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import flags
+
+# Public dtype aliases (paddle.float32 etc.)
+bool_ = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_ALIASES = {
+    "bool": bool_, "uint8": uint8, "int8": int8, "int16": int16,
+    "int32": int32, "int64": int64, "float16": float16,
+    "bfloat16": bfloat16, "bf16": bfloat16, "float32": float32,
+    "fp32": float32, "float64": float64, "complex64": complex64,
+    "complex128": complex128,
+}
+
+
+def dtype(name) -> jnp.dtype:
+    """Resolve a dtype spec (string/np.dtype/jnp dtype) to a jnp dtype."""
+    if isinstance(name, str):
+        if name not in _ALIASES:
+            raise TypeError(f"unknown dtype {name!r}")
+        return jnp.dtype(_ALIASES[name])
+    return jnp.dtype(name)
+
+
+def get_default_dtype() -> jnp.dtype:
+    return dtype(flags.get_flag("default_dtype"))
+
+
+def set_default_dtype(d) -> None:
+    flags.set_flags({"default_dtype": np.dtype(dtype(d)).name
+                     if not isinstance(d, str) else d})
+
+
+@contextlib.contextmanager
+def default_dtype_guard(d):
+    old = flags.get_flag("default_dtype")
+    set_default_dtype(d)
+    try:
+        yield
+    finally:
+        flags.set_flags({"default_dtype": old})
+
+
+def is_floating(d) -> bool:
+    return jnp.issubdtype(dtype(d), jnp.floating)
+
+
+def is_integer(d) -> bool:
+    return jnp.issubdtype(dtype(d), jnp.integer)
+
+
+def result_dtype(*args):
+    return jnp.result_type(*args)

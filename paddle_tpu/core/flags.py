@@ -1,0 +1,140 @@
+"""Global flag/config registry.
+
+TPU-native analog of the reference's gflags-based runtime flag system
+(reference: paddle/fluid/platform/flags.cc — 62 `PADDLE_DEFINE_EXPORTED_*`
+flags; Python surface `paddle.set_flags/get_flags`,
+python/paddle/fluid/framework.py:7125/7149; env parsing in
+paddle/fluid/platform/init.cc `InitGflags`).
+
+Design: a typed in-process registry. Flags are declared with a type, default
+and help string; values can be overridden from the environment
+(``PTPU_FLAGS_<name>``) at import time or programmatically via
+``set_flags``. There is no C++ gflags layer because on TPU the runtime knobs
+that mattered in the reference (allocator strategy, stream flags, cudnn
+switches) are owned by XLA/PJRT; what remains is framework-level policy.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Iterable, Mapping
+
+
+class FlagError(KeyError):
+    pass
+
+
+@dataclass
+class _Flag:
+    name: str
+    default: Any
+    type: type
+    help: str
+    value: Any
+    validator: Callable[[Any], bool] | None = None
+
+
+_REGISTRY: Dict[str, _Flag] = {}
+_LOCK = threading.RLock()
+_ENV_PREFIX = "PTPU_FLAGS_"
+
+
+def _coerce(flag_type: type, raw: Any) -> Any:
+    if isinstance(raw, flag_type):
+        return raw
+    if flag_type is bool:
+        if isinstance(raw, str):
+            low = raw.strip().lower()
+            if low in ("1", "true", "yes", "on"):
+                return True
+            if low in ("0", "false", "no", "off"):
+                return False
+            raise ValueError(f"cannot parse boolean flag value {raw!r}")
+        return bool(raw)
+    return flag_type(raw)
+
+
+def define_flag(
+    name: str,
+    default: Any,
+    help: str = "",
+    flag_type: type | None = None,
+    validator: Callable[[Any], bool] | None = None,
+) -> None:
+    """Declare a flag. Environment override ``PTPU_FLAGS_<name>`` wins over
+    the default (mirrors the reference's ``FLAGS_*`` env convention)."""
+    with _LOCK:
+        if name in _REGISTRY:
+            raise FlagError(f"flag {name!r} already defined")
+        ftype = flag_type or type(default)
+        value = default
+        env = os.environ.get(_ENV_PREFIX + name)
+        if env is None:
+            # Also honor the bare FLAGS_<name> spelling for familiarity.
+            env = os.environ.get("FLAGS_" + name)
+        if env is not None:
+            value = _coerce(ftype, env)
+        if validator is not None and not validator(value):
+            raise ValueError(f"invalid value {value!r} for flag {name!r}")
+        _REGISTRY[name] = _Flag(name, default, ftype, help, value, validator)
+
+
+def get_flags(names: str | Iterable[str] | None = None) -> Dict[str, Any]:
+    with _LOCK:
+        if names is None:
+            return {k: f.value for k, f in _REGISTRY.items()}
+        if isinstance(names, str):
+            names = [names]
+        out = {}
+        for n in names:
+            if n not in _REGISTRY:
+                raise FlagError(f"unknown flag {n!r}")
+            out[n] = _REGISTRY[n].value
+        return out
+
+
+def get_flag(name: str) -> Any:
+    return get_flags([name])[name]
+
+
+def set_flags(flags: Mapping[str, Any]) -> None:
+    with _LOCK:
+        for name, raw in flags.items():
+            if name not in _REGISTRY:
+                raise FlagError(f"unknown flag {name!r}")
+            f = _REGISTRY[name]
+            value = _coerce(f.type, raw)
+            if f.validator is not None and not f.validator(value):
+                raise ValueError(f"invalid value {value!r} for flag {name!r}")
+            f.value = value
+
+
+def flag_help() -> Dict[str, str]:
+    with _LOCK:
+        return {k: f.help for k, f in _REGISTRY.items()}
+
+
+# ---------------------------------------------------------------------------
+# Core framework flags (the TPU-relevant subset of the reference's 62).
+# ---------------------------------------------------------------------------
+define_flag("check_nan_inf", False,
+            "Scan every train-step output for NaN/Inf and raise "
+            "(ref: FLAGS_check_nan_inf, details/nan_inf_utils_detail.cc).")
+define_flag("default_dtype", "float32",
+            "Default floating dtype for new tensors/parameters.")
+define_flag("amp_dtype", "bfloat16",
+            "Compute dtype used by amp.auto_cast; bf16-first on TPU "
+            "(replaces the reference's fp16 O1/O2 lists).")
+define_flag("deterministic", False,
+            "Prefer deterministic XLA lowerings "
+            "(ref: FLAGS_cudnn_deterministic, platform/flags.cc:190).")
+define_flag("log_compiles", False, "Log XLA compilations of train steps.")
+define_flag("donate_buffers", True,
+            "Donate param/opt-state buffers in jitted train steps to halve "
+            "peak HBM (TPU analog of inplace op + GC in the reference "
+            "executors, framework/garbage_collector.h).")
+define_flag("prefetch_to_device", 2,
+            "DataLoader device-prefetch depth (ref: "
+            "fluid/reader.py buffer_size / use_double_buffer).")

@@ -1,0 +1,110 @@
+"""PRNG management.
+
+The reference manages randomness as mutable per-device generator state
+(reference: paddle/phi/core/generator.h, python/paddle/fluid/framework.py
+``_set_random_seed``; model-parallel RNG tracker in
+python/paddle/distributed/fleet/meta_parallel/parallel_layers/random.py —
+``RNGStatesTracker`` with named states like 'model_parallel_rng').
+
+TPU-native design: JAX keys are explicit and functional. We keep the
+*ergonomics* of implicit randomness (layers just call ``next_key()`` in
+forward) while staying trace-safe: a thread-local stack of ``KeyStream``
+objects supplies keys; a stream is seeded either globally (eager use) or
+from a key passed into the jitted step (so each step consumes fresh,
+reproducible randomness). Named sub-streams reproduce the reference's
+model-parallel RNG tracker: a 'global' stream (same key on every rank —
+e.g. dropout after a row-parallel linear must be identical across tp ranks)
+and a 'local' stream (folded with the mesh-axis index — e.g. dropout on
+tp-sharded activations must differ per shard).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import zlib
+from typing import Dict, Iterator, Optional
+
+import jax
+import numpy as np
+
+
+class KeyStream:
+    """A splittable stream of PRNG keys with named sub-streams."""
+
+    def __init__(self, key: jax.Array):
+        self._key = key
+        self._streams: Dict[str, jax.Array] = {}
+
+    @classmethod
+    def from_seed(cls, seed: int) -> "KeyStream":
+        return cls(jax.random.key(seed))
+
+    def next_key(self, name: str = "global") -> jax.Array:
+        """Return a fresh key from the named sub-stream."""
+        base = self._streams.get(name)
+        if base is None:
+            # Derive the sub-stream root deterministically from its name
+            # (crc32, not hash(): Python str hashing is salted per process
+            # and would desync named streams across ranks/runs).
+            base = jax.random.fold_in(
+                self._key, np.uint32(zlib.crc32(name.encode()) & 0x7FFFFFFF))
+        base, out = jax.random.split(base)
+        self._streams[name] = base
+        return out
+
+    def fold_in(self, data: int) -> "KeyStream":
+        return KeyStream(jax.random.fold_in(self._key, data))
+
+
+class _TLS(threading.local):
+    def __init__(self):
+        self.stack: list[KeyStream] = []
+        self.global_seed = 0
+
+
+_tls = _TLS()
+
+
+def seed(s: int) -> None:
+    """Set the global seed (analog of ``paddle.seed``)."""
+    _tls.global_seed = int(s)
+    _tls.stack = [KeyStream.from_seed(int(s))]
+
+
+def get_global_stream() -> KeyStream:
+    if not _tls.stack:
+        _tls.stack = [KeyStream.from_seed(_tls.global_seed)]
+    return _tls.stack[0]
+
+
+def current_stream() -> KeyStream:
+    if not _tls.stack:
+        _tls.stack = [KeyStream.from_seed(_tls.global_seed)]
+    return _tls.stack[-1]
+
+
+def next_key(name: str = "global") -> jax.Array:
+    """Fresh PRNG key from the innermost active stream. Safe under jit when
+    the enclosing step pushed a traced key via ``key_guard``."""
+    return current_stream().next_key(name)
+
+
+@contextlib.contextmanager
+def key_guard(key: jax.Array) -> Iterator[KeyStream]:
+    """Route all ``next_key`` calls in scope to a stream rooted at ``key``.
+
+    Jitted train steps pass their per-step key in through here so layer
+    code (dropout etc.) can remain key-free.
+    """
+    stream = KeyStream(key)
+    _tls.stack.append(stream)
+    try:
+        yield stream
+    finally:
+        _tls.stack.pop()
+
+
+def split_for_step(step: int | jax.Array) -> jax.Array:
+    """Derive a per-step key from the global seed (host-side helper)."""
+    return jax.random.fold_in(get_global_stream()._key, step)

@@ -1,0 +1,70 @@
+"""Framework-level utilities: autodiff facade, jit, save/load.
+
+- ``grad``/``value_and_grad``: thin façades over jax.grad — the autograd
+  engine (replaces the reference's eager tape, paddle/fluid/eager/
+  backward.cc:848 ``Backward``; gradient flows are derived by tracing, not
+  recorded per-op GradNodes).
+- ``jit``: the dygraph→compiled bridge. The reference rewrote Python AST
+  to a static ProgramDesc (python/paddle/fluid/dygraph/dygraph_to_static/
+  program_translator.py:991); here the same Python ``forward`` is traced
+  by XLA via jax.jit — one model definition, no transpiler.
+- ``save``/``load``: state_dict serialization
+  (ref: python/paddle/framework/io.py:574/791 paddle.save/load).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import pickle
+from typing import Any, Callable
+
+import jax
+import numpy as np
+
+from .nn.layer import Layer
+
+grad = jax.grad
+value_and_grad = jax.value_and_grad
+
+
+@contextlib.contextmanager
+def no_grad():
+    """API-parity context (ref: paddle.no_grad). JAX computes grads only
+    where jax.grad is applied, so this is a no-op marker."""
+    yield
+
+
+def jit(fn: Callable = None, *, static_argnums=(), donate_argnums=(),
+        **jit_kwargs):
+    """``@paddle_tpu.jit`` — compile a function with XLA (analog of
+    ``@paddle.jit.to_static``, ref: python/paddle/fluid/dygraph/jit.py)."""
+    def wrap(f):
+        return jax.jit(f, static_argnums=static_argnums,
+                       donate_argnums=donate_argnums, **jit_kwargs)
+    if fn is None:
+        return wrap
+    return wrap(fn)
+
+
+to_static = jit
+
+
+def _to_numpy_tree(obj):
+    return jax.tree_util.tree_map(lambda x: np.asarray(x), obj)
+
+
+def save(obj: Any, path: str) -> None:
+    """Serialize a state_dict / pytree / Layer to ``path``
+    (ref: paddle.save, python/paddle/framework/io.py:574)."""
+    if isinstance(obj, Layer):
+        obj = obj.state_dict()
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_numpy_tree(obj), f, protocol=4)
+
+
+def load(path: str) -> Any:
+    """ref: paddle.load (python/paddle/framework/io.py:791)."""
+    with open(path, "rb") as f:
+        return pickle.load(f)

@@ -1,0 +1,6 @@
+"""High-level API (ref: python/paddle/hapi/)."""
+
+from . import callbacks  # noqa: F401
+from .callbacks import (Callback, CSVLogger, EarlyStopping,  # noqa: F401
+                        LRScheduler, ModelCheckpoint, ProgBarLogger)
+from .model import Model  # noqa: F401

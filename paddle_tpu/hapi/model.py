@@ -1,0 +1,376 @@
+"""paddle_tpu.Model — the Keras-style trainer.
+
+Rebuild of the reference's high-level API
+(reference: python/paddle/hapi/model.py — Model:915, fit:1574,
+prepare:1499, evaluate:1709, predict:1791, train_batch:1055,
+DynamicGraphAdapter.train_batch:704, StaticGraphAdapter:246).
+
+TPU-native design: there is exactly one adapter. ``prepare`` builds a
+jitted functional train step — params/optimizer-state/buffers live on
+device across the whole fit loop (donated buffers, no per-step host
+sync; the reference's dygraph adapter re-enters Python per op, its static
+adapter pre-builds a Program — jit tracing gives us the static-graph
+performance with the dygraph definition). Sharded training reuses this
+exact class: ``parallel.DistributedModel`` supplies shardings and the
+step compiles to an SPMD program.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import flags, rng
+from ..io import DataLoader, Dataset
+from ..metric import Metric
+from ..nn.layer import Layer, functional_call, split_state
+from ..optimizer.optimizer import Optimizer
+from .callbacks import config_callbacks
+
+
+def _as_tuple(x):
+    if isinstance(x, (list, tuple)):
+        return tuple(x)
+    return (x,)
+
+
+class Model:
+    """ref: python/paddle/hapi/model.py:915."""
+
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self._inputs_spec = inputs
+        self._labels_spec = labels
+        self._optimizer: Optional[Optimizer] = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self.stop_training = False
+        # device-resident training state
+        self._params = None
+        self._frozen = None
+        self._buffers = None
+        self._opt_state = None
+        self._step_count = 0
+        self._train_step_fn = None
+        self._eval_step_fn = None
+        self._predict_fn = None
+        # sharding hooks (set by parallel.DistributedModel)
+        self._shard_params = None     # fn(params) -> sharded params
+        self._shard_batch = None      # fn(batch) -> sharded batch
+
+    # -- preparation --------------------------------------------------------
+    def prepare(self, optimizer: Optional[Optimizer] = None, loss=None,
+                metrics: Optional[Sequence[Metric]] = None,
+                amp_configs=None) -> None:
+        """ref: hapi/model.py:1499."""
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = list(metrics or [])
+        self._amp_configs = amp_configs
+        self._train_step_fn = None
+        self._eval_step_fn = None
+        self._predict_fn = None
+
+    def _sync_state_in(self):
+        """Pull state out of the stateful network into device trees.
+        Only trainable params are differentiated/updated; frozen ones
+        (Parameter(trainable=False)) ride along as constants."""
+        if self._params is None:
+            params, buffers = split_state(self.network)
+            meta = self.network.param_meta()
+            trainable = {k: v for k, v in params.items()
+                         if meta[k].trainable}
+            frozen = {k: v for k, v in params.items()
+                      if not meta[k].trainable}
+            if self._shard_params is not None:
+                trainable = self._shard_params(trainable)
+                frozen = self._shard_params(frozen)
+                buffers = self._shard_params(buffers)
+            self._params = dict(trainable)
+            self._frozen = dict(frozen)
+            self._buffers = dict(buffers)
+        if self._opt_state is None and self._optimizer is not None:
+            self._opt_state = self._optimizer.init_state(self._params)
+
+    def _sync_state_out(self):
+        """Write device state back into the network (on save/exit)."""
+        if self._params is not None:
+            for name, v in self._params.items():
+                self.network._assign_by_path(name, v)
+        if getattr(self, "_frozen", None):
+            for name, v in self._frozen.items():
+                self.network._assign_by_path(name, v)
+        if self._buffers is not None:
+            for name, v in self._buffers.items():
+                self.network._assign_by_path(name, v)
+
+    def _compute_loss(self, outputs, labels):
+        loss_fn = self._loss
+        outs = _as_tuple(outputs)
+        labs = _as_tuple(labels)
+        if isinstance(loss_fn, Layer):
+            return loss_fn(*outs, *labs)
+        return loss_fn(*outs, *labs)
+
+    def _metric_outputs(self, outputs, labels):
+        outs = _as_tuple(outputs)
+        labs = _as_tuple(labels)
+        return tuple(m.compute(outs[0], labs[0]) for m in self._metrics)
+
+    # -- compiled steps -----------------------------------------------------
+    def _build_train_step(self):
+        optimizer = self._optimizer
+
+        def step(params, frozen, opt_state, buffers, step_idx, key,
+                 inputs, labels):
+            def loss_fn(p):
+                with rng.key_guard(key):
+                    out, new_buf = functional_call(
+                        self.network, {**p, **frozen}, buffers, *inputs,
+                        training=True)
+                loss = self._compute_loss(out, labels)
+                return loss.astype(jnp.float32), (out, new_buf)
+            (loss, (out, new_buf)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            new_params, new_opt = optimizer.apply_gradients(
+                params, grads, opt_state, step_idx)
+            metric_outs = self._metric_outputs(out, labels)
+            return loss, new_params, new_opt, new_buf, metric_outs
+
+        donate = (0, 2, 3) if flags.get_flag("donate_buffers") else ()
+        return jax.jit(step, donate_argnums=donate)
+
+    def _build_eval_step(self):
+        def step(params, frozen, buffers, key, inputs, labels):
+            with rng.key_guard(key):
+                out, _ = functional_call(
+                    self.network, {**params, **frozen}, buffers, *inputs,
+                    training=False)
+            loss = self._compute_loss(out, labels) if self._loss else None
+            metric_outs = self._metric_outputs(out, labels)
+            return loss, metric_outs
+        return jax.jit(step)
+
+    def _build_predict_step(self):
+        def step(params, frozen, buffers, inputs):
+            out, _ = functional_call(
+                self.network, {**params, **frozen}, buffers, *inputs,
+                training=False)
+            return out
+        return jax.jit(step)
+
+    def _split_batch(self, batch) -> Tuple[Tuple, Tuple]:
+        batch = _as_tuple(batch)
+        if len(batch) == 1:
+            return batch, ()
+        n_labels = len(self._labels_spec) if self._labels_spec else 1
+        return batch[:-n_labels], batch[-n_labels:]
+
+    # -- batch-level API ----------------------------------------------------
+    def train_batch(self, inputs, labels=None) -> Dict[str, Any]:
+        """ref: hapi/model.py:1055."""
+        self._sync_state_in()
+        if self._train_step_fn is None:
+            self._train_step_fn = self._build_train_step()
+        inputs = _as_tuple(inputs)
+        labels = _as_tuple(labels) if labels is not None else ()
+        if self._shard_batch is not None:
+            inputs = self._shard_batch(inputs)
+            labels = self._shard_batch(labels)
+        key = rng.split_for_step(self._step_count)
+        loss, self._params, self._opt_state, self._buffers, metric_outs = \
+            self._train_step_fn(self._params, self._frozen, self._opt_state,
+                                self._buffers, self._step_count, key,
+                                inputs, labels)
+        self._step_count += 1
+        if flags.get_flag("check_nan_inf") and not np.isfinite(
+                np.asarray(loss)).all():
+            raise FloatingPointError(
+                f"NaN/Inf loss at step {self._step_count}")
+        logs = {"loss": float(loss)}
+        for m, mo in zip(self._metrics, metric_outs):
+            res = m.update(*_as_tuple(mo))
+            names = m.name() if isinstance(m.name(), list) else [m.name()]
+            vals = res if isinstance(res, list) else [res]
+            for n, v in zip(names, _as_tuple(vals)):
+                logs[n] = float(v)
+        return logs
+
+    def eval_batch(self, inputs, labels=None) -> Dict[str, Any]:
+        self._sync_state_in()
+        if self._eval_step_fn is None:
+            self._eval_step_fn = self._build_eval_step()
+        inputs = _as_tuple(inputs)
+        labels = _as_tuple(labels) if labels is not None else ()
+        if self._shard_batch is not None:
+            inputs = self._shard_batch(inputs)
+            labels = self._shard_batch(labels)
+        key = rng.split_for_step(self._step_count)
+        loss, metric_outs = self._eval_step_fn(
+            self._params, self._frozen, self._buffers, key, inputs, labels)
+        logs = {}
+        if loss is not None:
+            logs["loss"] = float(loss)
+        for m, mo in zip(self._metrics, metric_outs):
+            m.update(*_as_tuple(mo))
+        return logs
+
+    def predict_batch(self, inputs):
+        self._sync_state_in()
+        if self._predict_fn is None:
+            self._predict_fn = self._build_predict_step()
+        inputs = _as_tuple(inputs)
+        return self._predict_fn(self._params, self._frozen, self._buffers,
+                                inputs)
+
+    # -- fit/evaluate/predict loops -----------------------------------------
+    def _as_loader(self, data, batch_size, shuffle) -> DataLoader:
+        if isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle)
+        raise TypeError(f"unsupported data type {type(data)}")
+
+    def fit(self, train_data=None, eval_data=None, batch_size: int = 1,
+            epochs: int = 1, eval_freq: int = 1, log_freq: int = 10,
+            save_dir: Optional[str] = None, save_freq: int = 1,
+            verbose: int = 2, drop_last: bool = False, shuffle: bool = True,
+            num_workers: int = 0, callbacks=None) -> None:
+        """ref: hapi/model.py:1574."""
+        assert self._optimizer is not None and self._loss is not None, \
+            "call prepare(optimizer, loss, ...) before fit()"
+        loader = self._as_loader(train_data, batch_size, shuffle)
+        eval_loader = self._as_loader(eval_data, batch_size, False) \
+            if eval_data is not None else None
+        try:
+            steps = len(loader)
+        except TypeError:
+            steps = None
+        cbks = config_callbacks(callbacks, model=self, epochs=epochs,
+                                steps=steps, verbose=verbose,
+                                metrics=[m.name() for m in self._metrics],
+                                save_dir=save_dir)
+        self.stop_training = False
+        cbks.on_train_begin()
+        logs: Dict[str, Any] = {}
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            for step, batch in enumerate(loader):
+                cbks.on_train_batch_begin(step)
+                inputs, labels = self._split_batch(batch)
+                logs = self.train_batch(inputs, labels)
+                cbks.on_train_batch_end(step, logs)
+            if eval_loader is not None and epoch % eval_freq == 0:
+                eval_logs = self.evaluate(eval_loader, verbose=0,
+                                          _callbacks=cbks)
+                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+            cbks.on_epoch_end(epoch, logs)
+        cbks.on_train_end(logs)
+        self._sync_state_out()
+
+    def evaluate(self, eval_data, batch_size: int = 1, log_freq: int = 10,
+                 verbose: int = 2, num_workers: int = 0, callbacks=None,
+                 _callbacks=None) -> Dict[str, Any]:
+        """ref: hapi/model.py:1709."""
+        loader = self._as_loader(eval_data, batch_size, False)
+        cbks = _callbacks or config_callbacks(
+            callbacks, model=self, verbose=verbose,
+            metrics=[m.name() for m in self._metrics])
+        cbks.on_eval_begin()
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for step, batch in enumerate(loader):
+            cbks.on_eval_batch_begin(step)
+            inputs, labels = self._split_batch(batch)
+            logs = self.eval_batch(inputs, labels)
+            if "loss" in logs:
+                losses.append(logs["loss"])
+            cbks.on_eval_batch_end(step, logs)
+        out: Dict[str, Any] = {}
+        if losses:
+            out["loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            res = m.accumulate()
+            names = m.name() if isinstance(m.name(), list) else [m.name()]
+            vals = res if isinstance(res, list) else [res]
+            for n, v in zip(names, _as_tuple(vals)):
+                out[n] = float(v)
+        cbks.on_eval_end(out)
+        return out
+
+    def predict(self, test_data, batch_size: int = 1,
+                num_workers: int = 0, stack_outputs: bool = False):
+        """ref: hapi/model.py:1791."""
+        loader = self._as_loader(test_data, batch_size, False)
+        outputs = []
+        for batch in loader:
+            inputs = _as_tuple(batch)
+            # predict data has no labels
+            out = self.predict_batch(inputs)
+            outputs.append(jax.tree_util.tree_map(np.asarray, out))
+        if stack_outputs and outputs:
+            outputs = jax.tree_util.tree_map(
+                lambda *xs: np.concatenate(xs, axis=0), *outputs)
+        return outputs
+
+    # -- persistence --------------------------------------------------------
+    def save(self, path: str, training: bool = True) -> None:
+        """Saves ``path + '.pdparams'`` (+ ``.pdopt`` when training=True)
+        (ref: hapi/model.py save → fluid save_dygraph)."""
+        self._sync_state_out()
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        state = {k: np.asarray(v)
+                 for k, v in self.network.state_dict().items()}
+        with open(path + ".pdparams", "wb") as f:
+            pickle.dump(state, f, protocol=4)
+        if training and self._optimizer is not None:
+            opt_state = jax.tree_util.tree_map(
+                np.asarray, {"state": self._opt_state,
+                             "step": self._step_count})
+            with open(path + ".pdopt", "wb") as f:
+                pickle.dump(opt_state, f, protocol=4)
+
+    def load(self, path: str, reset_optimizer: bool = False) -> None:
+        with open(path + ".pdparams", "rb") as f:
+            state = pickle.load(f)
+        self.network.set_state_dict(state)
+        self._params = None
+        self._frozen = None
+        self._buffers = None
+        if not reset_optimizer and os.path.exists(path + ".pdopt"):
+            with open(path + ".pdopt", "rb") as f:
+                opt = pickle.load(f)
+            self._opt_state = jax.tree_util.tree_map(
+                jnp.asarray, opt["state"])
+            self._step_count = int(opt["step"])
+        else:
+            self._opt_state = None
+
+    def parameters(self):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None) -> Dict[str, int]:
+        """Parameter count summary (ref: hapi/model.py summary)."""
+        total = 0
+        trainable = 0
+        meta = self.network.param_meta()
+        for name, p in self.network.named_parameters():
+            n = int(np.prod(p.shape))
+            total += n
+            if meta[name].trainable:
+                trainable += n
+        info = {"total_params": total, "trainable_params": trainable}
+        print(f"Total params: {total:,}\nTrainable params: {trainable:,}")
+        return info

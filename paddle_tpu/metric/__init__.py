@@ -1,0 +1,163 @@
+"""Metrics (ref: python/paddle/metric/metrics.py — Metric base:45,
+Accuracy:183, Precision:300, Recall:406, Auc:512)."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class Metric:
+    """ref: python/paddle/metric/metrics.py:45."""
+
+    def __init__(self, name: Optional[str] = None):
+        self._name = name or type(self).__name__.lower()
+
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def name(self):
+        return self._name
+
+    def compute(self, pred, label):
+        """Optional pre-processing run inside the compiled step."""
+        return pred, label
+
+
+class Accuracy(Metric):
+    """Top-k accuracy (ref: metrics.py:183)."""
+
+    def __init__(self, topk: Union[int, Sequence[int]] = (1,),
+                 name: Optional[str] = None):
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        super().__init__(name or ("acc" if self.topk == (1,) else "acc"))
+        self.reset()
+
+    def compute(self, pred, label):
+        k = max(self.topk)
+        idx = jnp.argsort(-pred, axis=-1)[..., :k]
+        if label.ndim == pred.ndim:
+            label = jnp.argmax(label, axis=-1)
+        correct = (idx == label[..., None])
+        return correct
+
+    def update(self, correct):
+        correct = np.asarray(correct)
+        accs = []
+        for k in self.topk:
+            num = correct[..., :k].sum()
+            accs.append(float(num))
+        self.total = [t + a for t, a in zip(self.total, accs)]
+        self.count += int(np.prod(correct.shape[:-1]))
+        return [t / max(self.count, 1) for t in self.total]
+
+    def reset(self):
+        self.total = [0.0] * len(self.topk)
+        self.count = 0
+
+    def accumulate(self):
+        res = [t / max(self.count, 1) for t in self.total]
+        return res[0] if len(res) == 1 else res
+
+    def name(self):
+        if len(self.topk) == 1:
+            return self._name
+        return [f"{self._name}_top{k}" for k in self.topk]
+
+
+class Precision(Metric):
+    """Binary precision (ref: metrics.py:300)."""
+
+    def __init__(self, name: Optional[str] = None):
+        super().__init__(name or "precision")
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds)
+        labels = np.asarray(labels)
+        pred_pos = np.rint(preds).astype(np.int64).reshape(-1) == 1
+        lab = labels.astype(np.int64).reshape(-1) == 1
+        self.tp += int((pred_pos & lab).sum())
+        self.fp += int((pred_pos & ~lab).sum())
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return self.tp / denom if denom else 0.0
+
+
+class Recall(Metric):
+    """Binary recall (ref: metrics.py:406)."""
+
+    def __init__(self, name: Optional[str] = None):
+        super().__init__(name or "recall")
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds)
+        labels = np.asarray(labels)
+        pred_pos = np.rint(preds).astype(np.int64).reshape(-1) == 1
+        lab = labels.astype(np.int64).reshape(-1) == 1
+        self.tp += int((pred_pos & lab).sum())
+        self.fn += int((~pred_pos & lab).sum())
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return self.tp / denom if denom else 0.0
+
+
+class Auc(Metric):
+    """ROC AUC via threshold buckets (ref: metrics.py:512)."""
+
+    def __init__(self, num_thresholds: int = 4095,
+                 name: Optional[str] = None):
+        self.num_thresholds = num_thresholds
+        super().__init__(name or "auc")
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds)
+        labels = np.asarray(labels).reshape(-1)
+        if preds.ndim == 2 and preds.shape[1] == 2:
+            pos_prob = preds[:, 1]
+        else:
+            pos_prob = preds.reshape(-1)
+        bins = np.minimum(
+            (pos_prob * self.num_thresholds).astype(np.int64),
+            self.num_thresholds - 1)
+        for b, l in zip(bins, labels):
+            if l:
+                self._stat_pos[b] += 1
+            else:
+                self._stat_neg[b] += 1
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds, np.int64)
+        self._stat_neg = np.zeros(self.num_thresholds, np.int64)
+
+    def accumulate(self):
+        tot_pos = tot_neg = 0.0
+        area = 0.0
+        for i in range(self.num_thresholds - 1, -1, -1):
+            p, n = self._stat_pos[i], self._stat_neg[i]
+            area += n * (tot_pos + p + tot_pos) / 2.0
+            tot_pos += p
+            tot_neg += n
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        return area / (tot_pos * tot_neg)

@@ -1,0 +1,2 @@
+"""Model zoo (ref: python/paddle/vision/models, ERNIE/GPT from the
+reference's fleet examples). Populated incrementally."""

@@ -1,0 +1,26 @@
+"""paddle_tpu.nn — layers + functional (ref: python/paddle/nn/__init__.py)."""
+
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .clip import (ClipGradByGlobalNorm, ClipGradByNorm,  # noqa: F401
+                   ClipGradByValue)
+from .layer import (Layer, LayerDict, LayerList, Parameter,  # noqa: F401
+                    Sequential, functional_call, split_state)
+from .layers.common import (ELU, GELU, SELU, Dropout, Dropout2D,  # noqa
+                            Embedding, Flatten, Hardsigmoid, Hardswish,
+                            Identity, LeakyReLU, Linear, LogSoftmax, Mish,
+                            Pad2D, PReLU, ReLU, ReLU6, Sigmoid, SiLU,
+                            Softmax, Softplus, Softsign, Swish, Tanh,
+                            Upsample)
+from .layers.conv import (Conv1D, Conv2D, Conv2DTranspose, Conv3D)  # noqa
+from .layers.loss import (BCELoss, BCEWithLogitsLoss,  # noqa: F401
+                          CrossEntropyLoss, KLDivLoss, L1Loss, MSELoss,
+                          NLLLoss, SmoothL1Loss)
+from .layers.norm import (BatchNorm, BatchNorm1D, BatchNorm2D,  # noqa
+                          BatchNorm3D, GroupNorm, InstanceNorm2D, LayerNorm,
+                          RMSNorm, SyncBatchNorm)
+from .layers.pooling import (AdaptiveAvgPool2D, AdaptiveMaxPool2D,  # noqa
+                             AvgPool1D, AvgPool2D, MaxPool1D, MaxPool2D)
+from .layers.transformer import (MultiHeadAttention, Transformer,  # noqa
+                                 TransformerDecoder, TransformerDecoderLayer,
+                                 TransformerEncoder, TransformerEncoderLayer)

@@ -1,0 +1,64 @@
+"""Gradient clipping (ref: python/paddle/fluid/clip.py —
+ClipGradByValue/ClipGradByNorm/ClipGradByGlobalNorm; applied by the
+Optimizer before the update, fluid/optimizer.py _create_optimization_pass).
+
+Clips operate on gradient pytrees (functional), used by both eager
+``Optimizer.step`` and the compiled hapi train step. Global-norm clip
+computes the norm in fp32 over all leaves — under pjit the reductions are
+sharded+psummed by GSPMD automatically, replacing the reference's
+per-device squared-sum + allreduce dance.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class GradClipBase:
+    def __call__(self, grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(GradClipBase):
+    def __init__(self, max: float, min: float | None = None):
+        self.max = float(max)
+        self.min = float(-max if min is None else min)
+
+    def __call__(self, grads):
+        return jax.tree_util.tree_map(
+            lambda g: jnp.clip(g, self.min, self.max), grads)
+
+
+class ClipGradByNorm(GradClipBase):
+    """Per-tensor norm clip."""
+
+    def __init__(self, clip_norm: float):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, grads):
+        def _clip(g):
+            n = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            scale = jnp.minimum(1.0, self.clip_norm / jnp.maximum(n, 1e-12))
+            return (g * scale).astype(g.dtype)
+        return jax.tree_util.tree_map(_clip, grads)
+
+
+class ClipGradByGlobalNorm(GradClipBase):
+    def __init__(self, clip_norm: float):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, grads):
+        leaves = jax.tree_util.tree_leaves(grads)
+        total = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                    for g in leaves)
+        gnorm = jnp.sqrt(total)
+        scale = jnp.minimum(1.0, self.clip_norm / jnp.maximum(gnorm, 1e-12))
+        return jax.tree_util.tree_map(
+            lambda g: (g * scale).astype(g.dtype), grads)
+
+
+def global_norm(grads) -> jax.Array:
+    leaves = jax.tree_util.tree_leaves(grads)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                        for g in leaves))

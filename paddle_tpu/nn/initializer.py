@@ -1,0 +1,173 @@
+"""Parameter initializers.
+
+Rebuild of the reference's initializer zoo
+(reference: python/paddle/fluid/initializer.py — Constant/Uniform/Normal/
+TruncatedNormal/Xavier/MSRA(Kaiming)/Bilinear/Assign; python/paddle/nn/initializer/).
+
+Initializers are callables ``init(shape, dtype) -> jax.Array`` drawing from
+the framework RNG stream (core.rng), so layer construction is reproducible
+under ``paddle_tpu.seed``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import rng
+
+
+def _fan_in_out(shape: Sequence[int]):
+    shape = tuple(shape)
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv kernels are stored [out_c, in_c/groups, *k] (see layers/conv.py)
+    receptive = int(np.prod(shape[2:]))
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class Initializer:
+    def __call__(self, shape, dtype) -> jax.Array:
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value: float = 0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype):
+        return jnp.full(shape, self.value, dtype=dtype)
+
+
+class Uniform(Initializer):
+    def __init__(self, low: float = -1.0, high: float = 1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype):
+        return jax.random.uniform(rng.next_key("init"), shape, dtype=dtype,
+                                  minval=self.low, maxval=self.high)
+
+
+class Normal(Initializer):
+    def __init__(self, mean: float = 0.0, std: float = 1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype):
+        return self.mean + self.std * jax.random.normal(
+            rng.next_key("init"), shape, dtype=dtype)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean: float = 0.0, std: float = 1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype):
+        return self.mean + self.std * jax.random.truncated_normal(
+            rng.next_key("init"), -2.0, 2.0, shape, dtype=dtype)
+
+
+class XavierUniform(Initializer):
+    """Glorot uniform (ref: fluid/initializer.py XavierInitializer)."""
+
+    def __init__(self, fan_in: Optional[float] = None,
+                 fan_out: Optional[float] = None, gain: float = 1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype):
+        fi, fo = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        limit = self.gain * math.sqrt(6.0 / max(fi + fo, 1))
+        return jax.random.uniform(rng.next_key("init"), shape, dtype=dtype,
+                                  minval=-limit, maxval=limit)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain: float = 1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype):
+        fi, fo = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        std = self.gain * math.sqrt(2.0 / max(fi + fo, 1))
+        return std * jax.random.normal(rng.next_key("init"), shape,
+                                       dtype=dtype)
+
+
+class KaimingUniform(Initializer):
+    """MSRA init (ref: fluid/initializer.py MSRAInitializer)."""
+
+    def __init__(self, fan_in: Optional[float] = None,
+                 negative_slope: float = 0.0, nonlinearity: str = "relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def _gain(self):
+        if self.nonlinearity == "relu":
+            return math.sqrt(2.0)
+        if self.nonlinearity == "leaky_relu":
+            return math.sqrt(2.0 / (1 + self.negative_slope ** 2))
+        return 1.0
+
+    def __call__(self, shape, dtype):
+        fi, _ = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        limit = self._gain() * math.sqrt(3.0 / max(fi, 1))
+        return jax.random.uniform(rng.next_key("init"), shape, dtype=dtype,
+                                  minval=-limit, maxval=limit)
+
+
+class KaimingNormal(KaimingUniform):
+    def __call__(self, shape, dtype):
+        fi, _ = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        std = self._gain() / math.sqrt(max(fi, 1))
+        return std * jax.random.normal(rng.next_key("init"), shape,
+                                       dtype=dtype)
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = np.asarray(value)
+
+    def __call__(self, shape, dtype):
+        v = jnp.asarray(self.value, dtype=dtype)
+        if tuple(v.shape) != tuple(shape):
+            raise ValueError(
+                f"Assign initializer shape {v.shape} != requested {shape}")
+        return v
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain: float = 1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype):
+        return self.gain * jax.nn.initializers.orthogonal()(
+            rng.next_key("init"), shape, dtype)
+
+
+# convenience instances matching paddle.nn.initializer defaults
+def calculate_gain(nonlinearity: str, param: Optional[float] = None) -> float:
+    if nonlinearity in ("sigmoid", "linear", "conv1d", "conv2d", "conv3d"):
+        return 1.0
+    if nonlinearity == "tanh":
+        return 5.0 / 3
+    if nonlinearity == "relu":
+        return math.sqrt(2.0)
+    if nonlinearity == "leaky_relu":
+        a = 0.01 if param is None else param
+        return math.sqrt(2.0 / (1 + a ** 2))
+    if nonlinearity == "selu":
+        return 3.0 / 4
+    raise ValueError(f"unsupported nonlinearity {nonlinearity!r}")

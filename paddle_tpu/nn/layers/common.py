@@ -1,0 +1,198 @@
+"""Core layers: Linear, Embedding, Dropout, Flatten, activations-as-layers.
+
+Rebuild of the reference's ``paddle.nn`` layer zoo
+(reference: python/paddle/nn/layer/common.py — Linear/Dropout/Embedding/
+Flatten/Pad; python/paddle/nn/layer/activation.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ...core import dtype as dtype_mod
+from .. import functional as F
+from .. import initializer as I
+from ..layer import Layer, Parameter
+
+
+class Linear(Layer):
+    """y = xW + b, W: [in_features, out_features]
+    (ref: python/paddle/nn/layer/common.py Linear)."""
+
+    def __init__(self, in_features: int, out_features: int,
+                 weight_attr=None, bias_attr=None, axes=None,
+                 bias_axes=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        init_w = weight_attr if callable(weight_attr) else I.XavierUniform()
+        self.weight = self.create_parameter(
+            [in_features, out_features], initializer=init_w, axes=axes)
+        if bias_attr is False:
+            self.bias = None
+        else:
+            init_b = bias_attr if callable(bias_attr) else I.Constant(0.0)
+            self.bias = self.create_parameter(
+                [out_features], initializer=init_b, axes=bias_axes)
+
+    def forward(self, x):
+        return F.linear(x, self.weight, self.bias)
+
+
+class Embedding(Layer):
+    """ref: python/paddle/nn/layer/common.py Embedding."""
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 padding_idx: Optional[int] = None, sparse: bool = False,
+                 weight_attr=None, axes=None):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.padding_idx = padding_idx
+        init_w = weight_attr if callable(weight_attr) else I.Normal(0., 1.0)
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], initializer=init_w, axes=axes)
+
+    def forward(self, x):
+        return F.embedding(x, self.weight, self.padding_idx)
+
+
+class Dropout(Layer):
+    def __init__(self, p: float = 0.5, mode: str = "upscale_in_train"):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x):
+        return F.dropout(x, self.p, training=self.training, mode=self.mode)
+
+
+class Dropout2D(Layer):
+    def __init__(self, p: float = 0.5, data_format: str = "NCHW"):
+        super().__init__()
+        self.p = p
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.dropout2d(x, self.p, training=self.training,
+                           data_format=self.data_format)
+
+
+class Flatten(Layer):
+    def __init__(self, start_axis: int = 1, stop_axis: int = -1):
+        super().__init__()
+        self.start_axis = start_axis
+        self.stop_axis = stop_axis
+
+    def forward(self, x):
+        start = self.start_axis % x.ndim
+        stop = self.stop_axis % x.ndim
+        shape = x.shape[:start] + (-1,) + x.shape[stop + 1:]
+        return x.reshape(shape)
+
+
+class Identity(Layer):
+    def __init__(self, *args, **kwargs):
+        super().__init__()
+
+    def forward(self, x):
+        return x
+
+
+class Pad2D(Layer):
+    def __init__(self, padding, mode: str = "constant", value: float = 0.0,
+                 data_format: str = "NCHW"):
+        super().__init__()
+        self.padding = padding
+        self.mode = mode
+        self.value = value
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.pad(x, self.padding, self.mode, self.value,
+                     self.data_format)
+
+
+class Upsample(Layer):
+    def __init__(self, size=None, scale_factor=None, mode: str = "nearest",
+                 align_corners: bool = False, data_format: str = "NCHW"):
+        super().__init__()
+        self.size, self.scale_factor = size, scale_factor
+        self.mode, self.align_corners = mode, align_corners
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.interpolate(x, self.size, self.scale_factor, self.mode,
+                             self.align_corners, self.data_format)
+
+
+def _act_layer(name, fn, **fixed):
+    class _Act(Layer):
+        def __init__(self, **kwargs):
+            super().__init__()
+            self._kwargs = {**fixed, **kwargs}
+
+        def forward(self, x):
+            return fn(x, **self._kwargs)
+    _Act.__name__ = name
+    _Act.__qualname__ = name
+    return _Act
+
+
+ReLU = _act_layer("ReLU", F.relu)
+ReLU6 = _act_layer("ReLU6", F.relu6)
+Sigmoid = _act_layer("Sigmoid", F.sigmoid)
+Tanh = _act_layer("Tanh", F.tanh)
+GELU = _act_layer("GELU", F.gelu)
+SiLU = _act_layer("SiLU", F.silu)
+Swish = _act_layer("Swish", F.swish)
+Mish = _act_layer("Mish", F.mish)
+Hardswish = _act_layer("Hardswish", F.hardswish)
+Hardsigmoid = _act_layer("Hardsigmoid", F.hardsigmoid)
+Softplus = _act_layer("Softplus", F.softplus)
+Softsign = _act_layer("Softsign", F.softsign)
+ELU = _act_layer("ELU", F.elu)
+SELU = _act_layer("SELU", F.selu)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope: float = 0.01):
+        super().__init__()
+        self.negative_slope = negative_slope
+
+    def forward(self, x):
+        return F.leaky_relu(x, self.negative_slope)
+
+
+class Softmax(Layer):
+    def __init__(self, axis: int = -1):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return F.softmax(x, self.axis)
+
+
+class LogSoftmax(Layer):
+    def __init__(self, axis: int = -1):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return F.log_softmax(x, self.axis)
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters: int = 1, init: float = 0.25):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [num_parameters], initializer=I.Constant(init))
+
+    def forward(self, x):
+        w = self.weight
+        if w.shape[0] > 1:
+            shape = [1, -1] + [1] * (x.ndim - 2)
+            w = w.reshape(shape)
+        return F.prelu(x, w)

@@ -1,0 +1,177 @@
+"""Normalization layers (ref: python/paddle/nn/layer/norm.py —
+BatchNorm1D/2D/3D, LayerNorm, GroupNorm, InstanceNorm, SyncBatchNorm).
+
+BatchNorm running statistics are registered buffers; in functional/compiled
+training `functional_call` returns the updated buffers, replacing the
+reference's in-place mutable-variable update inside the batch_norm kernel.
+SyncBatchNorm: under a sharded batch axis, XLA's batch-norm-expander +
+GSPMD already give cross-replica statistics when the reduction spans the
+sharded axis — we compute stats with a psum over the 'dp' axis when inside
+shard_map; under plain pjit, stats over the global batch are what GSPMD
+computes naturally, so SyncBatchNorm == BatchNorm (documented divergence
+from the NCCL implementation, ref: python/paddle/nn/layer/norm.py:1063).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .. import functional as F
+from .. import initializer as I
+from ..layer import Layer
+
+
+class _BatchNormBase(Layer):
+    def __init__(self, num_features: int, momentum: float = 0.9,
+                 epsilon: float = 1e-5, weight_attr=None, bias_attr=None,
+                 data_format: str = "NCHW", use_global_stats: bool = False):
+        super().__init__()
+        self.num_features = num_features
+        self.momentum = momentum
+        self.epsilon = epsilon
+        self.data_format = data_format
+        self.use_global_stats = use_global_stats
+        if weight_attr is False:
+            self.weight = None
+            self.bias = None
+        else:
+            init_w = weight_attr if callable(weight_attr) else I.Constant(1.)
+            init_b = bias_attr if callable(bias_attr) else I.Constant(0.)
+            self.weight = self.create_parameter([num_features],
+                                                initializer=init_w)
+            self.bias = self.create_parameter([num_features],
+                                              initializer=init_b)
+        self.register_buffer("_mean", jnp.zeros([num_features], jnp.float32))
+        self.register_buffer("_variance",
+                             jnp.ones([num_features], jnp.float32))
+
+    def forward(self, x):
+        training = self.training and not self.use_global_stats
+        y, new_mean, new_var = F.batch_norm(
+            x, self._mean, self._variance, self.weight, self.bias,
+            training=training, momentum=self.momentum, epsilon=self.epsilon,
+            data_format=self.data_format)
+        if training:
+            self._mean = new_mean
+            self._variance = new_var
+        return y
+
+
+class BatchNorm1D(_BatchNormBase):
+    pass
+
+
+class BatchNorm2D(_BatchNormBase):
+    pass
+
+
+class BatchNorm3D(_BatchNormBase):
+    pass
+
+
+BatchNorm = BatchNorm2D  # legacy alias (ref: fluid.dygraph.BatchNorm)
+
+
+class SyncBatchNorm(_BatchNormBase):
+    """See module docstring: equals BatchNorm under GSPMD global-batch
+    semantics (ref: python/paddle/nn/layer/norm.py SyncBatchNorm)."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer: Layer) -> Layer:
+        for name, sub in list(layer._sublayers.items()):
+            if isinstance(sub, _BatchNormBase) and \
+                    not isinstance(sub, SyncBatchNorm):
+                new = SyncBatchNorm(sub.num_features, sub.momentum,
+                                    sub.epsilon,
+                                    data_format=sub.data_format)
+                new._parameters.update(sub._parameters)
+                new._buffers.update(sub._buffers)
+                layer._sublayers[name] = new
+            else:
+                cls.convert_sync_batchnorm(sub)
+        return layer
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, epsilon: float = 1e-5,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = (normalized_shape,)
+        self.normalized_shape = tuple(normalized_shape)
+        self.epsilon = epsilon
+        if weight_attr is False:
+            self.weight = None
+        else:
+            init_w = weight_attr if callable(weight_attr) else I.Constant(1.)
+            self.weight = self.create_parameter(list(self.normalized_shape),
+                                                initializer=init_w)
+        if bias_attr is False:
+            self.bias = None
+        else:
+            init_b = bias_attr if callable(bias_attr) else I.Constant(0.)
+            self.bias = self.create_parameter(list(self.normalized_shape),
+                                              initializer=init_b)
+
+    def forward(self, x):
+        return F.layer_norm(x, self.normalized_shape, self.weight,
+                            self.bias, self.epsilon)
+
+
+class RMSNorm(Layer):
+    """TPU-first addition (absent in reference v2.3; see
+    nn/functional.py rms_norm)."""
+
+    def __init__(self, hidden_size: int, epsilon: float = 1e-6):
+        super().__init__()
+        self.epsilon = epsilon
+        self.weight = self.create_parameter([hidden_size],
+                                            initializer=I.Constant(1.0))
+
+    def forward(self, x):
+        return F.rms_norm(x, self.weight, self.epsilon)
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups: int, num_channels: int,
+                 epsilon: float = 1e-5, weight_attr=None, bias_attr=None,
+                 data_format: str = "NCHW"):
+        super().__init__()
+        self.num_groups = num_groups
+        self.num_channels = num_channels
+        self.epsilon = epsilon
+        self.data_format = data_format
+        if weight_attr is False:
+            self.weight = None
+        else:
+            self.weight = self.create_parameter(
+                [num_channels], initializer=I.Constant(1.0))
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter(
+                [num_channels], initializer=I.Constant(0.0))
+
+    def forward(self, x):
+        return F.group_norm(x, self.num_groups, self.weight, self.bias,
+                            self.epsilon, self.data_format)
+
+
+class InstanceNorm2D(Layer):
+    def __init__(self, num_features: int, epsilon: float = 1e-5,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.epsilon = epsilon
+        if weight_attr is False:
+            self.weight = None
+            self.bias = None
+        else:
+            self.weight = self.create_parameter(
+                [num_features], initializer=I.Constant(1.0))
+            self.bias = self.create_parameter(
+                [num_features], initializer=I.Constant(0.0))
+
+    def forward(self, x):
+        return F.instance_norm(x, self.weight, self.bias, self.epsilon)

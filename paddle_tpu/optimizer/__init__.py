@@ -1,0 +1,5 @@
+"""paddle_tpu.optimizer (ref: python/paddle/optimizer/__init__.py)."""
+
+from . import lr  # noqa: F401
+from .optimizer import (SGD, Adadelta, Adagrad, Adam, Adamax, AdamW,  # noqa
+                        Lamb, LarsMomentum, Momentum, Optimizer, RMSProp)

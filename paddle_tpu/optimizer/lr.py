@@ -1,0 +1,360 @@
+"""Learning-rate schedulers.
+
+Rebuild of the reference's LRScheduler zoo
+(reference: python/paddle/optimizer/lr.py — LRScheduler base:31, NoamDecay,
+PiecewiseDecay, NaturalExpDecay, InverseTimeDecay, PolynomialDecay,
+LinearWarmup, ExponentialDecay, MultiStepDecay, StepDecay, LambdaDecay,
+ReduceOnPlateau, CosineAnnealingDecay, MultiplicativeDecay, OneCycleLR,
+CyclicLR).
+
+Dual API: every scheduler is (a) stateful Paddle-style — ``sched.step()``
+advances, ``sched.get_lr()`` reads — and (b) a pure function of the step
+count — ``sched(step)`` returns a jnp scalar, traceable inside a jitted
+train step so the LR lives on-device and never forces a recompile.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, List, Optional, Sequence
+
+import jax.numpy as jnp
+
+
+class LRScheduler:
+    def __init__(self, learning_rate: float = 0.1, last_epoch: int = -1,
+                 verbose: bool = False):
+        self.base_lr = float(learning_rate)
+        self.last_epoch = last_epoch
+        self.verbose = verbose
+        self.last_lr = None
+        self.step()
+
+    # functional form -------------------------------------------------------
+    def lr_at(self, step):
+        """Pure function of step → lr (jnp-traceable). Subclasses override."""
+        raise NotImplementedError
+
+    def __call__(self, step):
+        return self.lr_at(step)
+
+    # stateful form ---------------------------------------------------------
+    def get_lr(self) -> float:
+        return float(self.last_lr)
+
+    def step(self, epoch: Optional[int] = None) -> None:
+        self.last_epoch = epoch if epoch is not None else self.last_epoch + 1
+        self.last_lr = float(self.lr_at(jnp.asarray(self.last_epoch)))
+
+    def state_dict(self):
+        return {"last_epoch": self.last_epoch, "last_lr": self.last_lr}
+
+    def set_state_dict(self, state):
+        self.last_epoch = state["last_epoch"]
+        self.last_lr = state["last_lr"]
+
+
+class ConstantLR(LRScheduler):
+    def lr_at(self, step):
+        return jnp.asarray(self.base_lr, jnp.float32)
+
+
+class NoamDecay(LRScheduler):
+    def __init__(self, d_model: int, warmup_steps: int,
+                 learning_rate: float = 1.0, last_epoch: int = -1,
+                 verbose: bool = False):
+        self.d_model = d_model
+        self.warmup_steps = warmup_steps
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        step = jnp.maximum(step, 1).astype(jnp.float32)
+        a = step ** -0.5
+        b = step * self.warmup_steps ** -1.5
+        return self.base_lr * self.d_model ** -0.5 * jnp.minimum(a, b)
+
+
+class ExponentialDecay(LRScheduler):
+    def __init__(self, learning_rate: float, gamma: float,
+                 last_epoch: int = -1, verbose: bool = False):
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        return self.base_lr * self.gamma ** step.astype(jnp.float32)
+
+
+class NaturalExpDecay(LRScheduler):
+    def __init__(self, learning_rate: float, gamma: float,
+                 last_epoch: int = -1, verbose: bool = False):
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        return self.base_lr * jnp.exp(-self.gamma *
+                                      step.astype(jnp.float32))
+
+
+class InverseTimeDecay(LRScheduler):
+    def __init__(self, learning_rate: float, gamma: float,
+                 last_epoch: int = -1, verbose: bool = False):
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        return self.base_lr / (1 + self.gamma * step.astype(jnp.float32))
+
+
+class PolynomialDecay(LRScheduler):
+    def __init__(self, learning_rate: float, decay_steps: int,
+                 end_lr: float = 0.0001, power: float = 1.0,
+                 cycle: bool = False, last_epoch: int = -1,
+                 verbose: bool = False):
+        self.decay_steps = decay_steps
+        self.end_lr = end_lr
+        self.power = power
+        self.cycle = cycle
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        step = step.astype(jnp.float32)
+        if self.cycle:
+            decay_steps = self.decay_steps * jnp.ceil(
+                jnp.maximum(step, 1e-9) / self.decay_steps)
+            decay_steps = jnp.maximum(decay_steps, self.decay_steps)
+        else:
+            decay_steps = self.decay_steps
+            step = jnp.minimum(step, self.decay_steps)
+        frac = (1 - step / decay_steps) ** self.power
+        return (self.base_lr - self.end_lr) * frac + self.end_lr
+
+
+class PiecewiseDecay(LRScheduler):
+    def __init__(self, boundaries: Sequence[int], values: Sequence[float],
+                 last_epoch: int = -1, verbose: bool = False):
+        self.boundaries = list(boundaries)
+        self.values = list(values)
+        super().__init__(values[0], last_epoch, verbose)
+
+    def lr_at(self, step):
+        idx = jnp.searchsorted(jnp.asarray(self.boundaries), step,
+                               side="right")
+        return jnp.asarray(self.values, jnp.float32)[idx]
+
+
+class CosineAnnealingDecay(LRScheduler):
+    def __init__(self, learning_rate: float, T_max: int,
+                 eta_min: float = 0.0, last_epoch: int = -1,
+                 verbose: bool = False):
+        self.T_max = T_max
+        self.eta_min = eta_min
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        step = step.astype(jnp.float32)
+        cos = jnp.cos(jnp.pi * jnp.minimum(step, self.T_max) / self.T_max)
+        return self.eta_min + (self.base_lr - self.eta_min) * (1 + cos) / 2
+
+
+class LinearWarmup(LRScheduler):
+    def __init__(self, learning_rate, warmup_steps: int, start_lr: float,
+                 end_lr: float, last_epoch: int = -1, verbose: bool = False):
+        self.inner = learning_rate if isinstance(learning_rate, LRScheduler)\
+            else None
+        self.peak = learning_rate if not isinstance(
+            learning_rate, LRScheduler) else None
+        self.warmup_steps = warmup_steps
+        self.start_lr = start_lr
+        self.end_lr = end_lr
+        super().__init__(end_lr, last_epoch, verbose)
+
+    def lr_at(self, step):
+        stepf = step.astype(jnp.float32)
+        warm = self.start_lr + (self.end_lr - self.start_lr) * \
+            jnp.minimum(stepf, self.warmup_steps) / self.warmup_steps
+        if self.inner is not None:
+            after = self.inner.lr_at(jnp.maximum(step - self.warmup_steps,
+                                                 0))
+        else:
+            after = jnp.asarray(self.peak, jnp.float32)
+        return jnp.where(stepf < self.warmup_steps, warm, after)
+
+
+class StepDecay(LRScheduler):
+    def __init__(self, learning_rate: float, step_size: int,
+                 gamma: float = 0.1, last_epoch: int = -1,
+                 verbose: bool = False):
+        self.step_size = step_size
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        return self.base_lr * self.gamma ** (step // self.step_size) \
+            .astype(jnp.float32)
+
+
+class MultiStepDecay(LRScheduler):
+    def __init__(self, learning_rate: float, milestones: Sequence[int],
+                 gamma: float = 0.1, last_epoch: int = -1,
+                 verbose: bool = False):
+        self.milestones = list(milestones)
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        n = jnp.searchsorted(jnp.asarray(self.milestones), step,
+                             side="right")
+        return self.base_lr * self.gamma ** n.astype(jnp.float32)
+
+
+class LambdaDecay(LRScheduler):
+    def __init__(self, learning_rate: float, lr_lambda: Callable,
+                 last_epoch: int = -1, verbose: bool = False):
+        self.lr_lambda = lr_lambda
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        return self.base_lr * self.lr_lambda(step)
+
+
+class MultiplicativeDecay(LRScheduler):
+    def __init__(self, learning_rate: float, lr_lambda: Callable,
+                 last_epoch: int = -1, verbose: bool = False):
+        self.lr_lambda = lr_lambda
+        self._factor = 1.0
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):  # stateful only; functional form approximates
+        return jnp.asarray(self.base_lr * self._factor, jnp.float32)
+
+    def step(self, epoch=None):
+        self.last_epoch = epoch if epoch is not None else self.last_epoch + 1
+        if self.last_epoch > 0:
+            self._factor *= self.lr_lambda(self.last_epoch)
+        self.last_lr = self.base_lr * self._factor
+
+
+class ReduceOnPlateau(LRScheduler):
+    """Metric-driven; stateful only (host decisions, like the reference,
+    ref: python/paddle/optimizer/lr.py ReduceOnPlateau)."""
+
+    def __init__(self, learning_rate: float, mode: str = "min",
+                 factor: float = 0.1, patience: int = 10,
+                 threshold: float = 1e-4, threshold_mode: str = "rel",
+                 cooldown: int = 0, min_lr: float = 0.0,
+                 epsilon: float = 1e-8, verbose: bool = False):
+        self.mode = mode
+        self.factor = factor
+        self.patience = patience
+        self.threshold = threshold
+        self.threshold_mode = threshold_mode
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.epsilon = epsilon
+        self.best = None
+        self.num_bad = 0
+        self.cooldown_counter = 0
+        self._lr = float(learning_rate)
+        super().__init__(learning_rate, -1, verbose)
+
+    def lr_at(self, step):
+        return jnp.asarray(self._lr, jnp.float32)
+
+    def step(self, metrics=None, epoch=None):
+        self.last_epoch += 1
+        if metrics is None:
+            self.last_lr = self._lr
+            return
+        m = float(metrics)
+        if self.best is None or self._is_better(m):
+            self.best = m
+            self.num_bad = 0
+        else:
+            self.num_bad += 1
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.num_bad = 0
+        elif self.num_bad > self.patience:
+            new_lr = max(self._lr * self.factor, self.min_lr)
+            if self._lr - new_lr > self.epsilon:
+                self._lr = new_lr
+            self.cooldown_counter = self.cooldown
+            self.num_bad = 0
+        self.last_lr = self._lr
+
+    def _is_better(self, m):
+        t = self.threshold
+        if self.mode == "min":
+            ref = self.best * (1 - t) if self.threshold_mode == "rel" \
+                else self.best - t
+            return m < ref
+        ref = self.best * (1 + t) if self.threshold_mode == "rel" \
+            else self.best + t
+        return m > ref
+
+
+class OneCycleLR(LRScheduler):
+    def __init__(self, max_learning_rate: float, total_steps: int,
+                 divide_factor: float = 25.0,
+                 end_learning_rate: float = 0.0001,
+                 phase_pct: float = 0.3, anneal_strategy: str = "cos",
+                 last_epoch: int = -1, verbose: bool = False):
+        self.max_lr = max_learning_rate
+        self.total_steps = total_steps
+        self.initial_lr = max_learning_rate / divide_factor
+        self.end_lr = end_learning_rate
+        self.phase_pct = phase_pct
+        self.anneal = anneal_strategy
+        super().__init__(self.initial_lr, last_epoch, verbose)
+
+    def _interp(self, a, b, pct):
+        if self.anneal == "cos":
+            return b + (a - b) * (1 + jnp.cos(jnp.pi * pct)) / 2
+        return a + (b - a) * pct
+
+    def lr_at(self, step):
+        step = step.astype(jnp.float32)
+        up = self.phase_pct * self.total_steps
+        pct_up = jnp.clip(step / jnp.maximum(up, 1), 0, 1)
+        pct_down = jnp.clip((step - up) / jnp.maximum(
+            self.total_steps - up, 1), 0, 1)
+        return jnp.where(
+            step < up,
+            self._interp(self.initial_lr, self.max_lr, pct_up),
+            self._interp(self.max_lr, self.end_lr, pct_down))
+
+
+class CyclicLR(LRScheduler):
+    def __init__(self, base_learning_rate: float, max_learning_rate: float,
+                 step_size_up: int, step_size_down: Optional[int] = None,
+                 mode: str = "triangular", gamma: float = 1.0,
+                 last_epoch: int = -1, verbose: bool = False):
+        self.base_lr_ = base_learning_rate
+        self.max_lr = max_learning_rate
+        self.up = step_size_up
+        self.down = step_size_down or step_size_up
+        self.mode = mode
+        self.gamma = gamma
+        super().__init__(base_learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        step = step.astype(jnp.float32)
+        cycle_len = self.up + self.down
+        cycle = jnp.floor(1 + step / cycle_len)
+        x = step - (cycle - 1) * cycle_len
+        pct = jnp.where(x <= self.up, x / self.up,
+                        1 - (x - self.up) / self.down)
+        amp = self.max_lr - self.base_lr_
+        if self.mode == "triangular2":
+            amp = amp / (2.0 ** (cycle - 1))
+        elif self.mode == "exp_range":
+            amp = amp * self.gamma ** step
+        return self.base_lr_ + amp * pct
+
+
+def make_schedule(lr) -> Callable:
+    """Normalize float | LRScheduler → pure fn(step)->lr."""
+    if isinstance(lr, LRScheduler):
+        return lr.lr_at
+    val = float(lr)
+    return lambda step: jnp.asarray(val, jnp.float32)

@@ -1,0 +1,2 @@
+"""Parallelism core (SURVEY.md §2.3): mesh topology, sharding rules,
+distributed layers. Populated incrementally; see mesh.py / api.py."""

@@ -1,0 +1,637 @@
+"""paddle_tpu.tensor — the functional tensor API.
+
+Rebuild of the reference's tensor namespace
+(reference: python/paddle/tensor/{creation,math,manipulation,linalg,logic,
+random,search,stat,einsum}.py, which dispatch to phi kernels via _C_ops).
+Here each function is a jnp/lax call; names and argument conventions follow
+the reference (``x``, ``axis``, ``keepdim``), returning ``jax.Array``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtype_mod
+from ..core import rng
+
+# ---------------------------------------------------------------------------
+# creation (ref: python/paddle/tensor/creation.py)
+# ---------------------------------------------------------------------------
+
+
+def to_tensor(data, dtype=None, stop_gradient: bool = True):
+    dt = dtype_mod.dtype(dtype) if dtype is not None else None
+    return jnp.asarray(data, dtype=dt)
+
+
+def _default_float(dtype):
+    return dtype_mod.dtype(dtype) if dtype is not None \
+        else dtype_mod.get_default_dtype()
+
+
+def zeros(shape, dtype=None):
+    return jnp.zeros(shape, dtype=_default_float(dtype))
+
+
+def ones(shape, dtype=None):
+    return jnp.ones(shape, dtype=_default_float(dtype))
+
+
+def full(shape, fill_value, dtype=None):
+    return jnp.full(shape, fill_value, dtype=_default_float(dtype))
+
+
+def zeros_like(x, dtype=None):
+    return jnp.zeros_like(x, dtype=dtype and dtype_mod.dtype(dtype))
+
+
+def ones_like(x, dtype=None):
+    return jnp.ones_like(x, dtype=dtype and dtype_mod.dtype(dtype))
+
+
+def full_like(x, fill_value, dtype=None):
+    return jnp.full_like(x, fill_value,
+                         dtype=dtype and dtype_mod.dtype(dtype))
+
+
+def arange(start=0, end=None, step=1, dtype=None):
+    if end is None:
+        start, end = 0, start
+    return jnp.arange(start, end, step,
+                      dtype=dtype and dtype_mod.dtype(dtype))
+
+
+def linspace(start, stop, num, dtype=None):
+    return jnp.linspace(start, stop, num, dtype=_default_float(dtype))
+
+
+def eye(num_rows, num_columns=None, dtype=None):
+    return jnp.eye(num_rows, num_columns, dtype=_default_float(dtype))
+
+
+def diag(x, offset: int = 0):
+    return jnp.diag(x, offset)
+
+
+def tril(x, diagonal: int = 0):
+    return jnp.tril(x, diagonal)
+
+
+def triu(x, diagonal: int = 0):
+    return jnp.triu(x, diagonal)
+
+
+def meshgrid(*args):
+    return jnp.meshgrid(*args, indexing="ij")
+
+
+def assign(x):
+    return jnp.asarray(x)
+
+
+def clone(x):
+    return jnp.array(x)
+
+
+# ---------------------------------------------------------------------------
+# random (ref: python/paddle/tensor/random.py) — keys from core.rng streams
+# ---------------------------------------------------------------------------
+
+def rand(shape, dtype=None):
+    return jax.random.uniform(rng.next_key(), shape,
+                              dtype=_default_float(dtype))
+
+
+def randn(shape, dtype=None):
+    return jax.random.normal(rng.next_key(), shape,
+                             dtype=_default_float(dtype))
+
+
+def randint(low, high=None, shape=(1,), dtype="int64"):
+    if high is None:
+        low, high = 0, low
+    return jax.random.randint(rng.next_key(), shape, low, high,
+                              dtype=dtype_mod.dtype(dtype))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0):
+    return jax.random.uniform(rng.next_key(), shape,
+                              dtype=_default_float(dtype),
+                              minval=min, maxval=max)
+
+
+def normal(mean=0.0, std=1.0, shape=(1,)):
+    return mean + std * jax.random.normal(
+        rng.next_key(), shape, dtype=dtype_mod.get_default_dtype())
+
+
+def randperm(n, dtype="int64"):
+    return jax.random.permutation(rng.next_key(), n).astype(
+        dtype_mod.dtype(dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False):
+    logits = jnp.log(jnp.maximum(x, 1e-30))
+    if replacement:
+        return jax.random.categorical(
+            rng.next_key(), logits, shape=x.shape[:-1] + (num_samples,))
+    if num_samples > 1:
+        # Gumbel top-k trick for without-replacement sampling
+        g = jax.random.gumbel(rng.next_key(), x.shape)
+        return jnp.argsort(-(logits + g), axis=-1)[..., :num_samples]
+    return jax.random.categorical(rng.next_key(), logits)[..., None]
+
+
+def bernoulli(x):
+    return jax.random.bernoulli(rng.next_key(), x).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# math (ref: python/paddle/tensor/math.py)
+# ---------------------------------------------------------------------------
+
+add = jnp.add
+subtract = jnp.subtract
+multiply = jnp.multiply
+divide = jnp.divide
+floor_divide = jnp.floor_divide
+mod = remainder = jnp.remainder
+pow = jnp.power
+exp = jnp.exp
+expm1 = jnp.expm1
+log = jnp.log
+log2 = jnp.log2
+log10 = jnp.log10
+log1p = jnp.log1p
+sqrt = jnp.sqrt
+square = jnp.square
+abs = jnp.abs
+sign = jnp.sign
+floor = jnp.floor
+ceil = jnp.ceil
+round = jnp.round
+trunc = jnp.trunc
+sin = jnp.sin
+cos = jnp.cos
+tan = jnp.tan
+asin = jnp.arcsin
+acos = jnp.arccos
+atan = jnp.arctan
+atan2 = jnp.arctan2
+sinh = jnp.sinh
+cosh = jnp.cosh
+tanh = jnp.tanh
+asinh = jnp.arcsinh
+acosh = jnp.arccosh
+atanh = jnp.arctanh
+erf = jax.scipy.special.erf
+lgamma = jax.scipy.special.gammaln
+digamma = jax.scipy.special.digamma
+reciprocal = jnp.reciprocal
+maximum = jnp.maximum
+minimum = jnp.minimum
+fmax = jnp.fmax
+fmin = jnp.fmin
+logaddexp = jnp.logaddexp
+hypot = jnp.hypot
+nan_to_num = jnp.nan_to_num
+lerp = lambda x, y, w: x + w * (y - x)  # noqa: E731
+
+
+def rsqrt(x):
+    return jax.lax.rsqrt(x)
+
+
+def clip(x, min=None, max=None):
+    return jnp.clip(x, min, max)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True):
+    if bias_after_scale:
+        return x * scale + bias
+    return (x + bias) * scale
+
+
+def sum(x, axis=None, dtype=None, keepdim=False):
+    return jnp.sum(x, axis=axis, dtype=dtype and dtype_mod.dtype(dtype),
+                   keepdims=keepdim)
+
+
+def mean(x, axis=None, keepdim=False):
+    return jnp.mean(x, axis=axis, keepdims=keepdim)
+
+
+def prod(x, axis=None, keepdim=False):
+    return jnp.prod(x, axis=axis, keepdims=keepdim)
+
+
+def max(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=axis, keepdims=keepdim)
+
+
+def min(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=axis, keepdims=keepdim)
+
+
+def amax(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=axis, keepdims=keepdim)
+
+
+def amin(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=axis, keepdims=keepdim)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.std(x, axis=axis, ddof=1 if unbiased else 0,
+                   keepdims=keepdim)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(x, axis=axis, ddof=1 if unbiased else 0,
+                   keepdims=keepdim)
+
+
+def median(x, axis=None, keepdim=False):
+    return jnp.median(x, axis=axis, keepdims=keepdim)
+
+
+def quantile(x, q, axis=None, keepdim=False):
+    return jnp.quantile(x, q, axis=axis, keepdims=keepdim)
+
+
+def cumsum(x, axis=None, dtype=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jnp.cumsum(x, axis=axis, dtype=dtype and dtype_mod.dtype(dtype))
+
+
+def cumprod(x, dim=None, dtype=None):
+    return jnp.cumprod(x, axis=dim, dtype=dtype and dtype_mod.dtype(dtype))
+
+
+def logsumexp(x, axis=None, keepdim=False):
+    return jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdim)
+
+
+def logcumsumexp(x, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jax.lax.cumlogsumexp(x, axis=axis)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    return beta * input + alpha * (x @ y)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2)
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2)
+    return jnp.matmul(x, y)
+
+
+mm = matmul
+
+
+def bmm(x, y):
+    return jnp.matmul(x, y)
+
+
+def dot(x, y):
+    return jnp.sum(x * y, axis=-1)
+
+
+def outer(x, y):
+    return jnp.outer(x, y)
+
+
+def inner(x, y):
+    return jnp.inner(x, y)
+
+
+def kron(x, y):
+    return jnp.kron(x, y)
+
+
+def cross(x, y, axis=-1):
+    return jnp.cross(x, y, axis=axis)
+
+
+def trace(x, offset=0, axis1=0, axis2=1):
+    return jnp.trace(x, offset, axis1, axis2)
+
+
+def diff(x, n=1, axis=-1):
+    return jnp.diff(x, n=n, axis=axis)
+
+
+def einsum(equation, *operands):
+    return jnp.einsum(equation, *operands)
+
+
+def allclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def isclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+isnan = jnp.isnan
+isinf = jnp.isinf
+isfinite = jnp.isfinite
+
+
+# ---------------------------------------------------------------------------
+# logic / compare (ref: python/paddle/tensor/logic.py)
+# ---------------------------------------------------------------------------
+
+equal = jnp.equal
+not_equal = jnp.not_equal
+greater_than = jnp.greater
+greater_equal = jnp.greater_equal
+less_than = jnp.less
+less_equal = jnp.less_equal
+logical_and = jnp.logical_and
+logical_or = jnp.logical_or
+logical_not = jnp.logical_not
+logical_xor = jnp.logical_xor
+bitwise_and = jnp.bitwise_and
+bitwise_or = jnp.bitwise_or
+bitwise_xor = jnp.bitwise_xor
+bitwise_not = jnp.bitwise_not
+
+
+def equal_all(x, y):
+    return jnp.array_equal(x, y)
+
+
+def all(x, axis=None, keepdim=False):
+    return jnp.all(x, axis=axis, keepdims=keepdim)
+
+
+def any(x, axis=None, keepdim=False):
+    return jnp.any(x, axis=axis, keepdims=keepdim)
+
+
+# ---------------------------------------------------------------------------
+# manipulation (ref: python/paddle/tensor/manipulation.py)
+# ---------------------------------------------------------------------------
+
+def cast(x, dtype):
+    return x.astype(dtype_mod.dtype(dtype))
+
+
+def reshape(x, shape):
+    return jnp.reshape(x, shape)
+
+
+def transpose(x, perm):
+    return jnp.transpose(x, perm)
+
+
+def moveaxis(x, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+def swapaxes(x, axis0, axis1):
+    return jnp.swapaxes(x, axis0, axis1)
+
+
+def t(x):
+    return x.T
+
+
+def concat(xs, axis=0):
+    return jnp.concatenate(xs, axis=axis)
+
+
+def stack(xs, axis=0):
+    return jnp.stack(xs, axis=axis)
+
+
+def unstack(x, axis=0):
+    return [jnp.squeeze(s, axis) for s in
+            jnp.split(x, x.shape[axis], axis=axis)]
+
+
+def split(x, num_or_sections, axis=0):
+    if isinstance(num_or_sections, int):
+        return jnp.split(x, num_or_sections, axis=axis)
+    sections = np.cumsum(num_or_sections[:-1]).tolist()
+    return jnp.split(x, sections, axis=axis)
+
+
+def chunk(x, chunks, axis=0):
+    return jnp.array_split(x, chunks, axis=axis)
+
+
+def squeeze(x, axis=None):
+    return jnp.squeeze(x, axis=axis)
+
+
+def unsqueeze(x, axis):
+    return jnp.expand_dims(x, axis)
+
+
+def flatten(x, start_axis=0, stop_axis=-1):
+    start = start_axis % x.ndim
+    stop = stop_axis % x.ndim
+    return x.reshape(x.shape[:start] + (-1,) + x.shape[stop + 1:])
+
+
+def tile(x, repeat_times):
+    return jnp.tile(x, repeat_times)
+
+
+def expand(x, shape):
+    return jnp.broadcast_to(x, shape)
+
+
+def broadcast_to(x, shape):
+    return jnp.broadcast_to(x, shape)
+
+
+def broadcast_tensors(inputs):
+    return jnp.broadcast_arrays(*inputs)
+
+
+def flip(x, axis):
+    return jnp.flip(x, axis=axis)
+
+
+def roll(x, shifts, axis=None):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+def rot90(x, k=1, axes=(0, 1)):
+    return jnp.rot90(x, k, axes)
+
+
+def gather(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+def gather_nd(x, index):
+    return x[tuple(jnp.moveaxis(index, -1, 0))]
+
+
+def take_along_axis(x, indices, axis):
+    return jnp.take_along_axis(x, indices, axis=axis)
+
+
+def put_along_axis(x, indices, values, axis):
+    return jnp.put_along_axis(jnp.asarray(x), indices, values, axis=axis,
+                              inplace=False)
+
+
+def scatter(x, index, updates, overwrite=True):
+    if overwrite:
+        return jnp.asarray(x).at[index].set(updates)
+    return jnp.asarray(x).at[index].add(updates)
+
+
+def scatter_nd_add(x, index, updates):
+    return jnp.asarray(x).at[tuple(jnp.moveaxis(index, -1, 0))].add(updates)
+
+
+def index_select(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+def index_sample(x, index):
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+def masked_select(x, mask):
+    return x[mask]
+
+
+def masked_fill(x, mask, value):
+    return jnp.where(mask, value, x)
+
+
+def where(condition, x=None, y=None):
+    if x is None and y is None:
+        return jnp.nonzero(condition)
+    return jnp.where(condition, x, y)
+
+
+def nonzero(x, as_tuple=False):
+    res = jnp.nonzero(x)
+    if as_tuple:
+        return res
+    return jnp.stack(res, axis=1)
+
+
+def unique(x, return_index=False, return_inverse=False,
+           return_counts=False, axis=None):
+    return jnp.unique(x, return_index=return_index,
+                      return_inverse=return_inverse,
+                      return_counts=return_counts, axis=axis)
+
+
+def unbind(x, axis=0):
+    return unstack(x, axis)
+
+
+def repeat_interleave(x, repeats, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+def as_complex(x):
+    return jax.lax.complex(x[..., 0], x[..., 1])
+
+
+def as_real(x):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+def numel(x):
+    return jnp.asarray(x.size)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    shard_size = (index_num + nshards - 1) // nshards
+    lo, hi = shard_id * shard_size, (shard_id + 1) * shard_size
+    in_shard = (input >= lo) & (input < hi)
+    return jnp.where(in_shard, input - lo, ignore_value)
+
+
+# ---------------------------------------------------------------------------
+# search / sort (ref: python/paddle/tensor/search.py)
+# ---------------------------------------------------------------------------
+
+def argmax(x, axis=None, keepdim=False, dtype="int64"):
+    return jnp.argmax(x, axis=axis, keepdims=keepdim).astype(
+        dtype_mod.dtype(dtype))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64"):
+    return jnp.argmin(x, axis=axis, keepdims=keepdim).astype(
+        dtype_mod.dtype(dtype))
+
+
+def argsort(x, axis=-1, descending=False):
+    idx = jnp.argsort(x, axis=axis)
+    if descending:
+        idx = jnp.flip(idx, axis=axis)
+    return idx
+
+
+def sort(x, axis=-1, descending=False):
+    y = jnp.sort(x, axis=axis)
+    if descending:
+        y = jnp.flip(y, axis=axis)
+    return y
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True):
+    if not largest:
+        vals, idx = jax.lax.top_k(-jnp.moveaxis(x, axis, -1), k)
+        vals = -vals
+    else:
+        vals, idx = jax.lax.top_k(jnp.moveaxis(x, axis, -1), k)
+    return jnp.moveaxis(vals, -1, axis), jnp.moveaxis(idx, -1, axis)
+
+
+def searchsorted(sorted_sequence, values, right=False):
+    return jnp.searchsorted(sorted_sequence, values,
+                            side="right" if right else "left")
+
+
+def bincount(x, weights=None, minlength=0):
+    return jnp.bincount(x, weights=weights, minlength=minlength)
+
+
+def histogram(x, bins=100, min=0, max=0):
+    if min == 0 and max == 0:
+        rng_ = None
+    else:
+        rng_ = (min, max)
+    hist, _ = jnp.histogram(x, bins=bins, range=rng_)
+    return hist
+
+
+# ---------------------------------------------------------------------------
+# linalg (ref: python/paddle/tensor/linalg.py) — partial; more in .linalg
+# ---------------------------------------------------------------------------
+
+def norm(x, p=2, axis=None, keepdim=False):
+    if p == "fro" or (p == 2 and axis is None):
+        return jnp.sqrt(jnp.sum(jnp.square(jnp.abs(x)), axis=axis,
+                                keepdims=keepdim))
+    if p == jnp.inf or p == "inf":
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == -jnp.inf:
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    return jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=keepdim) ** (1. / p)
+
+
+def dist(x, y, p=2):
+    return norm(x - y, p=p)

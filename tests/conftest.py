@@ -1,0 +1,37 @@
+"""Test configuration: force an 8-device virtual CPU mesh so sharding /
+collective tests run without TPU hardware (SURVEY.md §4: the reference's
+analog is gloo-CPU collective tests + fake devices; here
+xla_force_host_platform_device_count gives us N host 'chips').
+
+Note: jax may already be imported by the interpreter (sitecustomize
+registers the TPU plugin), so we must use jax.config.update rather than
+env vars — it takes effect as long as the backend isn't initialized yet.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+assert jax.devices()[0].platform == "cpu", (
+    "tests must run on the virtual CPU mesh; got "
+    f"{jax.devices()}")
+assert jax.device_count() == 8, (
+    f"expected 8 virtual CPU devices, got {jax.device_count()}")
+
+
+@pytest.fixture(autouse=True)
+def _seed_everything():
+    import paddle_tpu
+    paddle_tpu.seed(42)
+    np.random.seed(42)
+    yield

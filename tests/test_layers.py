@@ -1,0 +1,214 @@
+"""Layer-system + functional-op tests (modelled on the reference's OpTest
+NumPy-reference pattern, ref: python/paddle/fluid/tests/unittests/
+op_test.py:309 check_output_with_place)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_linear_matches_numpy():
+    layer = nn.Linear(8, 4)
+    x = np.random.randn(3, 8).astype(np.float32)
+    y = layer(jnp.asarray(x))
+    ref = x @ np.asarray(layer.weight) + np.asarray(layer.bias)
+    np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-5)
+
+
+def test_linear_no_bias():
+    layer = nn.Linear(8, 4, bias_attr=False)
+    assert layer.bias is None
+    assert len(layer.parameters()) == 1
+
+
+def test_conv2d_matches_torch():
+    torch = pytest.importorskip("torch")
+    conv = nn.Conv2D(3, 8, 3, stride=2, padding=1)
+    x = np.random.randn(2, 3, 16, 16).astype(np.float32)
+    y = conv(jnp.asarray(x))
+    ty = torch.nn.functional.conv2d(
+        torch.tensor(x), torch.tensor(np.asarray(conv.weight)),
+        torch.tensor(np.asarray(conv.bias)), stride=2, padding=1)
+    np.testing.assert_allclose(np.asarray(y), ty.numpy(), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_conv2d_transpose_shape():
+    deconv = nn.Conv2DTranspose(4, 6, 3, stride=2, padding=1,
+                                output_padding=1)
+    x = jnp.ones((2, 4, 8, 8))
+    y = deconv(x)
+    assert y.shape == (2, 6, 16, 16)
+
+
+def test_pooling():
+    x = np.random.randn(2, 3, 8, 8).astype(np.float32)
+    y = F.max_pool2d(jnp.asarray(x), 2)
+    assert y.shape == (2, 3, 4, 4)
+    np.testing.assert_allclose(
+        np.asarray(y)[0, 0, 0, 0], x[0, 0, :2, :2].max(), rtol=1e-6)
+    ya = F.avg_pool2d(jnp.asarray(x), 2)
+    np.testing.assert_allclose(
+        np.asarray(ya)[0, 0, 0, 0], x[0, 0, :2, :2].mean(), rtol=1e-5)
+
+
+def test_adaptive_avg_pool():
+    x = jnp.ones((2, 3, 7, 7))
+    y = F.adaptive_avg_pool2d(x, 1)
+    assert y.shape == (2, 3, 1, 1)
+    np.testing.assert_allclose(np.asarray(y), 1.0, rtol=1e-6)
+
+
+def test_batchnorm_train_and_eval():
+    bn = nn.BatchNorm2D(4)
+    x = np.random.randn(8, 4, 5, 5).astype(np.float32) * 3 + 1
+    y = bn(jnp.asarray(x))
+    # normalized output: per-channel mean ~0, var ~1
+    m = np.asarray(y).mean(axis=(0, 2, 3))
+    v = np.asarray(y).var(axis=(0, 2, 3))
+    np.testing.assert_allclose(m, 0.0, atol=1e-4)
+    np.testing.assert_allclose(v, 1.0, atol=1e-2)
+    # running stats moved toward batch stats
+    assert not np.allclose(np.asarray(bn._mean), 0.0)
+    bn.eval()
+    y2 = bn(jnp.asarray(x))
+    assert y2.shape == x.shape
+
+
+def test_layernorm():
+    ln = nn.LayerNorm(16)
+    x = np.random.randn(4, 10, 16).astype(np.float32)
+    y = np.asarray(ln(jnp.asarray(x)))
+    np.testing.assert_allclose(y.mean(-1), 0.0, atol=1e-5)
+    np.testing.assert_allclose(y.std(-1), 1.0, atol=1e-2)
+
+
+def test_dropout_modes():
+    x = jnp.ones((1000,))
+    d = nn.Dropout(0.5)
+    y = d(x)
+    # upscale_in_train: surviving elements are 2.0
+    vals = np.unique(np.asarray(y))
+    assert set(np.round(vals, 5)).issubset({0.0, 2.0})
+    d.eval()
+    np.testing.assert_array_equal(np.asarray(d(x)), np.asarray(x))
+
+
+def test_cross_entropy_matches_numpy():
+    logits = np.random.randn(6, 10).astype(np.float32)
+    labels = np.random.randint(0, 10, (6,))
+    got = float(F.cross_entropy(jnp.asarray(logits), jnp.asarray(labels)))
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    ref = -np.log(p[np.arange(6), labels]).mean()
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+def test_cross_entropy_ignore_index():
+    logits = np.random.randn(4, 5).astype(np.float32)
+    labels = np.array([0, -100, 2, -100])
+    got = float(F.cross_entropy(jnp.asarray(logits), jnp.asarray(labels),
+                                ignore_index=-100))
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    ref = -np.log(p[[0, 2], [0, 2]]).mean()
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+def test_state_dict_roundtrip():
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    sd = net.state_dict()
+    assert set(sd) == {"0.weight", "0.bias", "2.weight", "2.bias"}
+    net2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    net2.set_state_dict(sd)
+    x = jnp.ones((1, 4))
+    np.testing.assert_allclose(np.asarray(net(x)), np.asarray(net2(x)),
+                               rtol=1e-6)
+
+
+def test_functional_call_pure():
+    bn = nn.BatchNorm1D(3)
+    params, buffers = nn.split_state(bn)
+    x = jnp.asarray(np.random.randn(10, 3).astype(np.float32))
+    out, new_buffers = nn.functional_call(bn, params, buffers, x,
+                                          training=True)
+    # original layer state untouched
+    np.testing.assert_allclose(np.asarray(bn._mean), 0.0)
+    # returned buffers updated
+    assert not np.allclose(np.asarray(new_buffers["_mean"]), 0.0)
+
+
+def test_functional_call_under_jit_and_grad():
+    net = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))
+    params, buffers = nn.split_state(net)
+    x = jnp.ones((2, 4))
+
+    @jax.jit
+    def loss_fn(p):
+        out, _ = nn.functional_call(net, p, buffers, x)
+        return jnp.sum(out ** 2)
+
+    g = jax.grad(loss_fn)(dict(params))
+    assert set(g) == set(params)
+    assert all(np.isfinite(np.asarray(v)).all() for v in g.values())
+
+
+def test_hooks():
+    layer = nn.Linear(2, 2)
+    calls = []
+    h = layer.register_forward_post_hook(
+        lambda l, inp, out: calls.append(out.shape))
+    layer(jnp.ones((1, 2)))
+    assert calls == [(1, 2)]
+    h.remove()
+    layer(jnp.ones((1, 2)))
+    assert len(calls) == 1
+
+
+def test_transformer_encoder_forward():
+    enc = nn.TransformerEncoderLayer(d_model=32, nhead=4,
+                                     dim_feedforward=64)
+    x = jnp.asarray(np.random.randn(2, 10, 32).astype(np.float32))
+    y = enc(x)
+    assert y.shape == (2, 10, 32)
+
+
+def test_multihead_attention_causal():
+    mha = nn.MultiHeadAttention(16, 2)
+    mha.eval()
+    x = jnp.asarray(np.random.randn(1, 6, 16).astype(np.float32))
+    y = mha(x, is_causal=True)
+    assert y.shape == (1, 6, 16)
+    # causality: output at position 0 must not depend on later tokens
+    x2 = x.at[:, 3:].set(0.0)
+    y2 = mha(x2, is_causal=True)
+    np.testing.assert_allclose(np.asarray(y[:, :3]), np.asarray(y2[:, :3]),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_embedding_padding_idx():
+    emb = nn.Embedding(10, 4, padding_idx=0)
+    ids = jnp.asarray([[0, 1, 2]])
+    out = emb(ids)
+    np.testing.assert_allclose(np.asarray(out[0, 0]), 0.0)
+
+
+def test_seed_reproducible():
+    pt.seed(7)
+    a = nn.Linear(4, 4).weight
+    pt.seed(7)
+    b = nn.Linear(4, 4).weight
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_clip_global_norm():
+    from paddle_tpu.nn.clip import ClipGradByGlobalNorm, global_norm
+    grads = {"a": jnp.ones((10,)) * 3, "b": jnp.ones((5,)) * 4}
+    clip = ClipGradByGlobalNorm(1.0)
+    clipped = clip(grads)
+    np.testing.assert_allclose(float(global_norm(clipped)), 1.0, rtol=1e-5)
