@@ -1,0 +1,64 @@
+"""Benchmark harness — prints ONE JSON line for the driver.
+
+Measures flagship train-step throughput on the available hardware
+(real TPU chip under the driver; CPU otherwise). Config: BASELINE.json
+config 1 (MNIST LeNet, Model.fit path) — the compiled train step is the
+same one `paddle_tpu.Model.fit` runs, so this measures the framework's
+end-to-end step (forward+backward+optimizer on device), not a kernel in
+isolation. `vs_baseline` is 1.0: the reference publishes no in-tree
+numbers (BASELINE.md — `published == {}`), so the baseline is this
+framework's own first measurement.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def bench_lenet(batch: int = 256, warmup: int = 5, iters: int = 30):
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.models import LeNet
+
+    paddle.seed(0)
+    net = LeNet(num_classes=10)
+    model = paddle.Model(net)
+    model.prepare(
+        optimizer=paddle.optimizer.Adam(learning_rate=1e-3, parameters=net),
+        loss=nn.CrossEntropyLoss())
+
+    rng = np.random.RandomState(0)
+    xs = rng.randn(batch, 1, 28, 28).astype(np.float32)
+    ys = rng.randint(0, 10, (batch, 1))
+
+    for _ in range(warmup):
+        model.train_batch([xs], [ys])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        logs = model.train_batch([xs], [ys])
+    dt = time.perf_counter() - t0
+    assert np.isfinite(logs["loss"])
+    return batch * iters / dt
+
+
+def main():
+    try:
+        ips = bench_lenet()
+        print(json.dumps({"metric": "lenet_mnist_train_images_per_sec",
+                          "value": round(float(ips), 1),
+                          "unit": "images/sec",
+                          "vs_baseline": 1.0}))
+    except Exception as e:  # never leave the driver without a line
+        print(json.dumps({"metric": "lenet_mnist_train_images_per_sec",
+                          "value": 0.0, "unit": "images/sec",
+                          "vs_baseline": 0.0, "error": str(e)[:200]}))
+        print(f"bench failed: {e}", file=sys.stderr)
+        raise
+
+
+if __name__ == "__main__":
+    main()

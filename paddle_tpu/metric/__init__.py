@@ -45,7 +45,12 @@ class Accuracy(Metric):
         k = max(self.topk)
         idx = jnp.argsort(-pred, axis=-1)[..., :k]
         if label.ndim == pred.ndim:
-            label = jnp.argmax(label, axis=-1)
+            # [N, C] one-hot vs [N, 1] index column (the reference accepts
+            # both, metrics.py:246): only argmax a genuine one-hot.
+            if label.shape[-1] == pred.shape[-1] and pred.shape[-1] > 1:
+                label = jnp.argmax(label, axis=-1)
+            else:
+                label = label[..., 0]
         correct = (idx == label[..., None])
         return correct
 

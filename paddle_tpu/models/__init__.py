@@ -1,2 +1,4 @@
 """Model zoo (ref: python/paddle/vision/models, ERNIE/GPT from the
 reference's fleet examples). Populated incrementally."""
+
+from .lenet import LeNet  # noqa

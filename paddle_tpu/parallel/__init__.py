@@ -1,2 +1,21 @@
-"""Parallelism core (SURVEY.md §2.3): mesh topology, sharding rules,
-distributed layers. Populated incrementally; see mesh.py / api.py."""
+"""paddle_tpu.parallel — distributed training on a named device mesh.
+
+TPU-native rebuild of the reference's distributed stack (SURVEY.md §2.3,
+§2.4): `python/paddle/distributed/` fleet + collective + auto_parallel,
+the C++ ProcessGroup/Reducer runtime, and the NCCL comm bootstrap all
+collapse into: a :class:`DeviceMesh` with named axes, logical-axis
+sharding rules, and XLA collectives.
+"""
+
+from .mesh import (AXIS_ORDER, DeviceMesh, get_mesh, init_mesh,  # noqa
+                   set_mesh)
+from .sharding import (DEFAULT_RULES, LogicalRules, named_sharding,  # noqa
+                       replicate, shard_batch, shard_params,
+                       with_logical_constraint)
+from .strategy import (AMPConfig, DistributedStrategy,  # noqa
+                       GradientMergeConfig, HybridConfig, MoEConfig,
+                       PipelineConfig, RecomputeConfig, ShardingConfig)
+from .api import (DataParallel, all_gather, all_reduce, barrier,  # noqa
+                  broadcast, distributed_model, get_rank, get_world_size,
+                  init_parallel_env)
+from . import collective  # noqa

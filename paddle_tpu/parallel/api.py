@@ -1,0 +1,155 @@
+"""paddle_tpu.parallel user API — fleet/init_parallel_env analog.
+
+Replaces the reference's distributed bring-up chain
+(reference: python/paddle/distributed/parallel.py:94 ``init_parallel_env``
+→ TCPStore rendezvous distributed/store/tcp_store.h → ProcessGroupNCCL
+ProcessGroup.h:53; fleet facade fleet/base/fleet_base.py:211 ``init`` /
+:947 ``distributed_model``). On TPU, rendezvous is the JAX coordination
+service (``jax.distributed.initialize``), process groups are mesh axes,
+and wrapping a model for DP/TP/FSDP means attaching shardings — the
+backward all-reduce the reference's EagerReducer performs
+(distributed/collective/reducer.h:88, bucketed fused allreduce) is
+inserted by XLA at the sharded/replicated boundary of the compiled step.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.layer import Layer, split_state
+from .mesh import DeviceMesh, get_mesh, init_mesh, set_mesh
+from .sharding import (LogicalRules, named_sharding, replicate,
+                       shard_batch, shard_params, with_logical_constraint)
+from .strategy import DistributedStrategy
+
+_initialized = False
+
+
+def init_parallel_env(coordinator_address: Optional[str] = None,
+                      num_processes: Optional[int] = None,
+                      process_id: Optional[int] = None) -> None:
+    """Multi-host bring-up (ref: distributed/parallel.py:94).
+
+    Single-host (or driver-managed TPU pods, where PJRT discovers the
+    topology) needs no rendezvous; explicit args or PADDLE_* env vars
+    trigger ``jax.distributed.initialize`` — the TCPStore replacement
+    (ref: distributed/parallel.py:240 creating core.TCPStore from
+    PADDLE_MASTER / PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM).
+    """
+    global _initialized
+    if _initialized:
+        return
+    addr = coordinator_address or os.environ.get("PADDLE_MASTER") \
+        or os.environ.get("MASTER_ADDR")
+    nproc = num_processes or int(os.environ.get("PADDLE_TRAINERS_NUM", 0))
+    pid = process_id if process_id is not None else \
+        int(os.environ.get("PADDLE_TRAINER_ID", 0))
+    if addr and nproc > 1:
+        jax.distributed.initialize(coordinator_address=addr,
+                                   num_processes=nproc, process_id=pid)
+    _initialized = True
+
+
+def get_world_size() -> int:
+    return jax.device_count()
+
+
+def get_rank() -> int:
+    return jax.process_index()
+
+
+def barrier() -> None:
+    """Host-level barrier (ref: operators/collective/barrier_op.cc): a
+    tiny all-reduce over all devices forces every process to sync."""
+    x = jnp.ones((jax.local_device_count(),))
+    jax.pmap(lambda v: jax.lax.psum(v, "i"), axis_name="i")(x).block_until_ready()
+
+
+# ---------------------------------------------------------------------------
+# eager host-level collectives (ref: python/paddle/distributed/collective.py
+# all_reduce/all_gather/broadcast). In compiled SPMD steps collectives are
+# implicit; these eager forms serve host-side coordination (metric
+# aggregation). A "per-rank tensor" is a stacked [group, ...] array.
+# ---------------------------------------------------------------------------
+
+def all_reduce(stacked, op: str = "sum"):
+    from . import collective
+    return collective.host_all_reduce(stacked, op)
+
+
+def all_gather(x, mesh: Optional[DeviceMesh] = None):
+    """Gather a sharded array to a fully-replicated one."""
+    mesh = mesh or get_mesh()
+    return jax.device_put(jnp.asarray(x),
+                          named_sharding(None, x.shape, mesh))
+
+
+def broadcast(stacked, src: int = 0, mesh: Optional[DeviceMesh] = None):
+    """ref: c_broadcast — on a stacked [group, ...] array, every slice
+    takes src's value. (For already-global arrays there is nothing to
+    broadcast in the single-controller model — use ``replicate``.)"""
+    x = jnp.asarray(stacked)
+    return jnp.broadcast_to(x[src], x.shape)
+
+
+# ---------------------------------------------------------------------------
+# model wrapping
+# ---------------------------------------------------------------------------
+
+class DataParallel(Layer):
+    """Eager DP wrapper (ref: paddle.DataParallel
+    fluid/dygraph/parallel.py:419). Forward shards the batch over the data
+    axes and replicates params; when used inside Model/jit the gradient
+    all-reduce is compiled in, replacing the Reducer's bucketed NCCL
+    all-reduce (imperative/reducer.h:129)."""
+
+    def __init__(self, layers: Layer, mesh: Optional[DeviceMesh] = None):
+        super().__init__()
+        self._layers = layers
+        self._mesh = mesh or get_mesh()
+        # replicate params onto the mesh once at wrap time
+        params, buffers = split_state(layers)
+        for name, v in {**params, **buffers}.items():
+            layers._assign_by_path(name, jax.device_put(
+                v, named_sharding(None, v.shape, self._mesh)))
+
+    def forward(self, *args, **kwargs):
+        args = tuple(shard_batch(a, self._mesh) for a in args)
+        return self._layers(*args, **kwargs)
+
+    def state_dict(self, *a, **kw):
+        return self._layers.state_dict(*a, **kw)
+
+    def set_state_dict(self, *a, **kw):
+        return self._layers.set_state_dict(*a, **kw)
+
+
+def distributed_model(model, strategy: Optional[DistributedStrategy] = None,
+                      mesh: Optional[DeviceMesh] = None,
+                      rules: Optional[LogicalRules] = None):
+    """Attach sharding to a hapi ``Model`` (ref: fleet_base.py:947
+    ``distributed_model`` wrapping TP→PP→Sharding→DP; here one call
+    installs param/batch placement hooks and the compiled step becomes the
+    full hybrid-parallel program)."""
+    if mesh is None:
+        mesh = get_mesh(required=False)
+        if mesh is None:
+            axes = strategy.mesh_axes() if strategy else {"dp": -1}
+            mesh = init_mesh(**(axes or {"dp": -1}))
+    rules = rules or LogicalRules()
+    meta = model.network.param_meta()
+
+    def _shard_params(tree):
+        return shard_params(tree, meta, mesh, rules)
+
+    def _shard_batch(tree):
+        return shard_batch(tree, mesh)
+
+    model._shard_params = _shard_params
+    model._shard_batch = _shard_batch
+    model._mesh = mesh
+    return model

@@ -1,0 +1,160 @@
+"""Device mesh + hybrid topology.
+
+TPU-native replacement for the reference's communicator topology stack
+(reference: python/paddle/distributed/fleet/base/topology.py:52
+``CommunicateTopology`` / :134 ``HybridCommunicateGroup`` — orthogonal
+dp×mp×pp×sharding process groups built from rank arithmetic) and the
+per-backend comm contexts (paddle/fluid/platform/collective_helper.h:71
+``NCCLCommContext``). On TPU there is no comm-id bootstrap and no ring
+management: a :class:`jax.sharding.Mesh` over the PJRT device topology IS
+the communicator; XLA lowers collectives onto ICI/DCN from sharding
+annotations. What remains of "topology" is naming the axes and answering
+rank/group queries, which this module provides.
+
+Canonical axis names (SURVEY.md §7 step 4): ``dp`` (data), ``fsdp``
+(sharded-data / ZeRO), ``tp`` (tensor), ``pp`` (pipeline), ``sp``
+(sequence/context), ``ep`` (expert).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_ORDER = ("pp", "dp", "fsdp", "ep", "sp", "tp")
+
+_current_mesh: Optional["DeviceMesh"] = None
+
+
+class DeviceMesh:
+    """A named device mesh (the HybridCommunicateGroup analog).
+
+    ``DeviceMesh(dp=2, tp=4)`` lays 8 devices out as a 2×4 grid. Axis
+    order follows :data:`AXIS_ORDER`: ``tp`` innermost so tensor-parallel
+    collectives ride the fastest ICI links, ``pp`` outermost so pipeline
+    p2p tolerates the slowest (DCN) links — mirroring the reference's
+    fleet order mp-innermost (fleet/base/topology.py:160).
+
+    An axis size of ``-1`` absorbs the remaining devices (like a reshape
+    wildcard).
+    """
+
+    def __init__(self, devices: Optional[Sequence] = None,
+                 **axis_sizes: int):
+        if devices is None:
+            devices = jax.devices()
+        devices = list(devices)
+        n = len(devices)
+        sizes: Dict[str, int] = {}
+        wildcard = None
+        for name in AXIS_ORDER:
+            s = int(axis_sizes.pop(name, 1))
+            if s == -1:
+                if wildcard is not None:
+                    raise ValueError("only one axis may be -1")
+                wildcard = name
+                s = 1
+            sizes[name] = s
+        if axis_sizes:
+            raise ValueError(
+                f"unknown mesh axes {sorted(axis_sizes)}; "
+                f"valid: {AXIS_ORDER}")
+        fixed = math.prod(sizes.values())
+        if wildcard is not None:
+            if n % fixed:
+                raise ValueError(
+                    f"{n} devices not divisible by {fixed}")
+            sizes[wildcard] = n // fixed
+            fixed = n
+        if fixed != n:
+            raise ValueError(
+                f"mesh {sizes} needs {fixed} devices, have {n}")
+        # Drop degenerate (size-1) axes from the physical mesh but remember
+        # them so sharding specs referring to them resolve to replication.
+        self.axis_sizes: Dict[str, int] = dict(sizes)
+        live = [a for a in AXIS_ORDER if sizes[a] > 1]
+        if not live:  # single device: keep a 1-wide dp axis for uniformity
+            live = ["dp"]
+        shape = tuple(sizes[a] for a in live)
+        arr = np.asarray(devices).reshape(shape)
+        self.mesh = Mesh(arr, axis_names=tuple(live))
+        self.axis_names: Tuple[str, ...] = tuple(live)
+
+    # -- queries (HybridCommunicateGroup parity) ---------------------------
+    @property
+    def size(self) -> int:
+        return self.mesh.size
+
+    def axis_size(self, name: str) -> int:
+        return self.axis_sizes.get(name, 1)
+
+    def has_axis(self, name: str) -> bool:
+        return self.axis_sizes.get(name, 1) > 1
+
+    @property
+    def data_axes(self) -> Tuple[str, ...]:
+        """Axes the global batch is split over (dp + fsdp)."""
+        return tuple(a for a in ("dp", "fsdp") if self.has_axis(a))
+
+    def batch_spec(self, extra: Tuple[str, ...] = ()):
+        from jax.sharding import PartitionSpec as P
+        axes = self.data_axes
+        lead = axes[0] if len(axes) == 1 else axes if axes else None
+        return P(lead, *extra)
+
+    def local_rank(self, axis: str) -> int:
+        """Rank of this process's first device along ``axis`` (host view;
+        analog of topology.py get_rank_from_stage)."""
+        dev = jax.local_devices()[0]
+        idx = np.argwhere(self.mesh.devices == dev)
+        if idx.size == 0:
+            return 0
+        pos = dict(zip(self.mesh.axis_names, idx[0]))
+        return int(pos.get(axis, 0))
+
+    def __enter__(self):
+        self._ctx = self.mesh.__enter__()
+        self._prev_mesh = _current_mesh
+        _set_current(self)
+        return self
+
+    def __exit__(self, *exc):
+        _set_current(self._prev_mesh)
+        return self.mesh.__exit__(*exc)
+
+    def __repr__(self):
+        live = {a: s for a, s in self.axis_sizes.items() if s > 1}
+        return f"DeviceMesh({live or {'dp': 1}}, {self.size} devices)"
+
+
+def _set_current(m: Optional[DeviceMesh]) -> None:
+    global _current_mesh
+    _current_mesh = m
+
+
+def init_mesh(**axis_sizes: int) -> DeviceMesh:
+    """Create and install the global mesh (fleet.init analog — ref:
+    python/paddle/distributed/fleet/base/fleet_base.py:211; the
+    degree knobs mirror DistributedStrategy's
+    {sharding,mp,pp,dp}_degree, fleet/meta_optimizers/
+    sharding_optimizer.py:123-135)."""
+    global _current_mesh
+    m = DeviceMesh(**axis_sizes)
+    _current_mesh = m
+    return m
+
+
+def get_mesh(required: bool = True) -> Optional[DeviceMesh]:
+    if _current_mesh is None and required:
+        raise RuntimeError(
+            "no DeviceMesh installed; call parallel.init_mesh(...) first")
+    return _current_mesh
+
+
+def set_mesh(m: Optional[DeviceMesh]) -> None:
+    global _current_mesh
+    _current_mesh = m

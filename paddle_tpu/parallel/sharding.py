@@ -1,0 +1,142 @@
+"""Logical-axis sharding rules → NamedShardings.
+
+This is the GSPMD-shaped replacement for the reference's entire
+dist-attr machinery (reference: python/paddle/distributed/auto_parallel/
+— ``ProcessMesh`` process_mesh.py:39, ``shard_tensor`` interface.py:34,
+``Completer`` dist-attr propagation completion.py:140, ``Partitioner``
+partitioner.py:37, ``Resharder`` reshard.py:600). On TPU the compiler does
+completion/partition/reshard; the framework's job reduces to mapping each
+parameter's *logical* axes (declared once at layer definition, e.g.
+``("embed", "mlp")``) onto *mesh* axes through a rule table — the
+Flax/T5X "logical axis rules" idiom.
+
+Default rules implement the reference's strategies in one table:
+ - Megatron TP (mp_layers.py:30/95/171): ``mlp``/``heads``/``vocab`` → tp
+ - ZeRO param sharding (group_sharded_stage3.py:60): ``embed`` → fsdp
+ - expert parallel (moe_layer.py:244): ``expert`` → ep
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from .mesh import DeviceMesh, get_mesh
+
+# (logical axis, mesh axis) — first matching rule whose mesh axis is live
+# and evenly divides the dimension wins.
+DEFAULT_RULES: Tuple[Tuple[str, str], ...] = (
+    ("batch", "dp"),
+    ("batch", "fsdp"),
+    ("expert", "ep"),
+    ("vocab", "tp"),
+    ("mlp", "tp"),
+    ("heads", "tp"),
+    ("kv", "tp"),
+    ("embed", "fsdp"),
+    ("seq", "sp"),
+)
+
+
+class LogicalRules:
+    def __init__(self, rules: Sequence[Tuple[str, str]] = DEFAULT_RULES):
+        self.rules = tuple(rules)
+
+    def mesh_axes(self, logical: Optional[Tuple[Optional[str], ...]],
+                  shape: Tuple[int, ...], mesh: DeviceMesh) -> P:
+        """Resolve logical dim names to a PartitionSpec, skipping mesh axes
+        already taken by another dim (a mesh axis may shard only one dim)."""
+        if logical is None:
+            return P()
+        used = set()
+        out = []
+        for dim, name in enumerate(logical):
+            pick = None
+            if name is not None:
+                for lname, maxis in self.rules:
+                    if (lname == name and maxis not in used
+                            and mesh.has_axis(maxis)
+                            and dim < len(shape)
+                            and shape[dim] % mesh.axis_size(maxis) == 0):
+                        pick = maxis
+                        used.add(maxis)
+                        break
+            out.append(pick)
+        while out and out[-1] is None:
+            out.pop()
+        return P(*out)
+
+
+def named_sharding(axes, shape, mesh: Optional[DeviceMesh] = None,
+                   rules: Optional[LogicalRules] = None) -> NamedSharding:
+    mesh = mesh or get_mesh()
+    rules = rules or LogicalRules()
+    return NamedSharding(mesh.mesh, rules.mesh_axes(axes, tuple(shape), mesh))
+
+
+def shard_params(params: Dict[str, jax.Array],
+                 meta: Dict[str, Any],
+                 mesh: Optional[DeviceMesh] = None,
+                 rules: Optional[LogicalRules] = None
+                 ) -> Dict[str, jax.Array]:
+    """Place each param with the sharding derived from its logical axes.
+    Params with no annotation are replicated (the reference's default for
+    non-distributed attrs, completion.py fallback)."""
+    mesh = mesh or get_mesh()
+    rules = rules or LogicalRules()
+    out = {}
+    for name, v in params.items():
+        axes = getattr(meta.get(name), "axes", None) if meta else None
+        s = NamedSharding(mesh.mesh,
+                          rules.mesh_axes(axes, tuple(v.shape), mesh))
+        out[name] = jax.device_put(v, s)
+    return out
+
+
+def shard_batch(batch, mesh: Optional[DeviceMesh] = None):
+    """Split the leading (batch) dim over the data axes — the DP half of the
+    reference's ``DataParallel`` (fluid/dygraph/parallel.py:419): instead
+    of replicating the model and all-reducing grads, the batch axis is
+    sharded and XLA inserts the gradient all-reduce where the sharded and
+    replicated program parts meet."""
+    mesh = mesh or get_mesh()
+    spec = mesh.batch_spec()
+    ndata = 1
+    for a in mesh.data_axes:
+        ndata *= mesh.axis_size(a)
+
+    def put(x):
+        x = jax.numpy.asarray(x) if not hasattr(x, "shape") else x
+        if getattr(x, "ndim", 0) == 0 or (
+                ndata and x.shape[0] % ndata):
+            # scalar, or a final partial batch (DataLoader drop_last=False)
+            # whose leading dim doesn't divide the data axes: replicate —
+            # correct, just unsharded for that one step.
+            return jax.device_put(x, NamedSharding(mesh.mesh, P()))
+        return jax.device_put(x, NamedSharding(mesh.mesh, spec))
+
+    return jax.tree_util.tree_map(put, batch)
+
+
+def replicate(tree, mesh: Optional[DeviceMesh] = None):
+    mesh = mesh or get_mesh()
+    s = NamedSharding(mesh.mesh, P())
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, s), tree)
+
+
+def with_logical_constraint(x, logical: Tuple[Optional[str], ...],
+                            mesh: Optional[DeviceMesh] = None,
+                            rules: Optional[LogicalRules] = None):
+    """In-graph activation sharding hint (the ``shard_op``/
+    ``shard_tensor`` analog, auto_parallel/interface.py:34/73). Safe to
+    call outside jit (no-op placement) and on unknown axes (replicates)."""
+    mesh = mesh or get_mesh(required=False)
+    if mesh is None:
+        return x
+    rules = rules or LogicalRules()
+    spec = rules.mesh_axes(logical, tuple(x.shape), mesh)
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh.mesh, spec))

@@ -1,0 +1,196 @@
+"""Parallel core tests on the 8-device virtual CPU mesh (SURVEY.md §4:
+the reference tests collectives with 2-rank gloo-CPU runs,
+test_collective_api_base.py; here the fake mesh plays that role)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, parallel
+from paddle_tpu.parallel import collective
+
+
+@pytest.fixture(autouse=True)
+def _clean_mesh():
+    yield
+    parallel.set_mesh(None)
+
+
+def test_mesh_construction():
+    m = parallel.init_mesh(dp=2, tp=4)
+    assert m.size == 8
+    assert m.axis_size("dp") == 2 and m.axis_size("tp") == 4
+    assert m.axis_size("pp") == 1
+    assert m.axis_names == ("dp", "tp")
+    assert m.data_axes == ("dp",)
+
+
+def test_mesh_wildcard():
+    m = parallel.init_mesh(dp=-1, tp=2)
+    assert m.axis_size("dp") == 4 and m.size == 8
+
+
+def test_mesh_errors():
+    with pytest.raises(ValueError):
+        parallel.DeviceMesh(dp=3, tp=3)
+    with pytest.raises(ValueError):
+        parallel.DeviceMesh(bogus=2)
+
+
+def test_sharding_rules_tp_fsdp():
+    m = parallel.init_mesh(fsdp=2, tp=4)
+    rules = parallel.LogicalRules()
+    # column-parallel weight [embed, mlp]: embed→fsdp, mlp→tp
+    spec = rules.mesh_axes(("embed", "mlp"), (256, 1024), m)
+    assert spec == P("fsdp", "tp")
+    # head dim not divisible by tp → left unsharded
+    spec = rules.mesh_axes(("embed", "heads"), (256, 6), m)
+    assert spec == P("fsdp")
+    # one mesh axis may shard only one dim
+    spec = rules.mesh_axes(("mlp", "heads"), (512, 512), m)
+    assert spec == P("tp")
+
+
+def test_shard_params_and_batch():
+    m = parallel.init_mesh(dp=2, tp=4)
+    lin = nn.Linear(16, 32, axes=("embed", "mlp"))
+    params, _ = nn.layer.split_state(lin)
+    meta = lin.param_meta()
+    sharded = parallel.shard_params(params, meta, m)
+    w = sharded["weight"]
+    assert w.sharding.spec == P(None, "tp")
+    batch = parallel.shard_batch(jnp.ones((8, 16)), m)
+    assert batch.sharding.spec == P("dp")
+
+
+def test_collective_psum_allgather_shift():
+    m = parallel.init_mesh(dp=8)
+
+    @jax.jit
+    def f(x):
+        def body(xs):
+            s = collective.psum(xs, "dp")
+            g = collective.all_gather(xs, "dp")
+            sh = collective.shift(xs, "dp", 1)
+            return s, g, sh
+        return shard_map(body, mesh=m.mesh,
+                         in_specs=P("dp"),
+                         out_specs=(P("dp"), P("dp"), P("dp")))(x)
+
+    x = jnp.arange(8.0)
+    s, g, sh = f(x)
+    np.testing.assert_allclose(np.asarray(s), np.full((8,), 28.0))
+    np.testing.assert_allclose(np.asarray(g).reshape(8, 8)[0], np.arange(8.0))
+    # ring shift by 1: rank i's value lands on rank i+1
+    np.testing.assert_allclose(np.asarray(sh), np.roll(np.arange(8.0), 1))
+
+
+def test_host_all_reduce():
+    stacked = jnp.arange(12.0).reshape(4, 3)
+    out = parallel.all_reduce(stacked, "sum")
+    np.testing.assert_allclose(np.asarray(out),
+                               np.arange(12.0).reshape(4, 3).sum(0))
+    with pytest.raises(ValueError):
+        parallel.all_reduce(stacked, "xor")
+
+
+def test_strategy_roundtrip():
+    s = parallel.DistributedStrategy()
+    s.hybrid_configs.mp_degree = 4
+    s.sharding.enable = True
+    s.sharding.degree = 2
+    axes = s.mesh_axes()
+    assert axes == {"dp": -1, "tp": 4, "fsdp": 2}
+    s2 = parallel.DistributedStrategy.from_dict(s.to_dict())
+    assert s2.hybrid_configs.mp_degree == 4
+    assert s2.sharding.degree == 2
+
+
+def test_data_parallel_training_matches_single_device():
+    """DP-sharded Model.fit reaches the same loss as unsharded (the
+    reference's TestDistBase methodology, test_dist_base.py:786 —
+    compare distributed vs single-process losses)."""
+    def build():
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        model = paddle.Model(net)
+        model.prepare(optimizer=paddle.optimizer.SGD(
+            learning_rate=0.1, parameters=net),
+            loss=nn.CrossEntropyLoss())
+        return model
+
+    rng = np.random.RandomState(0)
+    xs = rng.randn(32, 8).astype(np.float32)
+    ys = rng.randint(0, 4, (32, 1))
+
+    losses = {}
+    for mode in ("single", "dp"):
+        model = build()
+        if mode == "dp":
+            parallel.init_mesh(dp=8)
+            parallel.distributed_model(
+                model, parallel.DistributedStrategy())
+        out = [model.train_batch([xs], [ys])["loss"] for _ in range(5)]
+        losses[mode] = out
+        parallel.set_mesh(None)
+    np.testing.assert_allclose(losses["single"], losses["dp"],
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_tp_sharded_model_runs():
+    m = parallel.init_mesh(dp=2, tp=4)
+    net = nn.Sequential(nn.Linear(8, 32, axes=("embed", "mlp")),
+                        nn.ReLU(),
+                        nn.Linear(32, 4, axes=("mlp", "embed")))
+    model = paddle.Model(net)
+    model.prepare(optimizer=paddle.optimizer.Adam(
+        learning_rate=1e-2, parameters=net), loss=nn.CrossEntropyLoss())
+    parallel.distributed_model(model, mesh=m)
+    xs = np.random.randn(16, 8).astype(np.float32)
+    ys = np.random.randint(0, 4, (16, 1))
+    l0 = model.train_batch([xs], [ys])["loss"]
+    l1 = model.train_batch([xs], [ys])["loss"]
+    assert np.isfinite(l0) and l1 < l0
+    # params actually sharded on the tp axis
+    w = model._params["0.weight"]
+    assert w.sharding.spec == P(None, "tp")
+
+
+def test_collective_broadcast_in_spmd():
+    m = parallel.init_mesh(dp=8)
+
+    @jax.jit
+    def f(x):
+        return shard_map(lambda xs: collective.broadcast(xs, "dp", src=3),
+                         mesh=m.mesh, in_specs=P("dp"),
+                         out_specs=P("dp"))(x)
+
+    out = f(jnp.arange(8.0))
+    np.testing.assert_allclose(np.asarray(out), np.full((8,), 3.0))
+
+
+def test_shard_batch_partial_batch_replicates():
+    m = parallel.init_mesh(dp=8)
+    out = parallel.shard_batch(jnp.ones((5, 4)), m)  # 5 % 8 != 0
+    assert out.sharding.spec == P()
+    out = parallel.shard_batch(jnp.ones((16, 4)), m)
+    assert out.sharding.spec == P("dp")
+
+
+def test_mesh_context_restores_global():
+    m = parallel.init_mesh(dp=8)
+    with parallel.DeviceMesh(dp=2, tp=4):
+        assert parallel.get_mesh().axis_size("tp") == 4
+    assert parallel.get_mesh() is m
+
+
+def test_host_broadcast_stacked():
+    stacked = jnp.arange(6.0).reshape(3, 2)
+    out = parallel.broadcast(stacked, src=1)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.tile([2.0, 3.0], (3, 1)))
