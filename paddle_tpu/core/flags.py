@@ -131,6 +131,10 @@ define_flag("deterministic", False,
             "Prefer deterministic XLA lowerings "
             "(ref: FLAGS_cudnn_deterministic, platform/flags.cc:190).")
 define_flag("log_compiles", False, "Log XLA compilations of train steps.")
+define_flag("flash_attention", True,
+            "Dispatch scaled_dot_product_attention to the Pallas flash "
+            "kernel when the configuration supports it (analog of the "
+            "reference's fused_attention CUDA path).")
 define_flag("donate_buffers", True,
             "Donate param/opt-state buffers in jitted train steps to halve "
             "peak HBM (TPU analog of inplace op + GC in the reference "

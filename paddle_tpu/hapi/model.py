@@ -71,7 +71,11 @@ class Model:
         """ref: hapi/model.py:1499."""
         self._optimizer = optimizer
         self._loss = loss
-        self._metrics = list(metrics or [])
+        if metrics is None:
+            metrics = []
+        elif isinstance(metrics, Metric):  # single metric (ref: to_list)
+            metrics = [metrics]
+        self._metrics = list(metrics)
         self._amp_configs = amp_configs
         self._train_step_fn = None
         self._eval_step_fn = None

@@ -591,12 +591,27 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None,
                                  dropout_p: float = 0.0,
                                  is_causal: bool = False,
                                  scale: Optional[float] = None,
-                                 training: bool = True):
-    """q,k,v: [batch, seq, heads, head_dim] (TPU-friendly BSHD layout)."""
+                                 training: bool = True,
+                                 use_flash: bool = True):
+    """q,k,v: [batch, seq, heads, head_dim] (TPU-friendly BSHD layout).
+
+    Dispatches to the Pallas flash-attention kernel (paddle_tpu.ops)
+    when the configuration allows — the TPU analog of the reference's
+    fused attention (operators/fused/fused_attention_op.cu); otherwise
+    runs the XLA-fused reference math below.
+    """
     from .. import amp
     q, k, v = amp.white_cast(q, k, v)
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    from ..core import flags as _flags
+    if use_flash and _flags.get_flag("flash_attention"):
+        from ..ops.flash_attention import (flash_attention,
+                                           flash_attention_available)
+        if flash_attention_available(q.shape, k.shape, attn_mask,
+                                     dropout_p, training):
+            return flash_attention(q, k, v, causal=is_causal,
+                                   sm_scale=scale)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale
     if is_causal:

@@ -76,7 +76,8 @@ class MultiHeadAttention(Layer):
             cache = (k_cache, v_cache, idx + 1)
         out = F.scaled_dot_product_attention(
             q, k, v, attn_mask=attn_mask, dropout_p=self.dropout,
-            is_causal=is_causal, training=self.training)
+            is_causal=is_causal, training=self.training,
+            use_flash=self.use_flash)
         b, s = out.shape[0], out.shape[1]
         out = self.out_proj(out.reshape(b, s, self.embed_dim))
         if cache is not None:

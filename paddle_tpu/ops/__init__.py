@@ -1,0 +1,10 @@
+"""paddle_tpu.ops — custom TPU kernels (Pallas/Mosaic).
+
+The reference implements its fused hot-path ops as hand-written CUDA
+(reference: paddle/fluid/operators/fused/fused_attention_op.cu,
+fmha_ref.h, fused_multi_transformer_op.cu). The TPU-native equivalents
+live here as Pallas kernels compiled by Mosaic, with `interpret=True`
+fallback so the same kernels run (slowly) on CPU test meshes.
+"""
+
+from .flash_attention import flash_attention  # noqa: F401

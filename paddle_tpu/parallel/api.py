@@ -19,6 +19,7 @@ from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..nn.layer import Layer, split_state
 from .mesh import DeviceMesh, get_mesh, init_mesh, set_mesh
@@ -91,9 +92,13 @@ def all_gather(x, mesh: Optional[DeviceMesh] = None):
 def broadcast(stacked, src: int = 0, mesh: Optional[DeviceMesh] = None):
     """ref: c_broadcast — on a stacked [group, ...] array, every slice
     takes src's value. (For already-global arrays there is nothing to
-    broadcast in the single-controller model — use ``replicate``.)"""
+    broadcast in the single-controller model — use ``replicate``.)
+    With ``mesh``, the result is placed replicated on that mesh."""
     x = jnp.asarray(stacked)
-    return jnp.broadcast_to(x[src], x.shape)
+    out = jnp.broadcast_to(x[src], x.shape)
+    if mesh is not None:
+        out = jax.device_put(out, named_sharding(None, out.shape, mesh))
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -118,7 +123,12 @@ class DataParallel(Layer):
                 v, named_sharding(None, v.shape, self._mesh)))
 
     def forward(self, *args, **kwargs):
-        args = tuple(shard_batch(a, self._mesh) for a in args)
+        def _maybe_shard(v):
+            if isinstance(v, (jax.Array, np.ndarray)):
+                return shard_batch(v, self._mesh)
+            return v  # scalars/strings/config kwargs pass through
+        args = tuple(_maybe_shard(a) for a in args)
+        kwargs = {k: _maybe_shard(v) for k, v in kwargs.items()}
         return self._layers(*args, **kwargs)
 
     def state_dict(self, *a, **kw):
