@@ -17,6 +17,7 @@ step compiles to an SPMD program.
 
 from __future__ import annotations
 
+import contextlib
 import os
 import pickle
 import time
@@ -26,6 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import amp
 from ..core import flags, rng
 from ..io import DataLoader, Dataset
 from ..metric import Metric
@@ -127,6 +129,21 @@ class Model:
         labs = _as_tuple(labels)
         return tuple(m.compute(outs[0], labs[0]) for m in self._metrics)
 
+    def _amp_context(self):
+        """amp_configs from prepare() → auto_cast context entered at trace
+        time (ref: hapi/model.py _init_amp + amp/auto_cast.py). Accepts a
+        level string ("O1"/"O2") or a dict {level, dtype, ...}."""
+        cfg = self._amp_configs
+        if not cfg:
+            return contextlib.nullcontext()
+        if isinstance(cfg, str):
+            cfg = {"level": cfg}
+        level = cfg.get("level", "O1")
+        if level == "O0":
+            return contextlib.nullcontext()
+        return amp.auto_cast(enable=True, dtype=cfg.get("dtype"),
+                             level=level)
+
     # -- compiled steps -----------------------------------------------------
     def _build_train_step(self):
         optimizer = self._optimizer
@@ -134,7 +151,7 @@ class Model:
         def step(params, frozen, opt_state, buffers, step_idx, key,
                  inputs, labels):
             def loss_fn(p):
-                with rng.key_guard(key):
+                with rng.key_guard(key), self._amp_context():
                     out, new_buf = functional_call(
                         self.network, {**p, **frozen}, buffers, *inputs,
                         training=True)
@@ -152,7 +169,7 @@ class Model:
 
     def _build_eval_step(self):
         def step(params, frozen, buffers, key, inputs, labels):
-            with rng.key_guard(key):
+            with rng.key_guard(key), self._amp_context():
                 out, _ = functional_call(
                     self.network, {**params, **frozen}, buffers, *inputs,
                     training=False)

@@ -1,4 +1,14 @@
 """Model zoo (ref: python/paddle/vision/models, ERNIE/GPT from the
 reference's fleet examples). Populated incrementally."""
 
+from .bert import (BertConfig, BertForPretraining,  # noqa
+                   BertForSequenceClassification, BertModel,
+                   BertPretrainingCriterion, bert_config, ernie_config)
+from .gpt import (GPTConfig, GPTForCausalLM, GPTModel,  # noqa
+                  GPTPretrainingCriterion, gpt_config)
 from .lenet import LeNet  # noqa
+from .mobilenet import (MobileNetV1, MobileNetV2,  # noqa
+                        mobilenet_v1, mobilenet_v2)
+from .resnet import (BasicBlock, BottleneckBlock, ResNet,  # noqa
+                     resnet18, resnet34, resnet50, resnet101, resnet152)
+from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa

@@ -1,0 +1,230 @@
+"""BERT / ERNIE encoder models (BASELINE config 3: ERNIE-base pretraining).
+
+The reference ships BERT/ERNIE through its ecosystem on top of
+nn.TransformerEncoder (reference: python/paddle/nn/layer/transformer.py:652)
+with the fused encoder variant FusedTransformerEncoderLayer
+(python/paddle/incubate/nn/layer/fused_transformer.py:641). ERNIE-base is
+architecturally BERT-base (12L/768H/12A) with a different pretraining
+objective; both are covered by this module — ``ernie_config`` returns the
+same skeleton with ERNIE naming.
+
+TPU-native: same logical-axis sharding story as models/gpt.py; attention
+runs the Pallas flash kernel at pretraining sequence lengths.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer import Layer, LayerList
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: Optional[int] = None  # None = 4*hidden
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout: float = 0.1
+    attention_dropout: float = 0.1
+    activation: str = "gelu"
+    initializer_range: float = 0.02
+    layer_norm_epsilon: float = 1e-12
+    pad_token_id: int = 0
+    use_flash: bool = True
+
+    def __post_init__(self):
+        if self.intermediate_size is None:
+            self.intermediate_size = 4 * self.hidden_size
+
+
+PRESETS = {
+    "bert-base": dict(hidden_size=768, num_layers=12, num_heads=12),
+    "bert-large": dict(hidden_size=1024, num_layers=24, num_heads=16),
+    "ernie-base": dict(hidden_size=768, num_layers=12, num_heads=12,
+                       vocab_size=18000),
+    "ernie-large": dict(hidden_size=1024, num_layers=24, num_heads=16,
+                        vocab_size=18000),
+}
+
+
+def bert_config(name: str, **overrides) -> BertConfig:
+    cfg = dict(PRESETS[name])
+    cfg.update(overrides)
+    return BertConfig(**cfg)
+
+
+ernie_config = bert_config  # ERNIE-base == BERT skeleton, ERNIE vocab
+
+
+class BertEmbeddings(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        init = I.Normal(0.0, cfg.initializer_range)
+        self.word_embeddings = nn.Embedding(
+            cfg.vocab_size, cfg.hidden_size, padding_idx=cfg.pad_token_id,
+            weight_attr=init, axes=("vocab", "embed"))
+        self.position_embeddings = nn.Embedding(
+            cfg.max_position_embeddings, cfg.hidden_size, weight_attr=init,
+            axes=(None, "embed"))
+        self.token_type_embeddings = nn.Embedding(
+            cfg.type_vocab_size, cfg.hidden_size, weight_attr=init,
+            axes=(None, "embed"))
+        self.layer_norm = nn.LayerNorm(cfg.hidden_size,
+                                       epsilon=cfg.layer_norm_epsilon)
+        self.dropout = nn.Dropout(cfg.hidden_dropout)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        s = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = jnp.arange(s)[None, :]
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        x = (self.word_embeddings(input_ids)
+             + self.position_embeddings(position_ids)
+             + self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(x))
+
+
+class BertEncoderLayer(Layer):
+    """Post-LN encoder block (original BERT residual order)."""
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.attn = nn.MultiHeadAttention(
+            cfg.hidden_size, cfg.num_heads, dropout=cfg.attention_dropout,
+            use_flash=cfg.use_flash)
+        self.ln_1 = nn.LayerNorm(cfg.hidden_size,
+                                 epsilon=cfg.layer_norm_epsilon)
+        self.fc_in = nn.Linear(cfg.hidden_size, cfg.intermediate_size,
+                               weight_attr=I.Normal(0., cfg.initializer_range),
+                               axes=("embed", "mlp"), bias_axes=("mlp",))
+        self.fc_out = nn.Linear(cfg.intermediate_size, cfg.hidden_size,
+                                weight_attr=I.Normal(0., cfg.initializer_range),
+                                axes=("mlp", "embed"), bias_axes=(None,))
+        self.ln_2 = nn.LayerNorm(cfg.hidden_size,
+                                 epsilon=cfg.layer_norm_epsilon)
+        self.act = getattr(F, cfg.activation)
+        self.dropout = nn.Dropout(cfg.hidden_dropout)
+
+    def forward(self, x, attn_mask=None):
+        x = self.ln_1(x + self.dropout(self.attn(x, attn_mask=attn_mask)))
+        h = self.fc_out(self.act(self.fc_in(x)))
+        return self.ln_2(x + self.dropout(h))
+
+
+class BertPooler(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.dense = nn.Linear(cfg.hidden_size, cfg.hidden_size,
+                               weight_attr=I.Normal(0.,
+                                                    cfg.initializer_range))
+
+    def forward(self, hidden):
+        return F.tanh(self.dense(hidden[:, 0]))
+
+
+class BertModel(Layer):
+    """Trunk: embeddings → encoder stack → (sequence_output, pooled)."""
+
+    def __init__(self, cfg: BertConfig, with_pooler: bool = True):
+        super().__init__()
+        self.cfg = cfg
+        self.embeddings = BertEmbeddings(cfg)
+        self.encoder = LayerList(
+            [BertEncoderLayer(cfg) for _ in range(cfg.num_layers)])
+        self.pooler = BertPooler(cfg) if with_pooler else None
+
+    @staticmethod
+    def attention_mask_from_ids(input_ids, pad_token_id: int):
+        """[b, s] ids → additive [b, 1, 1, s] mask (-inf at padding)."""
+        pad = (input_ids == pad_token_id)
+        return jnp.where(pad, -jnp.inf, 0.0)[:, None, None, :]
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attn_mask=None):
+        x = self.embeddings(input_ids, token_type_ids, position_ids)
+        for layer in self.encoder:
+            x = layer(x, attn_mask=attn_mask)
+        pooled = self.pooler(x) if self.pooler is not None else None
+        return x, pooled
+
+
+class BertLMHead(Layer):
+    """MLM head: transform + LN + decode to vocab (tied to embeddings)."""
+
+    def __init__(self, cfg: BertConfig, embeddings: BertEmbeddings):
+        super().__init__()
+        self.transform = nn.Linear(
+            cfg.hidden_size, cfg.hidden_size,
+            weight_attr=I.Normal(0., cfg.initializer_range))
+        self.act = getattr(F, cfg.activation)
+        self.layer_norm = nn.LayerNorm(cfg.hidden_size,
+                                       epsilon=cfg.layer_norm_epsilon)
+        self._embeddings = [embeddings]  # plain list: not a sublayer (tied)
+        self.decoder_bias = self.create_parameter(
+            [cfg.vocab_size], initializer=I.Constant(0.0), axes=("vocab",))
+
+    def forward(self, hidden):
+        from .. import amp
+        h = self.layer_norm(self.act(self.transform(hidden)))
+        w = self._embeddings[0].word_embeddings.weight  # [V, H] tied
+        h, w = amp.white_cast(h, w)
+        return jnp.einsum("bsh,vh->bsv", h, w,
+                          preferred_element_type=jnp.float32) \
+            + self.decoder_bias
+
+
+class BertForPretraining(Layer):
+    """MLM + next-sentence-prediction heads (BERT objective; ERNIE uses
+    the same skeleton with knowledge-masking data — a data-pipeline
+    difference, not a model one)."""
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.bert = BertModel(cfg, with_pooler=True)
+        self.lm_head = BertLMHead(cfg, self.bert.embeddings)
+        self.nsp_head = nn.Linear(cfg.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, attn_mask=None):
+        seq, pooled = self.bert(input_ids, token_type_ids,
+                                attn_mask=attn_mask)
+        return self.lm_head(seq), self.nsp_head(pooled)
+
+
+class BertPretrainingCriterion(Layer):
+    def __init__(self, ignore_index: int = -100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, mlm_logits, nsp_logits, mlm_labels, nsp_labels=None):
+        loss = F.cross_entropy(
+            mlm_logits.reshape(-1, mlm_logits.shape[-1]),
+            mlm_labels.reshape(-1), ignore_index=self.ignore_index)
+        if nsp_labels is not None:
+            loss = loss + F.cross_entropy(nsp_logits,
+                                          nsp_labels.reshape(-1))
+        return loss
+
+
+class BertForSequenceClassification(Layer):
+    def __init__(self, cfg: BertConfig, num_classes: int = 2):
+        super().__init__()
+        self.bert = BertModel(cfg, with_pooler=True)
+        self.dropout = nn.Dropout(cfg.hidden_dropout)
+        self.classifier = nn.Linear(cfg.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attn_mask=None):
+        _, pooled = self.bert(input_ids, token_type_ids,
+                              attn_mask=attn_mask)
+        return self.classifier(self.dropout(pooled))

@@ -1,0 +1,329 @@
+"""GPT: decoder-only transformer LM (flagship model, BASELINE config 4).
+
+The reference ships GPT via its ecosystem (fleetx/PaddleNLP) built on the
+incubate fused transformer layers
+(reference: python/paddle/incubate/nn/layer/fused_transformer.py:176
+FusedMultiHeadAttention, :437 FusedFeedForward, :641
+FusedTransformerEncoderLayer; CUDA kernels
+paddle/fluid/operators/fused/fused_multi_transformer_op.cu) and the
+Megatron tensor-parallel layers (VocabParallelEmbedding /
+ColumnParallelLinear / RowParallelLinear,
+python/paddle/distributed/fleet/meta_parallel/parallel_layers/mp_layers.py:30).
+
+TPU-native design: one model definition carries logical sharding axes on
+its weights ("vocab", "embed", "heads", "mlp"); the same code runs dense
+on one chip or TP/FSDP/DP-sharded under a mesh — XLA inserts the
+identity/allreduce pairs the reference hand-codes in mp_layers.py.
+Attention dispatches to the Pallas flash kernel (paddle_tpu.ops).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer import Layer, LayerList
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    num_kv_heads: Optional[int] = None   # grouped-query; None = num_heads
+    ffn_hidden_size: Optional[int] = None  # None = 4*hidden
+    max_position_embeddings: int = 1024
+    hidden_dropout: float = 0.1
+    attention_dropout: float = 0.1
+    activation: str = "gelu"
+    initializer_range: float = 0.02
+    layer_norm_epsilon: float = 1e-5
+    tie_word_embeddings: bool = True
+    use_flash: bool = True
+
+    def __post_init__(self):
+        if self.ffn_hidden_size is None:
+            self.ffn_hidden_size = 4 * self.hidden_size
+        if self.num_kv_heads is None:
+            self.num_kv_heads = self.num_heads
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+# named presets; "gpt3-1.3b" is BASELINE config 4's hybrid-parallel target
+PRESETS = {
+    "gpt2-small": dict(hidden_size=768, num_layers=12, num_heads=12,
+                       max_position_embeddings=1024),
+    "gpt2-medium": dict(hidden_size=1024, num_layers=24, num_heads=16,
+                        max_position_embeddings=1024),
+    "gpt3-1.3b": dict(hidden_size=2048, num_layers=24, num_heads=16,
+                      max_position_embeddings=2048),
+    "gpt3-6.7b": dict(hidden_size=4096, num_layers=32, num_heads=32,
+                      max_position_embeddings=2048),
+}
+
+
+def gpt_config(name: str, **overrides) -> GPTConfig:
+    cfg = dict(PRESETS[name])
+    cfg.update(overrides)
+    return GPTConfig(**cfg)
+
+
+class GPTAttention(Layer):
+    """Causal self-attention with fused QKV and optional KV cache.
+
+    Unlike nn.MultiHeadAttention (API-parity layer), the QKV projection
+    is a single matmul — one big MXU op instead of three — and supports
+    grouped-query heads. Column-parallel in, row-parallel out."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        h, hd = cfg.hidden_size, cfg.head_dim
+        self.num_heads = cfg.num_heads
+        self.num_kv_heads = cfg.num_kv_heads
+        qkv_out = h + 2 * cfg.num_kv_heads * hd
+        init = I.Normal(0.0, cfg.initializer_range)
+        self.qkv_proj = nn.Linear(h, qkv_out, weight_attr=init,
+                                  axes=("embed", "heads"),
+                                  bias_axes=("heads",))
+        self.out_proj = nn.Linear(h, h, weight_attr=I.Normal(
+            0.0, cfg.initializer_range / math.sqrt(2 * cfg.num_layers)),
+            axes=("heads", "embed"), bias_axes=(None,))
+
+    def forward(self, x, attn_mask=None, cache=None):
+        b, s, h = x.shape
+        hd = self.cfg.head_dim
+        qkv = self.qkv_proj(x)
+        q, k, v = jnp.split(
+            qkv, [h, h + self.num_kv_heads * hd], axis=-1)
+        q = q.reshape(b, s, self.num_heads, hd)
+        k = k.reshape(b, s, self.num_kv_heads, hd)
+        v = v.reshape(b, s, self.num_kv_heads, hd)
+        if cache is not None:
+            k_cache, v_cache, idx = cache
+            k_cache = jax.lax.dynamic_update_slice_in_dim(
+                jnp.asarray(k_cache), k, idx, axis=1)
+            v_cache = jax.lax.dynamic_update_slice_in_dim(
+                jnp.asarray(v_cache), v, idx, axis=1)
+            cache = (k_cache, v_cache, idx + s)
+            k, v = k_cache, v_cache
+            # causal within the new window AND only written cache slots:
+            # query t (absolute idx+t) may attend keys at positions <= idx+t
+            kl = k.shape[1]
+            key_pos = jnp.arange(kl)[None, None, None, :]
+            qry_pos = (idx + jnp.arange(s))[None, None, :, None]
+            causal_mask = jnp.where(key_pos <= qry_pos, 0.0, -jnp.inf)
+            out = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=causal_mask,
+                dropout_p=self.cfg.attention_dropout,
+                training=self.training, use_flash=False)
+        else:
+            out = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=attn_mask,
+                is_causal=attn_mask is None,
+                dropout_p=self.cfg.attention_dropout,
+                training=self.training, use_flash=self.cfg.use_flash)
+        out = self.out_proj(out.reshape(b, s, h))
+        if cache is not None:
+            return out, cache
+        return out
+
+
+class GPTMLP(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        init = I.Normal(0.0, cfg.initializer_range)
+        init_out = I.Normal(
+            0.0, cfg.initializer_range / math.sqrt(2 * cfg.num_layers))
+        self.fc_in = nn.Linear(cfg.hidden_size, cfg.ffn_hidden_size,
+                               weight_attr=init,
+                               axes=("embed", "mlp"), bias_axes=("mlp",))
+        self.fc_out = nn.Linear(cfg.ffn_hidden_size, cfg.hidden_size,
+                                weight_attr=init_out,
+                                axes=("mlp", "embed"), bias_axes=(None,))
+        self.act = getattr(F, cfg.activation)
+        self.dropout = nn.Dropout(cfg.hidden_dropout)
+
+    def forward(self, x):
+        return self.dropout(self.fc_out(self.act(self.fc_in(x))))
+
+
+class GPTDecoderLayer(Layer):
+    """Pre-LN decoder block (GPT-2/3 style)."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.ln_1 = nn.LayerNorm(cfg.hidden_size,
+                                 epsilon=cfg.layer_norm_epsilon)
+        self.attn = GPTAttention(cfg)
+        self.ln_2 = nn.LayerNorm(cfg.hidden_size,
+                                 epsilon=cfg.layer_norm_epsilon)
+        self.mlp = GPTMLP(cfg)
+        self.dropout = nn.Dropout(cfg.hidden_dropout)
+
+    def forward(self, x, attn_mask=None, cache=None):
+        a = self.attn(self.ln_1(x), attn_mask=attn_mask, cache=cache)
+        if cache is not None:
+            a, cache = a
+        x = x + self.dropout(a)
+        x = x + self.mlp(self.ln_2(x))
+        if cache is not None:
+            return x, cache
+        return x
+
+
+class GPTEmbeddings(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        init = I.Normal(0.0, cfg.initializer_range)
+        # vocab-parallel embedding (ref: mp_layers.py:30
+        # VocabParallelEmbedding): shard the vocab dim over tp
+        self.word_embeddings = nn.Embedding(
+            cfg.vocab_size, cfg.hidden_size, weight_attr=init,
+            axes=("vocab", "embed"))
+        self.position_embeddings = nn.Embedding(
+            cfg.max_position_embeddings, cfg.hidden_size, weight_attr=init,
+            axes=(None, "embed"))
+        self.dropout = nn.Dropout(cfg.hidden_dropout)
+
+    def forward(self, input_ids, position_ids=None):
+        s = input_ids.shape[1]
+        max_pos = self.position_embeddings.num_embeddings
+        if s > max_pos:
+            raise ValueError(
+                f"sequence length {s} exceeds max_position_embeddings "
+                f"{max_pos} (an out-of-range gather would silently clamp)")
+        if position_ids is None:
+            position_ids = jnp.arange(s)[None, :]
+        x = self.word_embeddings(input_ids) + \
+            self.position_embeddings(position_ids)
+        return self.dropout(x)
+
+
+class GPTModel(Layer):
+    """Transformer trunk: embeddings → N decoder blocks → final LN."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embeddings = GPTEmbeddings(cfg)
+        self.layers = LayerList(
+            [GPTDecoderLayer(cfg) for _ in range(cfg.num_layers)])
+        self.ln_f = nn.LayerNorm(cfg.hidden_size,
+                                 epsilon=cfg.layer_norm_epsilon)
+
+    def forward(self, input_ids, position_ids=None, attn_mask=None,
+                caches=None):
+        x = self.embeddings(input_ids, position_ids)
+        new_caches = [] if caches is not None else None
+        for i, layer in enumerate(self.layers):
+            if caches is not None:
+                x, c = layer(x, attn_mask=attn_mask, cache=caches[i])
+                new_caches.append(c)
+            else:
+                x = layer(x, attn_mask=attn_mask)
+        x = self.ln_f(x)
+        if caches is not None:
+            return x, new_caches
+        return x
+
+
+class GPTForCausalLM(Layer):
+    """GPT with a (tied) LM head and generation utilities."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.gpt = GPTModel(cfg)
+        if not cfg.tie_word_embeddings:
+            self.lm_head = nn.Linear(cfg.hidden_size, cfg.vocab_size,
+                                     bias_attr=False,
+                                     axes=("embed", "vocab"))
+
+    def _logits(self, hidden):
+        if self.cfg.tie_word_embeddings:
+            from .. import amp
+            w = self.gpt.embeddings.word_embeddings.weight  # [V, H]
+            hidden, w = amp.white_cast(hidden, w)
+            return jnp.einsum("bsh,vh->bsv", hidden, w,
+                              preferred_element_type=jnp.float32)
+        return self.lm_head(hidden)
+
+    def forward(self, input_ids, position_ids=None, attn_mask=None,
+                caches=None):
+        out = self.gpt(input_ids, position_ids, attn_mask, caches)
+        if caches is not None:
+            hidden, new_caches = out
+            return self._logits(hidden), new_caches
+        return self._logits(out)
+
+    # -- decode-time KV cache -------------------------------------------
+    def init_caches(self, batch_size: int, max_len: int, dtype=jnp.float32):
+        cfg = self.cfg
+        shape = (batch_size, max_len, cfg.num_kv_heads, cfg.head_dim)
+        return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype), 0)
+                for _ in range(cfg.num_layers)]
+
+    def generate(self, input_ids, max_new_tokens: int = 20,
+                 temperature: float = 0.0, top_k: int = 0, seed: int = 0):
+        """Greedy (temperature=0) or top-k sampled decoding with a KV
+        cache. Eager loop — the serving path AOT-compiles a scan instead."""
+        self.eval()
+        b, s = input_ids.shape
+        max_len = s + max_new_tokens
+        if max_len > self.cfg.max_position_embeddings:
+            raise ValueError(
+                f"prompt {s} + max_new_tokens {max_new_tokens} exceeds "
+                f"max_position_embeddings "
+                f"{self.cfg.max_position_embeddings}")
+        caches = self.init_caches(b, max_len)
+        key = jax.random.PRNGKey(seed)
+        # prefill
+        logits, caches = self(input_ids, caches=caches)
+        tokens = input_ids
+        next_logits = logits[:, -1]
+        for step in range(max_new_tokens):
+            if temperature > 0.0:
+                key, sub = jax.random.split(key)
+                lg = next_logits / temperature
+                if top_k > 0:
+                    kth = jnp.sort(lg, axis=-1)[:, -top_k][:, None]
+                    lg = jnp.where(lg < kth, -jnp.inf, lg)
+                nxt = jax.random.categorical(sub, lg, axis=-1)
+            else:
+                nxt = jnp.argmax(next_logits, axis=-1)
+            nxt = nxt[:, None]
+            tokens = jnp.concatenate([tokens, nxt], axis=1)
+            if step == max_new_tokens - 1:
+                break
+            pos = jnp.full((b, 1), s + step)
+            next_logits, caches = self(nxt, position_ids=pos, caches=caches)
+            next_logits = next_logits[:, -1]
+        return tokens
+
+
+class GPTPretrainingCriterion(Layer):
+    """Shifted next-token cross entropy; the TP analog of the reference's
+    ParallelCrossEntropy (mp_layers.py:251 / c_softmax_with_cross_entropy)
+    falls out of sharding the vocab dim of logits."""
+
+    def __init__(self, ignore_index: int = -100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, logits, labels):
+        # logits [b, s, v], labels [b, s]: predict token t+1 at position t
+        lg = logits[:, :-1].reshape(-1, logits.shape[-1])
+        lb = labels[:, 1:].reshape(-1)
+        return F.cross_entropy(lg, lb, ignore_index=self.ignore_index)

@@ -1,0 +1,157 @@
+"""MobileNet v1/v2/v3 (ref: python/paddle/vision/models/mobilenetv1.py,
+mobilenetv2.py, mobilenetv3.py).
+
+TPU note: depthwise convs (groups == channels) don't map to the MXU;
+XLA lowers them on the VPU, which is why MobileNets bench worse per-FLOP
+on TPU than ResNets — kept for API parity with the reference model zoo.
+"""
+
+from __future__ import annotations
+
+from .. import nn
+from ..nn import functional as F
+
+
+def _make_divisible(v, divisor=8, min_value=None):
+    if min_value is None:
+        min_value = divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class ConvBNLayer(nn.Layer):
+    def __init__(self, in_c, out_c, kernel, stride=1, padding=0, groups=1,
+                 act="relu"):
+        super().__init__()
+        self.conv = nn.Conv2D(in_c, out_c, kernel, stride=stride,
+                              padding=padding, groups=groups,
+                              bias_attr=False)
+        self.bn = nn.BatchNorm2D(out_c)
+        self.act = act
+
+    def forward(self, x):
+        x = self.bn(self.conv(x))
+        if self.act == "relu":
+            x = F.relu(x)
+        elif self.act == "relu6":
+            x = F.relu6(x)
+        elif self.act == "hardswish":
+            x = F.hardswish(x)
+        return x
+
+
+class DepthwiseSeparable(nn.Layer):
+    def __init__(self, in_c, mid_c, out_c, stride, scale=1.0):
+        super().__init__()
+        mid_c, out_c = int(mid_c * scale), int(out_c * scale)
+        self.depthwise = ConvBNLayer(in_c, mid_c, 3, stride=stride,
+                                     padding=1, groups=in_c)
+        self.pointwise = ConvBNLayer(mid_c, out_c, 1)
+
+    def forward(self, x):
+        return self.pointwise(self.depthwise(x))
+
+
+class MobileNetV1(nn.Layer):
+    """ref: vision/models/mobilenetv1.py MobileNetV1(scale, num_classes)."""
+
+    def __init__(self, scale: float = 1.0, num_classes: int = 1000,
+                 with_pool: bool = True):
+        super().__init__()
+        self.scale = scale
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        s = lambda c: int(c * scale)  # noqa: E731
+        self.conv1 = ConvBNLayer(3, s(32), 3, stride=2, padding=1)
+        cfg = [  # in, mid, out, stride
+            (s(32), 32, 64, 1), (s(64), 64, 128, 2),
+            (s(128), 128, 128, 1), (s(128), 128, 256, 2),
+            (s(256), 256, 256, 1), (s(256), 256, 512, 2),
+            (s(512), 512, 512, 1), (s(512), 512, 512, 1),
+            (s(512), 512, 512, 1), (s(512), 512, 512, 1),
+            (s(512), 512, 512, 1), (s(512), 512, 1024, 2),
+            (s(1024), 1024, 1024, 1),
+        ]
+        self.blocks = nn.Sequential(*[
+            DepthwiseSeparable(i, m, o, st, scale) for i, m, o, st in cfg])
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = nn.Linear(s(1024), num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.conv1(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(nn.Flatten()(x))
+        return x
+
+
+class InvertedResidual(nn.Layer):
+    def __init__(self, in_c, out_c, stride, expand_ratio):
+        super().__init__()
+        self.use_res = stride == 1 and in_c == out_c
+        hidden = int(round(in_c * expand_ratio))
+        layers = []
+        if expand_ratio != 1:
+            layers.append(ConvBNLayer(in_c, hidden, 1, act="relu6"))
+        layers += [
+            ConvBNLayer(hidden, hidden, 3, stride=stride, padding=1,
+                        groups=hidden, act="relu6"),
+            ConvBNLayer(hidden, out_c, 1, act=None),
+        ]
+        self.conv = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV2(nn.Layer):
+    """ref: vision/models/mobilenetv2.py MobileNetV2(scale, num_classes)."""
+
+    def __init__(self, scale: float = 1.0, num_classes: int = 1000,
+                 with_pool: bool = True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        cfg = [  # t (expand), c, n (repeats), s (stride)
+            (1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+            (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+        in_c = _make_divisible(32 * scale)
+        self.conv1 = ConvBNLayer(3, in_c, 3, stride=2, padding=1,
+                                 act="relu6")
+        blocks = []
+        for t, c, n, s in cfg:
+            out_c = _make_divisible(c * scale)
+            for i in range(n):
+                blocks.append(InvertedResidual(
+                    in_c, out_c, s if i == 0 else 1, t))
+                in_c = out_c
+        self.blocks = nn.Sequential(*blocks)
+        self.out_c = _make_divisible(1280 * max(1.0, scale))
+        self.conv2 = ConvBNLayer(in_c, self.out_c, 1, act="relu6")
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = nn.Sequential(nn.Dropout(0.2),
+                                    nn.Linear(self.out_c, num_classes))
+
+    def forward(self, x):
+        x = self.conv2(self.blocks(self.conv1(x)))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(nn.Flatten()(x))
+        return x
+
+
+def mobilenet_v1(scale=1.0, **kwargs):
+    return MobileNetV1(scale=scale, **kwargs)
+
+
+def mobilenet_v2(scale=1.0, **kwargs):
+    return MobileNetV2(scale=scale, **kwargs)

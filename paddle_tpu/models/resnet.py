@@ -1,0 +1,145 @@
+"""ResNet family (ref: python/paddle/vision/models/resnet.py —
+BasicBlock/BottleneckBlock + resnet18/34/50/101/152; BASELINE config 2
+is ResNet-50 ImageNet).
+
+TPU notes: NCHW public API (reference parity); convs lower through
+``F.conv2d`` whose dimension-numbers let XLA pick the fastest internal
+layout for the MXU's convolution tiling. BatchNorm keeps running stats
+as buffers (mutated through functional_call's buffer threading)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Type, Union
+
+from .. import nn
+
+
+class BasicBlock(nn.Layer):
+    expansion = 1
+
+    def __init__(self, inplanes, planes, stride=1, downsample=None,
+                 norm_layer=nn.BatchNorm2D):
+        super().__init__()
+        self.conv1 = nn.Conv2D(inplanes, planes, 3, stride=stride,
+                               padding=1, bias_attr=False)
+        self.bn1 = norm_layer(planes)
+        self.conv2 = nn.Conv2D(planes, planes, 3, padding=1,
+                               bias_attr=False)
+        self.bn2 = norm_layer(planes)
+        self.relu = nn.ReLU()
+        self.downsample = downsample
+        self.stride = stride
+
+    def forward(self, x):
+        identity = x
+        out = self.relu(self.bn1(self.conv1(x)))
+        out = self.bn2(self.conv2(out))
+        if self.downsample is not None:
+            identity = self.downsample(x)
+        return self.relu(out + identity)
+
+
+class BottleneckBlock(nn.Layer):
+    expansion = 4
+
+    def __init__(self, inplanes, planes, stride=1, downsample=None,
+                 norm_layer=nn.BatchNorm2D):
+        super().__init__()
+        self.conv1 = nn.Conv2D(inplanes, planes, 1, bias_attr=False)
+        self.bn1 = norm_layer(planes)
+        self.conv2 = nn.Conv2D(planes, planes, 3, stride=stride,
+                               padding=1, bias_attr=False)
+        self.bn2 = norm_layer(planes)
+        self.conv3 = nn.Conv2D(planes, planes * self.expansion, 1,
+                               bias_attr=False)
+        self.bn3 = norm_layer(planes * self.expansion)
+        self.relu = nn.ReLU()
+        self.downsample = downsample
+        self.stride = stride
+
+    def forward(self, x):
+        identity = x
+        out = self.relu(self.bn1(self.conv1(x)))
+        out = self.relu(self.bn2(self.conv2(out)))
+        out = self.bn3(self.conv3(out))
+        if self.downsample is not None:
+            identity = self.downsample(x)
+        return self.relu(out + identity)
+
+
+class ResNet(nn.Layer):
+    """ref: vision/models/resnet.py ResNet(Block, depth, num_classes,
+    with_pool)."""
+
+    def __init__(self, block: Type[Union[BasicBlock, BottleneckBlock]],
+                 depth: int = 50, num_classes: int = 1000,
+                 with_pool: bool = True):
+        super().__init__()
+        layer_cfg = {18: [2, 2, 2, 2], 34: [3, 4, 6, 3],
+                     50: [3, 4, 6, 3], 101: [3, 4, 23, 3],
+                     152: [3, 8, 36, 3]}
+        layers = layer_cfg[depth]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.inplanes = 64
+        self.conv1 = nn.Conv2D(3, 64, 7, stride=2, padding=3,
+                               bias_attr=False)
+        self.bn1 = nn.BatchNorm2D(64)
+        self.relu = nn.ReLU()
+        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
+        self.layer1 = self._make_layer(block, 64, layers[0])
+        self.layer2 = self._make_layer(block, 128, layers[1], stride=2)
+        self.layer3 = self._make_layer(block, 256, layers[2], stride=2)
+        self.layer4 = self._make_layer(block, 512, layers[3], stride=2)
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = nn.Linear(512 * block.expansion, num_classes)
+
+    def _make_layer(self, block, planes, blocks, stride=1):
+        downsample = None
+        if stride != 1 or self.inplanes != planes * block.expansion:
+            downsample = nn.Sequential(
+                nn.Conv2D(self.inplanes, planes * block.expansion, 1,
+                          stride=stride, bias_attr=False),
+                nn.BatchNorm2D(planes * block.expansion),
+            )
+        layers = [block(self.inplanes, planes, stride, downsample)]
+        self.inplanes = planes * block.expansion
+        for _ in range(1, blocks):
+            layers.append(block(self.inplanes, planes))
+        return nn.Sequential(*layers)
+
+    def forward(self, x):
+        x = self.maxpool(self.relu(self.bn1(self.conv1(x))))
+        x = self.layer4(self.layer3(self.layer2(self.layer1(x))))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = nn.Flatten()(x)
+            x = self.fc(x)
+        return x
+
+
+def _resnet(block, depth, **kwargs):
+    return ResNet(block, depth, **kwargs)
+
+
+def resnet18(**kwargs):
+    return _resnet(BasicBlock, 18, **kwargs)
+
+
+def resnet34(**kwargs):
+    return _resnet(BasicBlock, 34, **kwargs)
+
+
+def resnet50(**kwargs):
+    return _resnet(BottleneckBlock, 50, **kwargs)
+
+
+def resnet101(**kwargs):
+    return _resnet(BottleneckBlock, 101, **kwargs)
+
+
+def resnet152(**kwargs):
+    return _resnet(BottleneckBlock, 152, **kwargs)

@@ -609,9 +609,14 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None,
         from ..ops.flash_attention import (flash_attention,
                                            flash_attention_available)
         if flash_attention_available(q.shape, k.shape, attn_mask,
-                                     dropout_p, training):
+                                     dropout_p, training,
+                                     is_causal=is_causal):
             return flash_attention(q, k, v, causal=is_causal,
                                    sm_scale=scale)
+    if q.shape[2] != k.shape[2]:  # grouped-query: materialize kv repeat
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale
     if is_causal:

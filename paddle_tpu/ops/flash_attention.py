@@ -356,6 +356,10 @@ def flash_attention(q, k, v, *, causal: bool = False,
     _, sk, hkv, _ = k.shape
     if hq % hkv != 0:
         raise ValueError(f"q heads {hq} not a multiple of kv heads {hkv}")
+    if causal and sq > sk:
+        raise ValueError(
+            f"causal flash attention requires s_q <= s_k, got {sq} > {sk}: "
+            "leading query rows would have no visible keys")
     sm_scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
     bq = _pick_block(sq, block_q)
     bk = _pick_block(sk, block_k)
@@ -367,7 +371,7 @@ def flash_attention(q, k, v, *, causal: bool = False,
 
 
 def flash_attention_available(q_shape, k_shape, attn_mask, dropout_p,
-                              training) -> bool:
+                              training, is_causal: bool = False) -> bool:
     """Whether the Pallas path handles this configuration."""
     if attn_mask is not None:
         return False
@@ -378,6 +382,10 @@ def flash_attention_available(q_shape, k_shape, attn_mask, dropout_p,
     b, sq, hq, d = q_shape
     sk, hkv = k_shape[1], k_shape[2]
     if hq % hkv != 0:
+        return False
+    if is_causal and sq > sk:
+        # degenerate: leading query rows have no visible keys (the
+        # reference math yields NaN rows); keep that on the XLA path
         return False
     # tiny shapes: the reference path is cheaper than kernel launch; odd
     # lengths would force sub-(8,128) tiles that Mosaic rejects — require

@@ -19,7 +19,6 @@ from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from ..nn.layer import Layer, split_state
 from .mesh import DeviceMesh, get_mesh, init_mesh, set_mesh
@@ -123,12 +122,10 @@ class DataParallel(Layer):
                 v, named_sharding(None, v.shape, self._mesh)))
 
     def forward(self, *args, **kwargs):
-        def _maybe_shard(v):
-            if isinstance(v, (jax.Array, np.ndarray)):
-                return shard_batch(v, self._mesh)
-            return v  # scalars/strings/config kwargs pass through
-        args = tuple(_maybe_shard(a) for a in args)
-        kwargs = {k: _maybe_shard(v) for k, v in kwargs.items()}
+        # shard_batch tree-maps over nested inputs; non-array leaves
+        # (strings/None/config) pass through untouched
+        args = shard_batch(args, self._mesh)
+        kwargs = shard_batch(kwargs, self._mesh)
         return self._layers(*args, **kwargs)
 
     def state_dict(self, *a, **kw):

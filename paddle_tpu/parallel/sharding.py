@@ -109,7 +109,10 @@ def shard_batch(batch, mesh: Optional[DeviceMesh] = None):
         ndata *= mesh.axis_size(a)
 
     def put(x):
-        x = jax.numpy.asarray(x) if not hasattr(x, "shape") else x
+        if not hasattr(x, "shape"):
+            if not isinstance(x, (int, float, complex, bool)):
+                return x  # strings/None/config leaves pass through
+            x = jax.numpy.asarray(x)
         if getattr(x, "ndim", 0) == 0 or (
                 ndata and x.shape[0] % ndata):
             # scalar, or a final partial batch (DataLoader drop_last=False)

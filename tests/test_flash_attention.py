@@ -121,6 +121,20 @@ def test_grads_gqa():
                                    err_msg=f"d{name}")
 
 
+def test_causal_longer_query_rejected():
+    """causal sq > sk: leading rows see no keys (NaN in reference math) —
+    the kernel refuses and the dispatcher keeps it on the XLA path."""
+    from paddle_tpu.ops.flash_attention import flash_attention_available
+    q = _rand((1, 256, 2, 64), 0)
+    k = _rand((1, 128, 2, 64), 1)
+    with pytest.raises(ValueError, match="s_q <= s_k"):
+        flash_attention(q, k, k, causal=True, interpret=True)
+    assert not flash_attention_available(q.shape, k.shape, None, 0.0,
+                                         False, is_causal=True)
+    assert flash_attention_available(q.shape, k.shape, None, 0.0,
+                                     False, is_causal=False)
+
+
 def test_bf16_runs():
     q = _rand((1, 128, 2, 64), 0, jnp.bfloat16)
     k = _rand((1, 128, 2, 64), 1, jnp.bfloat16)

@@ -1,0 +1,85 @@
+"""Vision model zoo tests (ref: python/paddle/tests/test_vision_models.py
+— instantiate each family, forward a small input, check logits shape)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu import models
+from paddle_tpu.nn.layer import functional_call, split_state
+
+
+def _x(n=1, size=64):
+    return jnp.asarray(
+        np.random.RandomState(0).randn(n, 3, size, size), jnp.float32)
+
+
+@pytest.mark.parametrize("ctor", [models.resnet18, models.resnet34,
+                                  models.resnet50])
+def test_resnet_forward(ctor):
+    net = ctor(num_classes=10)
+    net.eval()
+    out = net(_x())
+    assert out.shape == (1, 10)
+    assert np.all(np.isfinite(np.asarray(out)))
+
+
+def test_resnet_deep_constructs():
+    # 101/152: construct + param count only (forward is slow on CPU)
+    net = models.resnet101(num_classes=10)
+    n_params = sum(int(np.prod(p.shape)) for p in net.parameters())
+    assert n_params > 40e6
+
+
+def test_resnet50_param_count_imagenet():
+    net = models.resnet50()
+    n_params = sum(int(np.prod(p.shape)) for p in net.parameters())
+    # torchvision/paddle resnet50: 25.557M params
+    assert abs(n_params - 25_557_032) / 25_557_032 < 0.01, n_params
+
+
+def test_resnet_batchnorm_stats_update():
+    net = models.resnet18(num_classes=4)
+    params, buffers = split_state(net)
+    out, new_buf = functional_call(net, params, buffers, _x(2),
+                                   training=True)
+    changed = [k for k in buffers
+               if not np.allclose(buffers[k], new_buf[k])]
+    assert any("_mean" in k or "_variance" in k for k in changed)
+
+
+def test_vgg_forward():
+    net = models.vgg11(num_classes=7)
+    net.eval()
+    out = net(_x(1, 224))
+    assert out.shape == (1, 7)
+
+
+def test_mobilenet_v1_forward():
+    net = models.mobilenet_v1(scale=0.25, num_classes=5)
+    net.eval()
+    out = net(_x())
+    assert out.shape == (1, 5)
+
+
+def test_mobilenet_v2_forward():
+    net = models.mobilenet_v2(scale=0.5, num_classes=5)
+    net.eval()
+    out = net(_x())
+    assert out.shape == (1, 5)
+
+
+def test_resnet_train_step_grads():
+    import paddle_tpu as pt
+    from paddle_tpu import nn
+    net = models.resnet18(num_classes=4)
+    model = pt.Model(net)
+    model.prepare(optimizer=pt.optimizer.Momentum(
+        learning_rate=0.1, momentum=0.9, parameters=net),
+        loss=nn.CrossEntropyLoss())
+    xs = np.random.RandomState(0).randn(4, 3, 32, 32).astype(np.float32)
+    ys = np.random.RandomState(1).randint(0, 4, (4, 1))
+    logs1 = model.train_batch([xs], [ys])
+    logs2 = model.train_batch([xs], [ys])
+    assert np.isfinite(logs1["loss"]) and np.isfinite(logs2["loss"])
