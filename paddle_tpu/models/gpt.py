@@ -124,14 +124,17 @@ class GPTAttention(Layer):
             key_pos = jnp.arange(kl)[None, None, None, :]
             qry_pos = (idx + jnp.arange(s))[None, None, :, None]
             causal_mask = jnp.where(key_pos <= qry_pos, 0.0, -jnp.inf)
+            if attn_mask is not None:  # e.g. padded-prompt mask
+                causal_mask = causal_mask + attn_mask
             out = F.scaled_dot_product_attention(
                 q, k, v, attn_mask=causal_mask,
                 dropout_p=self.cfg.attention_dropout,
                 training=self.training, use_flash=False)
         else:
+            # always causal (decoder-only); an extra additive mask (e.g.
+            # padding) composes with it rather than replacing it
             out = F.scaled_dot_product_attention(
-                q, k, v, attn_mask=attn_mask,
-                is_causal=attn_mask is None,
+                q, k, v, attn_mask=attn_mask, is_causal=True,
                 dropout_p=self.cfg.attention_dropout,
                 training=self.training, use_flash=self.cfg.use_flash)
         out = self.out_proj(out.reshape(b, s, h))

@@ -21,6 +21,8 @@ from .layers.norm import (BatchNorm, BatchNorm1D, BatchNorm2D,  # noqa
                           RMSNorm, SyncBatchNorm)
 from .layers.pooling import (AdaptiveAvgPool2D, AdaptiveMaxPool2D,  # noqa
                              AvgPool1D, AvgPool2D, MaxPool1D, MaxPool2D)
+from .layers.moe import (GShardGate, MoELayer, NaiveGate,  # noqa
+                         SwitchGate, collect_aux_losses)
 from .layers.transformer import (MultiHeadAttention, Transformer,  # noqa
                                  TransformerDecoder, TransformerDecoderLayer,
                                  TransformerEncoder, TransformerEncoderLayer)

@@ -1,0 +1,232 @@
+"""Mixture-of-Experts with expert parallelism.
+
+Reference: python/paddle/incubate/distributed/models/moe/moe_layer.py:244
+``MoELayer`` with gates (gate/naive_gate.py, gshard_gate.py,
+switch_gate.py) and the counted all-to-all dispatch ops
+``global_scatter``/``global_gather``
+(paddle/fluid/operators/collective/global_scatter_op.cc,
+global_gather_op.cc) over an expert-parallel NCCL group.
+
+TPU-native design (GShard-style dense dispatch): no counted all-to-all —
+tokens are routed with capacity-bounded one-hot dispatch/combine tensors
+and einsums. Expert FFN weights are ONE stacked parameter
+[num_experts, d, ffn] carrying the logical "expert" axis; under a mesh
+with an ``ep`` axis the dispatch einsum's output is sharded expert-wise
+and XLA lowers the resharding to an all-to-all over ICI — the
+global_scatter/global_gather pair, compiled instead of hand-rolled.
+Static capacity keeps every shape compile-time constant (XLA-friendly),
+trading token dropping for no dynamic shapes — the same trade GShard and
+Switch make.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .. import functional as F
+from .. import initializer as I
+from ..layer import Layer
+
+# active aux-loss collectors (innermost last). Inside a jitted train step,
+# wrap the forward in `collect_aux_losses()` and add the result to the
+# objective — the functional analog of the reference reading
+# gate.get_loss() after forward (moe_layer.py).
+_AUX_STACK: list = []
+
+
+@contextlib.contextmanager
+def collect_aux_losses():
+    """Collect MoE gate auxiliary losses raised during forward.
+
+    Usage::
+        with collect_aux_losses() as get_aux:
+            out = model(x)
+        loss = criterion(out, y) + get_aux()
+    """
+    bucket: list = []
+    _AUX_STACK.append(bucket)
+    try:
+        yield lambda: (sum(bucket) if bucket
+                       else jnp.zeros((), jnp.float32))
+    finally:
+        _AUX_STACK.pop()
+
+
+class NaiveGate(Layer):
+    """Top-k softmax gate without auxiliary loss
+    (ref: moe/gate/naive_gate.py)."""
+
+    def __init__(self, d_model: int, num_experts: int, top_k: int = 2):
+        super().__init__()
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self.weight = self.create_parameter(
+            [d_model, num_experts], initializer=I.XavierUniform(),
+            axes=("embed", None))
+
+    def logits(self, x):
+        return jnp.einsum("gsd,de->gse", x, self.weight,
+                          preferred_element_type=jnp.float32)
+
+    def forward(self, x):
+        return self.logits(x), jnp.zeros((), jnp.float32)
+
+
+class GShardGate(NaiveGate):
+    """Top-2 gate with load-balancing auxiliary loss
+    (ref: moe/gate/gshard_gate.py; GShard paper §3.2)."""
+
+    def __init__(self, d_model: int, num_experts: int, top_k: int = 2,
+                 aux_loss_weight: float = 1e-2):
+        super().__init__(d_model, num_experts, top_k=top_k)
+        self.aux_loss_weight = aux_loss_weight
+
+    def _load_balance_aux(self, probs):
+        """fraction-of-tokens(top1) * mean-prob per expert (GShard eq.)."""
+        top1 = jnp.argmax(probs, axis=-1)                # [g, s]
+        mask1 = jax.nn.one_hot(top1, self.num_experts)
+        density = mask1.mean(axis=1)                     # [g, e]
+        density_proxy = probs.mean(axis=1)               # [g, e]
+        aux = (density * density_proxy).sum(-1).mean() * \
+            (self.num_experts ** 2) * self.aux_loss_weight
+        return aux.astype(jnp.float32)
+
+    def forward(self, x):
+        logits = self.logits(x)
+        probs = jax.nn.softmax(logits, axis=-1)          # [g, s, e]
+        return logits, self._load_balance_aux(probs)
+
+
+class SwitchGate(GShardGate):
+    """Top-1 gate (ref: moe/gate/switch_gate.py; Switch Transformer) —
+    GShard's load-balance loss with a single routed expert."""
+
+    def __init__(self, d_model: int, num_experts: int,
+                 aux_loss_weight: float = 1e-2):
+        super().__init__(d_model, num_experts, top_k=1,
+                         aux_loss_weight=aux_loss_weight)
+
+
+class ExpertFFN(Layer):
+    """All experts' FFNs as stacked weights: [e, d, ffn] / [e, ffn, d],
+    logical axis "expert" → ep mesh axis."""
+
+    def __init__(self, num_experts: int, d_model: int, d_hidden: int,
+                 activation: str = "gelu"):
+        super().__init__()
+        self.w_in = self.create_parameter(
+            [num_experts, d_model, d_hidden],
+            initializer=I.XavierUniform(),
+            axes=("expert", "embed", "mlp"))
+        self.b_in = self.create_parameter(
+            [num_experts, d_hidden], initializer=I.Constant(0.0),
+            axes=("expert", "mlp"))
+        self.w_out = self.create_parameter(
+            [num_experts, d_hidden, d_model],
+            initializer=I.XavierUniform(),
+            axes=("expert", "mlp", "embed"))
+        self.b_out = self.create_parameter(
+            [num_experts, d_model], initializer=I.Constant(0.0),
+            axes=("expert", "embed"))
+        self.act = getattr(F, activation)
+
+    def forward(self, x):  # x: [e, g, c, d] dispatched tokens
+        from ... import amp
+        x, w_in, w_out = amp.white_cast(x, self.w_in, self.w_out)
+        h = jnp.einsum("egcd,edf->egcf", x, w_in) + \
+            self.b_in[:, None, None, :].astype(x.dtype)
+        h = self.act(h)
+        out = jnp.einsum("egcf,efd->egcd", h, w_out) + \
+            self.b_out[:, None, None, :].astype(x.dtype)
+        return out
+
+
+class MoELayer(Layer):
+    """Capacity-bounded top-k MoE FFN (ref: moe_layer.py:244 MoELayer;
+    dispatch/combine replaces global_scatter/global_gather).
+
+    Input [batch, seq, d] → output [batch, seq, d]. Returns the aux
+    loss via the ``aux_loss`` attribute of the last call (also retrievable
+    functionally with ``forward_with_aux``).
+    """
+
+    def __init__(self, d_model: int, d_hidden: int, num_experts: int,
+                 gate: str = "gshard", top_k: int = 2,
+                 capacity_factor: float = 1.25,
+                 eval_capacity_factor: Optional[float] = None,
+                 activation: str = "gelu"):
+        super().__init__()
+        self.d_model = d_model
+        self.num_experts = num_experts
+        self.top_k = 1 if gate == "switch" else top_k
+        self.capacity_factor = capacity_factor
+        self.eval_capacity_factor = eval_capacity_factor or capacity_factor
+        if gate == "naive":
+            self.gate = NaiveGate(d_model, num_experts, top_k)
+        elif gate == "gshard":
+            self.gate = GShardGate(d_model, num_experts, top_k)
+        elif gate == "switch":
+            self.gate = SwitchGate(d_model, num_experts)
+        else:
+            raise ValueError(f"unknown gate {gate!r}")
+        self.experts = ExpertFFN(num_experts, d_model, d_hidden, activation)
+
+    def _capacity(self, tokens_per_group: int) -> int:
+        f = self.capacity_factor if self.training else \
+            self.eval_capacity_factor
+        cap = int(math.ceil(tokens_per_group * self.top_k * f /
+                            self.num_experts))
+        return max(cap, 4)
+
+    def forward_with_aux(self, x):
+        b, s, d = x.shape
+        xg = x.reshape(b, s, d)  # groups = batch
+        logits, aux = self.gate(xg)               # [g, s, e]
+        gates = jax.nn.softmax(logits, axis=-1)
+        c = self._capacity(s)
+        e = self.num_experts
+
+        # iterative top-k with capacity assignment (GShard dense algebra)
+        dispatch = jnp.zeros((b, s, e, c), dtype=x.dtype)
+        combine = jnp.zeros((b, s, e, c), dtype=jnp.float32)
+        # position counter per expert as we take top-1, top-2, ...
+        fill = jnp.zeros((b, e), dtype=jnp.int32)
+        g_remaining = gates
+        for _ in range(self.top_k):
+            top = jnp.argmax(g_remaining, axis=-1)           # [g, s]
+            top_mask = jax.nn.one_hot(top, e)                # [g, s, e]
+            gate_val = (gates * top_mask).sum(-1)            # [g, s]
+            # position of each token within its expert: running count
+            pos_in_expert = (jnp.cumsum(top_mask, axis=1) - top_mask) \
+                + fill[:, None, :]                           # [g, s, e]
+            pos = (pos_in_expert * top_mask).sum(-1).astype(jnp.int32)
+            keep = pos < c                                   # capacity
+            pos_oh = jax.nn.one_hot(jnp.where(keep, pos, c), c + 1,
+                                    dtype=x.dtype)[..., :c]  # [g, s, c]
+            contrib = top_mask[..., None] * pos_oh[:, :, None, :]
+            dispatch = dispatch + contrib.astype(x.dtype)
+            combine = combine + contrib * \
+                jnp.where(keep, gate_val, 0.0)[:, :, None, None]
+            fill = fill + top_mask.sum(axis=1).astype(jnp.int32)
+            g_remaining = g_remaining * (1.0 - top_mask)
+
+        # dispatch: [g, s, e, c] x [g, s, d] -> [e, g, c, d]
+        expert_in = jnp.einsum("gsec,gsd->egcd", dispatch, xg)
+        expert_out = self.experts(expert_in)                 # [e, g, c, d]
+        out = jnp.einsum("gsec,egcd->gsd",
+                         combine.astype(expert_out.dtype), expert_out)
+        return out.reshape(b, s, d), aux
+
+    def forward(self, x):
+        out, aux = self.forward_with_aux(x)
+        if _AUX_STACK:
+            _AUX_STACK[-1].append(aux)
+        elif not isinstance(aux, jax.core.Tracer):
+            # eager convenience only — never leak tracers onto the object
+            self.aux_loss = aux
+        return out

@@ -7,4 +7,5 @@ live here as Pallas kernels compiled by Mosaic, with `interpret=True`
 fallback so the same kernels run (slowly) on CPU test meshes.
 """
 
-from .flash_attention import flash_attention  # noqa: F401
+from .flash_attention import flash_attention  # noqa
+from .ring_attention import ring_attention  # noqa: F401

@@ -1,0 +1,139 @@
+"""Ring attention: exact attention over sequence-sharded activations.
+
+NEW capability relative to the reference (SURVEY.md §2.3: sequence/
+context parallelism is ABSENT there — its long-sequence story stops at
+fused/sparse attention kernels, operators/fused/fused_attention_op.cu and
+python/paddle/nn/functional/sparse_attention.py). On TPU, context
+parallelism is a natural fit for the ICI torus: each ``sp`` rank holds a
+sequence shard of Q/K/V, and K/V chunks rotate around the ring with
+``lax.ppermute`` while every rank accumulates its queries' attention over
+the full sequence using online log-sum-exp merging (Ring Attention,
+Liu et al. 2023 — blockwise-parallel transformer over a device ring).
+
+Communication pattern: P-1 ppermute steps of the local K/V chunk
+(overlapped with the block computation by XLA's latency-hiding
+scheduler); memory per chip is O(s/P) activations — sequences scale
+linearly with the ring size.
+
+Differentiation: the scan + ppermute graph is transposed by jax autodiff
+(reverse ring rotation in the backward), so no hand-written VJP is
+needed; block attention math stays in f32 log-space for stability.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _block_attention(q, k, v, sm_scale, mask):
+    """Partial attention of local queries against one K/V chunk.
+
+    q: [b, sq, h, d]; k/v: [b, sk, h, d]; mask: [sq, sk] additive or None.
+    Returns (out [b, sq, h, d] f32, lse [b, h, sq] f32) with
+    lse = -inf rows producing out = 0 (merged away by the combiner).
+    """
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * sm_scale
+    if mask is not None:
+        logits = logits + mask[None, None, :, :]
+    m = jnp.max(logits, axis=-1, keepdims=True)          # [b,h,q,1]
+    m_safe = jnp.maximum(m, NEG_INF)                     # avoid -inf - -inf
+    p = jnp.exp(logits - m_safe)
+    denom = jnp.sum(p, axis=-1, keepdims=True)
+    lse = (m_safe + jnp.log(jnp.maximum(denom, 1e-37)))[..., 0]  # [b,h,q]
+    fully_masked = denom[..., 0] <= 0.0
+    lse = jnp.where(fully_masked, NEG_INF, lse)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    out = out / jnp.maximum(denom, 1e-37).transpose(0, 2, 1, 3)
+    out = jnp.where(fully_masked.transpose(0, 2, 1)[..., None], 0.0, out)
+    return out, lse
+
+
+def _merge(o1, lse1, o2, lse2):
+    """Online combine of two partial attentions in log-space."""
+    lse = jnp.logaddexp(lse1, lse2)                       # [b,h,q]
+    w1 = jnp.exp(lse1 - lse)
+    w2 = jnp.exp(lse2 - lse)
+    o = o1 * w1.transpose(0, 2, 1)[..., None] + \
+        o2 * w2.transpose(0, 2, 1)[..., None]
+    return o, lse
+
+
+def ring_attention(q, k, v, *, causal: bool = False,
+                   sm_scale: Optional[float] = None,
+                   axis: str = "sp", mesh=None):
+    """Exact attention with Q/K/V sequence-sharded over mesh axis ``axis``.
+
+    q, k, v: [b, s_global, h, d] GLOBAL arrays (sharded or to-be-sharded
+    over the sp axis). Returns [b, s_global, h, d] with the same
+    sequence sharding. Equals full attention numerically.
+    """
+    from ..parallel.mesh import get_mesh
+    mesh = mesh or get_mesh()
+    sp = mesh.axis_size(axis)
+    b, s, h, d = q.shape
+    if s % sp:
+        raise ValueError(f"sequence {s} not divisible by sp={sp}")
+    s_local = s // sp
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+
+    if sp == 1:
+        out, _ = _block_attention(
+            q, k, v, scale,
+            _causal_mask(s, s, 0) if causal else None)
+        return out.astype(q.dtype)
+
+    spec = P(None, axis, None, None)
+
+    def per_shard(q_l, k_l, v_l):
+        rank = lax.axis_index(axis)
+        ring = [(i, (i + 1) % sp) for i in range(sp)]
+
+        rows = jnp.arange(s_local)
+        cols = jnp.arange(s_local)
+
+        def step(carry, j):
+            k_cur, v_cur, o_acc, lse_acc = carry
+            src = (rank - j) % sp  # which rank's chunk we now hold
+            if causal:
+                # global positions: q row r -> rank*s_local + r,
+                # k col c -> src*s_local + c; attend iff q_pos >= k_pos
+                q_pos = rank * s_local + rows[:, None]
+                k_pos = src * s_local + cols[None, :]
+                mask = jnp.where(q_pos >= k_pos, 0.0, NEG_INF)
+            else:
+                mask = None
+            o_j, lse_j = _block_attention(q_l, k_cur, v_cur, scale, mask)
+            o_acc, lse_acc = _merge(o_acc, lse_acc, o_j, lse_j)
+            k_nxt = lax.ppermute(k_cur, axis, ring)
+            v_nxt = lax.ppermute(v_cur, axis, ring)
+            return (k_nxt, v_nxt, o_acc, lse_acc), None
+
+        o0 = jnp.zeros(q_l.shape, jnp.float32)
+        lse0 = jnp.full((b, h, s_local), NEG_INF, jnp.float32)
+        carry, _ = _scan_helper(step, (k_l, v_l, o0, lse0), sp)
+        return carry[2].astype(q_l.dtype)
+
+    mapped = jax.shard_map(per_shard, mesh=mesh.mesh,
+                           in_specs=(spec, spec, spec),
+                           out_specs=spec, check_vma=False)
+    return mapped(q, k, v)
+
+
+def _scan_helper(step, init, n):
+    return lax.scan(step, init, jnp.arange(n))
+
+
+def _causal_mask(sq, sk, offset):
+    rows = jnp.arange(sq)[:, None] + offset
+    cols = jnp.arange(sk)[None, :]
+    return jnp.where(rows >= cols, 0.0, NEG_INF)

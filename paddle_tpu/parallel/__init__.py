@@ -18,4 +18,8 @@ from .strategy import (AMPConfig, DistributedStrategy,  # noqa
 from .api import (DataParallel, all_gather, all_reduce, barrier,  # noqa
                   broadcast, distributed_model, get_rank, get_world_size,
                   init_parallel_env)
+from .pipeline import (LayerDesc, PipelineLayer, PipelineParallel,  # noqa
+                       SharedLayerDesc, pipeline_spmd)
+from .recompute import (GradientMerge, RecomputeSequential,  # noqa
+                        recompute)
 from . import collective  # noqa

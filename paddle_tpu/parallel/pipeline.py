@@ -1,0 +1,231 @@
+"""Pipeline parallelism: SPMD microbatch pipelining over a ``pp`` mesh axis.
+
+Reference implementation being replaced:
+- dygraph: ``PipelineLayer`` with LayerDesc/SharedLayerDesc
+  (python/paddle/distributed/fleet/meta_parallel/parallel_layers/
+  pp_layers.py:162/:58/:77) and ``PipelineParallel.forward_backward_pipeline``
+  — an explicit 1F1B schedule (meta_parallel/pipeline_parallel.py:82-150)
+  over point-to-point sends (pp_utils/p2p_communication.py, partial_send/
+  recv ops).
+- static: ``PipelineTrainer``/``SectionWorker`` (framework/trainer.h:307)
+  and the FleetExecutor actor runtime (distributed/fleet_executor/).
+
+TPU-native design: there is no per-rank program — one SPMD program runs on
+every pp rank. The schedule is a ``lax.scan`` over M + P - 1 ticks inside
+``shard_map``; each tick every stage computes one microbatch (or a masked
+dummy in the fill/drain bubble) and passes its activation to the next
+stage with ``lax.ppermute`` over the ICI ring — the compiled analog of the
+reference's partial_send/recv + 1F1B loop. The backward pass is jax's
+transpose of the scan: activations flow backward through the reversed
+ppermute, giving the same bubble shape as the hand-written schedule, and
+``jax.checkpoint`` around the stage body keeps only per-tick boundary
+activations live (the 1F1B memory trade).
+
+Constraints (same as GSPMD-style pipelining everywhere): all stages run
+one shared computation graph, so stages must be structurally identical.
+Embedding/head layers stay outside the pipelined trunk (replicated over
+pp), which is how the flagship GPT composes it.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..nn.layer import Layer, LayerList, functional_call
+from .mesh import DeviceMesh, get_mesh
+
+
+# ---------------------------------------------------------------------------
+# declarative stage description (API parity with pp_layers.py)
+# ---------------------------------------------------------------------------
+
+class LayerDesc:
+    """Deferred layer construction (ref: pp_layers.py:58 LayerDesc)."""
+
+    def __init__(self, layer_cls, *args, **kwargs):
+        self.layer_cls = layer_cls
+        self.args = args
+        self.kwargs = kwargs
+
+    def build(self) -> Layer:
+        return self.layer_cls(*self.args, **self.kwargs)
+
+
+class SharedLayerDesc(LayerDesc):
+    """Weight-tied layer appearing in several stages (ref: pp_layers.py:77).
+    In the SPMD design tied weights live outside the pipelined trunk, so
+    this is kept for API parity: shared layers are hoisted out of the
+    stage list by PipelineLayer and must appear first/last."""
+
+    def __init__(self, key: str, layer_cls, *args, **kwargs):
+        super().__init__(layer_cls, *args, **kwargs)
+        self.key = key
+
+
+class PipelineLayer(Layer):
+    """Groups a flat layer list into ``num_stages`` equal stages
+    (ref: pp_layers.py:162 PipelineLayer(layers=[...], num_stages=N)).
+
+    The SPMD executor requires equal, structurally identical stages —
+    enforced here at construction."""
+
+    def __init__(self, layers: Sequence, num_stages: int):
+        super().__init__()
+        built: List[Layer] = []
+        for l in layers:
+            built.append(l.build() if isinstance(l, LayerDesc) else l)
+        if len(built) % num_stages != 0:
+            raise ValueError(
+                f"{len(built)} layers do not split evenly into "
+                f"{num_stages} stages")
+        per = len(built) // num_stages
+        self.num_stages = num_stages
+        self.layers_per_stage = per
+        stages = []
+        for s in range(num_stages):
+            from ..nn.layer import Sequential
+            stages.append(Sequential(*built[s * per:(s + 1) * per]))
+        self.stages = LayerList(stages)
+
+    def forward(self, x):
+        """Dense (non-pipelined) execution — correctness reference and
+        single-device fallback."""
+        for stage in self.stages:
+            x = stage(x)
+        return x
+
+
+# ---------------------------------------------------------------------------
+# the SPMD pipelining primitive
+# ---------------------------------------------------------------------------
+
+def _stack_stage_params(pipe: PipelineLayer):
+    """[stage0 params, ...] → one pytree with leading stage dim, plus the
+    treedef/keys needed to rebind inside stage_fn."""
+    stage_params = []
+    for stage in pipe.stages:
+        params = dict(stage.named_parameters())
+        stage_params.append(params)
+    keys = sorted(stage_params[0].keys())
+    for sp in stage_params[1:]:
+        if sorted(sp.keys()) != keys:
+            raise ValueError("pipeline stages are not structurally "
+                             "identical; SPMD pipelining requires it")
+    stacked = {k: jnp.stack([sp[k] for sp in stage_params]) for k in keys}
+    return stacked
+
+
+def pipeline_spmd(stage_fn: Callable, stacked_params, x,
+                  num_microbatches: int,
+                  mesh: Optional[DeviceMesh] = None,
+                  axis: str = "pp",
+                  mb_spec: P = P(),
+                  remat: bool = True):
+    """Run ``y = stage_{P-1}(... stage_0(x))`` pipelined over the mesh
+    axis ``axis``.
+
+    stage_fn(params_one_stage, mb) -> mb_out; every stage runs this same
+    function (SPMD). ``stacked_params``: pytree with leading dim P.
+    ``x``: [batch, ...] global input, split into ``num_microbatches``.
+    ``mb_spec``: PartitionSpec of one microbatch over the OTHER mesh axes
+    (e.g. P("dp") to keep data parallelism inside the pipeline).
+    """
+    mesh = mesh or get_mesh()
+    pp = mesh.axis_size(axis)
+    m = num_microbatches
+    b = x.shape[0]
+    if b % m:
+        raise ValueError(f"batch {b} not divisible by {m} microbatches")
+    mb_size = b // m
+    xm = x.reshape(m, mb_size, *x.shape[1:])
+
+    param_specs = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
+    in_mb_spec = P(None, *mb_spec)
+
+    body = stage_fn
+    if remat:
+        body = jax.checkpoint(stage_fn)
+
+    def per_shard(params, xm_local):
+        # params: leading dim P/pp == 1 on this rank
+        params_local = jax.tree_util.tree_map(lambda a: a[0], params)
+        rank = lax.axis_index(axis)
+        ticks = m + pp - 1
+        state0 = jnp.zeros_like(xm_local[0])
+
+        def tick(carry, t):
+            state = carry  # activation received from the previous stage
+            # stage 0 consumes microbatch t (clamped in the drain phase)
+            mb_idx = jnp.clip(t, 0, m - 1)
+            first_in = lax.dynamic_index_in_dim(xm_local, mb_idx, 0,
+                                                keepdims=False)
+            x_in = jnp.where(rank == 0, first_in, state)
+            y = body(params_local, x_in)
+            # shift activations one stage down the ring (last stage's
+            # output falls off — it is collected below)
+            nxt = lax.ppermute(y, axis,
+                               [(i, i + 1) for i in range(pp - 1)])
+            return nxt, y
+
+        _, ys = lax.scan(tick, state0, jnp.arange(ticks))
+        # last stage's valid outputs are ticks P-1 .. P-1+m
+        outs = lax.dynamic_slice_in_dim(ys, pp - 1, m, axis=0)
+        # broadcast them from the last rank to every pp rank so the head/
+        # loss (outside the pipeline, pp-replicated) sees real values
+        outs = jnp.where(rank == pp - 1, outs, jnp.zeros_like(outs))
+        outs = lax.psum(outs, axis)
+        return outs
+
+    mapped = jax.shard_map(
+        per_shard, mesh=mesh.mesh,
+        in_specs=(param_specs, in_mb_spec),
+        out_specs=in_mb_spec,
+        check_vma=False,
+    )
+    ym = mapped(stacked_params, xm)
+    return ym.reshape(b, *ym.shape[2:])
+
+
+class PipelineParallel(Layer):
+    """Wraps a PipelineLayer for pipelined execution under the current
+    mesh (ref: meta_parallel/pipeline_parallel.py PipelineParallel).
+
+    forward(x) pipelines the trunk over the pp axis with
+    ``num_microbatches`` microbatches; on a mesh without a pp axis it
+    falls back to dense execution.
+    """
+
+    def __init__(self, pipe: PipelineLayer, num_microbatches: int = 1,
+                 mesh: Optional[DeviceMesh] = None,
+                 mb_spec: P = P(), remat: bool = True):
+        super().__init__()
+        self.pipe = pipe
+        self.num_microbatches = num_microbatches
+        self._mesh = mesh
+        self._mb_spec = mb_spec
+        self._remat = remat
+
+    def forward(self, x):
+        mesh = self._mesh or get_mesh(required=False)
+        if mesh is None or mesh.axis_size("pp") <= 1:
+            return self.pipe(x)
+        if mesh.axis_size("pp") != self.pipe.num_stages:
+            raise ValueError(
+                f"mesh pp={mesh.axis_size('pp')} != "
+                f"{self.pipe.num_stages} pipeline stages")
+        stacked = _stack_stage_params(self.pipe)
+        proto = self.pipe.stages[0]
+
+        def stage_fn(params_local, mb):
+            out, _ = functional_call(proto, params_local, {}, mb)
+            return out
+
+        return pipeline_spmd(stage_fn, stacked, x,
+                             self.num_microbatches, mesh,
+                             mb_spec=self._mb_spec, remat=self._remat)

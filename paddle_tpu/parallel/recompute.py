@@ -1,0 +1,129 @@
+"""Activation recomputation + gradient accumulation.
+
+Recompute replaces the reference's PyLayer-based re-forward
+(reference: python/paddle/distributed/fleet/utils/recompute.py:350
+``recompute`` / :207 ``RecomputeFunction`` — saves inputs + RNG states,
+re-runs forward inside backward) and the static-graph rewrite pass
+(fleet/meta_optimizers/recompute_optimizer.py,
+passes/auto_parallel_recompute.py). On TPU the same trade is
+``jax.checkpoint`` (rematerialisation): XLA re-runs the checkpointed
+subgraph during the backward pass instead of keeping activations in HBM.
+RNG state restore falls out for free — dropout keys are pure function
+inputs, so the recomputed forward reproduces identical masks.
+
+Gradient merge replaces the reference's gradient_merge_optimizer
+(fleet/meta_optimizers/gradient_merge_optimizer.py) and
+GradMergeAllReduceOpHandle (framework/details/) — here a pure optimizer
+wrapper: accumulate k microbatch grads in the optimizer state and step
+once every k calls (a ``lax.cond`` on the on-device counter, so the
+merged step stays inside one compiled program).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.layer import Layer
+from ..optimizer.optimizer import Optimizer
+
+
+def recompute(function, *args, preserve_rng_state: bool = True, **kwargs):
+    """Run ``function`` normally in forward; re-run it during backward
+    instead of saving its activations (ref: fleet/utils/recompute.py:350).
+
+    ``function`` may be a Layer or any callable of traced arrays.
+    ``preserve_rng_state`` is accepted for API parity; PRNG keys are
+    explicit functional inputs here, so recomputation is always
+    bit-identical — there is no CUDA RNG state to snapshot/restore.
+    """
+    del preserve_rng_state
+    fn = function.__call__ if isinstance(function, Layer) else function
+    return jax.checkpoint(fn)(*args, **kwargs)
+
+
+class RecomputeSequential(Layer):
+    """Sequential container whose segments are rematerialised
+    (analog of applying the reference's recompute to chunks of a
+    Sequential; segments = number of checkpoint boundaries)."""
+
+    def __init__(self, *layers, segments: int = 1):
+        super().__init__()
+        from ..nn.layer import Sequential
+        if len(layers) == 1 and isinstance(layers[0], (list, tuple)):
+            layers = tuple(layers[0])
+        self.body = Sequential(*layers)
+        self.segments = max(1, segments)
+
+    def forward(self, x):
+        layers = list(self.body)
+        n = len(layers)
+        per = -(-n // self.segments)  # ceil: never more chunks than asked
+        i = 0
+        while i < n:
+            chunk = layers[i:i + per]
+
+            def run(v, chunk=chunk):
+                for l in chunk:
+                    v = l(v)
+                return v
+            x = jax.checkpoint(run)(x)
+            i += per
+        return x
+
+
+class GradientMerge:
+    """Optimizer wrapper: step every ``k_steps`` calls, accumulating
+    grads in between (ref: gradient_merge_optimizer.py; dygraph analog
+    is manual `accumulate + step every k`).
+
+    Wraps the pure `init_state/apply_gradients` API, so it composes with
+    Model's compiled train step and with sharded optimizers.
+    ``avg=True`` divides the merged grad by k (matches the reference's
+    GradientMergeOptimizer(avg=True) default).
+    """
+
+    def __init__(self, inner: Optimizer, k_steps: int, avg: bool = True):
+        self.inner = inner
+        self.k_steps = int(k_steps)
+        self.avg = avg
+        # surface the inner optimizer's config (lr schedule etc.)
+        self.lr_fn = inner.lr_fn
+        self.grad_clip = getattr(inner, "grad_clip", None)
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def init_state(self, params):
+        acc = jax.tree_util.tree_map(jnp.zeros_like, params)
+        return {"inner": self.inner.init_state(params),
+                "acc": acc,
+                "count": jnp.zeros((), jnp.int32)}
+
+    def apply_gradients(self, params, grads, state, step):
+        acc = jax.tree_util.tree_map(jnp.add, state["acc"], grads)
+        count = state["count"] + 1
+        k = self.k_steps
+
+        def do_step(operands):
+            params, acc, inner = operands
+            merged = acc
+            if self.avg:
+                merged = jax.tree_util.tree_map(lambda g: g / k, merged)
+            # LR schedule advances per *merged* step, not per microbatch
+            new_params, new_inner = self.inner.apply_gradients(
+                params, merged, inner, jnp.asarray(step) // k)
+            zeros = jax.tree_util.tree_map(jnp.zeros_like, acc)
+            return new_params, zeros, new_inner
+
+        def skip(operands):
+            return operands
+
+        params, acc, inner = jax.lax.cond(
+            count >= k, do_step, skip,
+            (params, acc, state["inner"]))
+        count = jnp.where(count >= k, 0, count)
+        return params, {"inner": inner, "acc": acc, "count": count}
