@@ -42,8 +42,10 @@ from . import hapi  # noqa: F401
 from . import parallel  # noqa: F401
 from . import models  # noqa: F401
 
-from .framework import (grad, jit, no_grad, save, load,  # noqa: F401
+from .framework import (grad, no_grad, save, load,  # noqa: F401
                         value_and_grad)
+from .framework import jit as compile  # noqa: F401  (jax.jit-style)
+from . import jit  # noqa: F401  (paddle.jit module: to_static/save/load)
 
 
 def is_compiled_with_cuda() -> bool:  # API parity helper

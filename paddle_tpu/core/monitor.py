@@ -1,0 +1,59 @@
+"""Runtime counters (ref: paddle/fluid/platform/monitor.h:80
+``StatRegistry`` + STAT_ADD/STAT_GET macros :133 — process-wide named
+int/float stats, e.g. GPU mem usage, used by PS metrics).
+
+Host-side only by design: device-side numbers (HBM usage, op times) come
+from XProf/jax.profiler; these counters cover framework-level events
+(batches loaded, checkpoints written, retries...)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Union
+
+Number = Union[int, float]
+
+
+class StatRegistry:
+    _instance = None
+    _lock = threading.Lock()
+
+    def __init__(self):
+        self._stats: Dict[str, Number] = {}
+        self._mu = threading.Lock()
+
+    @classmethod
+    def instance(cls) -> "StatRegistry":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    def add(self, name: str, value: Number = 1) -> None:
+        with self._mu:
+            self._stats[name] = self._stats.get(name, 0) + value
+
+    def set(self, name: str, value: Number) -> None:
+        with self._mu:
+            self._stats[name] = value
+
+    def get(self, name: str) -> Number:
+        with self._mu:
+            return self._stats.get(name, 0)
+
+    def snapshot(self) -> Dict[str, Number]:
+        with self._mu:
+            return dict(self._stats)
+
+    def reset(self) -> None:
+        with self._mu:
+            self._stats.clear()
+
+
+def stat_add(name: str, value: Number = 1) -> None:
+    """STAT_ADD analog (monitor.h:133)."""
+    StatRegistry.instance().add(name, value)
+
+
+def stat_get(name: str) -> Number:
+    return StatRegistry.instance().get(name)

@@ -1,0 +1,204 @@
+"""paddle_tpu.jit — program capture, saved programs, deployment.
+
+Reference being replaced:
+- ``@paddle.jit.to_static`` — a ~20-transformer AST rewriter turning
+  dygraph Python into ProgramDesc (python/paddle/fluid/dygraph/
+  dygraph_to_static/program_translator.py:239 StaticFunction, :991
+  ProgramTranslator).
+- ``paddle.jit.save/load`` — serialized inference programs + params
+  (fluid/dygraph/jit.py; static/io.py:435 save_inference_model), loaded
+  back as TranslatedLayer or served by the C++ AnalysisPredictor
+  (paddle/fluid/inference/api/analysis_predictor.h:95) / the C++ jit
+  Layer runtime (paddle/fluid/jit/layer.h).
+
+TPU-native design: program capture is jax tracing — no AST rewriting;
+``to_static`` wraps a Layer (or function) into a compiled, cached
+callable keyed by input shapes/dtypes. ``save`` exports the traced
+program as portable serialized StableHLO (jax.export) next to the
+params; ``load`` restores a TranslatedLayer whose forward executes the
+deserialized program — params are baked as captured constants or passed
+explicitly, and the artifact is servable from any PJRT runtime
+(the C++ serving path consumes the same .stablehlo bytes).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import export as jax_export
+
+from ..nn.layer import Layer, functional_call, split_state
+
+
+class InputSpec:
+    """Shape/dtype spec for traced inputs (ref: paddle.static.InputSpec)."""
+
+    def __init__(self, shape: Sequence[Optional[int]], dtype="float32",
+                 name: Optional[str] = None):
+        self.shape = tuple(shape)
+        self.dtype = jnp.dtype(dtype)
+        self.name = name
+
+    def to_aval(self):
+        # None dims become symbolic (export supports shape polymorphism);
+        # keep it simple: None → 1-polymorphic dim named by position
+        if any(d is None for d in self.shape):
+            dims = ",".join(f"b{i}" if d is None else str(d)
+                            for i, d in enumerate(self.shape))
+            return jax_export.symbolic_args_specs(
+                [jax.ShapeDtypeStruct(
+                    tuple(1 if d is None else d for d in self.shape),
+                    self.dtype)], dims)[0]
+        return jax.ShapeDtypeStruct(self.shape, self.dtype)
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype})"
+
+
+class StaticFunction:
+    """Compiled wrapper around a Layer/function
+    (ref analog: program_translator.py:239 — but capture-by-trace)."""
+
+    def __init__(self, fn_or_layer, input_spec=None):
+        self._target = fn_or_layer
+        self.input_spec = input_spec
+        self._compiled: Optional[Callable] = None
+        if isinstance(fn_or_layer, Layer):
+            self._layer = fn_or_layer
+        else:
+            self._layer = None
+
+    def _build(self):
+        if self._layer is not None:
+            layer = self._layer
+            params, buffers = split_state(layer)
+
+            def fwd(params, buffers, *args, **kwargs):
+                out, _ = functional_call(layer, params, buffers, *args,
+                                         training=False, **kwargs)
+                return out
+
+            jitted = jax.jit(fwd)
+            self._compiled = lambda *a, **kw: jitted(
+                dict(layer.named_parameters()),
+                dict(layer.named_buffers()), *a, **kw)
+        else:
+            self._compiled = jax.jit(self._target)
+
+    def __call__(self, *args, **kwargs):
+        if self._compiled is None:
+            self._build()
+        return self._compiled(*args, **kwargs)
+
+    @property
+    def layer(self):
+        return self._layer
+
+
+def to_static(fn=None, input_spec=None, **_ignored):
+    """``@paddle.jit.to_static`` analog (ref: fluid/dygraph/jit.py).
+    Tracing replaces AST transformation: Python control flow on traced
+    values must use lax.cond/scan — the same constraint the reference's
+    transpiled programs ended up with after ifelse/loop transformers."""
+    if fn is None:
+        return lambda f: to_static(f, input_spec=input_spec)
+    return StaticFunction(fn, input_spec=input_spec)
+
+
+# ---------------------------------------------------------------------------
+# save / load
+# ---------------------------------------------------------------------------
+
+_PROGRAM_FILE = "program.stablehlo"
+_PARAMS_FILE = "params.pkl"
+_META_FILE = "meta.json"
+
+
+def save(layer, path: str, input_spec: Sequence[InputSpec] = None) -> None:
+    """Export layer → serialized StableHLO + params
+    (ref: paddle.jit.save → __model__ + params; static/io.py:435).
+
+    ``path`` is used as a directory. The exported program takes
+    (params..., inputs...) explicitly so the artifact can be re-targeted
+    (params swappable at serve time — the analog of separate
+    __model__/params files).
+    """
+    if isinstance(layer, StaticFunction):
+        input_spec = input_spec or layer.input_spec
+        layer = layer.layer
+        if layer is None:
+            raise ValueError("save() needs a Layer-backed StaticFunction")
+    if input_spec is None:
+        raise ValueError("save() requires input_spec")
+    os.makedirs(path, exist_ok=True)
+    params, buffers = split_state(layer)
+
+    def fwd(params, buffers, *inputs):
+        out, _ = functional_call(layer, params, buffers, *inputs,
+                                 training=False)
+        return out
+
+    avals = [s.to_aval() if isinstance(s, InputSpec) else s
+             for s in input_spec]
+    p_avals = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+               for k, v in params.items()}
+    b_avals = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+               for k, v in buffers.items()}
+    exported = jax_export.export(jax.jit(fwd))(p_avals, b_avals, *avals)
+    with open(os.path.join(path, _PROGRAM_FILE), "wb") as f:
+        f.write(exported.serialize())
+    state = {"params": {k: np.asarray(v) for k, v in params.items()},
+             "buffers": {k: np.asarray(v) for k, v in buffers.items()}}
+    with open(os.path.join(path, _PARAMS_FILE), "wb") as f:
+        pickle.dump(state, f)
+    meta = {
+        "input_spec": [{"shape": list(getattr(s, "shape", ())),
+                        "dtype": str(getattr(s, "dtype", ""))}
+                       for s in input_spec],
+        "format_version": 1,
+    }
+    with open(os.path.join(path, _META_FILE), "w") as f:
+        json.dump(meta, f)
+
+
+class TranslatedLayer:
+    """Loaded saved program (ref: TranslatedLayer in fluid/dygraph/io.py;
+    C++ twin: paddle/fluid/jit/layer.h). Callable; params are restorable
+    and swappable (``set_state_dict``)."""
+
+    def __init__(self, exported, params, buffers):
+        self._exported = exported
+        self._params = params
+        self._buffers = buffers
+        self._call = jax.jit(exported.call)
+
+    def __call__(self, *inputs):
+        return self._call(self._params, self._buffers, *inputs)
+
+    def state_dict(self):
+        return {**self._params, **self._buffers}
+
+    def set_state_dict(self, state):
+        for k in self._params:
+            if k in state:
+                self._params[k] = jnp.asarray(state[k])
+        for k in self._buffers:
+            if k in state:
+                self._buffers[k] = jnp.asarray(state[k])
+
+
+def load(path: str) -> TranslatedLayer:
+    """ref: paddle.jit.load."""
+    with open(os.path.join(path, _PROGRAM_FILE), "rb") as f:
+        exported = jax_export.deserialize(f.read())
+    with open(os.path.join(path, _PARAMS_FILE), "rb") as f:
+        state = pickle.load(f)
+    params = {k: jnp.asarray(v) for k, v in state["params"].items()}
+    buffers = {k: jnp.asarray(v) for k, v in state["buffers"].items()}
+    return TranslatedLayer(exported, params, buffers)

@@ -125,6 +125,8 @@ class GPTAttention(Layer):
             qry_pos = (idx + jnp.arange(s))[None, None, :, None]
             causal_mask = jnp.where(key_pos <= qry_pos, 0.0, -jnp.inf)
             if attn_mask is not None:  # e.g. padded-prompt mask
+                if attn_mask.dtype == jnp.bool_:
+                    attn_mask = jnp.where(attn_mask, 0.0, -jnp.inf)
                 causal_mask = causal_mask + attn_mask
             out = F.scaled_dot_product_attention(
                 q, k, v, attn_mask=causal_mask,

@@ -22,7 +22,6 @@ needed; block attention math stays in f32 log-space for stability.
 
 from __future__ import annotations
 
-import functools
 import math
 from typing import Optional
 

