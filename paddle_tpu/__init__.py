@@ -46,6 +46,16 @@ from .framework import (grad, no_grad, save, load,  # noqa: F401
                         value_and_grad)
 from .framework import jit as compile  # noqa: F401  (jax.jit-style)
 from . import jit  # noqa: F401  (paddle.jit module: to_static/save/load)
+from . import autograd  # noqa: F401
+from . import device  # noqa: F401
+from . import distribution  # noqa: F401
+from . import distributed  # noqa: F401
+from . import profiler  # noqa: F401
+from . import regularizer  # noqa: F401
+from . import static  # noqa: F401
+from . import hub  # noqa: F401
+from . import text  # noqa: F401
+from . import vision  # noqa: F401
 
 
 def is_compiled_with_cuda() -> bool:  # API parity helper

@@ -1,0 +1,179 @@
+"""paddle_tpu.autograd — functional autodiff + PyLayer.
+
+Reference being replaced:
+- ``paddle.autograd.PyLayer`` (python/paddle/autograd/py_layer.py —
+  user-defined forward/backward with saved tensors, executed by the C++
+  eager PyLayer node, paddle/fluid/eager/pylayer/);
+- functional autodiff in incubate (python/paddle/incubate/autograd/:
+  vjp/jvp, Jacobian/Hessian classes, primitive-based autodiff
+  primops.py).
+
+TPU-native: jax IS the autograd engine — vjp/jvp/jacobian/hessian are
+direct re-exports with Paddle calling conventions, and PyLayer lowers to
+``jax.custom_vjp`` (the saved-tensor context maps to custom_vjp
+residuals). ``backward()``-style imperative autodiff is intentionally
+absent: gradients flow through ``paddle_tpu.grad`` /
+``Model``'s compiled steps (SURVEY.md §3.1's eager tape collapses into
+jax.grad of the functional forward).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def vjp(func: Callable, xs, v=None):
+    """ref: incubate/autograd/functional.py vjp(func, xs, v).
+    Returns (func(xs), vjp_result)."""
+    single = not isinstance(xs, (tuple, list))
+    args = (xs,) if single else tuple(xs)
+    out, pullback = jax.vjp(func, *args)
+    if v is None:
+        v = jnp.ones_like(out)
+    grads = pullback(v)
+    return out, grads[0] if single else grads
+
+
+def jvp(func: Callable, xs, v=None):
+    """ref: incubate/autograd/functional.py jvp."""
+    single = not isinstance(xs, (tuple, list))
+    args = (xs,) if single else tuple(xs)
+    if v is None:
+        tangents = tuple(jnp.ones_like(a) for a in args)
+    else:
+        tangents = (v,) if single else tuple(v)
+    out, tangent_out = jax.jvp(func, args, tangents)
+    return out, tangent_out
+
+
+class Jacobian:
+    """ref: incubate/autograd/functional.py Jacobian — lazy full
+    jacobian with [] indexing."""
+
+    def __init__(self, func: Callable, xs, is_batched: bool = False):
+        fn = jax.vmap(jax.jacrev(func)) if is_batched else \
+            jax.jacrev(func)
+        self._value = fn(xs)
+
+    def __getitem__(self, idx):
+        return self._value[idx]
+
+    @property
+    def shape(self):
+        return self._value.shape
+
+    def __array__(self):
+        import numpy as np
+        return np.asarray(self._value)
+
+
+class Hessian:
+    """ref: incubate/autograd/functional.py Hessian."""
+
+    def __init__(self, func: Callable, xs, is_batched: bool = False):
+        fn = jax.hessian(func)
+        if is_batched:
+            fn = jax.vmap(fn)
+        self._value = fn(xs)
+
+    def __getitem__(self, idx):
+        return self._value[idx]
+
+    @property
+    def shape(self):
+        return self._value.shape
+
+    def __array__(self):
+        import numpy as np
+        return np.asarray(self._value)
+
+
+jacobian = jax.jacrev
+hessian = jax.hessian
+grad = jax.grad
+
+
+# ---------------------------------------------------------------------------
+# PyLayer
+# ---------------------------------------------------------------------------
+
+class PyLayerContext:
+    """ref: py_layer.py PyLayerContext — save_for_backward/saved_tensor."""
+
+    def __init__(self):
+        self._saved: Tuple = ()
+        self.attrs: dict = {}
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    def saved_tensor(self):
+        return self._saved
+
+
+class PyLayerMeta(type):
+    def __init__(cls, name, bases, ns):
+        super().__init__(name, bases, ns)
+        if name == "PyLayer" or not bases:
+            return
+        cls._attrs_stack = []
+
+        @jax.custom_vjp
+        def _fn(*args):
+            ctx = PyLayerContext()
+            return cls.forward(ctx, *args)
+
+        def _fwd(*args):
+            ctx = PyLayerContext()
+            out = cls.forward(ctx, *args)
+            # residuals must be jax types: carry saved tensors + inputs;
+            # python-side ctx.attrs ride a per-class stack (fwd trace
+            # always precedes the matching bwd trace)
+            cls._attrs_stack.append(ctx.attrs)
+            return out, (ctx._saved, args)
+
+        def _bwd(res, g):
+            saved, args = res
+            ctx = PyLayerContext()
+            ctx._saved = saved
+            if cls._attrs_stack:
+                ctx.attrs = cls._attrs_stack.pop(0)
+            grads = cls.backward(ctx, g)
+            if not isinstance(grads, tuple):
+                grads = (grads,)
+            # pad with zeros for non-differentiable args
+            out = []
+            gi = iter(grads)
+            for a in args:
+                try:
+                    out.append(next(gi))
+                except StopIteration:
+                    out.append(jnp.zeros_like(a))
+            return tuple(out)
+
+        _fn.defvjp(_fwd, _bwd)
+        cls._fn = _fn
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """User-defined differentiable op (ref: paddle.autograd.PyLayer).
+
+    Subclass with static ``forward(ctx, *args)`` and
+    ``backward(ctx, grad)``; call with ``MyLayer.apply(*args)``.
+    ``ctx.save_for_backward`` carries residuals — under the hood this is
+    a ``jax.custom_vjp``, so it works inside jit/grad/vmap."""
+
+    @staticmethod
+    def forward(ctx: PyLayerContext, *args):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx: PyLayerContext, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args):
+        return cls._fn(*args)

@@ -1,0 +1,87 @@
+"""paddle_tpu.device — device management facade.
+
+Reference: python/paddle/device/ (set_device/get_device/
+is_compiled_with_*, cuda streams/events under device/cuda/). On TPU the
+runtime owns streams — XLA schedules compute/transfer overlap itself —
+so Stream/Event become synchronization-scope facades over
+block_until_ready, kept for API familiarity rather than scheduling
+control (SURVEY.md §2.4: no comm streams, no c_sync_* ordering ops)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+
+def get_all_device_type():
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_device() -> str:
+    d = jax.devices()[0]
+    return f"{d.platform}:{d.id}"
+
+
+def set_device(device: str) -> str:
+    """ref: paddle.device.set_device("gpu:0") → here "tpu"/"cpu".
+    Single-controller jax places by sharding, not a global default;
+    this validates the request and returns the canonical name."""
+    name = device.split(":")[0]
+    plats = {d.platform for d in jax.devices()}
+    if name not in plats and not (name == "tpu" and "axon" in plats):
+        raise ValueError(
+            f"device {device!r} not available; have {sorted(plats)}")
+    return get_device()
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def synchronize(device: Optional[str] = None) -> None:
+    """Block until all outstanding device work is complete
+    (ref: paddle.device.cuda.synchronize)."""
+    for d in jax.devices():
+        try:
+            d.synchronize_all_activity()  # newer PJRT
+        except AttributeError:
+            pass
+    # portable fallback: a tiny computation barriers the stream
+    jax.block_until_ready(jax.numpy.zeros(()))
+
+
+class Event:
+    """ref: device/cuda/Event — record/synchronize/elapsed via host
+    timestamps + device barriers (XLA has no user event objects)."""
+
+    def __init__(self):
+        self._t = None
+
+    def record(self):
+        import time
+        synchronize()
+        self._t = time.perf_counter()
+
+    def synchronize(self):
+        synchronize()
+
+    def elapsed_time(self, end: "Event") -> float:
+        """milliseconds between two recorded events."""
+        if self._t is None or end._t is None:
+            raise RuntimeError("record() both events first")
+        return (end._t - self._t) * 1e3
+
+
+class Stream:
+    """ref: device/cuda/Stream — a no-op scope: XLA owns stream
+    assignment; kept so portable code using `with Stream():` runs."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def synchronize(self):
+        synchronize()

@@ -1,0 +1,18 @@
+"""paddle_tpu.distributed — reference-compatible namespace.
+
+The reference exposes its distributed stack as ``paddle.distributed``
+(python/paddle/distributed/); this package re-exports the TPU-native
+implementation living in :mod:`paddle_tpu.parallel` under the familiar
+names, plus the process launcher (``python -m
+paddle_tpu.distributed.launch``)."""
+
+from ..parallel import (AXIS_ORDER, DataParallel, DeviceMesh,  # noqa
+                        DistributedStrategy, GradientMerge, LayerDesc,
+                        LogicalRules, PipelineLayer, PipelineParallel,
+                        RecomputeSequential, SharedLayerDesc, all_gather,
+                        all_reduce, barrier, broadcast, distributed_model,
+                        get_mesh, get_rank, get_world_size, init_mesh,
+                        init_parallel_env, named_sharding, pipeline_spmd,
+                        recompute, replicate, set_mesh, shard_batch,
+                        shard_params)
+from . import launch  # noqa

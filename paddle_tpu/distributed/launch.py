@@ -1,0 +1,122 @@
+"""Multi-process launcher: ``python -m paddle_tpu.distributed.launch``.
+
+Reference being replaced: ``python -m paddle.distributed.launch``
+(python/paddle/distributed/launch/__main__.py:18 → main.py; the
+CollectiveController builds a Pod of Containers, sets
+PADDLE_TRAINER_ID / PADDLE_TRAINER_ENDPOINTS / FLAGS_selected_gpus and
+spawns one process per device with a watcher that restarts failures —
+launch/controllers/collective.py, launch/job/).
+
+TPU-native scope: on TPU pods the scheduler (GKE/driver) launches one
+process per host and PJRT discovers topology — no per-chip spawning.
+This launcher covers the reference's single-host multi-process story
+(and CPU multi-process testing): it spawns N ranks with the
+PADDLE_MASTER / PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM environment
+that ``parallel.init_parallel_env`` consumes (jax.distributed
+coordination service = the TCPStore analog), streams logs per rank, and
+propagates the first failure (optionally restarting, the elastic
+watcher's job)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+
+def find_free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def launch(nproc: int, training_script: str,
+           script_args: List[str],
+           master: Optional[str] = None,
+           log_dir: Optional[str] = None,
+           max_restarts: int = 0,
+           env_extra: Optional[dict] = None) -> int:
+    """Spawn ``nproc`` ranks of ``training_script``; return exit code."""
+    master = master or f"127.0.0.1:{find_free_port()}"
+    restarts = 0
+    while True:
+        procs = []
+        logs = []
+        for rank in range(nproc):
+            env = dict(os.environ)
+            env.update(env_extra or {})
+            env["PADDLE_MASTER"] = master
+            env["MASTER_ADDR"] = master.split(":")[0]
+            env["MASTER_PORT"] = master.split(":")[1]
+            env["PADDLE_TRAINER_ID"] = str(rank)
+            env["PADDLE_TRAINERS_NUM"] = str(nproc)
+            env["RANK"] = str(rank)
+            env["WORLD_SIZE"] = str(nproc)
+            stdout = None
+            if log_dir:
+                os.makedirs(log_dir, exist_ok=True)
+                f = open(os.path.join(log_dir, f"worker.{rank}.log"), "w")
+                logs.append(f)
+                stdout = f
+            procs.append(subprocess.Popen(
+                [sys.executable, training_script, *script_args],
+                env=env, stdout=stdout,
+                stderr=subprocess.STDOUT if stdout else None))
+
+        exit_code = 0
+        try:
+            while procs:
+                for p in list(procs):
+                    rc = p.poll()
+                    if rc is None:
+                        continue
+                    procs.remove(p)
+                    if rc != 0:
+                        exit_code = rc
+                        # fail fast: kill the rest (watcher semantics)
+                        for q in procs:
+                            q.send_signal(signal.SIGTERM)
+                        for q in procs:
+                            q.wait(timeout=30)
+                        procs = []
+                        break
+                time.sleep(0.2)
+        finally:
+            for f in logs:
+                f.close()
+
+        if exit_code == 0:
+            return 0
+        restarts += 1
+        if restarts > max_restarts:
+            return exit_code
+        print(f"[launch] restart {restarts}/{max_restarts} after "
+              f"failure (code {exit_code})", file=sys.stderr)
+        master = f"127.0.0.1:{find_free_port()}"  # fresh rendezvous
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="single-host multi-process launcher "
+                    "(ref: python -m paddle.distributed.launch)")
+    parser.add_argument("--nproc_per_node", type=int, default=1)
+    parser.add_argument("--master", type=str, default=None,
+                        help="host:port rendezvous (default: free port)")
+    parser.add_argument("--log_dir", type=str, default=None)
+    parser.add_argument("--max_restarts", type=int, default=0)
+    parser.add_argument("training_script")
+    parser.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+    return launch(args.nproc_per_node, args.training_script,
+                  args.script_args, master=args.master,
+                  log_dir=args.log_dir, max_restarts=args.max_restarts)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,391 @@
+"""Probability distributions (ref: python/paddle/distribution/ —
+Distribution base distribution.py, Normal, Uniform, Categorical, Beta,
+Dirichlet, Multinomial, kl_divergence registry kl.py, transforms).
+
+TPU-native: sampling draws keys from the framework PRNG stream
+(core.rng) so eager calls are conveniently stateful while traced code
+uses key_guard — the same split the rest of the framework makes. All
+densities are jnp math (XLA-fused); reparameterized sampling where the
+reference has it (Normal/Uniform via location-scale) keeps pathwise
+gradients working.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Sequence, Tuple, Type
+
+import jax
+import jax.numpy as jnp
+
+from ..core import rng as rng_mod
+
+
+def _shape(sample_shape, batch_shape):
+    return tuple(sample_shape) + tuple(batch_shape)
+
+
+class Distribution:
+    """ref: distribution/distribution.py Distribution."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape: Sequence[int] = ()):
+        raise NotImplementedError
+
+    def rsample(self, shape: Sequence[int] = ()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return jnp.exp(self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def _key(self):
+        return rng_mod.next_key("distribution")
+
+
+class Normal(Distribution):
+    """ref: distribution/normal.py."""
+
+    def __init__(self, loc, scale):
+        self.loc = jnp.asarray(loc, jnp.float32)
+        self.scale = jnp.asarray(scale, jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return jnp.broadcast_to(self.loc, self.batch_shape)
+
+    @property
+    def variance(self):
+        return jnp.broadcast_to(self.scale ** 2, self.batch_shape)
+
+    def rsample(self, shape=()):
+        eps = jax.random.normal(self._key(),
+                                _shape(shape, self.batch_shape))
+        return self.loc + self.scale * eps
+
+    sample = rsample
+
+    def log_prob(self, value):
+        var = self.scale ** 2
+        return (-((value - self.loc) ** 2) / (2 * var)
+                - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        return jnp.broadcast_to(
+            0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale),
+            self.batch_shape)
+
+    def kl_divergence(self, other: "Normal"):
+        var_ratio = (self.scale / other.scale) ** 2
+        t1 = ((self.loc - other.loc) / other.scale) ** 2
+        return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+
+
+class Uniform(Distribution):
+    """ref: distribution/uniform.py."""
+
+    def __init__(self, low, high):
+        self.low = jnp.asarray(low, jnp.float32)
+        self.high = jnp.asarray(high, jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.low.shape,
+                                              self.high.shape))
+
+    @property
+    def mean(self):
+        return (self.low + self.high) / 2
+
+    @property
+    def variance(self):
+        return (self.high - self.low) ** 2 / 12
+
+    def rsample(self, shape=()):
+        u = jax.random.uniform(self._key(),
+                               _shape(shape, self.batch_shape))
+        return self.low + (self.high - self.low) * u
+
+    sample = rsample
+
+    def log_prob(self, value):
+        inside = (value >= self.low) & (value < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return jnp.where(inside, lp, -jnp.inf)
+
+    def entropy(self):
+        return jnp.broadcast_to(jnp.log(self.high - self.low),
+                                self.batch_shape)
+
+
+class Categorical(Distribution):
+    """ref: distribution/categorical.py (logits parameterization)."""
+
+    def __init__(self, logits=None, probs=None):
+        if (logits is None) == (probs is None):
+            raise ValueError("pass exactly one of logits/probs")
+        if probs is not None:
+            probs = jnp.asarray(probs, jnp.float32)
+            logits = jnp.log(jnp.clip(probs, 1e-37))
+        self.logits = jnp.asarray(logits, jnp.float32)
+        super().__init__(self.logits.shape[:-1])
+
+    @property
+    def probs(self):
+        return jax.nn.softmax(self.logits, axis=-1)
+
+    def sample(self, shape=()):
+        return jax.random.categorical(
+            self._key(), self.logits,
+            shape=_shape(shape, self.batch_shape))
+
+    def log_prob(self, value):
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        value = jnp.asarray(value, jnp.int32)
+        return jnp.take_along_axis(
+            logp, value[..., None], axis=-1)[..., 0]
+
+    def entropy(self):
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        return -(jnp.exp(logp) * logp).sum(-1)
+
+    def kl_divergence(self, other: "Categorical"):
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        logq = jax.nn.log_softmax(other.logits, axis=-1)
+        return (jnp.exp(logp) * (logp - logq)).sum(-1)
+
+
+class Bernoulli(Distribution):
+    """ref: distribution/bernoulli.py."""
+
+    def __init__(self, probs):
+        self.probs_ = jnp.asarray(probs, jnp.float32)
+        super().__init__(self.probs_.shape)
+
+    @property
+    def mean(self):
+        return self.probs_
+
+    @property
+    def variance(self):
+        return self.probs_ * (1 - self.probs_)
+
+    def sample(self, shape=()):
+        return jax.random.bernoulli(
+            self._key(), self.probs_,
+            shape=_shape(shape, self.batch_shape)).astype(jnp.float32)
+
+    def log_prob(self, value):
+        p = jnp.clip(self.probs_, 1e-7, 1 - 1e-7)
+        return value * jnp.log(p) + (1 - value) * jnp.log1p(-p)
+
+    def entropy(self):
+        p = jnp.clip(self.probs_, 1e-7, 1 - 1e-7)
+        return -(p * jnp.log(p) + (1 - p) * jnp.log1p(-p))
+
+
+class Beta(Distribution):
+    """ref: distribution/beta.py."""
+
+    def __init__(self, alpha, beta):
+        self.alpha = jnp.asarray(alpha, jnp.float32)
+        self.beta = jnp.asarray(beta, jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape,
+                                              self.beta.shape))
+
+    @property
+    def mean(self):
+        return self.alpha / (self.alpha + self.beta)
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return self.alpha * self.beta / (s * s * (s + 1))
+
+    def sample(self, shape=()):
+        return jax.random.beta(self._key(), self.alpha, self.beta,
+                               shape=_shape(shape, self.batch_shape))
+
+    def log_prob(self, value):
+        from jax.scipy.special import betaln
+        return ((self.alpha - 1) * jnp.log(value)
+                + (self.beta - 1) * jnp.log1p(-value)
+                - betaln(self.alpha, self.beta))
+
+    def entropy(self):
+        from jax.scipy.special import betaln, digamma
+        a, b = self.alpha, self.beta
+        return (betaln(a, b) - (a - 1) * digamma(a)
+                - (b - 1) * digamma(b)
+                + (a + b - 2) * digamma(a + b))
+
+
+class Dirichlet(Distribution):
+    """ref: distribution/dirichlet.py."""
+
+    def __init__(self, concentration):
+        self.concentration = jnp.asarray(concentration, jnp.float32)
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    @property
+    def mean(self):
+        return self.concentration / self.concentration.sum(-1,
+                                                           keepdims=True)
+
+    def sample(self, shape=()):
+        return jax.random.dirichlet(
+            self._key(), self.concentration,
+            shape=_shape(shape, self.batch_shape))
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        a = self.concentration
+        return ((jnp.log(value) * (a - 1)).sum(-1)
+                + gammaln(a.sum(-1)) - gammaln(a).sum(-1))
+
+
+class Multinomial(Distribution):
+    """ref: distribution/multinomial.py."""
+
+    def __init__(self, total_count: int, probs):
+        self.total_count = int(total_count)
+        self.probs_ = jnp.asarray(probs, jnp.float32)
+        super().__init__(self.probs_.shape[:-1], self.probs_.shape[-1:])
+
+    @property
+    def mean(self):
+        return self.total_count * self.probs_
+
+    def sample(self, shape=()):
+        n = self.probs_.shape[-1]
+        draws = jax.random.categorical(
+            self._key(), jnp.log(jnp.clip(self.probs_, 1e-37)),
+            shape=_shape(shape, self.batch_shape) + (self.total_count,))
+        return jax.nn.one_hot(draws, n).sum(-2)
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        logp = jnp.log(jnp.clip(self.probs_, 1e-37))
+        return (gammaln(self.total_count + 1.0)
+                - gammaln(value + 1.0).sum(-1)
+                + (value * logp).sum(-1))
+
+
+class Laplace(Distribution):
+    """ref: distribution/laplace.py."""
+
+    def __init__(self, loc, scale):
+        self.loc = jnp.asarray(loc, jnp.float32)
+        self.scale = jnp.asarray(scale, jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return jnp.broadcast_to(self.loc, self.batch_shape)
+
+    @property
+    def variance(self):
+        return jnp.broadcast_to(2 * self.scale ** 2, self.batch_shape)
+
+    def rsample(self, shape=()):
+        u = jax.random.uniform(self._key(),
+                               _shape(shape, self.batch_shape),
+                               minval=-0.5, maxval=0.5)
+        return self.loc - self.scale * jnp.sign(u) * jnp.log1p(
+            -2 * jnp.abs(u))
+
+    sample = rsample
+
+    def log_prob(self, value):
+        return (-jnp.abs(value - self.loc) / self.scale
+                - jnp.log(2 * self.scale))
+
+    def entropy(self):
+        return jnp.broadcast_to(1 + jnp.log(2 * self.scale),
+                                self.batch_shape)
+
+
+class Gumbel(Distribution):
+    """ref: distribution/gumbel.py."""
+
+    def __init__(self, loc, scale):
+        self.loc = jnp.asarray(loc, jnp.float32)
+        self.scale = jnp.asarray(scale, jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return self.loc + self.scale * 0.5772156649015329
+
+    def rsample(self, shape=()):
+        g = jax.random.gumbel(self._key(),
+                              _shape(shape, self.batch_shape))
+        return self.loc + self.scale * g
+
+    sample = rsample
+
+    def log_prob(self, value):
+        z = (value - self.loc) / self.scale
+        return -(z + jnp.exp(-z)) - jnp.log(self.scale)
+
+
+# ---------------------------------------------------------------------------
+# KL registry (ref: distribution/kl.py kl_divergence + register_kl)
+# ---------------------------------------------------------------------------
+
+_KL_REGISTRY: Dict[Tuple[Type, Type], callable] = {}
+
+
+def register_kl(type_p: Type, type_q: Type):
+    def deco(fn):
+        _KL_REGISTRY[(type_p, type_q)] = fn
+        return fn
+    return deco
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    fn = _KL_REGISTRY.get((type(p), type(q)))
+    if fn is not None:
+        return fn(p, q)
+    if type(p) is type(q) and hasattr(p, "kl_divergence"):
+        return p.kl_divergence(q)
+    raise NotImplementedError(
+        f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+
+
+@register_kl(Uniform, Normal)
+def _kl_uniform_normal(p: Uniform, q: Normal):
+    # E_p[log p - log q] in closed form
+    width = p.high - p.low
+    mean = (p.low + p.high) / 2
+    e_x2 = (p.low ** 2 + p.low * p.high + p.high ** 2) / 3
+    return (-jnp.log(width)
+            + jnp.log(q.scale) + 0.5 * math.log(2 * math.pi)
+            + (e_x2 - 2 * q.loc * mean + q.loc ** 2)
+            / (2 * q.scale ** 2))

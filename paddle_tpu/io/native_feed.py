@@ -85,6 +85,10 @@ class FileDataFeed:
         self._groups: List[Tuple[str, int]] = []
         for item in schema.split(","):
             ty, w = item.split(":")
+            if ty not in ("f32", "i64"):
+                raise ValueError(
+                    f"schema type {ty!r} not supported (f32/i64 only); "
+                    "the native engine would silently parse it as f32")
             self._groups.append((ty, int(w)))
 
     def __iter__(self):

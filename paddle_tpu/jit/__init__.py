@@ -77,17 +77,29 @@ class StaticFunction:
     def _build(self):
         if self._layer is not None:
             layer = self._layer
-            params, buffers = split_state(layer)
 
-            def fwd(params, buffers, *args, **kwargs):
-                out, _ = functional_call(layer, params, buffers, *args,
-                                         training=False, **kwargs)
+            def fwd(training, params, buffers, *args, **kwargs):
+                out, new_buf = functional_call(layer, params, buffers,
+                                               *args, training=training,
+                                               **kwargs)
+                return out, new_buf
+
+            jitted = jax.jit(fwd, static_argnums=(0,))
+
+            def run(*a, **kw):
+                # honor the layer's live train/eval mode (one compiled
+                # program per mode); training mode also writes mutated
+                # buffers (BN stats) back, matching eager semantics
+                training = layer.training
+                out, new_buf = jitted(
+                    training, dict(layer.named_parameters()),
+                    dict(layer.named_buffers()), *a, **kw)
+                if training:
+                    for k, v in new_buf.items():
+                        layer._assign_by_path(k, v)
                 return out
 
-            jitted = jax.jit(fwd)
-            self._compiled = lambda *a, **kw: jitted(
-                dict(layer.named_parameters()),
-                dict(layer.named_buffers()), *a, **kw)
+            self._compiled = run
         else:
             self._compiled = jax.jit(self._target)
 

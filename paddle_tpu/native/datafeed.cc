@@ -19,7 +19,7 @@
 //   ptdf_create(schema, sep, batch, nthreads, qcap, shuffle, seed)
 //   ptdf_add_file(h, path)
 //   ptdf_start(h)
-//   ptdf_next(h, out_ptrs[], out_rows*)   -> 1 ok, 0 end-of-data
+//   ptdf_next(h, out_ptrs[])              -> rows filled, 0 = end
 //   ptdf_destroy(h)
 
 #include <atomic>

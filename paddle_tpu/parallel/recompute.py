@@ -92,6 +92,8 @@ class GradientMerge:
         # lr_fn/grad_clip etc. delegate to inner via __getattr__
 
     def __getattr__(self, name):
+        if name == "inner":  # not yet set (unpickling) — avoid recursion
+            raise AttributeError(name)
         return getattr(self.inner, name)
 
     def init_state(self, params):

@@ -180,11 +180,14 @@ class Profiler:
         _events.active = False
 
     def _transition(self, new_state: ProfilerState):
+        # RECORD_AND_RETURN marks a cycle boundary: the trace closes (and
+        # on_trace_ready fires) even if the next state records again
+        if self._state == ProfilerState.RECORD_AND_RETURN:
+            self._stop_trace()
         if new_state in (ProfilerState.RECORD,
                          ProfilerState.RECORD_AND_RETURN):
             self._start_trace()
-        elif self._state in (ProfilerState.RECORD,
-                             ProfilerState.RECORD_AND_RETURN):
+        elif self._state == ProfilerState.RECORD:
             self._stop_trace()
         self._state = new_state
 
@@ -198,7 +201,9 @@ class Profiler:
     # -- host-side stats (ref: profiler/profiler_statistic.py tables) ----
     def summary(self, sorted_by: str = "total") -> str:
         rows = []
-        for name, times in _events.stats.items():
+        with _events.lock:
+            snapshot = {k: list(v) for k, v in _events.stats.items()}
+        for name, times in snapshot.items():
             rows.append((name, len(times), sum(times),
                          sum(times) / len(times), max(times)))
         key = {"total": 2, "avg": 3, "max": 4, "calls": 1}[sorted_by]

@@ -1,0 +1,42 @@
+"""Weight regularizers (ref: python/paddle/fluid/regularizer.py —
+L1Decay/L2Decay appended as grad-transform ops by the optimizer).
+
+TPU-native: a regularizer is a pure penalty over the param pytree; the
+optimizer applies it as a gradient transform (decoupled L2 lives in
+AdamW's weight_decay instead, matching the reference's split between
+L2Decay-as-regularizer and AdamW)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class L1Decay:
+    """ref: regularizer.py L1Decay(regularization_coeff)."""
+
+    def __init__(self, coeff: float = 0.0):
+        self.coeff = coeff
+
+    def penalty(self, params) -> jax.Array:
+        leaves = jax.tree_util.tree_leaves(params)
+        return self.coeff * sum(jnp.abs(p).sum() for p in leaves)
+
+    def grad_transform(self, grads, params):
+        return jax.tree_util.tree_map(
+            lambda g, p: g + self.coeff * jnp.sign(p), grads, params)
+
+
+class L2Decay:
+    """ref: regularizer.py L2Decay(regularization_coeff)."""
+
+    def __init__(self, coeff: float = 0.0):
+        self.coeff = coeff
+
+    def penalty(self, params) -> jax.Array:
+        leaves = jax.tree_util.tree_leaves(params)
+        return 0.5 * self.coeff * sum((p * p).sum() for p in leaves)
+
+    def grad_transform(self, grads, params):
+        return jax.tree_util.tree_map(
+            lambda g, p: g + self.coeff * p, grads, params)

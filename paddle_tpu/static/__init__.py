@@ -1,0 +1,60 @@
+"""paddle_tpu.static — static-graph facade.
+
+Reference: python/paddle/static/ — Program/Executor world with
+``save/load_inference_model`` (static/io.py:435/685), static ``nn``
+layers, ``InputSpec``. SURVEY.md §7's design stance: the reference's
+dual dygraph/static worlds collapse into ONE traced definition here, so
+this module is a thin compatibility facade:
+
+- ``InputSpec`` — shared with paddle_tpu.jit;
+- ``save_inference_model`` / ``load_inference_model`` — the deployment
+  artifact is jit.save's serialized StableHLO + params;
+- ``Executor`` — runs loaded inference programs (the NaiveExecutor-style
+  serving loop; the training Executor is Model's compiled step).
+
+There is deliberately no ProgramDesc/BlockDesc IR: XLA HLO is the IR,
+produced by tracing (SURVEY.md L5 → jit mapping)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from .. import jit as _jit
+from ..jit import InputSpec  # noqa: F401
+
+
+def save_inference_model(path_prefix: str, feed_vars, fetch_vars=None,
+                         executor=None, layer=None,
+                         input_spec: Sequence = None, **_ignored):
+    """ref: static/io.py:435. TPU form: pass the Layer (or let
+    ``feed_vars`` be the Layer for convenience) + input_spec."""
+    target = layer if layer is not None else feed_vars
+    spec = input_spec or fetch_vars
+    if not hasattr(target, "forward") and not callable(target):
+        raise ValueError(
+            "save_inference_model needs the model Layer: "
+            "save_inference_model(path, layer, input_spec=[...])")
+    _jit.save(target, path_prefix, input_spec=spec)
+
+
+def load_inference_model(path_prefix: str, executor=None, **_ignored):
+    """ref: static/io.py:685 → returns the loaded callable program."""
+    return _jit.load(path_prefix)
+
+
+class Executor:
+    """Serving-run facade (ref: fluid/executor.py Executor.run — the
+    inference direction only; training goes through Model/jit)."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program, feed=None, fetch_list=None):
+        """``program`` is a TranslatedLayer from load_inference_model;
+        ``feed`` a dict or list of input arrays (ordered)."""
+        if feed is None:
+            raise ValueError("feed required")
+        inputs = list(feed.values()) if isinstance(feed, dict) else \
+            list(feed)
+        out = program(*inputs)
+        return out if isinstance(out, (list, tuple)) else [out]

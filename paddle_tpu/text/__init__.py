@@ -1,0 +1,108 @@
+"""paddle_tpu.text (ref: python/paddle/text/ — NLP datasets +
+ViterbiDecoder  viterbi_decode.py).
+
+Datasets follow the vision pattern: local standard formats only
+(zero-egress). The decoder is the compute piece: CRF viterbi decoding
+as a lax.scan — batched, jittable, TPU-resident, replacing the
+reference's viterbi_decode C++ op (paddle/fluid/operators/
+viterbi_decode_op.cc)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn.layer import Layer
+
+
+def viterbi_decode(potentials, transitions, lengths=None,
+                   include_bos_eos_tag: bool = False):
+    """Most-likely tag path per sequence.
+
+    potentials: [batch, seq, ntags] emission scores;
+    transitions: [ntags, ntags] (transitions[i, j]: score of i→j);
+    lengths: [batch] valid lengths (default: full).
+    Returns (scores [batch], paths [batch, seq]).
+    ref: python/paddle/text/viterbi_decode.py ViterbiDecoder.
+    """
+    potentials = jnp.asarray(potentials)
+    transitions = jnp.asarray(transitions)
+    b, s, n = potentials.shape
+    if lengths is None:
+        lengths = jnp.full((b,), s, jnp.int32)
+    lengths = jnp.asarray(lengths, jnp.int32)
+
+    def step(carry, t):
+        alpha = carry                     # [b, n] best score ending in tag
+        emit = potentials[:, t]           # [b, n]
+        # score[i, j] = alpha[i] + trans[i, j] + emit[j]
+        scores = alpha[:, :, None] + transitions[None, :, :] + \
+            emit[:, None, :]
+        best_prev = jnp.argmax(scores, axis=1)        # [b, n]
+        best_score = jnp.max(scores, axis=1)          # [b, n]
+        # frozen past the sequence end
+        active = (t < lengths)[:, None]
+        alpha = jnp.where(active, best_score, alpha)
+        return alpha, jnp.where(active, best_prev,
+                                jnp.arange(n)[None, :])
+
+    alpha0 = potentials[:, 0]
+    alpha, backps = jax.lax.scan(step, alpha0, jnp.arange(1, s))
+    scores = jnp.max(alpha, axis=-1)
+    last_tag = jnp.argmax(alpha, axis=-1)             # [b]
+
+    def back(carry, bp):
+        tag = carry
+        prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+        return prev, tag
+
+    # reverse scan emits the tag at times 1..s-1 into their positions and
+    # carries the time-0 tag out
+    tag0, path_tail = jax.lax.scan(back, last_tag, backps, reverse=True)
+    paths = jnp.concatenate([tag0[:, None],
+                             path_tail.transpose(1, 0)], axis=1)  # [b, s]
+    return scores, paths
+
+
+class ViterbiDecoder(Layer):
+    """ref: paddle.text.ViterbiDecoder(transitions,
+    include_bos_eos_tag)."""
+
+    def __init__(self, transitions, include_bos_eos_tag: bool = False):
+        super().__init__()
+        self.transitions = jnp.asarray(transitions)
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
+class UCIHousing:
+    """ref: text/datasets pattern — placeholder reader for the classic
+    regression set; reads the standard housing.data file locally."""
+
+    def __init__(self, root: str, mode: str = "train"):
+        import os
+        p = os.path.join(root, "housing.data")
+        if not os.path.exists(p):
+            raise FileNotFoundError(
+                f"{p} not found; zero-egress environment needs the "
+                "standard UCI housing.data file on disk")
+        data = np.loadtxt(p)
+        x, y = data[:, :-1].astype(np.float32), data[:, -1:].astype(
+            np.float32)
+        n = int(0.8 * len(x))
+        if mode == "train":
+            self.x, self.y = x[:n], y[:n]
+        else:
+            self.x, self.y = x[n:], y[n:]
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]

@@ -1,0 +1,10 @@
+"""paddle_tpu.vision (ref: python/paddle/vision/ — models, datasets,
+transforms, ops). Models live in paddle_tpu.models; this package holds
+the data side."""
+
+from . import datasets  # noqa
+from . import transforms  # noqa
+from ..models import (LeNet, MobileNetV1, MobileNetV2, ResNet,  # noqa
+                      VGG, mobilenet_v1, mobilenet_v2, resnet18,
+                      resnet34, resnet50, resnet101, resnet152,
+                      vgg11, vgg13, vgg16, vgg19)

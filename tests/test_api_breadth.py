@@ -1,0 +1,215 @@
+"""Distribution, autograd/PyLayer, regularizer, device, static facade,
+launch CLI (ref: unittests test_distribution*, test_pylayer_op,
+test_regularizer, launch tests — SURVEY.md §2.2)."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy import stats as sps
+
+import paddle_tpu as pt
+from paddle_tpu import autograd, distribution as D, regularizer
+
+
+# -- distributions ---------------------------------------------------------
+
+def test_normal_sample_logprob_entropy():
+    d = D.Normal(loc=1.0, scale=2.0)
+    s = d.sample([20000])
+    assert abs(float(s.mean()) - 1.0) < 0.1
+    assert abs(float(s.std()) - 2.0) < 0.1
+    v = jnp.asarray([0.0, 1.0, 3.0])
+    np.testing.assert_allclose(d.log_prob(v),
+                               sps.norm(1.0, 2.0).logpdf(np.asarray(v)),
+                               atol=1e-5)
+    np.testing.assert_allclose(float(d.entropy()),
+                               sps.norm(1.0, 2.0).entropy(), atol=1e-5)
+
+
+def test_normal_kl():
+    p, q = D.Normal(0.0, 1.0), D.Normal(1.0, 2.0)
+    ref = (np.log(2.0) + (1 + 1) / (2 * 4) - 0.5)
+    np.testing.assert_allclose(float(D.kl_divergence(p, q)), ref,
+                               atol=1e-6)
+
+
+def test_uniform_and_kl_cross_family():
+    u = D.Uniform(0.0, 2.0)
+    np.testing.assert_allclose(float(u.mean), 1.0)
+    lp = u.log_prob(jnp.asarray([1.0, 3.0]))
+    assert np.isneginf(np.asarray(lp)[1])
+    kl = D.kl_divergence(u, D.Normal(0.0, 1.0))
+    assert np.isfinite(float(kl)) and float(kl) > 0
+
+
+def test_categorical():
+    d = D.Categorical(probs=jnp.asarray([0.1, 0.2, 0.7]))
+    s = np.asarray(d.sample([5000]))
+    freq = np.bincount(s, minlength=3) / 5000
+    np.testing.assert_allclose(freq, [0.1, 0.2, 0.7], atol=0.03)
+    np.testing.assert_allclose(float(d.log_prob(2)), np.log(0.7),
+                               atol=1e-5)
+    ref_ent = -(0.1 * np.log(0.1) + 0.2 * np.log(0.2) +
+                0.7 * np.log(0.7))
+    np.testing.assert_allclose(float(d.entropy()), ref_ent, atol=1e-5)
+
+
+@pytest.mark.parametrize("dist,mean", [
+    (lambda: D.Bernoulli(0.3), 0.3),
+    (lambda: D.Beta(2.0, 3.0), 0.4),
+    (lambda: D.Laplace(0.5, 1.0), 0.5),
+])
+def test_moments_match(dist, mean):
+    d = dist()
+    s = np.asarray(d.sample([20000]))
+    assert abs(s.mean() - mean) < 0.05
+
+
+def test_dirichlet_multinomial_gumbel():
+    di = D.Dirichlet(jnp.asarray([1.0, 2.0, 3.0]))
+    s = np.asarray(di.sample([1000]))
+    np.testing.assert_allclose(s.sum(-1), 1.0, atol=1e-5)
+    np.testing.assert_allclose(s.mean(0), [1 / 6, 2 / 6, 3 / 6],
+                               atol=0.05)
+    m = D.Multinomial(10, jnp.asarray([0.5, 0.5]))
+    sm = np.asarray(m.sample([500]))
+    assert (sm.sum(-1) == 10).all()
+    g = D.Gumbel(0.0, 1.0)
+    assert abs(float(np.asarray(g.sample([20000])).mean()) -
+               0.5772) < 0.05
+
+
+def test_normal_rsample_pathwise_grad():
+    def loss(mu):
+        pt.seed(0)
+        return (D.Normal(mu, 1.0).rsample([100]) ** 2).mean()
+    g = jax.grad(loss)(jnp.asarray(2.0))
+    assert abs(float(g) - 4.0) < 0.5  # d/dmu E[(mu+eps)^2] = 2mu
+
+
+# -- autograd / PyLayer ----------------------------------------------------
+
+def test_vjp_jvp():
+    f = lambda x: (x ** 2).sum()
+    x = jnp.asarray([1.0, 2.0])
+    out, g = autograd.vjp(f, x)
+    np.testing.assert_allclose(g, 2 * np.asarray(x))
+    out, t = autograd.jvp(f, x, jnp.asarray([1.0, 0.0]))
+    np.testing.assert_allclose(float(t), 2.0)
+
+
+def test_jacobian_hessian():
+    f = lambda x: jnp.stack([x[0] * x[1], x[0] ** 2])
+    x = jnp.asarray([2.0, 3.0])
+    J = autograd.Jacobian(f, x)
+    np.testing.assert_allclose(J[:], [[3.0, 2.0], [4.0, 0.0]])
+    H = autograd.Hessian(lambda x: (x ** 3).sum(), x)
+    np.testing.assert_allclose(H[:], np.diag([12.0, 18.0]))
+
+
+def test_pylayer_custom_grad():
+    class ScaledTanh(autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            y = jnp.tanh(x)
+            ctx.save_for_backward(y)
+            return y
+
+        @staticmethod
+        def backward(ctx, g):
+            (y,) = ctx.saved_tensor()
+            return g * 2.0 * (1 - y ** 2)  # deliberately 2x true grad
+
+    x = jnp.asarray([0.3, -0.7])
+    out = ScaledTanh.apply(x)
+    np.testing.assert_allclose(out, np.tanh(np.asarray(x)), atol=1e-6)
+    g = jax.grad(lambda x: ScaledTanh.apply(x).sum())(x)
+    np.testing.assert_allclose(g, 2.0 * (1 - np.tanh(np.asarray(x)) ** 2),
+                               atol=1e-6)
+    # works under jit too
+    g2 = jax.jit(jax.grad(lambda x: ScaledTanh.apply(x).sum()))(x)
+    np.testing.assert_allclose(g, g2, atol=1e-6)
+
+
+# -- regularizer / device / static ----------------------------------------
+
+def test_regularizers():
+    params = {"w": jnp.asarray([1.0, -2.0])}
+    grads = {"w": jnp.zeros(2)}
+    l1 = regularizer.L1Decay(0.1)
+    np.testing.assert_allclose(float(l1.penalty(params)), 0.3, atol=1e-6)
+    g = l1.grad_transform(grads, params)
+    np.testing.assert_allclose(g["w"], [0.1, -0.1], atol=1e-6)
+    l2 = regularizer.L2Decay(0.1)
+    np.testing.assert_allclose(float(l2.penalty(params)), 0.25,
+                               atol=1e-6)
+    g = l2.grad_transform(grads, params)
+    np.testing.assert_allclose(g["w"], [0.1, -0.2], atol=1e-6)
+
+
+def test_device_api():
+    from paddle_tpu import device
+    assert device.device_count() >= 1
+    assert ":" in device.get_device()
+    device.synchronize()
+    e1, e2 = device.Event(), device.Event()
+    e1.record()
+    (jnp.ones((64, 64)) @ jnp.ones((64, 64))).block_until_ready()
+    e2.record()
+    assert e1.elapsed_time(e2) >= 0
+    with pytest.raises(ValueError):
+        device.set_device("rocm:0")
+
+
+def test_static_facade_roundtrip(tmp_path):
+    from paddle_tpu import nn, static
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    net.eval()
+    x = jnp.asarray(np.random.RandomState(0).randn(3, 4), jnp.float32)
+    ref = np.asarray(net(x))
+    path = str(tmp_path / "inf")
+    static.save_inference_model(path, net,
+                                input_spec=[static.InputSpec([3, 4])])
+    exe = static.Executor()
+    prog = static.load_inference_model(path, exe)
+    out = exe.run(prog, feed=[x])
+    np.testing.assert_allclose(np.asarray(out[0]), ref, atol=1e-5)
+
+
+# -- launcher --------------------------------------------------------------
+
+def test_launch_spawns_ranks(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent("""
+        import os, sys
+        rank = os.environ["PADDLE_TRAINER_ID"]
+        n = os.environ["PADDLE_TRAINERS_NUM"]
+        master = os.environ["PADDLE_MASTER"]
+        open(os.path.join(sys.argv[1], f"rank{rank}.txt"), "w").write(
+            f"{rank}/{n}@{master}")
+    """))
+    out_dir = tmp_path / "out"
+    out_dir.mkdir()
+    from paddle_tpu.distributed.launch import launch
+    rc = launch(3, str(script), [str(out_dir)])
+    assert rc == 0
+    files = sorted(os.listdir(out_dir))
+    assert files == ["rank0.txt", "rank1.txt", "rank2.txt"]
+    body = open(out_dir / "rank2.txt").read()
+    assert body.startswith("2/3@")
+
+
+def test_launch_propagates_failure(tmp_path):
+    script = tmp_path / "bad.py"
+    script.write_text("import os, sys; "
+                      "sys.exit(3 if os.environ['PADDLE_TRAINER_ID'] "
+                      "== '1' else 0)")
+    from paddle_tpu.distributed.launch import launch
+    rc = launch(2, str(script), [])
+    assert rc == 3

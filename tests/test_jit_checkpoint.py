@@ -30,6 +30,23 @@ def test_to_static_layer_matches_eager():
     np.testing.assert_allclose(np.asarray(static(x)), eager, atol=1e-6)
 
 
+def test_to_static_respects_train_mode():
+    """Training mode keeps dropout live and updates BN buffers."""
+    from paddle_tpu import nn as _nn
+    net = _nn.Sequential(_nn.Linear(8, 8), _nn.BatchNorm1D(8))
+    x = jnp.asarray(np.random.RandomState(0).randn(16, 8), jnp.float32)
+    static = jit.to_static(net)
+    net.train()
+    mean_before = np.asarray(net.state_dict()["1._mean"])
+    static(x)
+    mean_after = np.asarray(net.state_dict()["1._mean"])
+    assert not np.allclose(mean_before, mean_after), \
+        "BN running stats must update in train mode"
+    net.eval()
+    out1, out2 = static(x), static(x)
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2))
+
+
 def test_to_static_function_decorator():
     @jit.to_static
     def f(x):

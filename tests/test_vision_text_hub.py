@@ -1,0 +1,160 @@
+"""vision transforms/datasets, text ViterbiDecoder, hub
+(ref: test_transforms.py, test_datasets.py, test_viterbi_decode_op.py,
+test_hub.py)."""
+
+import gzip
+import os
+import pickle
+import struct
+import textwrap
+
+import numpy as np
+import pytest
+
+from paddle_tpu import hub
+from paddle_tpu.text import ViterbiDecoder, viterbi_decode
+from paddle_tpu.vision import datasets, transforms as T
+
+
+# -- transforms ------------------------------------------------------------
+
+def test_to_tensor_and_normalize():
+    img = (np.arange(2 * 3 * 3) % 255).astype(np.uint8).reshape(3, 3, 2)
+    t = T.Compose([T.ToTensor(),
+                   T.Normalize(mean=[0.5, 0.5], std=[0.5, 0.5])])
+    out = t(img)
+    assert out.shape == (2, 3, 3)
+    assert out.dtype == np.float32
+    assert out.min() >= -1.0 and out.max() <= 1.0
+
+
+def test_resize_bilinear_and_nearest():
+    img = np.zeros((4, 4, 3), np.float32)
+    img[2:, 2:] = 1.0
+    out = T.Resize((8, 8))._apply_image(img)
+    assert out.shape == (8, 8, 3)
+    assert 0.0 < out[3, 3, 0] < 1.0  # interpolated edge
+    outn = T.Resize((8, 8), "nearest")._apply_image(img)
+    assert set(np.unique(outn)) == {0.0, 1.0}
+
+
+def test_crops_and_flip():
+    img = np.arange(36, dtype=np.float32).reshape(6, 6)
+    assert T.CenterCrop(4)._apply_image(img).shape == (4, 4)
+    assert T.RandomCrop(4)._apply_image(img).shape == (4, 4)
+    assert T.RandomCrop(8)._apply_image(img).shape == (8, 8)  # padded
+    flipped = T.RandomHorizontalFlip(prob=1.0)._apply_image(img)
+    np.testing.assert_allclose(flipped, img[:, ::-1])
+    rrc = T.RandomResizedCrop(5)._apply_image(
+        np.random.rand(16, 16, 3).astype(np.float32))
+    assert rrc.shape == (5, 5, 3)
+
+
+# -- datasets --------------------------------------------------------------
+
+def _write_mnist(root, n=10):
+    os.makedirs(root, exist_ok=True)
+    imgs = (np.arange(n * 28 * 28) % 256).astype(np.uint8)
+    with gzip.open(os.path.join(
+            root, "train-images-idx3-ubyte.gz"), "wb") as f:
+        f.write(struct.pack(">i", 2051) +
+                struct.pack(">iii", n, 28, 28) + imgs.tobytes())
+    labels = (np.arange(n) % 10).astype(np.uint8)
+    with gzip.open(os.path.join(
+            root, "train-labels-idx1-ubyte.gz"), "wb") as f:
+        f.write(struct.pack(">i", 2049) + struct.pack(">i", n) +
+                labels.tobytes())
+
+
+def test_mnist_idx_reader(tmp_path):
+    _write_mnist(str(tmp_path))
+    ds = datasets.MNIST(str(tmp_path), mode="train")
+    assert len(ds) == 10
+    img, lbl = ds[3]
+    assert img.shape == (1, 28, 28) and img.dtype == np.float32
+    assert lbl == 3
+
+
+def test_mnist_missing_raises(tmp_path):
+    with pytest.raises(FileNotFoundError, match="no network"):
+        datasets.MNIST(str(tmp_path / "nope"))
+
+
+def test_cifar_reader(tmp_path):
+    d = tmp_path / "cifar-10-batches-py"
+    d.mkdir()
+    for i in range(1, 6):
+        with open(d / f"data_batch_{i}", "wb") as f:
+            pickle.dump({b"data": np.zeros((5, 3072), np.uint8),
+                         b"labels": [i % 10] * 5}, f)
+    ds = datasets.Cifar10(str(tmp_path), mode="train")
+    assert len(ds) == 25
+    img, lbl = ds[0]
+    assert img.shape == (3, 32, 32)
+
+
+def test_dataset_folder_npy(tmp_path):
+    for cls in ["cat", "dog"]:
+        os.makedirs(tmp_path / cls)
+        for i in range(3):
+            np.save(tmp_path / cls / f"{i}.npy",
+                    np.ones((8, 8, 3), np.float32))
+    ds = datasets.DatasetFolder(str(tmp_path))
+    assert ds.classes == ["cat", "dog"]
+    assert len(ds) == 6
+    img, lbl = ds[5]
+    assert img.shape == (8, 8, 3) and lbl == 1
+
+
+# -- viterbi ---------------------------------------------------------------
+
+def _brute_force_viterbi(pot, trans):
+    s, n = pot.shape
+    import itertools
+    best, path = -1e30, None
+    for tags in itertools.product(range(n), repeat=s):
+        sc = pot[0, tags[0]] + sum(
+            trans[tags[t - 1], tags[t]] + pot[t, tags[t]]
+            for t in range(1, s))
+        if sc > best:
+            best, path = sc, tags
+    return best, list(path)
+
+
+def test_viterbi_matches_brute_force():
+    rs = np.random.RandomState(0)
+    pot = rs.randn(2, 5, 3).astype(np.float32)
+    trans = rs.randn(3, 3).astype(np.float32)
+    scores, paths = viterbi_decode(pot, trans)
+    for b in range(2):
+        ref_s, ref_p = _brute_force_viterbi(pot[b], trans)
+        assert abs(float(scores[b]) - ref_s) < 1e-4
+        assert list(np.asarray(paths[b])) == ref_p
+
+
+def test_viterbi_decoder_layer():
+    trans = np.eye(3, dtype=np.float32)
+    dec = ViterbiDecoder(trans)
+    pot = np.zeros((1, 4, 3), np.float32)
+    pot[0, :, 1] = 1.0  # tag 1 always best
+    scores, paths = dec(pot)
+    assert list(np.asarray(paths[0])) == [1, 1, 1, 1]
+
+
+# -- hub -------------------------------------------------------------------
+
+def test_hub_local(tmp_path):
+    (tmp_path / "hubconf.py").write_text(textwrap.dedent("""
+        def tiny_model(width=4):
+            "builds a tiny model"
+            from paddle_tpu import nn
+            return nn.Linear(width, width)
+        def _private():
+            pass
+    """))
+    assert hub.list(str(tmp_path)) == ["tiny_model"]
+    assert "tiny" in hub.help(str(tmp_path), "tiny_model")
+    m = hub.load(str(tmp_path), "tiny_model", width=6)
+    assert m.weight.shape == (6, 6)
+    with pytest.raises(NotImplementedError, match="zero-egress"):
+        hub.load(str(tmp_path), "tiny_model", source="github")
