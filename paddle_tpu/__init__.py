@@ -53,7 +53,11 @@ from . import distributed  # noqa: F401
 from . import profiler  # noqa: F401
 from . import regularizer  # noqa: F401
 from . import static  # noqa: F401
+from . import fft  # noqa: F401
 from . import hub  # noqa: F401
+from . import incubate  # noqa: F401
+from . import signal  # noqa: F401
+from . import sparse  # noqa: F401
 from . import text  # noqa: F401
 from . import vision  # noqa: F401
 

@@ -1,0 +1,27 @@
+"""paddle_tpu.fft — spectral API (ref: python/paddle/fft.py over
+pocketfft C++ kernels, cmake/external/pocketfft.cmake +
+phi/kernels/funcs/fft*). On TPU, FFTs lower through XLA's FFT HLO —
+no external library."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+fft = jnp.fft.fft
+ifft = jnp.fft.ifft
+fft2 = jnp.fft.fft2
+ifft2 = jnp.fft.ifft2
+fftn = jnp.fft.fftn
+ifftn = jnp.fft.ifftn
+rfft = jnp.fft.rfft
+irfft = jnp.fft.irfft
+rfft2 = jnp.fft.rfft2
+irfft2 = jnp.fft.irfft2
+rfftn = jnp.fft.rfftn
+irfftn = jnp.fft.irfftn
+hfft = jnp.fft.hfft
+ihfft = jnp.fft.ihfft
+fftfreq = jnp.fft.fftfreq
+rfftfreq = jnp.fft.rfftfreq
+fftshift = jnp.fft.fftshift
+ifftshift = jnp.fft.ifftshift

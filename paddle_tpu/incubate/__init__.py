@@ -1,0 +1,18 @@
+"""paddle_tpu.incubate — reference-parity namespace
+(ref: python/paddle/incubate/ — MoE under distributed/models/moe,
+fused transformer layers under nn/layer/fused_transformer.py, functional
+autograd, sparse utils). The implementations live in their TPU-native
+homes; this package re-exports them under the familiar paths."""
+
+from ..autograd import Hessian, Jacobian, jvp, vjp  # noqa
+from ..nn.layers.moe import (GShardGate, MoELayer, NaiveGate,  # noqa
+                             SwitchGate)
+from ..nn.layers.sparse_embedding import (MultiSlotEmbedding,  # noqa
+                                          SparseEmbedding)
+
+# Fused-layer names (ref: incubate/nn/layer/fused_transformer.py):
+# on TPU "fused" is the compiler's job — these alias the standard layers
+# whose attention already dispatches to the Pallas flash kernel.
+from ..nn.layers.transformer import (  # noqa
+    MultiHeadAttention as FusedMultiHeadAttention,
+    TransformerEncoderLayer as FusedTransformerEncoderLayer)

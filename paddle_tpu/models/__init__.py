@@ -12,3 +12,4 @@ from .mobilenet import (MobileNetV1, MobileNetV2,  # noqa
 from .resnet import (BasicBlock, BottleneckBlock, ResNet,  # noqa
                      resnet18, resnet34, resnet50, resnet101, resnet152)
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa
+from .widedeep import DeepFM, WideDeep, synthetic_criteo  # noqa

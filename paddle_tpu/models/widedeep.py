@@ -1,0 +1,104 @@
+"""Wide&Deep + DeepFM CTR models (BASELINE config 5: Wide&Deep CTR,
+replacing the reference's PS-trained recommendation path — the model the
+Dataset/DataFeed/PS machinery existed to train; ref example pattern:
+train_from_dataset with distributed_lookup_table, SURVEY.md §3.5).
+
+Criteo-style input: dense [batch, 13] float features + sparse
+[batch, 26] categorical ids hashed into one shared table. On a mesh the
+table rows shard over fsdp (SparseEmbedding's "vocab" axis) — multi-host
+scale without a parameter server."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn.layer import Layer
+from ..nn.layers.sparse_embedding import MultiSlotEmbedding
+
+
+class WideDeep(Layer):
+    """ref model family: wide (linear over sparse) + deep (embeddings +
+    MLP), joint logit (Cheng et al. 2016; the canonical PS workload)."""
+
+    def __init__(self, num_dense: int = 13, num_slots: int = 26,
+                 vocab_size: int = 1000 * 1000, embedding_dim: int = 16,
+                 hidden: Sequence[int] = (256, 128, 64)):
+        super().__init__()
+        self.num_dense = num_dense
+        # wide: 1-dim embedding = per-feature scalar weight (sparse LR);
+        # hash_ids folds raw 2^32-range ids into the table
+        self.wide = MultiSlotEmbedding(vocab_size, 1, hash_ids=True)
+        self.wide_dense = nn.Linear(num_dense, 1)
+        # deep: shared table + MLP over [dense | slot embeddings]
+        self.embedding = MultiSlotEmbedding(vocab_size, embedding_dim,
+                                            hash_ids=True)
+        dims = [num_dense + num_slots * embedding_dim, *hidden]
+        mlp = []
+        for i in range(len(dims) - 1):
+            mlp += [nn.Linear(dims[i], dims[i + 1]), nn.ReLU()]
+        mlp.append(nn.Linear(dims[-1], 1))
+        self.deep = nn.Sequential(*mlp)
+
+    def forward(self, dense, sparse_ids):
+        wide_logit = self.wide(sparse_ids).sum(-1, keepdims=True) + \
+            self.wide_dense(dense)
+        deep_in = jnp.concatenate(
+            [dense, self.embedding(sparse_ids)], axis=-1)
+        deep_logit = self.deep(deep_in)
+        return (wide_logit + deep_logit)[:, 0]  # [batch] logits
+
+
+class DeepFM(Layer):
+    """Factorization-machine + deep tower sharing one embedding table
+    (the other canonical CTR model in the reference's PS examples)."""
+
+    def __init__(self, num_dense: int = 13, num_slots: int = 26,
+                 vocab_size: int = 1000 * 1000, embedding_dim: int = 16,
+                 hidden: Sequence[int] = (128, 64)):
+        super().__init__()
+        self.first_order = MultiSlotEmbedding(vocab_size, 1,
+                                              hash_ids=True)
+        self.dense_w = nn.Linear(num_dense, 1)
+        self.embedding = MultiSlotEmbedding(vocab_size, embedding_dim,
+                                            hash_ids=True)
+        self.num_slots = num_slots
+        self.embedding_dim = embedding_dim
+        dims = [num_dense + num_slots * embedding_dim, *hidden]
+        mlp = []
+        for i in range(len(dims) - 1):
+            mlp += [nn.Linear(dims[i], dims[i + 1]), nn.ReLU()]
+        mlp.append(nn.Linear(dims[-1], 1))
+        self.deep = nn.Sequential(*mlp)
+
+    def forward(self, dense, sparse_ids):
+        b = dense.shape[0]
+        first = self.first_order(sparse_ids).sum(-1, keepdims=True) + \
+            self.dense_w(dense)
+        flat = self.embedding(sparse_ids)            # [b, slots*dim]
+        v = flat.reshape(b, self.num_slots, self.embedding_dim)
+        # FM second order: 0.5 * ((Σv)² - Σv²)
+        sum_sq = v.sum(axis=1) ** 2
+        sq_sum = (v ** 2).sum(axis=1)
+        second = 0.5 * (sum_sq - sq_sum).sum(-1, keepdims=True)
+        deep = self.deep(jnp.concatenate([dense, flat], axis=-1))
+        return (first + second + deep)[:, 0]
+
+
+def synthetic_criteo(n: int = 1024, num_dense: int = 13,
+                     num_slots: int = 26, vocab_size: int = 10000,
+                     seed: int = 0):
+    """Synthetic click data with learnable structure: the click
+    probability depends on a few 'magic' feature ids and one dense
+    column, so models can demonstrably fit it."""
+    import numpy as np
+    rs = np.random.RandomState(seed)
+    dense = rs.randn(n, num_dense).astype(np.float32)
+    sparse = rs.randint(1, vocab_size, (n, num_slots)).astype(np.int64)
+    magic = (sparse[:, 0] % 5 == 0).astype(np.float32)
+    logit = 2.0 * magic + dense[:, 0] - 0.5
+    p = 1.0 / (1.0 + np.exp(-logit))
+    labels = (rs.rand(n) < p).astype(np.float32)
+    return dense, sparse, labels

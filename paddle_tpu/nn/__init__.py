@@ -23,6 +23,8 @@ from .layers.pooling import (AdaptiveAvgPool2D, AdaptiveMaxPool2D,  # noqa
                              AvgPool1D, AvgPool2D, MaxPool1D, MaxPool2D)
 from .layers.moe import (GShardGate, MoELayer, NaiveGate,  # noqa
                          SwitchGate, collect_aux_losses)
+from .layers.sparse_embedding import (MultiSlotEmbedding,  # noqa
+                                      SparseEmbedding)
 from .layers.transformer import (MultiHeadAttention, Transformer,  # noqa
                                  TransformerDecoder, TransformerDecoderLayer,
                                  TransformerEncoder, TransformerEncoderLayer)

@@ -1,0 +1,121 @@
+"""Sparse (large-vocab) embedding tables on the device mesh.
+
+This is the TPU re-imagining of the reference's ENTIRE parameter-server
+sparse path (SURVEY.md §2.1/2.3 PS rows): brpc PS client/server
+(paddle/fluid/distributed/ps/service/brpc_ps_client.cc), sharded
+``MemorySparseTable`` (ps/table/memory_sparse_table.h) with accessor SGD
+rules (table/sparse_sgd_rule.cc), the async ``Communicator`` push/pull
+(service/communicator/communicator.h:234), and the GPU-PS hash tables
+(framework/fleet/heter_ps/). The Python surface mirrors
+``paddle.static.nn.sparse_embedding`` / ``paddle.nn.Embedding(sparse=True)``.
+
+TPU-native design:
+- the table is ONE mesh-sharded array (logical axes ("vocab", "embed") —
+  rows sharded over fsdp, or over ep for table-parallel layouts). There
+  is no RPC: a lookup is a gather whose cross-shard traffic XLA lowers
+  to collectives over ICI — the compiled analog of pull_sparse.
+- the gradient is a scatter-add into the same sharded layout — the
+  push_sparse analog — applied by the regular (jit-compiled, sharded)
+  optimizer step. Async/geo-SGD staleness semantics are intentionally
+  NOT reproduced: synchronous SPMD steps on ICI are faster than the
+  network asynchrony the PS existed to hide.
+- padding id 0 convention for variable-length slots (CTR datasets pad
+  with 0): ``padding_idx=0`` rows embed to zeros, matching MultiSlot
+  semantics where absent features contribute nothing to the pooled slot.
+- ``hash_ids=True`` folds arbitrary (e.g. 2^32-range Criteo) ids into
+  the table with a modulo hash — the analog of the PS's key-sharding
+  hash. Without it, out-of-range ids are clamped by the XLA gather
+  (standard gather semantics), so CTR models enable hashing explicitly.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .. import initializer as I
+from ..layer import Layer
+
+
+class SparseEmbedding(Layer):
+    """Pooled sparse-slot embedding (ref: paddle.static.nn.sparse_embedding
+    + fluid MultiSlot semantics).
+
+    forward(ids): ids [batch, num_ids] int — each row is a bag of feature
+    ids (0 = padding); returns pooled [batch, embedding_dim] with
+    ``combiner`` ∈ {"sum", "mean", "sqrtn"}.
+    """
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 combiner: str = "sum", padding_idx: Optional[int] = 0,
+                 weight_attr=None, hash_ids: bool = False):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.combiner = combiner
+        self.padding_idx = padding_idx
+        self.hash_ids = hash_ids
+        init_w = weight_attr if callable(weight_attr) else \
+            I.Uniform(-1e-3, 1e-3)  # CTR-style tiny init
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], initializer=init_w,
+            axes=("vocab", "embed"))
+
+    def _fold_ids(self, ids):
+        """Map raw ids into table range, preserving the padding id."""
+        if not self.hash_ids:
+            return ids
+        folded = 1 + (ids % jnp.asarray(self.num_embeddings - 1,
+                                        ids.dtype))
+        if self.padding_idx is not None:
+            folded = jnp.where(ids == self.padding_idx,
+                               jnp.asarray(self.padding_idx, ids.dtype),
+                               folded)
+        return folded
+
+    def forward(self, ids):
+        ids = self._fold_ids(jnp.asarray(ids))
+        b, k = ids.shape
+        flat = ids.reshape(-1)
+        emb = jnp.take(self.weight, flat, axis=0, mode="clip").reshape(
+            b, k, self.embedding_dim)
+        if self.padding_idx is not None:
+            mask = (ids != self.padding_idx)[..., None]
+            emb = emb * mask.astype(emb.dtype)
+            counts = mask.sum(axis=1).astype(emb.dtype)
+        else:
+            counts = jnp.full((b, 1), float(k), emb.dtype)
+        pooled = emb.sum(axis=1)
+        if self.combiner == "mean":
+            pooled = pooled / jnp.maximum(counts, 1.0)
+        elif self.combiner == "sqrtn":
+            pooled = pooled / jnp.sqrt(jnp.maximum(counts, 1.0))
+        return pooled
+
+
+class MultiSlotEmbedding(Layer):
+    """One shared table, many slots (the MultiSlot layout of the CTR
+    pipeline: 26 categorical slots in Criteo). ids [batch, num_slots]
+    single-id-per-slot, or [batch, num_slots, ids_per_slot] bags.
+    Returns [batch, num_slots * embedding_dim] concatenated slot
+    embeddings (ref: the distributed_lookup_table op's output layout,
+    operators/pscore/distributed_lookup_table_op.cc)."""
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 combiner: str = "sum", padding_idx: Optional[int] = 0,
+                 hash_ids: bool = False):
+        super().__init__()
+        self.table = SparseEmbedding(num_embeddings, embedding_dim,
+                                     combiner=combiner,
+                                     padding_idx=padding_idx,
+                                     hash_ids=hash_ids)
+        self.embedding_dim = embedding_dim
+
+    def forward(self, ids):
+        ids = jnp.asarray(ids)
+        if ids.ndim == 2:
+            ids = ids[:, :, None]
+        b, slots, per = ids.shape
+        pooled = self.table(ids.reshape(b * slots, per))
+        return pooled.reshape(b, slots * self.embedding_dim)

@@ -1,0 +1,98 @@
+"""paddle_tpu.signal — STFT/ISTFT (ref: python/paddle/signal.py
+stft/istft over the frame + fft kernels)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+def frame(x, frame_length: int, hop_length: int, axis: int = -1):
+    """Slide overlapping frames over the time axis
+    (ref: signal.py frame op). axis=-1 → [..., frame_length, num_frames];
+    axis=0 → [num_frames, frame_length, ...] (reference layouts)."""
+    if axis not in (0, -1):
+        raise ValueError("frame supports axis=0 or axis=-1")
+    x = jnp.asarray(x)
+    if axis == 0:
+        x = jnp.moveaxis(x, 0, -1)
+    n = x.shape[-1]
+    if n < frame_length:
+        raise ValueError(
+            f"input length {n} < frame_length {frame_length}")
+    num = 1 + (n - frame_length) // hop_length
+    idx = (jnp.arange(frame_length)[None, :]
+           + hop_length * jnp.arange(num)[:, None])  # [num, frame]
+    out = x[..., idx]                                # [..., num, frame]
+    out = jnp.swapaxes(out, -1, -2)                  # [..., frame, num]
+    if axis == 0:
+        out = jnp.moveaxis(out, -1, 0)               # [num, ..., frame]
+        out = jnp.moveaxis(out, -1, 1)               # [num, frame, ...]
+    return out
+
+
+def stft(x, n_fft: int, hop_length: Optional[int] = None,
+         win_length: Optional[int] = None, window=None,
+         center: bool = True, pad_mode: str = "reflect",
+         onesided: bool = True):
+    """ref: paddle.signal.stft — returns [..., n_fft//2+1, frames]."""
+    x = jnp.asarray(x, jnp.float32)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        window = jnp.ones((win_length,), jnp.float32)
+    window = jnp.asarray(window, jnp.float32)
+    if win_length < n_fft:  # center-pad the window to n_fft
+        lpad = (n_fft - win_length) // 2
+        window = jnp.pad(window, (lpad, n_fft - win_length - lpad))
+    if center:
+        pad = n_fft // 2
+        cfg = [(0, 0)] * (x.ndim - 1) + [(pad, pad)]
+        x = jnp.pad(x, cfg, mode=pad_mode)
+    frames = frame(x, n_fft, hop_length)             # [..., n_fft, num]
+    if onesided:  # real input: rfft does half the work directly
+        return jnp.fft.rfft(frames * window[:, None], axis=-2)
+    return jnp.fft.fft(frames * window[:, None], axis=-2)
+
+
+def istft(spec, n_fft: int, hop_length: Optional[int] = None,
+          win_length: Optional[int] = None, window=None,
+          center: bool = True, length: Optional[int] = None,
+          onesided: bool = True):
+    """ref: paddle.signal.istft — overlap-add inverse."""
+    spec = jnp.asarray(spec)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        window = jnp.ones((win_length,), jnp.float32)
+    window = jnp.asarray(window, jnp.float32)
+    if win_length < n_fft:
+        lpad = (n_fft - win_length) // 2
+        window = jnp.pad(window, (lpad, n_fft - win_length - lpad))
+    if onesided:
+        frames = jnp.fft.irfft(spec, n=n_fft, axis=-2)
+    else:
+        frames = jnp.fft.ifft(spec, axis=-2).real
+    frames = frames * window[:, None]
+    num = frames.shape[-1]
+    out_len = n_fft + hop_length * (num - 1)
+    batch_shape = frames.shape[:-2]
+    # vectorized overlap-add: one scatter-add over flat positions
+    pos = (hop_length * jnp.arange(num)[:, None]
+           + jnp.arange(n_fft)[None, :]).reshape(-1)   # [num*n_fft]
+    flat = jnp.swapaxes(frames, -1, -2).reshape(
+        batch_shape + (num * n_fft,))
+    out = jnp.zeros(batch_shape + (out_len,), frames.dtype)
+    out = out.at[..., pos].add(flat)
+    norm = jnp.zeros((out_len,), jnp.float32).at[pos].add(
+        jnp.tile(window ** 2, num))
+    out = out / jnp.maximum(norm, 1e-8)
+    if center:
+        pad = n_fft // 2
+        out = out[..., pad:]  # drop left pad; right region still holds
+        if length is None:    # valid overlap — keep it when length asks
+            out = out[..., : max(out_len - 2 * pad, 0)]
+    if length is not None:
+        out = out[..., :length]
+    return out

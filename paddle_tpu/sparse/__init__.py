@@ -1,0 +1,104 @@
+"""paddle_tpu.sparse — sparse tensors (ref: paddle/phi sparse COO/CSR
+tensors, phi/core/sparse_coo_tensor.h / sparse_csr_tensor.h, kernels
+under phi/kernels/sparse/, Python surface python/paddle/incubate/sparse).
+
+TPU-native: jax.experimental.sparse.BCOO is the device format (XLA has
+no native CSR on TPU; CSR inputs are converted). Sparse matmul/SDDMM
+lower to gather/scatter + dense MXU tiles — fine for the moderate
+sparsity the reference's API targets; the CTR/embedding path uses
+nn.SparseEmbedding instead (dedicated design, SURVEY.md §7 step 8)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+
+class SparseCooTensor:
+    """ref: paddle.incubate.sparse.sparse_coo_tensor."""
+
+    def __init__(self, bcoo: jsparse.BCOO):
+        self._bcoo = bcoo
+
+    # -- constructors ----------------------------------------------------
+    @classmethod
+    def from_dense(cls, x, nse: Optional[int] = None):
+        x = jnp.asarray(x)
+        return cls(jsparse.BCOO.fromdense(x, nse=nse))
+
+    # -- paddle-style accessors -----------------------------------------
+    def indices(self):
+        return self._bcoo.indices.T  # [ndim, nnz] (paddle layout)
+
+    def values(self):
+        return self._bcoo.data
+
+    @property
+    def shape(self):
+        return self._bcoo.shape
+
+    def nnz(self):
+        return self._bcoo.nse
+
+    def to_dense(self):
+        return self._bcoo.todense()
+
+    # -- math ------------------------------------------------------------
+    def __add__(self, other):
+        if isinstance(other, SparseCooTensor):
+            # O(nnz): concatenate coordinate lists, merge duplicates
+            merged = jsparse.BCOO(
+                (jnp.concatenate([self._bcoo.data, other._bcoo.data]),
+                 jnp.concatenate([self._bcoo.indices,
+                                  other._bcoo.indices])),
+                shape=self._bcoo.shape)
+            return SparseCooTensor(merged.sum_duplicates())
+        return self.to_dense() + other
+
+    def matmul(self, dense):
+        return self._bcoo @ jnp.asarray(dense)
+
+    __matmul__ = matmul
+
+
+def sparse_coo_tensor(indices, values, shape):
+    """ref: paddle.incubate.sparse.sparse_coo_tensor(indices [ndim, nnz],
+    values [nnz], shape)."""
+    indices = jnp.asarray(indices)
+    values = jnp.asarray(values)
+    bcoo = jsparse.BCOO((values, indices.T), shape=tuple(shape))
+    return SparseCooTensor(bcoo)
+
+
+def sparse_csr_tensor(crows, cols, values, shape):
+    """ref: paddle.incubate.sparse.sparse_csr_tensor — converted to COO
+    on device (no TPU-native CSR)."""
+    crows = jnp.asarray(crows)
+    cols = jnp.asarray(cols)
+    values = jnp.asarray(values)
+    nrows = len(crows) - 1
+    counts = crows[1:] - crows[:-1]
+    rows = jnp.repeat(jnp.arange(nrows), counts,
+                      total_repeat_length=values.shape[0])
+    return sparse_coo_tensor(jnp.stack([rows, cols]), values, shape)
+
+
+def matmul(sp, dense):
+    """Sparse @ dense (ref: incubate/sparse matmul)."""
+    if isinstance(sp, SparseCooTensor):
+        return sp.matmul(dense)
+    return jnp.asarray(sp) @ jnp.asarray(dense)
+
+
+def masked_matmul(a, b, mask: "SparseCooTensor"):
+    """SDDMM: (a @ b) sampled at mask's sparsity pattern
+    (ref: incubate/sparse masked_matmul; phi sparse sddmm kernels)."""
+    a = jnp.asarray(a)
+    b = jnp.asarray(b)
+    idx = mask._bcoo.indices  # [nnz, 2]
+    rows, cols = idx[:, 0], idx[:, 1]
+    vals = jnp.einsum("nk,nk->n", a[rows, :], b[:, cols].T)
+    return SparseCooTensor(
+        jsparse.BCOO((vals, idx), shape=(a.shape[0], b.shape[1])))

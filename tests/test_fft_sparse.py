@@ -1,0 +1,91 @@
+"""fft/signal/sparse modules (ref: unittests fft/, test_signal.py,
+sparse test suite)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu import fft, signal, sparse
+
+
+def test_fft_roundtrip():
+    x = np.random.RandomState(0).randn(64).astype(np.float32)
+    X = fft.fft(x)
+    np.testing.assert_allclose(np.asarray(fft.ifft(X)).real, x,
+                               atol=1e-5)
+    Xr = fft.rfft(x)
+    assert Xr.shape == (33,)
+    np.testing.assert_allclose(np.asarray(fft.irfft(Xr, 64)), x,
+                               atol=1e-5)
+
+
+def test_stft_istft_roundtrip():
+    rs = np.random.RandomState(0)
+    x = rs.randn(2, 400).astype(np.float32)
+    win = np.hanning(128).astype(np.float32)
+    spec = signal.stft(x, n_fft=128, hop_length=32, window=win)
+    assert spec.shape[-2] == 65  # onesided bins
+    y = signal.istft(spec, n_fft=128, hop_length=32, window=win,
+                     length=400)
+    np.testing.assert_allclose(np.asarray(y), x, atol=1e-3)
+
+
+def test_frame_shapes():
+    x = jnp.arange(10.0)
+    f = signal.frame(x, frame_length=4, hop_length=2)
+    assert f.shape == (4, 4)
+    np.testing.assert_allclose(f[:, 0], [0, 1, 2, 3])
+    np.testing.assert_allclose(f[:, 1], [2, 3, 4, 5])
+
+
+def test_sparse_coo_roundtrip_and_matmul():
+    dense = np.zeros((4, 5), np.float32)
+    dense[0, 1] = 2.0
+    dense[3, 4] = -1.0
+    sp = sparse.SparseCooTensor.from_dense(dense)
+    assert sp.nnz() == 2
+    np.testing.assert_allclose(np.asarray(sp.to_dense()), dense)
+    rhs = np.random.RandomState(0).randn(5, 3).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(sp @ rhs), dense @ rhs,
+                               atol=1e-5)
+
+
+def test_sparse_constructors():
+    sp = sparse.sparse_coo_tensor([[0, 1], [2, 0]], [1.5, 2.5], (2, 3))
+    dense = np.asarray(sp.to_dense())
+    assert dense[0, 2] == 1.5 and dense[1, 0] == 2.5
+    csr = sparse.sparse_csr_tensor([0, 1, 2], [2, 0], [1.5, 2.5], (2, 3))
+    np.testing.assert_allclose(np.asarray(csr.to_dense()), dense)
+
+
+def test_frame_axis0_layout():
+    x = np.arange(20.0).reshape(10, 2)  # [time, batch]
+    f = signal.frame(x, frame_length=4, hop_length=2, axis=0)
+    assert f.shape == (4, 4, 2)  # [num, frame, batch]
+    np.testing.assert_allclose(np.asarray(f[1, :, 0]), [4, 6, 8, 10])
+    with pytest.raises(ValueError, match="frame_length"):
+        signal.frame(np.arange(3.0), 8, 4)
+
+
+def test_sparse_add_stays_sparse():
+    a = sparse.sparse_coo_tensor([[0, 1], [1, 1]], [1.0, 2.0], (3, 3))
+    b = sparse.sparse_coo_tensor([[0, 2], [1, 0]], [5.0, 7.0], (3, 3))
+    c = a + b
+    dense = np.asarray(c.to_dense())
+    assert dense[0, 1] == 6.0 and dense[1, 1] == 2.0 and dense[2, 0] == 7.0
+
+
+def test_masked_matmul_sddmm():
+    rs = np.random.RandomState(0)
+    a = rs.randn(4, 6).astype(np.float32)
+    b = rs.randn(6, 5).astype(np.float32)
+    mask_dense = np.zeros((4, 5), np.float32)
+    mask_dense[1, 2] = 1.0
+    mask_dense[3, 0] = 1.0
+    mask = sparse.SparseCooTensor.from_dense(mask_dense)
+    out = sparse.masked_matmul(a, b, mask)
+    full = a @ b
+    out_d = np.asarray(out.to_dense())
+    np.testing.assert_allclose(out_d[1, 2], full[1, 2], atol=1e-5)
+    np.testing.assert_allclose(out_d[3, 0], full[3, 0], atol=1e-5)
+    assert out_d[0, 0] == 0.0
