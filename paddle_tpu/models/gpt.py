@@ -48,6 +48,7 @@ class GPTConfig:
     layer_norm_epsilon: float = 1e-5
     tie_word_embeddings: bool = True
     use_flash: bool = True
+    remat: bool = False  # rematerialize each block (jax.checkpoint)
 
     def __post_init__(self):
         if self.ffn_hidden_size is None:
@@ -236,6 +237,10 @@ class GPTModel(Layer):
             if caches is not None:
                 x, c = layer(x, attn_mask=attn_mask, cache=caches[i])
                 new_caches.append(c)
+            elif self.cfg.remat:
+                # trade FLOPs for HBM: recompute the block in backward
+                x = jax.checkpoint(
+                    lambda x, l=layer: l(x, attn_mask=attn_mask))(x)
             else:
                 x = layer(x, attn_mask=attn_mask)
         x = self.ln_f(x)
@@ -261,8 +266,10 @@ class GPTForCausalLM(Layer):
             from .. import amp
             w = self.gpt.embeddings.word_embeddings.weight  # [V, H]
             hidden, w = amp.white_cast(hidden, w)
-            return jnp.einsum("bsh,vh->bsv", hidden, w,
-                              preferred_element_type=jnp.float32)
+            # logits stay in the compute dtype (bf16 under AMP): the
+            # [b, s, vocab] buffer dominates HBM and the loss upcasts to
+            # f32 for its log-softmax anyway (F.cross_entropy)
+            return jnp.einsum("bsh,vh->bsv", hidden, w)
         return self.lm_head(hidden)
 
     def forward(self, input_ids, position_ids=None, attn_mask=None,
