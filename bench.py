@@ -1,13 +1,27 @@
 """Benchmark harness — prints ONE JSON line for the driver.
 
-Headline: GPT-2-small causal-LM training throughput (tokens/sec) on the
-available hardware (real TPU chip under the driver; CPU otherwise) —
-the flagship transformer path: Pallas flash attention, bf16 AMP (O1),
-fused AdamW step, donated buffers. The measured step is the same
-compiled step `paddle_tpu.Model.fit` runs — framework end-to-end, not a
-kernel in isolation. `vs_baseline` is 1.0: the reference publishes no
-in-tree numbers (BASELINE.md — `published == {}`), so the baseline is
-this framework's own first measurement.
+Covers the operative BASELINE.md configs on the available hardware
+(real TPU chip under the driver; CPU smoke otherwise):
+
+  - GPT-2-small causal-LM training  (BASELINE config 4 family; headline)
+  - ResNet-50 ImageNet-shape training (BASELINE config 2)
+  - BERT-base pretraining            (BASELINE config 3)
+
+Each sub-benchmark reports throughput AND MFU (model FLOPs per second /
+chip bf16 peak), so the number carries its own context. The measured
+step is the same compiled step `paddle_tpu.Model.fit` runs — framework
+end-to-end, not a kernel in isolation. Timing loops enqueue steps
+asynchronously and block once on the final result (the trainer no longer
+syncs per step).
+
+FLOPs accounting (standard MFU conventions, PaLM appendix B):
+  transformer train FLOPs/token = 6*N_params + attention term
+    (causal GPT: 6*L*s*H; bidirectional BERT: 12*L*s*H)
+  resnet: 3x forward FLOPs, forward measured analytically per conv.
+
+``vs_baseline`` compares the headline GPT tokens/sec against round 1's
+measured 47224.8 (BENCH_r01.json) — the reference publishes no in-tree
+numbers (BASELINE.md: `published == {}`).
 """
 
 from __future__ import annotations
@@ -18,19 +32,67 @@ import time
 
 import numpy as np
 
+ROUND1_GPT_TOKENS_PER_SEC = 47224.8
+
+# bf16 peak FLOP/s per chip by device kind (public figures)
+PEAK_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5litepod": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+
+def chip_peak_flops():
+    import jax
+    d = jax.devices()[0]
+    return PEAK_FLOPS.get(getattr(d, "device_kind", ""), None)
+
+
+def param_count(net) -> int:
+    from paddle_tpu.nn.layer import split_state
+    params, _ = split_state(net)
+    return int(sum(np.prod(v.shape) for v in params.values()))
+
+
+def _timed_steps(model, feed, warmup: int, iters: int) -> float:
+    """Run warmup steps, then time `iters` steps with one final sync."""
+    import jax
+    for _ in range(warmup):
+        logs = model.train_batch(*feed)
+    jax.block_until_ready(logs["loss"])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        logs = model.train_batch(*feed)
+    jax.block_until_ready(logs["loss"])
+    dt = time.perf_counter() - t0
+    assert np.isfinite(np.asarray(logs["loss"])), logs
+    return dt
+
+
+def _mfu(model_flops_per_sec) -> float | None:
+    peak = chip_peak_flops()
+    if peak is None or model_flops_per_sec is None:
+        return None
+    return round(model_flops_per_sec / peak, 4)
+
+
+# ---------------------------------------------------------------------------
+# config 4 family: GPT-2-small (headline)
+# ---------------------------------------------------------------------------
 
 def bench_gpt(batch: int = 8, seq: int = 1024, warmup: int = 3,
-              iters: int = 20):
-    import jax
-
+              iters: int = 20, cpu_smoke: bool = False):
     import paddle_tpu as paddle
     from paddle_tpu.models.gpt import (GPTForCausalLM,
                                        GPTPretrainingCriterion, gpt_config)
 
     paddle.seed(0)
-    # dropouts off so the flash kernel dispatches (throughput config)
-    cpu_smoke = jax.default_backend() == "cpu"
-    if cpu_smoke:  # no-TPU smoke config — reported under a distinct metric
+    if cpu_smoke:
         cfg = gpt_config("gpt2-small", num_layers=2, hidden_size=256,
                          num_heads=4, max_position_embeddings=seq,
                          hidden_dropout=0.0, attention_dropout=0.0)
@@ -45,35 +107,129 @@ def bench_gpt(batch: int = 8, seq: int = 1024, warmup: int = 3,
                                          weight_decay=0.01),
         loss=GPTPretrainingCriterion(),
         amp_configs="O1")
+    n_params = param_count(net)
 
     rng = np.random.RandomState(0)
     ids = rng.randint(0, cfg.vocab_size, (batch, seq))
+    dt = _timed_steps(model, ([ids], [ids]), warmup, iters)
+    tps = batch * seq * iters / dt
+    # causal attention: 6*L*s*H train FLOPs per token
+    flops_per_token = 6 * n_params + \
+        6 * cfg.num_layers * seq * cfg.hidden_size
+    return {"metric": "gpt2s_train_tokens_per_sec",
+            "value": round(tps, 1), "unit": "tokens/sec",
+            "batch": batch, "seq": seq, "params": n_params,
+            "mfu": _mfu(tps * flops_per_token)}
 
-    for _ in range(warmup):
-        model.train_batch([ids], [ids])
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        logs = model.train_batch([ids], [ids])
-    dt = time.perf_counter() - t0
-    assert np.isfinite(logs["loss"]), logs
-    return batch * seq * iters / dt
+
+# ---------------------------------------------------------------------------
+# config 2: ResNet-50 ImageNet-shape
+# ---------------------------------------------------------------------------
+
+RESNET50_FWD_FLOPS = 4.09e9   # per 224x224 image, 2*MACs convention
+
+
+def bench_resnet(batch: int = 128, warmup: int = 3, iters: int = 10,
+                 cpu_smoke: bool = False):
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.models.resnet import resnet50
+
+    paddle.seed(0)
+    size = 32 if cpu_smoke else 224
+    if cpu_smoke:
+        batch, iters = 4, 3
+    net = resnet50()
+    model = paddle.Model(net)
+    model.prepare(
+        optimizer=paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                            parameters=net),
+        loss=nn.CrossEntropyLoss(),
+        amp_configs="O1")
+    rng = np.random.RandomState(0)
+    imgs = rng.randn(batch, 3, size, size).astype(np.float32)
+    labels = rng.randint(0, 1000, (batch, 1))
+    dt = _timed_steps(model, ([imgs], [labels]), warmup, iters)
+    ips = batch * iters / dt
+    flops_per_img = 3 * RESNET50_FWD_FLOPS * (size / 224.0) ** 2
+    return {"metric": "resnet50_train_images_per_sec",
+            "value": round(ips, 1), "unit": "images/sec",
+            "batch": batch, "image_size": size,
+            "mfu": _mfu(ips * flops_per_img) if size == 224 else None}
+
+
+# ---------------------------------------------------------------------------
+# config 3: BERT-base pretraining
+# ---------------------------------------------------------------------------
+
+def bench_bert(batch: int = 64, seq: int = 128, warmup: int = 3,
+               iters: int = 15, cpu_smoke: bool = False):
+    import paddle_tpu as paddle
+    from paddle_tpu.models.bert import (BertForPretraining,
+                                        BertPretrainingCriterion,
+                                        bert_config)
+
+    paddle.seed(0)
+    if cpu_smoke:
+        cfg = bert_config("bert-base", num_layers=2, hidden_size=128,
+                          num_heads=2, hidden_dropout=0.0,
+                          attention_dropout=0.0)
+        batch, iters = 2, 3
+    else:
+        cfg = bert_config("bert-base", hidden_dropout=0.0,
+                          attention_dropout=0.0)
+    net = BertForPretraining(cfg)
+    model = paddle.Model(net)
+    model.prepare(
+        optimizer=paddle.optimizer.AdamW(learning_rate=1e-4, parameters=net,
+                                         weight_decay=0.01),
+        loss=BertPretrainingCriterion(),
+        amp_configs="O1")
+    n_params = param_count(net)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (batch, seq))
+    mlm_labels = np.where(rng.rand(batch, seq) < 0.15, ids, -100)
+    nsp = rng.randint(0, 2, (batch,))
+
+    dt = _timed_steps(model, ([ids], [mlm_labels, nsp]), warmup, iters)
+    sps = batch * iters / dt
+    flops_per_token = 6 * n_params + \
+        12 * cfg.num_layers * seq * cfg.hidden_size
+    return {"metric": "bertbase_train_samples_per_sec",
+            "value": round(sps, 1), "unit": "samples/sec",
+            "batch": batch, "seq": seq, "params": n_params,
+            "mfu": _mfu(sps * seq * flops_per_token)}
 
 
 def main():
+    import jax
+    cpu_smoke = jax.default_backend() == "cpu"
+    extra = {}
+    for name, fn in (("resnet50", bench_resnet), ("bert", bench_bert)):
+        try:
+            extra[name] = fn(cpu_smoke=cpu_smoke)
+        except Exception as e:  # noqa: BLE001 — report, keep the line
+            extra[name] = {"error": str(e)[:200]}
+            print(f"bench {name} failed: {e}", file=sys.stderr)
+
     metric = "gpt2s_train_tokens_per_sec"
     try:
-        import jax
-        if jax.default_backend() == "cpu":  # tiny smoke config, not GPT-2s
+        gpt = bench_gpt(cpu_smoke=cpu_smoke)
+        if cpu_smoke:
             metric = "gpt2s_smoke_cpu_tokens_per_sec"
-        tps = bench_gpt()
+        vs = round(gpt["value"] / ROUND1_GPT_TOKENS_PER_SEC, 3) \
+            if not cpu_smoke else 1.0
         print(json.dumps({"metric": metric,
-                          "value": round(float(tps), 1),
+                          "value": gpt["value"],
                           "unit": "tokens/sec",
-                          "vs_baseline": 1.0}))
+                          "vs_baseline": vs,
+                          "mfu": gpt.get("mfu"),
+                          "device": jax.devices()[0].device_kind,
+                          "extra": extra}))
     except Exception as e:  # never leave the driver without a line
-        print(json.dumps({"metric": metric,
-                          "value": 0.0, "unit": "tokens/sec",
-                          "vs_baseline": 0.0, "error": str(e)[:200]}))
+        print(json.dumps({"metric": metric, "value": 0.0,
+                          "unit": "tokens/sec", "vs_baseline": 0.0,
+                          "error": str(e)[:200], "extra": extra}))
         print(f"bench failed: {e}", file=sys.stderr)
         raise
 

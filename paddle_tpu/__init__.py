@@ -9,7 +9,7 @@ subpackages.
 
 from __future__ import annotations
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 from .core import dtype as _dtype_mod
 from .core import flags as _flags_mod

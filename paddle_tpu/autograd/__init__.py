@@ -32,7 +32,7 @@ def vjp(func: Callable, xs, v=None):
     args = (xs,) if single else tuple(xs)
     out, pullback = jax.vjp(func, *args)
     if v is None:
-        v = jnp.ones_like(out)
+        v = jax.tree_util.tree_map(jnp.ones_like, out)
     grads = pullback(v)
     return out, grads[0] if single else grads
 
@@ -114,12 +114,31 @@ class PyLayerContext:
         return self._saved
 
 
+class _StaticAttrs:
+    """Pytree-static carrier for ctx.attrs: flattens to zero leaves with
+    itself as aux_data, so trace-time Python constants ride the
+    custom_vjp residuals (correct under nesting and retracing, unlike a
+    side stack)."""
+
+    def __init__(self, d: dict):
+        self.d = d
+
+    def __eq__(self, other):
+        return isinstance(other, _StaticAttrs) and self.d == other.d
+
+    def __hash__(self):
+        return hash(tuple(sorted((k, repr(v)) for k, v in self.d.items())))
+
+
+jax.tree_util.register_pytree_node(
+    _StaticAttrs, lambda a: ((), a), lambda aux, _: aux)
+
+
 class PyLayerMeta(type):
     def __init__(cls, name, bases, ns):
         super().__init__(name, bases, ns)
         if name == "PyLayer" or not bases:
             return
-        cls._attrs_stack = []
 
         @jax.custom_vjp
         def _fn(*args):
@@ -129,18 +148,15 @@ class PyLayerMeta(type):
         def _fwd(*args):
             ctx = PyLayerContext()
             out = cls.forward(ctx, *args)
-            # residuals must be jax types: carry saved tensors + inputs;
-            # python-side ctx.attrs ride a per-class stack (fwd trace
-            # always precedes the matching bwd trace)
-            cls._attrs_stack.append(ctx.attrs)
-            return out, (ctx._saved, args)
+            # residuals: saved tensors + inputs (jax types) and the
+            # trace-time ctx.attrs as a static pytree node
+            return out, (ctx._saved, args, _StaticAttrs(ctx.attrs))
 
         def _bwd(res, g):
-            saved, args = res
+            saved, args, attrs = res
             ctx = PyLayerContext()
             ctx._saved = saved
-            if cls._attrs_stack:
-                ctx.attrs = cls._attrs_stack.pop(0)
+            ctx.attrs = attrs.d
             grads = cls.backward(ctx, g)
             if not isinstance(grads, tuple):
                 grads = (grads,)

@@ -89,28 +89,34 @@ class ProgBarLogger(Callback):
         if self.verbose and self.epochs:
             print(f"Epoch {epoch + 1}/{self.epochs}")
 
+    @staticmethod
+    def _fmt(logs) -> str:
+        # float() here is the ONLY host sync in the train loop — it
+        # happens at display time (every log_freq steps), not per step
+        def one(k, v):
+            try:
+                return f"{k}: {float(v):.4f}"
+            except (TypeError, ValueError):
+                return f"{k}: {v}"
+        return " - ".join(one(k, v) for k, v in logs.items())
+
     def on_train_batch_end(self, step, logs=None):
         logs = logs or {}
         if self.verbose == 2 and step % self.log_freq == 0:
-            items = " - ".join(f"{k}: {v:.4f}" if isinstance(v, float)
-                               else f"{k}: {v}" for k, v in logs.items())
             total = f"/{self.steps}" if self.steps else ""
-            print(f"step {step + 1}{total} - {items}")
+            print(f"step {step + 1}{total} - {self._fmt(logs)}")
 
     def on_epoch_end(self, epoch, logs=None):
         logs = logs or {}
         if self.verbose:
             dur = time.time() - self._start
-            items = " - ".join(f"{k}: {v:.4f}" if isinstance(v, float)
-                               else f"{k}: {v}" for k, v in logs.items())
-            print(f"Epoch {epoch + 1} done in {dur:.1f}s - {items}")
+            print(f"Epoch {epoch + 1} done in {dur:.1f}s - "
+                  f"{self._fmt(logs)}")
 
     def on_eval_end(self, logs=None):
         logs = logs or {}
         if self.verbose:
-            items = " - ".join(f"{k}: {v:.4f}" if isinstance(v, float)
-                               else f"{k}: {v}" for k, v in logs.items())
-            print(f"Eval - {items}")
+            print(f"Eval - {self._fmt(logs)}")
 
 
 class ModelCheckpoint(Callback):
@@ -236,10 +242,10 @@ class CSVLogger(Callback):
 
 def config_callbacks(callbacks=None, model=None, epochs=None, steps=None,
                      verbose: int = 2, metrics=None,
-                     save_dir=None) -> CallbackList:
+                     save_dir=None, log_freq: int = 1) -> CallbackList:
     cbks = list(callbacks or [])
     if not any(isinstance(c, ProgBarLogger) for c in cbks) and verbose:
-        cbks = [ProgBarLogger(verbose=verbose)] + cbks
+        cbks = [ProgBarLogger(verbose=verbose, log_freq=log_freq)] + cbks
     if not any(isinstance(c, LRScheduler) for c in cbks):
         cbks.append(LRScheduler())
     if save_dir and not any(isinstance(c, ModelCheckpoint) for c in cbks):

@@ -214,7 +214,11 @@ class Model:
                 np.asarray(loss)).all():
             raise FloatingPointError(
                 f"NaN/Inf loss at step {self._step_count}")
-        logs = {"loss": float(loss)}
+        # keep the loss on device — no per-step host sync (the reference's
+        # dygraph adapter also returns without waiting; a float() here
+        # would serialize every step on the device stream). Callbacks /
+        # callers coerce with float() only when they actually display it.
+        logs = {"loss": loss}
         for m, mo in zip(self._metrics, metric_outs):
             res = m.update(*_as_tuple(mo))
             names = m.name() if isinstance(m.name(), list) else [m.name()]
@@ -237,7 +241,7 @@ class Model:
             self._params, self._frozen, self._buffers, key, inputs, labels)
         logs = {}
         if loss is not None:
-            logs["loss"] = float(loss)
+            logs["loss"] = loss  # device value; coerced by the consumer
         for m, mo in zip(self._metrics, metric_outs):
             m.update(*_as_tuple(mo))
         return logs
@@ -275,6 +279,7 @@ class Model:
             steps = None
         cbks = config_callbacks(callbacks, model=self, epochs=epochs,
                                 steps=steps, verbose=verbose,
+                                log_freq=log_freq,
                                 metrics=[m.name() for m in self._metrics],
                                 save_dir=save_dir)
         self.stop_training = False

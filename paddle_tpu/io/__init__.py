@@ -237,6 +237,24 @@ class DistributedBatchSampler(BatchSampler):
 # Collation + DataLoader
 # ---------------------------------------------------------------------------
 
+class WorkerInfo:
+    """ref: fluid/dataloader/worker.py WorkerInfo — id/num_workers/seed
+    visible inside a worker process so IterableDatasets can shard."""
+
+    def __init__(self, wid: int, num_workers: int, seed: int):
+        self.id = wid
+        self.num_workers = num_workers
+        self.seed = seed
+
+
+_worker_info: Optional[WorkerInfo] = None
+
+
+def get_worker_info() -> Optional[WorkerInfo]:
+    """ref: paddle.io.get_worker_info — None in the main process."""
+    return _worker_info
+
+
 def default_collate_fn(batch: List[Any]):
     """Stack a list of samples into a batch (ref:
     fluid/dataloader/collate.py default_collate_fn)."""
@@ -307,6 +325,57 @@ class _PrefetchIterator:
             pass
 
 
+# -- multiprocess workers (ref: _DataLoaderIterMultiProcess,
+#    fluid/dataloader/dataloader_iter.py:342) --------------------------------
+#
+# fork-based: the dataset is inherited by the worker processes (no
+# per-batch pickling of the dataset), batches return through pipes as
+# pickled numpy — the reference's shared-memory LoDTensor queue is a
+# CUDA-pinned-memory optimization that doesn't apply to a PJRT host
+# buffer, so plain pipes + the device-prefetch thread give the same
+# overlap. Workers never touch jax/TPU state.
+
+_mp_dataset = None
+_mp_collate = None
+
+
+def _map_worker_init(dataset, collate_fn, wid, num_workers, seed):
+    global _mp_dataset, _mp_collate, _worker_info
+    _mp_dataset = dataset
+    _mp_collate = collate_fn
+    _worker_info = WorkerInfo(wid, num_workers, seed)
+    np.random.seed((seed + wid) % (2 ** 31))
+
+
+def _map_worker_collate(batch_idx):
+    return _mp_collate([_mp_dataset[i] for i in batch_idx])
+
+
+def _iter_worker_loop(dataset, collate_fn, batch_size, drop_last,
+                      wid, num_workers, seed, out_q):
+    """Worker body for IterableDataset: iterate a private copy with
+    worker_info set (the dataset shards itself via get_worker_info, same
+    contract as the reference), collate and ship batches."""
+    global _worker_info
+    _worker_info = WorkerInfo(wid, num_workers, seed)
+    np.random.seed((seed + wid) % (2 ** 31))
+    try:
+        it = iter(dataset)
+        if batch_size is None:
+            for item in it:
+                out_q.put(("item", item))
+        else:
+            while True:
+                batch = list(itertools.islice(it, batch_size))
+                if not batch or (len(batch) < batch_size and drop_last):
+                    break
+                out_q.put(("item", collate_fn(batch)))
+        out_q.put(("done", None))
+    except BaseException as e:  # noqa: BLE001 — ship to parent
+        import traceback
+        out_q.put(("error", traceback.format_exc() + repr(e)))
+
+
 class DataLoader:
     """ref: python/paddle/fluid/reader.py:275 DataLoader."""
 
@@ -349,8 +418,103 @@ class DataLoader:
             for batch_idx in self.batch_sampler:
                 yield self.collate_fn([self.dataset[i] for i in batch_idx])
 
+    def _produce_multiprocess_map(self, seed):
+        """Ordered pipelined map over batch indices on a fork pool —
+        up to num_workers*prefetch_factor batches in flight."""
+        import collections
+        from concurrent.futures import ProcessPoolExecutor
+        import multiprocessing as mp
+        ctx = mp.get_context("fork")
+        wid_counter = ctx.Value("i", 0)
+
+        def _init(dataset, collate, nw, sd):
+            with wid_counter.get_lock():
+                wid = wid_counter.value
+                wid_counter.value += 1
+            _map_worker_init(dataset, collate, wid, nw, sd)
+
+        pool = ProcessPoolExecutor(
+            max_workers=self.num_workers, mp_context=ctx,
+            initializer=_init,
+            initargs=(self.dataset, self.collate_fn, self.num_workers,
+                      seed))
+        try:
+            pending: "collections.deque" = collections.deque()
+            depth = self.num_workers * max(self.prefetch_factor, 1)
+            it = iter(self.batch_sampler)
+            for batch_idx in it:
+                pending.append(pool.submit(_map_worker_collate, batch_idx))
+                if len(pending) >= depth:
+                    yield pending.popleft().result()
+            while pending:
+                yield pending.popleft().result()
+        finally:
+            pool.shutdown(wait=False, cancel_futures=True)
+
+    def _produce_multiprocess_iter(self, seed):
+        """IterableDataset workers: each process iterates its own copy
+        with worker_info set (datasets shard via get_worker_info, ref
+        contract); parent round-robins worker queues for a deterministic
+        order."""
+        import multiprocessing as mp
+
+        ctx = mp.get_context("fork")
+        queues = [ctx.Queue(maxsize=max(self.prefetch_factor, 1))
+                  for _ in range(self.num_workers)]
+        procs = [
+            ctx.Process(
+                target=_iter_worker_loop,
+                args=(self.dataset, self.collate_fn, self.batch_size,
+                      self.drop_last, w, self.num_workers, seed, queues[w]),
+                daemon=True)
+            for w in range(self.num_workers)]
+        for p in procs:
+            p.start()
+        alive = [True] * self.num_workers
+        try:
+            while any(alive):
+                for w in range(self.num_workers):
+                    if not alive[w]:
+                        continue
+                    while True:
+                        try:
+                            kind, payload = queues[w].get(timeout=5.0)
+                            break
+                        except queue.Empty:
+                            # watchdog (ref: _DataLoaderIterMultiProcess
+                            # worker-status check): a worker killed by
+                            # the OS (OOM/segfault) sends nothing — fail
+                            # loudly instead of hanging fit() forever
+                            if not procs[w].is_alive():
+                                raise RuntimeError(
+                                    f"DataLoader worker {w} died "
+                                    f"(exitcode {procs[w].exitcode})")
+                    if kind == "error":
+                        raise RuntimeError(
+                            f"DataLoader worker {w} failed:\n{payload}")
+                    if kind == "done":
+                        alive[w] = False
+                        continue
+                    yield payload
+        finally:
+            for p in procs:
+                p.terminate()
+
     def __iter__(self):
-        return _PrefetchIterator(self._produce, self.prefetch_factor,
+        if self.num_workers > 0:
+            # resolve the seed HERE (caller thread, where paddle.seed's
+            # thread-local state lives — the produce generator body runs
+            # on the prefetch thread) and advance it per epoch so
+            # augmentations differ across epochs like the serial path
+            self._epoch_count = getattr(self, "_epoch_count", -1) + 1
+            seed = (int(rng_mod._tls.global_seed)
+                    + self._epoch_count) % (2 ** 31)
+            mp_produce = self._produce_multiprocess_iter if self._iterable \
+                else self._produce_multiprocess_map
+            produce = (lambda: mp_produce(seed))
+        else:
+            produce = self._produce
+        return _PrefetchIterator(produce, self.prefetch_factor,
                                  self.to_device)
 
     def __len__(self):

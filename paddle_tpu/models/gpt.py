@@ -212,9 +212,12 @@ class GPTEmbeddings(Layer):
                 f"{max_pos} (an out-of-range gather would silently clamp)")
         if position_ids is None:
             position_ids = jnp.arange(s)[None, :]
-        x = self.word_embeddings(input_ids) + \
-            self.position_embeddings(position_ids)
-        return self.dropout(x)
+        from ..parallel.sharding import with_logical_constraint
+        tok = with_logical_constraint(
+            self.word_embeddings(input_ids), ("batch", "seq", None))
+        pos = with_logical_constraint(
+            self.position_embeddings(position_ids), (None, "seq", None))
+        return self.dropout(tok + pos)
 
 
 class GPTModel(Layer):
@@ -231,7 +234,13 @@ class GPTModel(Layer):
 
     def forward(self, input_ids, position_ids=None, attn_mask=None,
                 caches=None):
+        from ..parallel.sharding import with_logical_constraint
         x = self.embeddings(input_ids, position_ids)
+        # activation layout anchor: batch over the data axes, hidden
+        # replicated — fsdp-sharded params are all-gathered at use
+        # (ZeRO-3), rather than letting fsdp leak into activation hidden
+        # dims (which forced full-remat reshards in the partitioner)
+        x = with_logical_constraint(x, ("batch", "seq", None))
         new_caches = [] if caches is not None else None
         for i, layer in enumerate(self.layers):
             if caches is not None:
@@ -243,6 +252,7 @@ class GPTModel(Layer):
                     lambda x, l=layer: l(x, attn_mask=attn_mask))(x)
             else:
                 x = layer(x, attn_mask=attn_mask)
+            x = with_logical_constraint(x, ("batch", "seq", None))
         x = self.ln_f(x)
         if caches is not None:
             return x, new_caches

@@ -52,11 +52,22 @@ class Embedding(Layer):
         self.embedding_dim = embedding_dim
         self.padding_idx = padding_idx
         init_w = weight_attr if callable(weight_attr) else I.Normal(0., 1.0)
+        self._axes = tuple(axes) if axes else None
         self.weight = self.create_parameter(
             [num_embeddings, embedding_dim], initializer=init_w, axes=axes)
 
     def forward(self, x):
-        return F.embedding(x, self.weight, self.padding_idx)
+        w = self.weight
+        if self._axes is not None:
+            # ZeRO semantics: the stored table may be sharded on the
+            # hidden dim (fsdp); all-gather hidden before the lookup so
+            # the gather operand is sharded only on the vocab dim — a
+            # form the SPMD partitioner handles natively (masked local
+            # lookup + psum, the Megatron VocabParallelEmbedding trick)
+            # instead of falling back to full rematerialization.
+            from ...parallel.sharding import with_logical_constraint
+            w = with_logical_constraint(w, (self._axes[0], None))
+        return F.embedding(x, w, self.padding_idx)
 
 
 class Dropout(Layer):

@@ -136,14 +136,18 @@ def _set_current(m: Optional[DeviceMesh]) -> None:
     _current_mesh = m
 
 
-def init_mesh(**axis_sizes: int) -> DeviceMesh:
+def init_mesh(devices: Optional[Sequence] = None,
+              **axis_sizes: int) -> DeviceMesh:
     """Create and install the global mesh (fleet.init analog — ref:
     python/paddle/distributed/fleet/base/fleet_base.py:211; the
     degree knobs mirror DistributedStrategy's
     {sharding,mp,pp,dp}_degree, fleet/meta_optimizers/
-    sharding_optimizer.py:123-135)."""
+    sharding_optimizer.py:123-135).
+
+    ``devices`` optionally restricts the mesh to a subset of
+    ``jax.devices()`` (e.g. a 4-device mesh on an 8-device host)."""
     global _current_mesh
-    m = DeviceMesh(**axis_sizes)
+    m = DeviceMesh(devices=devices, **axis_sizes)
     _current_mesh = m
     return m
 

@@ -48,23 +48,30 @@ class LogicalRules:
     def mesh_axes(self, logical: Optional[Tuple[Optional[str], ...]],
                   shape: Tuple[int, ...], mesh: DeviceMesh) -> P:
         """Resolve logical dim names to a PartitionSpec, skipping mesh axes
-        already taken by another dim (a mesh axis may shard only one dim)."""
+        already taken by another dim (a mesh axis may shard only one dim).
+        A logical name matching several rules collects ALL its live mesh
+        axes (e.g. ``batch`` → ``("dp", "fsdp")``), so activation
+        constraints agree with :func:`shard_batch`'s placement — the
+        disagreement used to force an involuntary full rematerialization
+        in the SPMD partitioner."""
         if logical is None:
             return P()
         used = set()
         out = []
         for dim, name in enumerate(logical):
-            pick = None
-            if name is not None:
+            picks = []
+            if name is not None and dim < len(shape):
+                prod = 1
                 for lname, maxis in self.rules:
                     if (lname == name and maxis not in used
                             and mesh.has_axis(maxis)
-                            and dim < len(shape)
-                            and shape[dim] % mesh.axis_size(maxis) == 0):
-                        pick = maxis
+                            and shape[dim] % (
+                                prod * mesh.axis_size(maxis)) == 0):
+                        picks.append(maxis)
                         used.add(maxis)
-                        break
-            out.append(pick)
+                        prod *= mesh.axis_size(maxis)
+            out.append(tuple(picks) if len(picks) > 1
+                       else (picks[0] if picks else None))
         while out and out[-1] is None:
             out.pop()
         return P(*out)

@@ -25,6 +25,12 @@ def viterbi_decode(potentials, transitions, lengths=None,
     potentials: [batch, seq, ntags] emission scores;
     transitions: [ntags, ntags] (transitions[i, j]: score of i→j);
     lengths: [batch] valid lengths (default: full).
+    include_bos_eos_tag: treat the last transition row (index n-1) as
+    the start tag and the second-to-last row (n-2) as the stop tag —
+    same convention as the reference kernel
+    (paddle/phi/kernels/cpu/viterbi_decode_kernel.cc:222-252: rows split
+    into [rest, stop_trans, start_trans]; start added at t=0, stop added
+    at each sequence's final step).
     Returns (scores [batch], paths [batch, seq]).
     ref: python/paddle/text/viterbi_decode.py ViterbiDecoder.
     """
@@ -34,6 +40,8 @@ def viterbi_decode(potentials, transitions, lengths=None,
     if lengths is None:
         lengths = jnp.full((b,), s, jnp.int32)
     lengths = jnp.asarray(lengths, jnp.int32)
+    start_row = transitions[n - 1]        # [n]
+    stop_row = transitions[n - 2]         # [n]
 
     def step(carry, t):
         alpha = carry                     # [b, n] best score ending in tag
@@ -46,10 +54,16 @@ def viterbi_decode(potentials, transitions, lengths=None,
         # frozen past the sequence end
         active = (t < lengths)[:, None]
         alpha = jnp.where(active, best_score, alpha)
+        if include_bos_eos_tag:
+            last = (t == lengths - 1)[:, None]
+            alpha = alpha + jnp.where(last, stop_row[None, :], 0.0)
         return alpha, jnp.where(active, best_prev,
                                 jnp.arange(n)[None, :])
 
     alpha0 = potentials[:, 0]
+    if include_bos_eos_tag:
+        alpha0 = alpha0 + start_row[None, :] + jnp.where(
+            (lengths == 1)[:, None], stop_row[None, :], 0.0)
     alpha, backps = jax.lax.scan(step, alpha0, jnp.arange(1, s))
     scores = jnp.max(alpha, axis=-1)
     last_tag = jnp.argmax(alpha, axis=-1)             # [b]

@@ -157,8 +157,12 @@ class ToTensor(BaseTransform):
     def _apply_image(self, img):
         if img.ndim == 2:
             img = img[:, :, None]
+        orig_dtype = img.dtype
         img = img.astype(np.float32)
-        if img.max() > 1.0:
+        # scale iff the input was uint8 (dtype-based, like the
+        # reference) — never from the data values, and not for 16/32-bit
+        # integer images whose range isn't 0..255
+        if orig_dtype == np.uint8:
             img = img / 255.0
         return np.ascontiguousarray(img.transpose(2, 0, 1))
 

@@ -1,0 +1,33 @@
+"""Wheel/packaging smoke tests (ref: the reference ships a
+paddlepaddle wheel built by python/setup.py.in; BASELINE.json's north
+star names a paddlepaddle-tpu wheel). The full `pip wheel .` build is
+exercised out-of-band (slow); here we check the metadata is coherent."""
+
+import os
+import tomllib
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _project():
+    with open(os.path.join(REPO, "pyproject.toml"), "rb") as f:
+        return tomllib.load(f)["project"]
+
+
+def test_pyproject_version_matches_package():
+    import paddle_tpu
+    assert _project()["version"] == paddle_tpu.__version__
+
+
+def test_launcher_entry_point_resolves():
+    ep = _project()["scripts"]["paddle-tpu-launch"]
+    mod, fn = ep.split(":")
+    import importlib
+    m = importlib.import_module(mod)
+    assert callable(getattr(m, fn))
+
+
+def test_native_sources_are_package_data():
+    # the wheel carries datafeed.cc for on-demand compilation
+    assert os.path.exists(
+        os.path.join(REPO, "paddle_tpu", "native", "datafeed.cc"))

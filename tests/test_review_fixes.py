@@ -119,3 +119,83 @@ def test_named_rng_streams_stable():
                            capture_output=True, text=True).stdout.strip()
             for _ in range(2)}
     assert len(outs) == 1  # identical across fresh interpreters
+
+
+# -- round-2 advisor fixes -------------------------------------------------
+
+def test_pylayer_nested_attrs_not_swapped():
+    """Two applies of the same PyLayer with different ctx.attrs inside one
+    differentiated function must keep their own attrs in backward
+    (round-1 advisor: FIFO side-stack swapped them under custom_vjp's
+    LIFO backward order; attrs now ride the residuals)."""
+    from paddle_tpu import autograd
+
+    class Scale(autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, x, s):
+            ctx.attrs["s"] = s
+            return x * s
+
+        @staticmethod
+        def backward(ctx, g):
+            return g * ctx.attrs["s"], jnp.zeros(())
+
+    def f(x):
+        y = Scale.apply(x, 3.0)   # dy/dx = 3
+        z = Scale.apply(y, 4.0)   # dz/dy = 4
+        return z
+
+    g = jax.grad(f)(jnp.asarray(2.0))
+    assert float(g) == 12.0  # was 11 with swapped attrs
+
+    # also correct under jit (retracing-safe: no side stack)
+    gj = jax.jit(jax.grad(f))(jnp.asarray(2.0))
+    assert float(gj) == 12.0
+
+
+def test_vjp_multi_output_default_cotangent():
+    from paddle_tpu import autograd
+
+    def f(x):
+        return (x * 2.0, x * 3.0)
+
+    out, g = autograd.vjp(f, jnp.asarray(1.0))
+    assert float(g) == 5.0
+
+
+def test_totensor_scales_by_dtype_not_data():
+    from paddle_tpu.vision.transforms import ToTensor
+    dark = np.zeros((4, 4, 3), np.uint8)
+    dark[0, 0, 0] = 1  # max == 1: the old data-based check skipped /255
+    out = ToTensor()(dark)
+    assert abs(float(out.max()) - 1.0 / 255.0) < 1e-7
+    # float input in [0,1] is untouched
+    f = np.full((4, 4, 3), 0.5, np.float32)
+    assert float(ToTensor()(f).max()) == 0.5
+
+
+def test_viterbi_include_bos_eos_tag():
+    """Against a brute force with the reference convention: start tag =
+    last transitions row, stop tag = second-to-last row
+    (viterbi_decode_kernel.cc:222-252)."""
+    from paddle_tpu.text import viterbi_decode
+    import itertools
+    rs = np.random.RandomState(3)
+    b, s, n = 2, 4, 4
+    pot = rs.randn(b, s, n).astype(np.float32)
+    trans = rs.randn(n, n).astype(np.float32)
+    lengths = np.array([4, 2], np.int32)
+    scores, paths = viterbi_decode(pot, trans, lengths,
+                                   include_bos_eos_tag=True)
+    for bi in range(b):
+        L = int(lengths[bi])
+        best, bestp = -1e30, None
+        for tags in itertools.product(range(n), repeat=L):
+            sc = trans[n - 1, tags[0]] + pot[bi, 0, tags[0]]
+            for t in range(1, L):
+                sc += trans[tags[t - 1], tags[t]] + pot[bi, t, tags[t]]
+            sc += trans[n - 2, tags[L - 1]]
+            if sc > best:
+                best, bestp = sc, tags
+        assert abs(float(scores[bi]) - best) < 1e-4
+        assert list(np.asarray(paths[bi])[:L]) == list(bestp)
