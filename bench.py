@@ -59,18 +59,38 @@ def param_count(net) -> int:
     return int(sum(np.prod(v.shape) for v in params.values()))
 
 
-def _timed_steps(model, feed, warmup: int, iters: int) -> float:
-    """Run warmup steps, then time `iters` steps with one final sync."""
+def _device_feed(feed):
+    """Pre-place the synthetic batch on device and force arrival.
+
+    The input pipeline is benchmarked separately (io tests); feeding
+    host arrays here would measure the host→device link, not the
+    training step. A tiny reduction FETCHED to host proves arrival —
+    on tunneled PJRT backends `block_until_ready` can signal at enqueue,
+    so only a host value fetch is a true synchronization point."""
     import jax
+    import jax.numpy as jnp
+    placed = jax.tree_util.tree_map(
+        lambda x: jax.device_put(np.asarray(x)), feed)
+    for leaf in jax.tree_util.tree_leaves(placed):
+        float(jnp.sum(leaf.astype(jnp.float32)))
+    return placed
+
+
+def _timed_steps(model, feed, warmup: int, iters: int) -> float:
+    """Warmup, then time `iters` chained steps. The device queue is
+    drained by FETCHING the final loss to host inside the timed region
+    (see _device_feed: block_until_ready is not a reliable sync here)."""
+    feed = _device_feed(feed)
+    logs = None
     for _ in range(warmup):
         logs = model.train_batch(*feed)
-    jax.block_until_ready(logs["loss"])
+    float(np.asarray(logs["loss"]))  # true sync
     t0 = time.perf_counter()
     for _ in range(iters):
         logs = model.train_batch(*feed)
-    jax.block_until_ready(logs["loss"])
+    val = np.asarray(logs["loss"])   # true sync, inside the timing
     dt = time.perf_counter() - t0
-    assert np.isfinite(np.asarray(logs["loss"])), logs
+    assert np.isfinite(val), logs
     return dt
 
 
@@ -129,7 +149,7 @@ def bench_gpt(batch: int = 8, seq: int = 1024, warmup: int = 3,
 RESNET50_FWD_FLOPS = 4.09e9   # per 224x224 image, 2*MACs convention
 
 
-def bench_resnet(batch: int = 128, warmup: int = 3, iters: int = 10,
+def bench_resnet(batch: int = 128, warmup: int = 3, iters: int = 30,
                  cpu_smoke: bool = False):
     import paddle_tpu as paddle
     from paddle_tpu import nn
@@ -163,7 +183,7 @@ def bench_resnet(batch: int = 128, warmup: int = 3, iters: int = 10,
 # ---------------------------------------------------------------------------
 
 def bench_bert(batch: int = 64, seq: int = 128, warmup: int = 3,
-               iters: int = 15, cpu_smoke: bool = False):
+               iters: int = 30, cpu_smoke: bool = False):
     import paddle_tpu as paddle
     from paddle_tpu.models.bert import (BertForPretraining,
                                         BertPretrainingCriterion,

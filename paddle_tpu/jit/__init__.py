@@ -130,6 +130,39 @@ def to_static(fn=None, input_spec=None, **_ignored):
 _PROGRAM_FILE = "program.stablehlo"
 _PARAMS_FILE = "params.pkl"
 _META_FILE = "meta.json"
+# C-consumable twins (read by the native predictor,
+# paddle_tpu/native/predictor.cc — the AnalysisPredictor analog):
+_MLIR_FILE = "program.mlir.bc"          # raw StableHLO bytecode
+_PBIN_FILE = "params.pbin"              # binary params, flatten order
+_COPTS_FILE = "compile_options.pb"      # serialized CompileOptionsProto
+
+_DTYPE_CODES = {
+    "float32": 0, "float64": 1, "int32": 2, "int64": 3, "bfloat16": 4,
+    "float16": 5, "uint8": 6, "int8": 7, "bool": 8, "uint32": 9,
+    "uint64": 10, "int16": 11, "uint16": 12,
+}
+
+
+def _write_pbin(path: str, named_arrays) -> None:
+    """params.pbin: magic 'PTP1', u32 count, then per entry
+    u32 name_len, name, u32 dtype_code, u32 ndim, u64 dims[], u64 nbytes,
+    raw bytes — readable with no Python on the serving side."""
+    import struct
+    with open(path, "wb") as f:
+        f.write(b"PTP1")
+        f.write(struct.pack("<I", len(named_arrays)))
+        for name, arr in named_arrays:
+            arr = np.asarray(arr)
+            raw = arr.tobytes()
+            nb = name.encode()
+            f.write(struct.pack("<I", len(nb)))
+            f.write(nb)
+            f.write(struct.pack("<I", _DTYPE_CODES[str(arr.dtype)]))
+            f.write(struct.pack("<I", arr.ndim))
+            for d in arr.shape:
+                f.write(struct.pack("<Q", d))
+            f.write(struct.pack("<Q", len(raw)))
+            f.write(raw)
 
 
 def save(layer, path: str, input_spec: Sequence[InputSpec] = None) -> None:
@@ -169,11 +202,50 @@ def save(layer, path: str, input_spec: Sequence[InputSpec] = None) -> None:
              "buffers": {k: np.asarray(v) for k, v in buffers.items()}}
     with open(os.path.join(path, _PARAMS_FILE), "wb") as f:
         pickle.dump(state, f)
+
+    # C-consumable twins for the native predictor. The exported main's
+    # leading arguments are the flattened (params, buffers) pytree —
+    # write params.pbin in exactly that order so the C side can bind
+    # them positionally with no pytree logic. Best-effort like the
+    # compile-options twin: an exotic dtype or symbolic shape disables
+    # native serving but never breaks the Python artifact.
+    try:
+        with open(os.path.join(path, _MLIR_FILE), "wb") as f:
+            f.write(exported.mlir_module_serialized)
+        flat_named = (
+            [(k, state["params"][k]) for k in sorted(params)] +
+            [(k, state["buffers"][k]) for k in sorted(buffers)])
+        _write_pbin(os.path.join(path, _PBIN_FILE), flat_named)
+        from jax._src.lib import xla_client as _xc
+        with open(os.path.join(path, _COPTS_FILE), "wb") as f:
+            f.write(_xc.CompileOptions().SerializeAsString())
+    except Exception as e:
+        import warnings
+        warnings.warn(f"native serving twins not written ({e}); "
+                      "Python jit.load still works")
+
+    def _dims(shape):
+        # symbolic dims (shape polymorphism) serialize as their name
+        return [int(d) if isinstance(d, int) else str(d) for d in shape]
+
+    n_state = len(params) + len(buffers)
+    # the exported main's trailing args are the true input avals AFTER
+    # jax dtype canonicalization (int64→int32 without x64) — the native
+    # predictor must feed exactly these dtypes
+    exported_in = [{"shape": _dims(a.shape), "dtype": str(a.dtype)}
+                   for a in exported.in_avals[n_state:]]
     meta = {
-        "input_spec": [{"shape": list(getattr(s, "shape", ())),
+        "input_spec": [{"shape": [d if d is None or isinstance(d, int)
+                                  else str(d)
+                                  for d in getattr(s, "shape", ())],
                         "dtype": str(getattr(s, "dtype", ""))}
                        for s in input_spec],
-        "format_version": 1,
+        "exported_inputs": exported_in,
+        "outputs": [{"shape": _dims(o.shape), "dtype": str(o.dtype)}
+                    for o in exported.out_avals],
+        "n_state_args": n_state,
+        "platforms": list(exported.platforms),
+        "format_version": 2,
     }
     with open(os.path.join(path, _META_FILE), "w") as f:
         json.dump(meta, f)

@@ -471,25 +471,36 @@ def cross_entropy(logits, label, weight=None, ignore_index: int = -100,
                   reduction: str = "mean", soft_label: bool = False,
                   axis: int = -1, label_smoothing: float = 0.0):
     """ref: functional/loss.py cross_entropy (softmax_with_cross_entropy
-    kernel). Computes in fp32 regardless of input dtype."""
-    logits = logits.astype(jnp.float32)
-    logp = jax.nn.log_softmax(logits, axis=axis)
+    kernel). Accumulates in fp32 regardless of input dtype.
+
+    Hard-label path is written as streaming logsumexp rather than
+    materializing ``log_softmax`` — on a [tokens, vocab] LM loss the
+    full fp32 log-probability tensor is pure HBM traffic (the
+    reference's fused softmax_with_cross_entropy CUDA kernel avoids it
+    the same way); XLA fuses the converts/exp into the two reductions."""
     if soft_label:
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
         tgt = label.astype(jnp.float32)
         if label_smoothing:
             tgt = label_smooth(tgt, label_smoothing)
         loss = -(tgt * logp).sum(axis=axis)
         valid = None
     else:
+        xf = logits.astype(jnp.float32)
         label = label.astype(jnp.int32)
-        if label.ndim == logp.ndim:  # [..., 1] index form
+        if label.ndim == xf.ndim:  # [..., 1] index form
             label = label.squeeze(axis)
-        num_classes = logp.shape[axis]
         safe = jnp.where(label == ignore_index, 0, label)
-        picked = jnp.take_along_axis(
-            logp, safe[..., None], axis=axis).squeeze(axis)
+        m = jax.lax.stop_gradient(
+            jnp.max(xf, axis=axis, keepdims=True))
+        lse = m.squeeze(axis) + jnp.log(
+            jnp.sum(jnp.exp(xf - m), axis=axis))
+        picked_logit = jnp.take_along_axis(
+            xf, jnp.expand_dims(safe, axis), axis=axis).squeeze(axis)
+        picked = picked_logit - lse            # log p[label]
         if label_smoothing:
-            smooth_term = logp.mean(axis=axis)
+            # mean(log_softmax) == mean(x) - lse
+            smooth_term = jnp.mean(xf, axis=axis) - lse
             picked = (1 - label_smoothing) * picked + \
                 label_smoothing * smooth_term
         loss = -picked
