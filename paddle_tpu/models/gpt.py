@@ -259,6 +259,18 @@ class GPTModel(Layer):
         return x
 
 
+def _lm_logits(cfg: GPTConfig, embeddings: GPTEmbeddings, hidden,
+               lm_head=None):
+    """Shared head: tied-embedding matmul (bf16 under AMP; the loss
+    upcasts to f32 for its log-softmax) or a separate lm_head."""
+    if cfg.tie_word_embeddings:
+        from .. import amp
+        w = embeddings.word_embeddings.weight  # [V, H]
+        hidden, w = amp.white_cast(hidden, w)
+        return jnp.einsum("bsh,vh->bsv", hidden, w)
+    return lm_head(hidden)
+
+
 class GPTForCausalLM(Layer):
     """GPT with a (tied) LM head and generation utilities."""
 
@@ -272,15 +284,8 @@ class GPTForCausalLM(Layer):
                                      axes=("embed", "vocab"))
 
     def _logits(self, hidden):
-        if self.cfg.tie_word_embeddings:
-            from .. import amp
-            w = self.gpt.embeddings.word_embeddings.weight  # [V, H]
-            hidden, w = amp.white_cast(hidden, w)
-            # logits stay in the compute dtype (bf16 under AMP): the
-            # [b, s, vocab] buffer dominates HBM and the loss upcasts to
-            # f32 for its log-softmax anyway (F.cross_entropy)
-            return jnp.einsum("bsh,vh->bsv", hidden, w)
-        return self.lm_head(hidden)
+        return _lm_logits(self.cfg, self.gpt.embeddings, hidden,
+                          getattr(self, "lm_head", None))
 
     def forward(self, input_ids, position_ids=None, attn_mask=None,
                 caches=None):
@@ -333,6 +338,63 @@ class GPTForCausalLM(Layer):
             next_logits, caches = self(nxt, position_ids=pos, caches=caches)
             next_logits = next_logits[:, -1]
         return tokens
+
+
+class GPTForCausalLMPipe(Layer):
+    """GPT composed with SPMD pipeline parallelism over the decoder trunk.
+
+    The reference builds this as ``GPTForPretrainingPipe`` — a
+    PipelineLayer of embedding/decoder/head segments dispatched by the
+    1F1B runtime (fleet meta_parallel pp_layers.py:162,
+    pipeline_parallel.py:82). TPU-native composition: embeddings, final
+    LN and the (tied) LM head stay OUTSIDE the pipelined trunk —
+    pp-replicated, their grads all-reduced by XLA at the shard boundary,
+    replacing the reference's shared-embedding allreduce
+    (pp_layers.py SharedLayerDesc) — while the structurally identical
+    decoder blocks run under ``parallel.PipelineParallel`` with the
+    circular schedule. The pipeline's output arrives sharded over pp on
+    the batch dim, so the head/loss run data-parallel over pp for free.
+    """
+
+    def __init__(self, cfg: GPTConfig, num_microbatches: int = 1,
+                 virtual_pp_degree: int = 1, mesh=None):
+        super().__init__()
+        from ..parallel import get_mesh
+        from ..parallel.pipeline import PipelineLayer, PipelineParallel
+        self.cfg = cfg
+        mesh = mesh or get_mesh(required=False)
+        pp = mesh.axis_size("pp") if mesh is not None else 1
+        num_stages = pp * virtual_pp_degree
+        if cfg.num_layers % num_stages:
+            raise ValueError(
+                f"num_layers {cfg.num_layers} not divisible by "
+                f"pp*virtual_pp_degree = {num_stages}")
+        self.embeddings = GPTEmbeddings(cfg)
+        blocks = [GPTDecoderLayer(cfg) for _ in range(cfg.num_layers)]
+        mb_spec = mesh.batch_spec() if mesh is not None else None
+        from jax.sharding import PartitionSpec as P
+        self.pipe = PipelineParallel(
+            PipelineLayer(blocks, num_stages=num_stages),
+            num_microbatches=num_microbatches,
+            virtual_pp_degree=virtual_pp_degree,
+            mesh=mesh, mb_spec=mb_spec if mb_spec is not None else P(),
+            remat=True)
+        self.ln_f = nn.LayerNorm(cfg.hidden_size,
+                                 epsilon=cfg.layer_norm_epsilon)
+        if not cfg.tie_word_embeddings:
+            self.lm_head = nn.Linear(cfg.hidden_size, cfg.vocab_size,
+                                     bias_attr=False,
+                                     axes=("embed", "vocab"))
+
+    def _logits(self, hidden):
+        return _lm_logits(self.cfg, self.embeddings, hidden,
+                          getattr(self, "lm_head", None))
+
+    def forward(self, input_ids, position_ids=None):
+        x = self.embeddings(input_ids, position_ids)
+        x = self.pipe(x)
+        x = self.ln_f(x)
+        return self._logits(x)
 
 
 class GPTPretrainingCriterion(Layer):

@@ -1,30 +1,62 @@
-"""Pipeline parallelism: SPMD microbatch pipelining over a ``pp`` mesh axis.
+"""Pipeline parallelism: circular SPMD microbatch pipelining over ``pp``.
 
 Reference implementation being replaced:
 - dygraph: ``PipelineLayer`` with LayerDesc/SharedLayerDesc
   (python/paddle/distributed/fleet/meta_parallel/parallel_layers/
   pp_layers.py:162/:58/:77) and ``PipelineParallel.forward_backward_pipeline``
   — an explicit 1F1B schedule (meta_parallel/pipeline_parallel.py:82-150)
-  over point-to-point sends (pp_utils/p2p_communication.py, partial_send/
-  recv ops).
+  over point-to-point sends (pp_utils/p2p_communication.py), with
+  interleaved scheduling selected by ``virtual_pp_degree``
+  (pp_layers.py get_stage_from_index).
 - static: ``PipelineTrainer``/``SectionWorker`` (framework/trainer.h:307)
   and the FleetExecutor actor runtime (distributed/fleet_executor/).
 
-TPU-native design: there is no per-rank program — one SPMD program runs on
-every pp rank. The schedule is a ``lax.scan`` over M + P - 1 ticks inside
-``shard_map``; each tick every stage computes one microbatch (or a masked
-dummy in the fill/drain bubble) and passes its activation to the next
-stage with ``lax.ppermute`` over the ICI ring — the compiled analog of the
-reference's partial_send/recv + 1F1B loop. The backward pass is jax's
-transpose of the scan: activations flow backward through the reversed
-ppermute, giving the same bubble shape as the hand-written schedule, and
-``jax.checkpoint`` around the stage body keeps only per-tick boundary
-activations live (the 1F1B memory trade).
+TPU-native design: there is no per-rank program — one SPMD program runs
+on every pp rank inside ``shard_map``. Stage weights live as ONE tensor
+per parameter with a leading stage dim sharded over the ``pp`` mesh axis,
+so each rank holds only its own stages' weights (the pp memory win is in
+the sharding, not in per-rank code). The schedule is a ``lax.scan`` over
+ticks; each tick every rank runs one stage-chunk on one microbatch and
+passes the activation to the next rank with ``lax.ppermute`` over the ICI
+ring — the compiled analog of partial_send/recv.
 
-Constraints (same as GSPMD-style pipelining everywhere): all stages run
-one shared computation graph, so stages must be structurally identical.
-Embedding/head layers stay outside the pipelined trunk (replicated over
-pp), which is how the flagship GPT composes it.
+Scheduling: with ``virtual_pp_degree = v`` each rank holds ``v``
+stage-chunks assigned round-robin (rank r owns chunks r, r+pp, r+2pp, …),
+the Megatron "interleaved" layout the reference selects with
+virtual_pp_degree (pp_layers.py:390). Microbatches are injected in waves
+of ``pp``; a microbatch circulates the ring ``v`` times. Total ticks are
+``m*v + pp - 1`` chunk-times versus ``(m + pp - 1)*v`` for the naive
+schedule — the fill/drain bubble shrinks by ``v``. During bubble ticks a
+rank computes on a zero/garbage activation whose result is never written
+anywhere; that compute is inherent to SPMD pipelining (every device runs
+the same program each tick — a hand-scheduled rank would be idle, not
+faster).
+
+Outputs: the last chunk's results accumulate into a carried buffer via
+``dynamic_update_slice`` (no per-tick stacked activations), and after the
+scan one ring scatter (``ppermute`` from the last rank to each rank)
+leaves the output sharded over pp on the microbatch dim — the head/loss
+downstream runs data-parallel over pp for free. There is no broadcast:
+total comm is one activation per rank per tick plus ``m/pp`` microbatches
+scattered once, versus the reference's P2P sends plus its separate
+embedding-grad allreduce.
+
+Memory profile (honest): this is GPipe-with-rematerialisation, not 1F1B.
+``jax.checkpoint`` around the chunk body makes the backward residual one
+boundary activation per tick (``m*v + pp - 1`` boundaries per rank),
+where true 1F1B holds at most ``pp`` full per-stage activation sets.
+With remat the per-rank residual is smaller than 1F1B's whenever
+``(m*v + pp)·|boundary| < pp·|stage internals|``, which holds for
+transformer blocks at realistic microbatch counts; the recompute cost is
+one extra forward, the standard TPU trade.
+
+Constraints (same as GSPMD-style pipelining everywhere): all stage-chunks
+run one shared computation graph, so chunks must be structurally
+identical, and the trunk must be buffer-free (no BatchNorm running
+stats). Embedding/head layers stay outside the pipelined trunk
+(pp-replicated), which is how ``models.gpt.GPTForCausalLMPipe`` composes
+it. Tensor parallelism inside the shard_map body is not yet supported —
+use pp × dp meshes (tp composes with dp/fsdp in the non-pp path).
 """
 
 from __future__ import annotations
@@ -36,7 +68,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ..nn.layer import Layer, LayerList, functional_call
+from ..nn.layer import Layer, LayerList, Parameter, functional_call
 from .mesh import DeviceMesh, get_mesh
 
 
@@ -68,11 +100,12 @@ class SharedLayerDesc(LayerDesc):
 
 
 class PipelineLayer(Layer):
-    """Groups a flat layer list into ``num_stages`` equal stages
+    """Groups a flat layer list into ``num_stages`` equal stage-chunks
     (ref: pp_layers.py:162 PipelineLayer(layers=[...], num_stages=N)).
 
-    The SPMD executor requires equal, structurally identical stages —
-    enforced here at construction."""
+    The SPMD executor requires equal, structurally identical chunks —
+    enforced here at construction. With interleaving, ``num_stages`` is
+    the TOTAL chunk count ``pp * virtual_pp_degree``."""
 
     def __init__(self, layers: Sequence, num_stages: int):
         super().__init__()
@@ -104,127 +137,223 @@ class PipelineLayer(Layer):
 # the SPMD pipelining primitive
 # ---------------------------------------------------------------------------
 
-def _stack_stage_params(pipe: PipelineLayer):
-    """[stage0 params, ...] → one pytree with leading stage dim, plus the
-    treedef/keys needed to rebind inside stage_fn."""
-    stage_params = []
-    for stage in pipe.stages:
-        params = dict(stage.named_parameters())
-        stage_params.append(params)
-    keys = sorted(stage_params[0].keys())
-    for sp in stage_params[1:]:
-        if sorted(sp.keys()) != keys:
-            raise ValueError("pipeline stages are not structurally "
-                             "identical; SPMD pipelining requires it")
-    stacked = {k: jnp.stack([sp[k] for sp in stage_params]) for k in keys}
-    return stacked
-
-
 def pipeline_spmd(stage_fn: Callable, stacked_params, x,
                   num_microbatches: int,
                   mesh: Optional[DeviceMesh] = None,
                   axis: str = "pp",
+                  virtual: int = 1,
                   mb_spec: P = P(),
                   remat: bool = True):
-    """Run ``y = stage_{P-1}(... stage_0(x))`` pipelined over the mesh
-    axis ``axis``.
+    """Run ``y = chunk_{S-1}(… chunk_0(x))`` pipelined over mesh axis
+    ``axis`` with the circular schedule described in the module docstring.
 
-    stage_fn(params_one_stage, mb) -> mb_out; every stage runs this same
-    function (SPMD). ``stacked_params``: pytree with leading dim P.
-    ``x``: [batch, ...] global input, split into ``num_microbatches``.
-    ``mb_spec``: PartitionSpec of one microbatch over the OTHER mesh axes
-    (e.g. P("dp") to keep data parallelism inside the pipeline).
+    ``stage_fn(params_one_chunk, mb) -> mb_out`` — every rank runs this
+    same function (SPMD). ``stacked_params``: pytree whose leaves have a
+    leading dim ``S = pp * virtual`` in ROUND-ROBIN order: position
+    ``r*virtual + c`` holds chunk ``c*pp + r`` (so sharding dim 0 over pp
+    in equal blocks gives rank r exactly its chunks). ``x``: [batch, ...]
+    global input, split into ``num_microbatches``. ``mb_spec``:
+    PartitionSpec of one microbatch over the OTHER mesh axes (e.g.
+    P("dp") keeps data parallelism inside the pipeline).
     """
     mesh = mesh or get_mesh()
     pp = mesh.axis_size(axis)
+    v = virtual
+    S = pp * v
     m = num_microbatches
     b = x.shape[0]
     if b % m:
         raise ValueError(f"batch {b} not divisible by {m} microbatches")
     mb_size = b // m
     xm = x.reshape(m, mb_size, *x.shape[1:])
+    m_pad = -(-m // pp) * pp  # output buffer rounded up to a pp multiple
+    c_sz = m_pad // pp
 
     param_specs = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
     in_mb_spec = P(None, *mb_spec)
+    out_spec = P(axis, *mb_spec)
 
-    body = stage_fn
-    if remat:
-        body = jax.checkpoint(stage_fn)
+    # Per-tick randomness: the scan body is traced ONCE, so an ambient
+    # next_key() inside it would freeze one dropout mask for every tick/
+    # microbatch/chunk. Instead fold the tick index into a base key drawn
+    # here (from the enclosing step's key_guard stream) — unique per
+    # (microbatch, chunk) since each occupies a unique tick — and route
+    # the chunk body's implicit RNG through it. Folding inside the
+    # (rematerialised) body keeps forward and backward masks identical.
+    from ..core import rng as _rng
+    base_key = _rng.next_key()
+
+    def chunk_body(params_local, mb, t):
+        with _rng.key_guard(jax.random.fold_in(base_key, t)):
+            return stage_fn(params_local, mb)
+
+    body = jax.checkpoint(chunk_body) if remat else chunk_body
+
+    # injection time of microbatch j: waves of pp, one wave per ring lap
+    # (ref schedule: meta_parallel/pipeline_parallel.py:82 1F1B loop;
+    # interleaving per pp_layers.py virtual_pp_degree)
+    t0_last = ((m - 1) // pp) * S + ((m - 1) % pp)
+    ticks = t0_last + S
 
     def per_shard(params, xm_local):
-        # params: leading dim P/pp == 1 on this rank
-        params_local = jax.tree_util.tree_map(lambda a: a[0], params)
-        rank = lax.axis_index(axis)
-        ticks = m + pp - 1
+        # params: leading dim S/pp == v on this rank (its chunk-group)
+        r = lax.axis_index(axis)
         state0 = jnp.zeros_like(xm_local[0])
+        out0 = jnp.zeros((m_pad,) + xm_local.shape[1:], xm_local.dtype)
 
         def tick(carry, t):
-            state = carry  # activation received from the previous stage
-            # stage 0 consumes microbatch t (clamped in the drain phase)
-            mb_idx = jnp.clip(t, 0, m - 1)
-            first_in = lax.dynamic_index_in_dim(xm_local, mb_idx, 0,
-                                                keepdims=False)
-            x_in = jnp.where(rank == 0, first_in, state)
-            y = body(params_local, x_in)
-            # shift activations one stage down the ring (last stage's
-            # output falls off — it is collected below)
-            nxt = lax.ppermute(y, axis,
-                               [(i, i + 1) for i in range(pp - 1)])
-            return nxt, y
+            state, out_buf = carry
+            # which of this rank's v chunks runs this tick
+            c = ((t - r) % S) // pp
+            params_c = jax.tree_util.tree_map(
+                (lambda a: a[0]) if v == 1 else
+                (lambda a: lax.dynamic_index_in_dim(a, c, 0, keepdims=False)),
+                params)
+            # chunk 0 on rank 0 injects a fresh microbatch when one is due
+            tm = t % S
+            j_in = (t // S) * pp + tm
+            inject = (r == 0) & (c == 0) & (tm < pp) & (j_in < m)
+            first_in = lax.dynamic_index_in_dim(
+                xm_local, jnp.clip(j_in, 0, m - 1), 0, keepdims=False)
+            x_in = jnp.where(inject, first_in, state)
+            y = body(params_c, x_in, t)
+            # chunk S-1 on the last rank finishes microbatch j_out this
+            # tick iff one was injected S-1 ticks ago
+            t0o = t - (S - 1)
+            j_out = (t0o // S) * pp + (t0o % S)
+            emit = (r == pp - 1) & (t0o >= 0) & ((t0o % S) < pp) & (j_out < m)
+            jc = jnp.clip(j_out, 0, m_pad - 1)
+            cur = lax.dynamic_slice_in_dim(out_buf, jc, 1, 0)
+            val = jnp.where(emit, y[None], cur)
+            out_buf = lax.dynamic_update_slice_in_dim(out_buf, val, jc, 0)
+            # shift activations one rank down the ICI ring; the wraparound
+            # edge feeds chunk k back in as chunk k+1's input (circular);
+            # with v == 1 nothing consumes it, so skip the send
+            if v == 1:
+                perm = [(i, i + 1) for i in range(pp - 1)]
+            else:
+                perm = [(i, (i + 1) % pp) for i in range(pp)]
+            nxt = lax.ppermute(y, axis, perm)
+            return (nxt, out_buf), None
 
-        _, ys = lax.scan(tick, state0, jnp.arange(ticks))
-        # last stage's valid outputs are ticks P-1 .. P-1+m
-        outs = lax.dynamic_slice_in_dim(ys, pp - 1, m, axis=0)
-        # broadcast them from the last rank to every pp rank so the head/
-        # loss (outside the pipeline, pp-replicated) sees real values
-        outs = jnp.where(rank == pp - 1, outs, jnp.zeros_like(outs))
-        outs = lax.psum(outs, axis)
-        return outs
+        (_, out_buf), _ = lax.scan(tick, (state0, out0), jnp.arange(ticks))
+        # one ring scatter: rank pp-1 holds all outputs; send chunk k to
+        # rank k so the result leaves sharded over pp on the mb dim
+        local = jnp.zeros((c_sz,) + xm_local.shape[1:], xm_local.dtype)
+        for k in range(pp):
+            chunk = lax.dynamic_slice_in_dim(out_buf, k * c_sz, c_sz, 0)
+            local = local + lax.ppermute(chunk, axis, [(pp - 1, k)])
+        return local
 
     mapped = jax.shard_map(
         per_shard, mesh=mesh.mesh,
         in_specs=(param_specs, in_mb_spec),
-        out_specs=in_mb_spec,
+        out_specs=out_spec,
         check_vma=False,
     )
     ym = mapped(stacked_params, xm)
+    ym = ym[:m] if m_pad != m else ym
     return ym.reshape(b, *ym.shape[2:])
+
+
+def _round_robin_order(pp: int, v: int) -> List[int]:
+    """Stacking order: position r*v + c holds chunk c*pp + r."""
+    return [c * pp + r for r in range(pp) for c in range(v)]
 
 
 class PipelineParallel(Layer):
     """Wraps a PipelineLayer for pipelined execution under the current
-    mesh (ref: meta_parallel/pipeline_parallel.py PipelineParallel).
+    mesh (ref: meta_parallel/pipeline_parallel.py PipelineParallel;
+    interleaving ref: pp_layers.py virtual_pp_degree).
 
-    forward(x) pipelines the trunk over the pp axis with
-    ``num_microbatches`` microbatches; on a mesh without a pp axis it
-    falls back to dense execution.
+    The stage-chunks' weights are re-registered HERE as stacked
+    parameters with a leading ``pp_stage`` logical axis (one tensor per
+    parameter, dim 0 of size ``num_stages`` in round-robin order), so
+    ``shard_params`` places each rank's chunks on that rank — the pp
+    memory partition is a sharding, not per-rank code. ``forward(x)``
+    pipelines the trunk with ``num_microbatches`` microbatches; on a mesh
+    without a pp axis it falls back to dense execution.
     """
 
     def __init__(self, pipe: PipelineLayer, num_microbatches: int = 1,
+                 virtual_pp_degree: int = 1,
                  mesh: Optional[DeviceMesh] = None,
                  mb_spec: P = P(), remat: bool = True):
         super().__init__()
-        self.pipe = pipe
+        if pipe.num_stages % virtual_pp_degree:
+            raise ValueError(
+                f"num_stages {pipe.num_stages} not divisible by "
+                f"virtual_pp_degree {virtual_pp_degree}")
+        self.num_stages = pipe.num_stages
+        self.virtual_pp_degree = virtual_pp_degree
         self.num_microbatches = num_microbatches
         self._mesh = mesh
         self._mb_spec = mb_spec
         self._remat = remat
 
+        pp = pipe.num_stages // virtual_pp_degree
+        chunks = list(pipe.stages)
+        for i, ch in enumerate(chunks):
+            if any(True for _ in ch.named_buffers()):
+                raise ValueError(
+                    "pipelined trunk must be buffer-free (stage "
+                    f"{i} registers buffers, e.g. BatchNorm stats)")
+        # structural prototype for one chunk; NOT a sublayer — its own
+        # concrete params are shadowed by the stacked ones below
+        object.__setattr__(self, "_proto", chunks[0])
+        metas = chunks[0].param_meta()
+        keys = sorted(dict(chunks[0].named_parameters()).keys())
+        for ch in chunks[1:]:
+            if sorted(dict(ch.named_parameters()).keys()) != keys:
+                raise ValueError("pipeline stages are not structurally "
+                                 "identical; SPMD pipelining requires it")
+        order = _round_robin_order(pp, virtual_pp_degree)
+        self._keys = keys
+        for key in keys:
+            stacked = jnp.stack(
+                [dict(chunks[i].named_parameters())[key] for i in order])
+            axes = metas[key].axes
+            if axes is None:
+                axes = (None,) * (stacked.ndim - 1)
+            self.add_parameter(
+                key.replace(".", "__"),
+                Parameter(stacked, trainable=metas[key].trainable,
+                          axes=("pp_stage", *axes)))
+
+    def _stacked(self):
+        return {k: self._parameters[k.replace(".", "__")]
+                for k in self._keys}
+
+    def _chunk_params(self, stacked, pos: int):
+        return {k: stacked[k][pos] for k in self._keys}
+
     def forward(self, x):
         mesh = self._mesh or get_mesh(required=False)
+        stacked = self._stacked()
+        v = self.virtual_pp_degree
         if mesh is None or mesh.axis_size("pp") <= 1:
-            return self.pipe(x)
-        if mesh.axis_size("pp") != self.pipe.num_stages:
+            # dense fallback: run chunks in logical order
+            pp = self.num_stages // v
+            for k in range(self.num_stages):
+                pos = (k % pp) * v + (k // pp)
+                x, _ = functional_call(
+                    self._proto, self._chunk_params(stacked, pos), {}, x,
+                    training=self.training)
+            return x
+        pp = mesh.axis_size("pp")
+        if pp * v != self.num_stages:
             raise ValueError(
-                f"mesh pp={mesh.axis_size('pp')} != "
-                f"{self.pipe.num_stages} pipeline stages")
-        stacked = _stack_stage_params(self.pipe)
-        proto = self.pipe.stages[0]
+                f"mesh pp={pp} x virtual_pp_degree={v} != "
+                f"{self.num_stages} pipeline stages")
 
+        # _proto is not a registered sublayer, so train()/eval() on this
+        # wrapper never reach it — propagate the mode explicitly per call
         def stage_fn(params_local, mb):
-            out, _ = functional_call(proto, params_local, {}, mb)
+            out, _ = functional_call(self._proto, params_local, {}, mb,
+                                     training=self.training)
             return out
 
         return pipeline_spmd(stage_fn, stacked, x,
                              self.num_microbatches, mesh,
-                             mb_spec=self._mb_spec, remat=self._remat)
+                             virtual=v, mb_spec=self._mb_spec,
+                             remat=self._remat)

@@ -31,6 +31,7 @@ from .mesh import DeviceMesh, get_mesh
 DEFAULT_RULES: Tuple[Tuple[str, str], ...] = (
     ("batch", "dp"),
     ("batch", "fsdp"),
+    ("pp_stage", "pp"),
     ("expert", "ep"),
     ("vocab", "tp"),
     ("mlp", "tp"),
