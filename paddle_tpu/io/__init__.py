@@ -499,6 +499,8 @@ class DataLoader:
         finally:
             for p in procs:
                 p.terminate()
+            for p in procs:  # reap — terminate alone leaks zombies
+                p.join(timeout=5.0)
 
     def __iter__(self):
         if self.num_workers > 0:

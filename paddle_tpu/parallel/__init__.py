@@ -22,4 +22,6 @@ from .pipeline import (LayerDesc, PipelineLayer, PipelineParallel,  # noqa
                        SharedLayerDesc, pipeline_spmd)
 from .recompute import (GradientMerge, RecomputeSequential,  # noqa
                         recompute)
+from .planner import ChipSpec, Plan, evaluate, plan  # noqa
 from . import collective  # noqa
+from . import planner  # noqa

@@ -137,16 +137,51 @@ class DataParallel(Layer):
 
 def distributed_model(model, strategy: Optional[DistributedStrategy] = None,
                       mesh: Optional[DeviceMesh] = None,
-                      rules: Optional[LogicalRules] = None):
+                      rules: Optional[LogicalRules] = None,
+                      global_batch: Optional[int] = None,
+                      seq_len: Optional[int] = None):
     """Attach sharding to a hapi ``Model`` (ref: fleet_base.py:947
     ``distributed_model`` wrapping TP→PP→Sharding→DP; here one call
     installs param/batch placement hooks and the compiled step becomes the
-    full hybrid-parallel program)."""
+    full hybrid-parallel program).
+
+    With no explicit ``mesh``/``strategy``, passing ``global_batch``
+    invokes the auto-parallel planner (ref: auto_parallel/engine.py:53
+    Engine auto mode): the cost model picks (dp, fsdp, tp) for the
+    current device count and the chosen layout is recorded on the
+    returned model as ``model._plan``. ``seq_len`` defaults to the
+    model's ``max_position_embeddings`` hint for sequence models."""
+    if strategy is not None and global_batch is not None:
+        raise ValueError(
+            "pass either strategy (manual layout) or global_batch "
+            "(auto-planned layout), not both — the planner would be "
+            "silently skipped")
     if mesh is None:
         mesh = get_mesh(required=False)
+        if mesh is not None and global_batch is not None:
+            import warnings
+            warnings.warn(
+                "distributed_model(global_batch=...) found a mesh already "
+                "installed; the auto-parallel planner was skipped and the "
+                "existing mesh is used as-is")
         if mesh is None:
-            axes = strategy.mesh_axes() if strategy else {"dp": -1}
-            mesh = init_mesh(**(axes or {"dp": -1}))
+            if strategy is None and global_batch is not None:
+                from . import planner
+                best = planner.plan(model.network, jax.device_count(),
+                                    global_batch=global_batch,
+                                    seq_len=seq_len, rules=rules)
+                if not best.fits:
+                    import warnings
+                    warnings.warn(
+                        "auto-parallel planner predicts an OOM on every "
+                        f"layout; using the smallest footprint: "
+                        f"{best.describe()}")
+                mesh = init_mesh(**{k: v for k, v in best.axes.items()
+                                    if v > 1} or {"dp": -1})
+                model._plan = best
+            else:
+                axes = strategy.mesh_axes() if strategy else {"dp": -1}
+                mesh = init_mesh(**(axes or {"dp": -1}))
     rules = rules or LogicalRules()
     meta = model.network.param_meta()
 

@@ -1,0 +1,311 @@
+"""Auto-parallel planner: choose mesh axis sizes from a cost model.
+
+Reference implementation being replaced: the auto_parallel planner stack —
+``Planner``/``ParallelTuner`` searching dist-attr configurations
+(python/paddle/distributed/auto_parallel/planner_v2.py:30), the measured
+per-op cost model (python/paddle/cost_model/cost_model.py,
+static_op_benchmark.json) and the comm/comp cost classes
+(auto_parallel/cost/base_cost.py), driven from ``Engine``
+(auto_parallel/engine.py:53).
+
+TPU-native design: the reference searches per-op process meshes and
+dims_mappings because every op can be placed differently; under GSPMD the
+placement degrees of freedom collapse to the MESH FACTORIZATION — XLA
+propagates a consistent sharding once axis sizes are fixed. So the search
+space here is factorizations of ``n_devices`` into (dp, fsdp, tp), scored
+by an analytic cost model with two parts:
+
+- **HBM footprint per chip** (the hard constraint): params + grads +
+  optimizer moments, each divided by the mesh axes the runtime's
+  ``LogicalRules`` would actually shard them over (the SAME rule table
+  ``shard_params`` uses — the plan predicts exactly what the runtime
+  does), plus an activation/logits estimate from model hints.
+- **Step time** (the objective): MXU compute time (model FLOPs / peak)
+  plus ICI time for the collectives each axis implies — dp/fsdp gradient
+  reduce-scatter+all-gather (ring cost 2·(n-1)/n·bytes), fsdp param
+  all-gather at use (ZeRO-3), tp's per-block activation all-reduces.
+
+Chip constants default to TPU v5e (16 GiB HBM, 197 bf16 TFLOP/s,
+~45 GB/s ICI per link) and are overridable via ``ChipSpec``.
+
+Pipeline parallelism is not part of the automatic search: pp changes the
+program (microbatching, a stage-splittable trunk), not just placement —
+callers opt in via ``models.gpt.GPTForCausalLMPipe`` and a ``pp`` mesh
+axis. The planner plans the data/model axes which compose with it.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from .sharding import LogicalRules
+
+_GiB = float(1 << 30)
+
+
+@dataclass(frozen=True)
+class ChipSpec:
+    """Per-chip hardware envelope (defaults: TPU v5e)."""
+    hbm_bytes: float = 16 * _GiB
+    peak_flops: float = 197e12          # bf16 MXU
+    ici_bytes_per_s: float = 45e9       # per-direction ring bandwidth
+    hbm_headroom: float = 0.85          # usable fraction (XLA workspace)
+
+
+@dataclass
+class ModelStats:
+    """What the cost model needs to know about one training step."""
+    param_bytes_sharded: float   # per chip, after rule-table sharding
+    param_bytes_total: float
+    grad_bytes_sharded: float
+    opt_bytes_sharded: float
+    act_bytes: float             # activations + logits, per chip
+    flops_per_chip: float
+    comm_bytes: float            # ICI bytes per step per chip
+
+
+@dataclass
+class Plan:
+    axes: Dict[str, int]
+    fits: bool
+    hbm_bytes: float
+    hbm_limit: float
+    step_time_s: float
+    compute_time_s: float
+    comm_time_s: float
+    breakdown: Dict[str, float] = field(default_factory=dict)
+
+    def describe(self) -> str:
+        ax = " x ".join(f"{k}={v}" for k, v in self.axes.items() if v > 1) \
+            or "single-device"
+        return (f"{ax}: {self.hbm_bytes / _GiB:.2f} GiB/chip "
+                f"(limit {self.hbm_limit / _GiB:.2f}), "
+                f"step {self.step_time_s * 1e3:.1f} ms "
+                f"(compute {self.compute_time_s * 1e3:.1f} + "
+                f"comm {self.comm_time_s * 1e3:.1f})"
+                f"{'' if self.fits else '  [OOM]'}")
+
+
+def abstract_model(ctor):
+    """Construct a Layer whose parameters are shape-only (no HBM/RAM):
+    the constructor runs under ``jax.eval_shape`` so initializers never
+    execute — plan models too big to materialize (the reference plans on
+    the static Program, which never materializes weights either;
+    engine.py prepares before parameter allocation)."""
+    import jax
+    import jax.numpy as jnp
+
+    box = {}
+
+    def build():
+        box["net"] = ctor()
+        return jnp.zeros(())
+
+    jax.eval_shape(build)
+    return box["net"]
+
+
+class _AxisSizes:
+    """Duck-typed stand-in for DeviceMesh inside LogicalRules.mesh_axes —
+    planning must not require the devices to exist yet."""
+
+    def __init__(self, sizes: Dict[str, int]):
+        self.axis_sizes = dict(sizes)
+
+    def axis_size(self, name: str) -> int:
+        return self.axis_sizes.get(name, 1)
+
+    def has_axis(self, name: str) -> bool:
+        return self.axis_sizes.get(name, 1) > 1
+
+
+def _factorizations(n: int, axes: Tuple[str, ...]) -> List[Dict[str, int]]:
+    """All ordered factorizations of n over the given axes."""
+    if len(axes) == 1:
+        return [{axes[0]: n}]
+    out = []
+    for d in range(1, n + 1):
+        if n % d == 0:
+            for rest in _factorizations(n // d, axes[1:]):
+                out.append({axes[0]: d, **rest})
+    return out
+
+
+def _model_hints(net) -> Dict[str, float]:
+    """Pull transformer-shaped hints off the model config if present."""
+    cfg = getattr(net, "cfg", None)
+    hints = {}
+    for name in ("hidden_size", "num_layers", "vocab_size",
+                 "max_position_embeddings"):
+        v = getattr(cfg, name, None)
+        if v is not None:
+            hints[name] = float(v)
+    return hints
+
+
+def _extract(net):
+    """One tree walk: (shapes, logical axes, hints) — reused across every
+    candidate the search evaluates."""
+    meta = net.param_meta()
+    shapes = {name: tuple(p.shape) for name, p in net.named_parameters()}
+    logical = {name: getattr(meta.get(name), "axes", None)
+               for name in shapes}
+    return shapes, logical, _model_hints(net)
+
+
+def _stats_for(shapes, logical, hints, axes: Dict[str, int],
+               global_batch: int, seq_len: int,
+               rules: LogicalRules, param_dtype_bytes: int,
+               act_dtype_bytes: int) -> ModelStats:
+    mesh = _AxisSizes(axes)
+
+    n_data = axes.get("dp", 1) * axes.get("fsdp", 1)
+    tp = axes.get("tp", 1)
+    b_local = max(1, global_batch // n_data)
+
+    param_total = 0.0
+    param_sharded = 0.0
+    for name, shape in shapes.items():
+        size = math.prod(shape) or 1
+        spec = rules.mesh_axes(logical[name], shape, mesh)
+        div = 1
+        for entry in spec:
+            for ax in (entry if isinstance(entry, tuple) else (entry,)):
+                if ax is not None:
+                    div *= axes.get(ax, 1)
+        param_total += size * param_dtype_bytes
+        param_sharded += size * param_dtype_bytes / div
+
+    # grads mirror param sharding; Adam-family moments are 2 extra copies
+    # in f32 (optimizer state inherits the param sharding)
+    grad_sharded = param_sharded
+    opt_sharded = 2.0 * param_sharded * (4 / param_dtype_bytes)
+
+    h = hints.get("hidden_size", 0.0)
+    layers = hints.get("num_layers", 0.0)
+    vocab = hints.get("vocab_size", 0.0)
+    if h and layers:
+        # remat'd transformer: one boundary activation [b,s,h] per block
+        # (boundaries are not tp-sharded; +2 blocks of working set) plus
+        # logits [b,s,V/tp] (vocab-sharded over tp by the rule table)
+        act = (layers + 2.0) * b_local * seq_len * h * act_dtype_bytes
+        logits = b_local * seq_len * (vocab / tp) * act_dtype_bytes \
+            if vocab else 0.0
+        act_bytes = act + logits
+    else:
+        # non-transformer fallback: assume activations ~ 2x sharded params
+        act_bytes = 2.0 * param_sharded
+
+    n_params = param_total / param_dtype_bytes
+    tokens_local = b_local * seq_len
+    flops_per_chip = 6.0 * n_params * tokens_local / tp
+
+    # ICI bytes per step per chip (ring costs):
+    comm = 0.0
+    dp, fsdp = axes.get("dp", 1), axes.get("fsdp", 1)
+    red = dp * fsdp  # gradients reduce over all data axes
+    if red > 1:
+        # reduce-scatter + all-gather of grads (allreduce ring identity)
+        comm += 2.0 * (red - 1) / red * (param_total / max(tp, 1))
+    if fsdp > 1:
+        # ZeRO-3: params all-gathered at use, forward + backward
+        comm += 2.0 * (fsdp - 1) / fsdp * (param_total / max(tp, 1))
+    if tp > 1 and layers:
+        # Megatron blocks: 2 activation allreduces per block forward,
+        # 2 in backward, on [b_local, s, h]
+        act_blk = b_local * seq_len * h * act_dtype_bytes
+        comm += 4.0 * layers * 2.0 * (tp - 1) / tp * act_blk
+
+    return ModelStats(param_sharded, param_total, grad_sharded,
+                      opt_sharded, act_bytes, flops_per_chip, comm)
+
+
+def _infer_seq_len(seq_len: Optional[int], hints: Dict[str, float]) -> int:
+    """seq_len=None: read the model's max_position_embeddings hint — a
+    default of 1 on a sequence model would understate activations,
+    logits, FLOPs, and tp comm by the whole sequence length."""
+    if seq_len is not None:
+        return seq_len
+    return int(hints.get("max_position_embeddings", 1))
+
+
+def _evaluate(shapes, logical, hints, axes: Dict[str, int],
+              global_batch: int, seq_len: int, chip: ChipSpec,
+              rules: LogicalRules, param_dtype_bytes: int,
+              act_dtype_bytes: int) -> Plan:
+    s = _stats_for(shapes, logical, hints, axes, global_batch, seq_len,
+                   rules, param_dtype_bytes, act_dtype_bytes)
+    hbm = s.param_bytes_sharded + s.grad_bytes_sharded + \
+        s.opt_bytes_sharded + s.act_bytes
+    limit = chip.hbm_bytes * chip.hbm_headroom
+    compute_t = s.flops_per_chip / chip.peak_flops
+    comm_t = s.comm_bytes / chip.ici_bytes_per_s
+    # TPU overlaps collectives with compute only partially; summing ranks
+    # conservatively (the relative order of candidates is what matters)
+    return Plan(axes=dict(axes), fits=hbm <= limit, hbm_bytes=hbm,
+                hbm_limit=limit, step_time_s=compute_t + comm_t,
+                compute_time_s=compute_t, comm_time_s=comm_t,
+                breakdown={
+                    "params": s.param_bytes_sharded,
+                    "grads": s.grad_bytes_sharded,
+                    "opt_state": s.opt_bytes_sharded,
+                    "activations": s.act_bytes,
+                    "comm_bytes": s.comm_bytes,
+                })
+
+
+def evaluate(net, axes: Dict[str, int], global_batch: int,
+             seq_len: Optional[int] = None,
+             chip: Optional[ChipSpec] = None,
+             rules: Optional[LogicalRules] = None,
+             param_dtype_bytes: int = 4,
+             act_dtype_bytes: int = 2) -> Plan:
+    """Cost one candidate mesh factorization (the reference's
+    ``CostEstimator.estimate`` analog, auto_parallel/cost/estimate_cost)."""
+    shapes, logical, hints = _extract(net)
+    return _evaluate(shapes, logical, hints, axes, global_batch,
+                     _infer_seq_len(seq_len, hints), chip or ChipSpec(),
+                     rules or LogicalRules(), param_dtype_bytes,
+                     act_dtype_bytes)
+
+
+def plan(net, n_devices: int, global_batch: int,
+         seq_len: Optional[int] = None,
+         chip: Optional[ChipSpec] = None,
+         rules: Optional[LogicalRules] = None,
+         param_dtype_bytes: int = 4,
+         act_dtype_bytes: int = 2,
+         return_all: bool = False):
+    """Choose (dp, fsdp, tp) for ``net`` on ``n_devices`` chips.
+
+    Enumerates every factorization, drops layouts that exceed HBM or that
+    shard dims unevenly (a tp that does not divide the head count would
+    fall back to replication at runtime — the cost model sees that
+    through the rule table), and returns the feasible Plan with the
+    lowest predicted step time. If nothing fits, returns the
+    smallest-footprint plan with ``fits=False`` so the caller can report
+    an honest OOM prediction. Ref: planner_v2.py Planner.plan.
+    """
+    chip = chip or ChipSpec()
+    rules = rules or LogicalRules()
+    shapes, logical, hints = _extract(net)  # one tree walk for all cands
+    seq = _infer_seq_len(seq_len, hints)
+    cands = []
+    for axes in _factorizations(n_devices, ("dp", "fsdp", "tp")):
+        if global_batch % (axes["dp"] * axes["fsdp"]):
+            continue
+        cands.append(_evaluate(shapes, logical, hints, axes,
+                               global_batch, seq, chip, rules,
+                               param_dtype_bytes, act_dtype_bytes))
+    if not cands:
+        raise ValueError(
+            f"no mesh factorization of {n_devices} devices divides "
+            f"global batch {global_batch}")
+    feasible = [p for p in cands if p.fits]
+    if feasible:
+        best = min(feasible, key=lambda p: p.step_time_s)
+    else:
+        best = min(cands, key=lambda p: p.hbm_bytes)
+    return (best, cands) if return_all else best

@@ -1,0 +1,123 @@
+"""Auto-parallel planner: cost model + layout search.
+
+Analog of the reference's planner tests
+(unittests/auto_parallel/test_cost_model.py, test_planner.py): the cost
+model must predict the OOM the runtime would hit and pick a layout that
+avoids it."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import parallel
+from paddle_tpu.parallel import planner
+from paddle_tpu.models.gpt import (GPTConfig, GPTForCausalLM,
+                                   GPTPretrainingCriterion, gpt_config)
+
+_GiB = float(1 << 30)
+
+
+@pytest.fixture(scope="module")
+def gpt_1p3b():
+    # shape-only construction: 1.3B params never materialize
+    return planner.abstract_model(
+        lambda: GPTForCausalLM(gpt_config("gpt3-1.3b")))
+
+
+def test_naive_dp_ooms_on_1p3b(gpt_1p3b):
+    """GPT-1.3B with Adam on 8 v5e chips under pure DP: ~21 GiB/chip of
+    params+grads+moments alone — the cost model must flag it."""
+    p = planner.evaluate(gpt_1p3b, {"dp": 8}, global_batch=64,
+                         seq_len=2048)
+    assert not p.fits, p.describe()
+    # params+grads (f32) + 2 adam moments = 4x param bytes, unsharded
+    assert p.breakdown["params"] > 4.5 * _GiB
+    assert p.hbm_bytes > p.hbm_limit
+
+
+def test_planner_picks_nontrivial_layout_for_1p3b(gpt_1p3b):
+    """VERDICT r1 item 5 'done' bar: the planner must find a layout that
+    fits where naive DP OOMs, and it must be non-trivial."""
+    best, cands = planner.plan(gpt_1p3b, 8, global_batch=64,
+                               seq_len=2048, return_all=True)
+    assert best.fits, best.describe()
+    assert best.axes.get("fsdp", 1) * best.axes.get("tp", 1) > 1, \
+        best.describe()
+    assert best.hbm_bytes < best.hbm_limit
+    # and it should be the fastest feasible candidate
+    for c in cands:
+        if c.fits:
+            assert best.step_time_s <= c.step_time_s + 1e-12
+
+
+def test_planner_prefers_pure_dp_when_everything_fits():
+    """Small model: dp has the least comm (no param all-gather, no
+    activation all-reduce), so the planner must not over-shard."""
+    pt.seed(0)
+    net = GPTForCausalLM(GPTConfig(
+        vocab_size=128, hidden_size=32, num_layers=2, num_heads=2,
+        max_position_embeddings=32, use_flash=False))
+    best = planner.plan(net, 8, global_batch=32, seq_len=32)
+    assert best.fits
+    assert best.axes["dp"] == 8, best.describe()
+
+
+def test_evaluate_breakdown_sums_to_total(gpt_1p3b):
+    p = planner.evaluate(gpt_1p3b, {"fsdp": 4, "tp": 2},
+                         global_batch=64, seq_len=2048)
+    parts = (p.breakdown["params"] + p.breakdown["grads"] +
+             p.breakdown["opt_state"] + p.breakdown["activations"])
+    np.testing.assert_allclose(p.hbm_bytes, parts, rtol=1e-9)
+    # fsdp shards the bulk of the params
+    assert p.breakdown["params"] < 2.0 * _GiB
+
+
+def test_batch_divisibility_filters_layouts(gpt_1p3b):
+    # global_batch=4 rules out dp*fsdp=8 factorizations
+    best = planner.plan(gpt_1p3b, 8, global_batch=4, seq_len=2048)
+    assert best.axes.get("dp", 1) * best.axes.get("fsdp", 1) <= 4
+
+
+def test_seq_len_inferred_from_model_hints(gpt_1p3b):
+    """seq_len=None must read max_position_embeddings (2048 for 1.3B) —
+    a silent default of 1 would understate activations 2048x."""
+    inferred = planner.plan(gpt_1p3b, 8, global_batch=64)
+    explicit = planner.plan(gpt_1p3b, 8, global_batch=64, seq_len=2048)
+    assert inferred.axes == explicit.axes
+    np.testing.assert_allclose(inferred.hbm_bytes, explicit.hbm_bytes)
+
+
+def test_strategy_and_global_batch_conflict():
+    pt.seed(0)
+    net = GPTForCausalLM(GPTConfig(
+        vocab_size=64, hidden_size=16, num_layers=2, num_heads=2,
+        max_position_embeddings=32, use_flash=False))
+    model = pt.Model(net)
+    with pytest.raises(ValueError, match="not both"):
+        parallel.distributed_model(
+            model, strategy=parallel.DistributedStrategy(),
+            global_batch=16)
+
+
+def test_distributed_model_auto_plans_mesh():
+    """distributed_model(global_batch=...) runs the planner and attaches
+    the chosen mesh + plan (Engine auto-mode analog)."""
+    pt.seed(0)
+    cfg = GPTConfig(vocab_size=64, hidden_size=16, num_layers=2,
+                    num_heads=2, max_position_embeddings=32,
+                    hidden_dropout=0.0, attention_dropout=0.0,
+                    use_flash=False)
+    net = GPTForCausalLM(cfg)
+    model = pt.Model(net)
+    model.prepare(
+        optimizer=pt.optimizer.AdamW(learning_rate=1e-3, parameters=net),
+        loss=GPTPretrainingCriterion())
+    try:
+        parallel.distributed_model(model, global_batch=16, seq_len=32)
+        assert model._plan.fits
+        assert model._mesh is not None
+        ids = np.random.RandomState(0).randint(0, 64, (16, 32))
+        logs = model.train_batch([ids], [ids])
+        assert np.isfinite(logs["loss"])
+    finally:
+        parallel.set_mesh(None)
