@@ -16,3 +16,5 @@ from ..parallel import (AXIS_ORDER, DataParallel, DeviceMesh,  # noqa
                         recompute, replicate, set_mesh, shard_batch,
                         shard_params)
 from . import launch  # noqa
+from . import elastic  # noqa
+from .elastic import ElasticManager, ElasticStatus, Heartbeat  # noqa

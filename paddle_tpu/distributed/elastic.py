@@ -1,0 +1,264 @@
+"""Elastic training: failure detection, restart decisions, resume.
+
+Reference being replaced: the etcd-backed ``ElasticManager``
+(python/paddle/distributed/fleet/elastic/manager.py:131) — workers
+register TTL-leased nodes under a job prefix, a watcher compares the
+live-node count to the expected np and maps it to ``ElasticStatus``
+HOLD/RESTART/COMPLETED/EXIT (manager.py ElasticStatus), and the launcher
+tears down / respawns ranks accordingly; paired with epoch-level
+auto-checkpoint resume (fluid/incubate/checkpoint/auto_checkpoint.py).
+
+TPU-native redesign: there is no etcd in the loop. On TPU pods the
+platform scheduler owns membership, and in-process failures surface two
+ways: a rank process DIES (observable by the parent launcher — the
+analog of an expired etcd lease), or a rank WEDGES while its process
+stays alive (a hung device: only visible as lack of training progress).
+So the manager watches both signals locally:
+
+- process liveness — ``Popen.poll`` per rank, the lease expiry analog;
+- progress heartbeats — each rank touches a per-rank file, either from
+  a daemon thread (process-liveness semantics, like the reference's
+  lease-keepalive thread) or from the training loop via ``beat()``
+  (progress semantics — catches hangs the thread mode cannot).
+
+A failed generation is torn down (SIGTERM all ranks), the rendezvous
+port is rotated, and a new generation starts with
+``PADDLE_ELASTIC_RESTART_COUNT`` incremented; ranks resume from the
+latest ``io.AutoCheckpoint``/``CheckpointManager`` snapshot. Restart
+budget and statuses mirror the reference's semantics.
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+from .launch import find_free_port
+from typing import Dict, List, Optional
+
+HB_DIR_ENV = "PADDLE_ELASTIC_HB_DIR"
+RESTART_COUNT_ENV = "PADDLE_ELASTIC_RESTART_COUNT"
+
+
+class ElasticStatus(enum.Enum):
+    """ref: elastic/manager.py ElasticStatus."""
+    HOLD = "hold"            # generation healthy, keep watching
+    COMPLETED = "completed"  # every rank exited 0
+    RESTART = "restart"      # a rank died or stalled; respawn
+    ERROR = "error"          # restart budget exhausted
+
+
+# ---------------------------------------------------------------------------
+# rank side
+# ---------------------------------------------------------------------------
+
+class Heartbeat:
+    """Rank-side progress signal (the reference's TTL lease keepalive,
+    manager.py lease refresh thread).
+
+    mode="thread": a daemon thread touches ``hb.{rank}`` every
+    ``interval`` — equivalent to the reference's semantics (proves the
+    process is alive). mode="manual": the training loop calls
+    :meth:`beat` each step, writing ``progress.{rank}`` — stronger,
+    proves actual progress. The two write DIFFERENT files, and the
+    manager judges staleness on progress files whenever any exist, so
+    the auto-started liveness thread can never mask a wedged device
+    that has stopped making progress."""
+
+    def __init__(self, directory: Optional[str] = None,
+                 rank: Optional[int] = None, interval: float = 1.0,
+                 mode: str = "thread"):
+        directory = directory or os.environ.get(HB_DIR_ENV)
+        if directory is None:
+            raise ValueError(
+                f"no heartbeat directory (arg or ${HB_DIR_ENV})")
+        rank = rank if rank is not None else int(
+            os.environ.get("PADDLE_TRAINER_ID", 0))
+        os.makedirs(directory, exist_ok=True)
+        prefix = "hb" if mode == "thread" else "progress"
+        self.path = os.path.join(directory, f"{prefix}.{rank}")
+        self.interval = interval
+        self.mode = mode
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.beat()
+        if mode == "thread":
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+
+    def beat(self) -> None:
+        with open(self.path, "w") as f:
+            f.write(str(time.time()))
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            self.beat()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def restart_count() -> int:
+    """How many times the elastic manager has restarted this job (0 on
+    the first incarnation) — scripts branch on this to decide resume."""
+    return int(os.environ.get(RESTART_COUNT_ENV, 0))
+
+
+# ---------------------------------------------------------------------------
+# launcher side
+# ---------------------------------------------------------------------------
+
+class ElasticManager:
+    """Spawns ranks, watches liveness + heartbeats, decides
+    HOLD/RESTART/COMPLETED/ERROR per generation, and re-runs up to
+    ``max_restarts`` times (ref: manager.py watch loop + launcher
+    restart in launch/controllers/collective.py)."""
+
+    def __init__(self, nproc: int, training_script: str,
+                 script_args: List[str],
+                 master: Optional[str] = None,
+                 log_dir: Optional[str] = None,
+                 max_restarts: int = 0,
+                 heartbeat_timeout: Optional[float] = None,
+                 env_extra: Optional[Dict[str, str]] = None,
+                 poll_interval: float = 0.2):
+        self.nproc = nproc
+        self.script = training_script
+        self.script_args = script_args
+        self.master = master or f"127.0.0.1:{find_free_port()}"
+        self.log_dir = log_dir
+        self.max_restarts = max_restarts
+        self.heartbeat_timeout = heartbeat_timeout
+        self.env_extra = env_extra or {}
+        self.poll_interval = poll_interval
+        self.restarts = 0
+
+    # -- one generation ------------------------------------------------
+    def _spawn(self) -> None:
+        self._procs: List[subprocess.Popen] = []
+        self._logs = []
+        self._gen_start = time.time()
+        if self.heartbeat_timeout is not None:
+            if self.log_dir:
+                self._hb_dir = os.path.join(
+                    self.log_dir, f"elastic_hb_gen{self.restarts}")
+            else:
+                import tempfile
+                self._hb_dir = os.path.join(
+                    tempfile.gettempdir(),
+                    f"pt_elastic_hb_{os.getpid()}_{self.restarts}")
+            os.makedirs(self._hb_dir, exist_ok=True)
+            # leftover beats from a previous run sharing this dir would
+            # read as instantly-stale and restart a healthy generation
+            for f in os.listdir(self._hb_dir):
+                try:
+                    os.unlink(os.path.join(self._hb_dir, f))
+                except OSError:
+                    pass
+        for rank in range(self.nproc):
+            env = dict(os.environ)
+            env.update(self.env_extra)
+            env["PADDLE_MASTER"] = self.master
+            env["MASTER_ADDR"], env["MASTER_PORT"] = \
+                self.master.split(":")
+            env["PADDLE_TRAINER_ID"] = str(rank)
+            env["PADDLE_TRAINERS_NUM"] = str(self.nproc)
+            env["RANK"] = str(rank)
+            env["WORLD_SIZE"] = str(self.nproc)
+            env[RESTART_COUNT_ENV] = str(self.restarts)
+            if self.heartbeat_timeout is not None:
+                env[HB_DIR_ENV] = self._hb_dir
+            stdout = None
+            if self.log_dir:
+                os.makedirs(self.log_dir, exist_ok=True)
+                f = open(os.path.join(
+                    self.log_dir, f"worker.{rank}.log"), "a")
+                self._logs.append(f)
+                stdout = f
+            self._procs.append(subprocess.Popen(
+                [sys.executable, self.script, *self.script_args],
+                env=env, stdout=stdout,
+                stderr=subprocess.STDOUT if stdout else None))
+
+    def _teardown(self) -> None:
+        for p in self._procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        deadline = time.time() + 30
+        for p in self._procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+        for f in self._logs:
+            f.close()
+        self._logs = []
+
+    def _newest(self, prefix: str) -> Optional[float]:
+        newest = None
+        for rank in range(self.nproc):
+            path = os.path.join(self._hb_dir, f"{prefix}.{rank}")
+            try:
+                m = os.path.getmtime(path)
+            except OSError:
+                continue
+            newest = m if newest is None else max(newest, m)
+        return newest
+
+    def _heartbeats_stale(self) -> bool:
+        if self.heartbeat_timeout is None:
+            return False
+        grace = max(3 * self.heartbeat_timeout, 5.0)
+        now = time.time()
+        # progress beats (manual, from the training loop) outrank the
+        # liveness thread: a wedged device keeps the thread beating but
+        # stalls progress — judge on progress whenever any rank sent one
+        newest = self._newest("progress")
+        if newest is None:
+            newest = self._newest("hb")
+        if newest is None:  # nothing beat yet: allow spawn grace
+            return now - self._gen_start > grace
+        return now - newest > self.heartbeat_timeout
+
+    def _watch_generation(self) -> "tuple[ElasticStatus, int]":
+        live = list(self._procs)
+        code = 0
+        try:
+            while live:
+                for p in list(live):
+                    rc = p.poll()
+                    if rc is None:
+                        continue
+                    live.remove(p)
+                    if rc != 0:
+                        return ElasticStatus.RESTART, rc
+                if self._heartbeats_stale():
+                    return ElasticStatus.RESTART, -1
+                time.sleep(self.poll_interval)
+            return ElasticStatus.COMPLETED, 0
+        finally:
+            self._teardown()
+
+    # -- the job -------------------------------------------------------
+    def run(self) -> int:
+        """Run to completion with restarts; return the exit code."""
+        while True:
+            self._spawn()
+            status, code = self._watch_generation()
+            if status is ElasticStatus.COMPLETED:
+                return 0
+            self.restarts += 1
+            if self.restarts > self.max_restarts:
+                return code if code != 0 else 1
+            print(f"[elastic] restart {self.restarts}/{self.max_restarts}"
+                  f" after {'stall' if code == -1 else f'exit {code}'}",
+                  file=sys.stderr)
+            # fresh rendezvous for the new generation (the reference
+            # re-registers under a new etcd index the same way)
+            self.master = f"127.0.0.1:{find_free_port()}"

@@ -20,12 +20,8 @@ watcher's job)."""
 from __future__ import annotations
 
 import argparse
-import os
-import signal
 import socket
-import subprocess
 import sys
-import time
 from typing import List, Optional
 
 
@@ -40,64 +36,19 @@ def launch(nproc: int, training_script: str,
            master: Optional[str] = None,
            log_dir: Optional[str] = None,
            max_restarts: int = 0,
+           heartbeat_timeout: Optional[float] = None,
            env_extra: Optional[dict] = None) -> int:
-    """Spawn ``nproc`` ranks of ``training_script``; return exit code."""
-    master = master or f"127.0.0.1:{find_free_port()}"
-    restarts = 0
-    while True:
-        procs = []
-        logs = []
-        for rank in range(nproc):
-            env = dict(os.environ)
-            env.update(env_extra or {})
-            env["PADDLE_MASTER"] = master
-            env["MASTER_ADDR"] = master.split(":")[0]
-            env["MASTER_PORT"] = master.split(":")[1]
-            env["PADDLE_TRAINER_ID"] = str(rank)
-            env["PADDLE_TRAINERS_NUM"] = str(nproc)
-            env["RANK"] = str(rank)
-            env["WORLD_SIZE"] = str(nproc)
-            stdout = None
-            if log_dir:
-                os.makedirs(log_dir, exist_ok=True)
-                f = open(os.path.join(log_dir, f"worker.{rank}.log"), "w")
-                logs.append(f)
-                stdout = f
-            procs.append(subprocess.Popen(
-                [sys.executable, training_script, *script_args],
-                env=env, stdout=stdout,
-                stderr=subprocess.STDOUT if stdout else None))
+    """Spawn ``nproc`` ranks of ``training_script``; return exit code.
 
-        exit_code = 0
-        try:
-            while procs:
-                for p in list(procs):
-                    rc = p.poll()
-                    if rc is None:
-                        continue
-                    procs.remove(p)
-                    if rc != 0:
-                        exit_code = rc
-                        # fail fast: kill the rest (watcher semantics)
-                        for q in procs:
-                            q.send_signal(signal.SIGTERM)
-                        for q in procs:
-                            q.wait(timeout=30)
-                        procs = []
-                        break
-                time.sleep(0.2)
-        finally:
-            for f in logs:
-                f.close()
-
-        if exit_code == 0:
-            return 0
-        restarts += 1
-        if restarts > max_restarts:
-            return exit_code
-        print(f"[launch] restart {restarts}/{max_restarts} after "
-              f"failure (code {exit_code})", file=sys.stderr)
-        master = f"127.0.0.1:{find_free_port()}"  # fresh rendezvous
+    One code path: the ElasticManager watches every generation
+    (process liveness always; progress heartbeats when
+    ``heartbeat_timeout`` is set) and restarts failed/stalled
+    generations up to ``max_restarts`` times."""
+    from .elastic import ElasticManager
+    return ElasticManager(
+        nproc, training_script, script_args, master=master,
+        log_dir=log_dir, max_restarts=max_restarts,
+        heartbeat_timeout=heartbeat_timeout, env_extra=env_extra).run()
 
 
 def main(argv=None) -> int:
@@ -110,12 +61,16 @@ def main(argv=None) -> int:
                         help="host:port rendezvous (default: free port)")
     parser.add_argument("--log_dir", type=str, default=None)
     parser.add_argument("--max_restarts", type=int, default=0)
+    parser.add_argument("--heartbeat_timeout", type=float, default=None,
+                        help="restart the job if no rank heartbeats for "
+                             "this many seconds (elastic stall watch)")
     parser.add_argument("training_script")
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
     return launch(args.nproc_per_node, args.training_script,
                   args.script_args, master=args.master,
-                  log_dir=args.log_dir, max_restarts=args.max_restarts)
+                  log_dir=args.log_dir, max_restarts=args.max_restarts,
+                  heartbeat_timeout=args.heartbeat_timeout)
 
 
 if __name__ == "__main__":

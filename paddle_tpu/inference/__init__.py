@@ -190,9 +190,34 @@ class Predictor:
             if config.plugin_options is not None else \
             default_plugin_options()
         err = ctypes.create_string_buffer(4096)
-        self._h = lib.ptpred_create(
-            plugin.encode(), options.encode(), config.model_dir.encode(),
-            err, len(err))
+        # Bound client creation: PJRT_Client_Create on a tunneled device
+        # blocks indefinitely while another client holds the chip (the
+        # relay queues the claim), which would freeze the caller — run it
+        # on a helper thread and fail loudly on timeout instead. The
+        # stuck thread is daemonized and leaked knowingly; the process
+        # stays usable. Override via PT_PJRT_CREATE_TIMEOUT (seconds).
+        import threading
+        timeout = float(os.environ.get("PT_PJRT_CREATE_TIMEOUT", 120))
+        box = {}
+
+        def _create():
+            try:
+                box["h"] = lib.ptpred_create(
+                    plugin.encode(), options.encode(),
+                    config.model_dir.encode(), err, len(err))
+            except BaseException as e:  # re-raised on the caller thread
+                box["exc"] = e
+
+        t = threading.Thread(target=_create, daemon=True)
+        t.start()
+        t.join(timeout)
+        if t.is_alive():
+            raise TimeoutError(
+                f"PJRT client creation did not finish in {timeout:.0f}s "
+                f"— device busy or tunnel wedged (plugin {plugin})")
+        if "exc" in box:
+            raise box["exc"]
+        self._h = box.get("h")
         if not self._h:
             raise RuntimeError(
                 f"predictor create failed: {err.value.decode()}")

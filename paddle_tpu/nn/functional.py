@@ -35,9 +35,15 @@ silu = jax.nn.silu
 swish = jax.nn.silu
 elu = jax.nn.elu
 selu = jax.nn.selu
-gelu = jax.nn.gelu
 glu = jax.nn.glu
 tanh = jnp.tanh
+
+
+def gelu(x, approximate: bool = False):
+    """Exact erf form by default, matching the reference's
+    paddle.nn.functional.gelu(approximate=False) (phi/kernels gelu);
+    jax.nn.gelu's own default is the tanh approximation."""
+    return jax.nn.gelu(x, approximate=approximate)
 
 
 def leaky_relu(x, negative_slope: float = 0.01):

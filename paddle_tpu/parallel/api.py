@@ -51,6 +51,13 @@ def init_parallel_env(coordinator_address: Optional[str] = None,
     if addr and nproc > 1:
         jax.distributed.initialize(coordinator_address=addr,
                                    num_processes=nproc, process_id=pid)
+    # under an elastic launcher, start the liveness heartbeat (the
+    # lease-keepalive the reference's ElasticManager expects;
+    # fleet/elastic/manager.py) — manual progress beats can be layered
+    # on via distributed.elastic.Heartbeat(mode="manual")
+    from ..distributed import elastic as _elastic
+    if os.environ.get(_elastic.HB_DIR_ENV):
+        _elastic.Heartbeat()
     _initialized = True
 
 

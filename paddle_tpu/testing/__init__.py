@@ -1,0 +1,142 @@
+"""paddle_tpu.testing — the systematic op-test harness.
+
+Reference being replaced: ``OpTest``
+(python/paddle/fluid/tests/unittests/op_test.py:309 ``check_output`` —
+forward vs a reference implementation with per-dtype tolerances;
+op_test.py:1892 ``check_grad`` — numeric finite-difference gradients
+with per-op ``max_relative_error``).
+
+TPU-native redesign: the reference perturbs every input element and
+rebuilds the op's output (O(numel) forward passes). Here the gradient
+check is a *directional-derivative identity* — for random direction
+``v`` and cotangent ``u``::
+
+    <grad_x <f(x), u>, v>  ==  d/de <f(x + e v), u> |_{e=0}
+
+The left side is one ``jax.grad`` call (the thing being validated); the
+right side is one central finite difference — two forward evaluations
+total, O(1) instead of O(numel), and it still detects every wrong-VJP
+failure mode except errors exactly orthogonal to a random direction
+(probability ~0). Forward checks compare the jitted op against a NumPy
+reference under a per-dtype tolerance table, like the reference's
+``np.allclose`` with dtype-keyed atol/rtol.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# per-dtype forward tolerances (ref: op_test.py dtype-dependent
+# atol/rtol selection in check_output)
+FORWARD_TOL: Dict[Any, Tuple[float, float]] = {
+    np.dtype(np.float32): (2e-5, 2e-5),
+    np.dtype(np.float64): (1e-12, 1e-12),
+    np.dtype(np.float16): (2e-3, 2e-3),
+    # bfloat16 compared after cast to f32
+}
+# directional FD: f32 central differences are noisy; this is a
+# structure/sign check, not a precision check (ref: per-op
+# max_relative_error values of 0.005-0.7 in the unittests)
+GRAD_RTOL = 5e-2
+GRAD_ATOL = 1e-3
+
+
+def arr(shape, low=-1.0, high=1.0, dtype=np.float32, seed=0):
+    """Deterministic test input on [low, high)."""
+    r = np.random.RandomState(seed)
+    x = r.uniform(low, high, size=shape)
+    return x.astype(dtype)
+
+
+@dataclass
+class OpSpec:
+    """One op's test declaration: the op, a NumPy reference, inputs."""
+    name: str
+    fn: Callable                      # the paddle_tpu op
+    ref: Optional[Callable]           # NumPy reference (None: skip fwd)
+    inputs: Tuple[Any, ...]           # positional inputs (np arrays ok)
+    kwargs: Dict[str, Any] = field(default_factory=dict)
+    grad: bool = True                 # run the directional-FD check
+    grad_wrt: Tuple[int, ...] = (0,)  # which positional args get grads
+    jit: bool = True                  # False: dynamic-output-shape op,
+    #                                   eager-only (bincount, unique, ...)
+    fd_eps: float = 1e-3
+    rtol: Optional[float] = None      # forward override
+    atol: Optional[float] = None
+    grad_rtol: float = GRAD_RTOL
+    grad_atol: float = GRAD_ATOL
+
+    def __repr__(self):  # pytest id
+        return self.name
+
+
+def check_forward(spec: OpSpec) -> None:
+    if spec.ref is None:
+        return
+    call = (lambda *a: spec.fn(*a, **spec.kwargs))
+    out = (jax.jit(call) if spec.jit else call)(*spec.inputs)
+    expect = spec.ref(*[np.asarray(x) for x in spec.inputs])
+    out_t = jax.tree_util.tree_leaves(out)
+    exp_t = jax.tree_util.tree_leaves(expect)
+    assert len(out_t) == len(exp_t), \
+        f"{spec.name}: {len(out_t)} outputs vs {len(exp_t)} expected"
+    for o, e in zip(out_t, exp_t):
+        o = np.asarray(o)
+        e = np.asarray(e)
+        if o.dtype == jnp.bfloat16:
+            o = o.astype(np.float32)
+        rtol, atol = FORWARD_TOL.get(np.dtype(o.dtype) if
+                                     o.dtype.kind == "f" else None,
+                                     (0.0, 0.0))
+        np.testing.assert_allclose(
+            o, e.astype(o.dtype) if o.dtype.kind == "f" else e,
+            rtol=spec.rtol if spec.rtol is not None else rtol,
+            atol=spec.atol if spec.atol is not None else atol,
+            err_msg=f"{spec.name} forward mismatch")
+
+
+def check_grad(spec: OpSpec) -> None:
+    if not spec.grad:
+        return
+    inputs = [jnp.asarray(x) for x in spec.inputs]
+
+    def scalar(*args):
+        # the RandomState is created per call so grad, f(x+ev) and
+        # f(x-ev) all contract against the SAME cotangent u
+        r = np.random.RandomState(1234)
+        out = spec.fn(*args, **spec.kwargs)
+        leaves = jax.tree_util.tree_leaves(out)
+        total = 0.0
+        for leaf in leaves:
+            u = jnp.asarray(
+                r.uniform(-1, 1, size=np.shape(leaf)).astype(np.float32))
+            total = total + jnp.sum(leaf.astype(jnp.float32) * u)
+        return total
+
+    grads = jax.grad(scalar, argnums=spec.grad_wrt)(*inputs)
+    for slot, g in zip(spec.grad_wrt, grads):
+        rv = np.random.RandomState(99 + slot)
+        v = rv.uniform(-1, 1, size=np.shape(inputs[slot])) \
+            .astype(np.float32)
+        v = jnp.asarray(v)
+        eps = spec.fd_eps
+        plus = list(inputs)
+        minus = list(inputs)
+        plus[slot] = inputs[slot] + eps * v
+        minus[slot] = inputs[slot] - eps * v
+        fd = (float(scalar(*plus)) - float(scalar(*minus))) / (2 * eps)
+        analytic = float(jnp.sum(g * v))
+        np.testing.assert_allclose(
+            analytic, fd, rtol=spec.grad_rtol, atol=spec.grad_atol,
+            err_msg=f"{spec.name} grad (arg {slot}): analytic "
+                    f"{analytic} vs finite-difference {fd}")
+
+
+def run_spec(spec: OpSpec) -> None:
+    check_forward(spec)
+    check_grad(spec)

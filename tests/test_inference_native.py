@@ -40,7 +40,11 @@ def _save_and_serve(net, x, tmp_path, atol):
     jit.save(net, path,
              input_spec=[jit.InputSpec(list(x.shape), str(x.dtype))])
     from paddle_tpu import inference
-    pred = inference.create_predictor(inference.Config(path))
+    os.environ.setdefault("PT_PJRT_CREATE_TIMEOUT", "90")
+    try:
+        pred = inference.create_predictor(inference.Config(path))
+    except TimeoutError as e:
+        pytest.skip(f"device unavailable for native predictor: {e}")
     out = pred.run([x])[0]
     assert out.shape == ref.shape
     # CPU-exported f32 convs run through the MXU's bf16 passes on TPU:
@@ -104,10 +108,13 @@ def test_native_predictor_fresh_process(tmp_path):
     """)
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)  # let the server pick its backend
+    env.setdefault("PT_PJRT_CREATE_TIMEOUT", "90")
     proc = subprocess.run([sys.executable, "-c", script],
                           capture_output=True, text=True, timeout=300,
                           env=env, cwd=os.path.dirname(
                               os.path.dirname(os.path.abspath(__file__))))
+    if "TimeoutError" in proc.stderr and "tunnel" in proc.stderr:
+        pytest.skip("device unavailable for native predictor")
     assert "SERVED_OK" in proc.stdout, proc.stderr[-2000:]
     out = np.load(tmp_path / "out.npy")
     np.testing.assert_allclose(out, ref, atol=5e-2)

@@ -1,0 +1,392 @@
+"""Systematic op sweep: every numeric op vs a NumPy reference + a
+directional finite-difference gradient check.
+
+The analog of the reference's per-op OpTest subclasses
+(unittests/test_activation_op.py, test_elementwise_*_op.py,
+test_reduce_op.py, ... — each calling check_output/check_grad,
+op_test.py:309/:1892), collapsed into one declarative table driven by
+paddle_tpu.testing."""
+
+import numpy as np
+import pytest
+import scipy.special as sps
+
+import paddle_tpu as pt
+import paddle_tpu.tensor as T
+from paddle_tpu.nn import functional as F
+from paddle_tpu.testing import OpSpec, arr, run_spec
+
+S = (3, 4)          # default shape
+POS = dict(low=0.1, high=2.0)      # positive domain (log, sqrt, ...)
+SAFE = dict(low=-0.9, high=0.9)    # inside (-1, 1) (asin, atanh, ...)
+OFF = dict(low=0.15, high=1.0)     # away from piecewise kinks at 0
+
+
+def _np_gelu(x):
+    return 0.5 * x * (1 + sps.erf(x / np.sqrt(2)))
+
+
+def _np_softmax(x, axis=-1):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+def _np_logsumexp(x, axis=None):
+    return sps.logsumexp(x, axis=axis)
+
+
+def _np_layer_norm(x, eps=1e-5):
+    m = x.mean(-1, keepdims=True)
+    v = x.var(-1, keepdims=True)
+    return (x - m) / np.sqrt(v + eps)
+
+
+def _np_xent(logits, labels):
+    ls = logits - sps.logsumexp(logits, axis=-1, keepdims=True)
+    return -ls[np.arange(len(labels)), labels].mean()
+
+
+_X = arr(S, seed=0)
+_Y = arr(S, seed=1)
+_XP = arr(S, seed=2, **POS)
+_YP = arr(S, seed=3, **POS)
+_XS = arr(S, seed=4, **SAFE)
+_XO = arr(S, seed=5, **OFF)
+_M1 = arr((3, 5), seed=6)
+_M2 = arr((5, 4), seed=7)
+_V1 = arr((6,), seed=8)
+_V2 = arr((6,), seed=9)
+_LG = arr((6, 5), seed=10)
+_LB = np.array([0, 2, 4, 1, 3, 2])
+
+SPECS = [
+    # -- elementwise unary (test_activation_op.py family) ---------------
+    OpSpec("abs", T.abs, np.abs, (_XO,)),
+    OpSpec("exp", T.exp, np.exp, (_X,)),
+    OpSpec("expm1", T.expm1, np.expm1, (_X,)),
+    OpSpec("log", T.log, np.log, (_XP,)),
+    OpSpec("log2", T.log2, np.log2, (_XP,)),
+    OpSpec("log10", T.log10, np.log10, (_XP,)),
+    OpSpec("log1p", T.log1p, np.log1p, (_XP,)),
+    OpSpec("sqrt", T.sqrt, np.sqrt, (_XP,)),
+    OpSpec("rsqrt", T.rsqrt, lambda x: 1 / np.sqrt(x), (_XP,)),
+    OpSpec("square", T.square, np.square, (_X,)),
+    OpSpec("reciprocal", T.reciprocal, np.reciprocal, (_XP,)),
+    OpSpec("sin", T.sin, np.sin, (_X,)),
+    OpSpec("cos", T.cos, np.cos, (_X,)),
+    OpSpec("tan", T.tan, np.tan, (_XS,)),
+    OpSpec("asin", T.asin, np.arcsin, (_XS,)),
+    OpSpec("acos", T.acos, np.arccos, (_XS,)),
+    OpSpec("atan", T.atan, np.arctan, (_X,)),
+    OpSpec("sinh", T.sinh, np.sinh, (_X,)),
+    OpSpec("cosh", T.cosh, np.cosh, (_X,)),
+    OpSpec("tanh", T.tanh, np.tanh, (_X,)),
+    OpSpec("asinh", T.asinh, np.arcsinh, (_X,)),
+    OpSpec("acosh", T.acosh, np.arccosh, (arr(S, low=1.5, high=3.0),)),
+    OpSpec("atanh", T.atanh, np.arctanh, (_XS,)),
+    OpSpec("erf", T.erf, sps.erf, (_X,)),
+    OpSpec("digamma", T.digamma, sps.digamma, (_XP,), grad_rtol=0.1),
+    OpSpec("lgamma", T.lgamma, sps.gammaln, (_XP,), grad_rtol=0.1),
+    OpSpec("sigmoid", F.sigmoid, sps.expit, (_X,)),
+    OpSpec("sign", T.sign, np.sign, (_XO,), grad=False),
+    OpSpec("floor", T.floor, np.floor, (_X,), grad=False),
+    OpSpec("ceil", T.ceil, np.ceil, (_X,), grad=False),
+    OpSpec("round", T.round, np.round, (_X,), grad=False),
+    OpSpec("trunc", T.trunc, np.trunc, (_X,), grad=False),
+    OpSpec("scale", T.scale, lambda x: 2.5 * x + 1.0, (_X,),
+           kwargs=dict(scale=2.5, bias=1.0)),
+    OpSpec("clip", T.clip, lambda x: np.clip(x, -0.5, 0.5), (_X,),
+           kwargs=dict(min=-0.5, max=0.5)),
+    OpSpec("nan_to_num", T.nan_to_num, np.nan_to_num,
+           (np.array([[np.nan, 1.0], [np.inf, -np.inf]], np.float32),),
+           grad=False),
+
+    # -- activations (nn.functional) ------------------------------------
+    OpSpec("relu", F.relu, lambda x: np.maximum(x, 0), (_XO,)),
+    OpSpec("relu6", F.relu6, lambda x: np.clip(x, 0, 6), (_XO,)),
+    OpSpec("gelu", F.gelu, _np_gelu, (_X,)),
+    OpSpec("gelu.tanh", lambda x: F.gelu(x, approximate=True),
+           _np_gelu, (_X,), rtol=1e-3, atol=1e-3),
+    OpSpec("silu", F.silu, lambda x: x * sps.expit(x), (_X,)),
+    OpSpec("swish", F.swish, lambda x: x * sps.expit(x), (_X,)),
+    OpSpec("mish", F.mish,
+           lambda x: x * np.tanh(np.log1p(np.exp(x))), (_X,)),
+    OpSpec("softplus", F.softplus, lambda x: np.log1p(np.exp(x)), (_X,)),
+    OpSpec("softsign", F.softsign, lambda x: x / (1 + np.abs(x)), (_XO,)),
+    OpSpec("leaky_relu", F.leaky_relu,
+           lambda x: np.where(x >= 0, x, 0.01 * x), (_XO,)),
+    OpSpec("elu", F.elu,
+           lambda x: np.where(x >= 0, x, np.expm1(x)), (_XO,)),
+    OpSpec("selu", F.selu,
+           lambda x: 1.0507009873554805 * np.where(
+               x >= 0, x, 1.6732632423543772 * np.expm1(x)), (_XO,)),
+    OpSpec("hardtanh", F.hardtanh, lambda x: np.clip(x, -1, 1), (_X,)),
+    OpSpec("hardsigmoid", F.hardsigmoid,
+           lambda x: np.clip(x / 6 + 0.5, 0, 1), (_X,)),
+    OpSpec("hardswish", F.hardswish,
+           lambda x: x * np.clip(x + 3, 0, 6) / 6, (_X,)),
+    OpSpec("tanhshrink", F.tanhshrink, lambda x: x - np.tanh(x), (_X,)),
+    OpSpec("softshrink", F.softshrink,
+           lambda x: np.where(x > 0.5, x - 0.5,
+                              np.where(x < -0.5, x + 0.5, 0)), (_X,)),
+    OpSpec("hardshrink", F.hardshrink,
+           lambda x: np.where(np.abs(x) > 0.5, x, 0), (_X,)),
+    OpSpec("glu", F.glu,
+           lambda x: x[:, :2] * sps.expit(x[:, 2:]), (_X,)),
+
+    # -- binary elementwise (test_elementwise_*_op.py) ------------------
+    OpSpec("add", T.add, np.add, (_X, _Y), grad_wrt=(0, 1)),
+    OpSpec("subtract", T.subtract, np.subtract, (_X, _Y),
+           grad_wrt=(0, 1)),
+    OpSpec("multiply", T.multiply, np.multiply, (_X, _Y),
+           grad_wrt=(0, 1)),
+    OpSpec("divide", T.divide, np.divide, (_X, _YP), grad_wrt=(0, 1)),
+    OpSpec("pow", T.pow, np.power, (_XP, _YP), grad_wrt=(0, 1)),
+    OpSpec("maximum", T.maximum, np.maximum, (_X, _Y)),
+    OpSpec("minimum", T.minimum, np.minimum, (_X, _Y)),
+    OpSpec("fmax", T.fmax, np.fmax, (_X, _Y)),
+    OpSpec("fmin", T.fmin, np.fmin, (_X, _Y)),
+    OpSpec("mod", T.mod, np.mod, (_XP, _YP), grad=False),
+    OpSpec("floor_divide", T.floor_divide, np.floor_divide,
+           (_XP, _YP), grad=False),
+    OpSpec("atan2", T.atan2, np.arctan2, (_XP, _YP), grad_wrt=(0, 1)),
+    OpSpec("hypot", T.hypot, np.hypot, (_XP, _YP), grad_wrt=(0, 1)),
+    OpSpec("logaddexp", T.logaddexp, np.logaddexp, (_X, _Y),
+           grad_wrt=(0, 1)),
+    OpSpec("lerp", lambda x, y: T.lerp(x, y, 0.3),
+           lambda x, y: x + 0.3 * (y - x), (_X, _Y), grad_wrt=(0, 1)),
+    OpSpec("dist", T.dist, lambda x, y: np.linalg.norm(x - y), (_X, _Y),
+           grad_wrt=(0, 1)),
+
+    # -- comparison / logical (forward only) ----------------------------
+    OpSpec("equal", T.equal, np.equal, (_X, _X), grad=False),
+    OpSpec("not_equal", T.not_equal, np.not_equal, (_X, _Y), grad=False),
+    OpSpec("less_than", T.less_than, np.less, (_X, _Y), grad=False),
+    OpSpec("less_equal", T.less_equal, np.less_equal, (_X, _Y),
+           grad=False),
+    OpSpec("greater_than", T.greater_than, np.greater, (_X, _Y),
+           grad=False),
+    OpSpec("greater_equal", T.greater_equal, np.greater_equal, (_X, _Y),
+           grad=False),
+    OpSpec("isfinite", T.isfinite, np.isfinite,
+           (np.array([1.0, np.inf, np.nan], np.float32),), grad=False),
+    OpSpec("isnan", T.isnan, np.isnan,
+           (np.array([1.0, np.inf, np.nan], np.float32),), grad=False),
+    OpSpec("isinf", T.isinf, np.isinf,
+           (np.array([1.0, np.inf, np.nan], np.float32),), grad=False),
+    OpSpec("logical_and", T.logical_and, np.logical_and,
+           (_X > 0, _Y > 0), grad=False),
+    OpSpec("logical_or", T.logical_or, np.logical_or,
+           (_X > 0, _Y > 0), grad=False),
+    OpSpec("logical_xor", T.logical_xor, np.logical_xor,
+           (_X > 0, _Y > 0), grad=False),
+    OpSpec("logical_not", T.logical_not, np.logical_not,
+           (_X > 0,), grad=False),
+    OpSpec("bitwise_and", T.bitwise_and, np.bitwise_and,
+           (np.array([5, 12]), np.array([3, 10])), grad=False),
+    OpSpec("bitwise_or", T.bitwise_or, np.bitwise_or,
+           (np.array([5, 12]), np.array([3, 10])), grad=False),
+    OpSpec("bitwise_xor", T.bitwise_xor, np.bitwise_xor,
+           (np.array([5, 12]), np.array([3, 10])), grad=False),
+    OpSpec("bitwise_not", T.bitwise_not, np.bitwise_not,
+           (np.array([5, 12]),), grad=False),
+
+    # -- reductions (test_reduce_op.py family) --------------------------
+    OpSpec("sum", T.sum, np.sum, (_X,)),
+    OpSpec("sum.axis", lambda x: T.sum(x, axis=1),
+           lambda x: np.sum(x, axis=1), (_X,)),
+    OpSpec("mean", T.mean, np.mean, (_X,)),
+    OpSpec("prod", T.prod, np.prod, (_XP,)),
+    OpSpec("max", T.max, np.max, (_X,)),
+    OpSpec("min", T.min, np.min, (_X,)),
+    OpSpec("amax", T.amax, np.amax, (_X,)),
+    OpSpec("amin", T.amin, np.amin, (_X,)),
+    OpSpec("std", T.std, lambda x: np.std(x, ddof=1), (_X,)),
+    OpSpec("var", T.var, lambda x: np.var(x, ddof=1), (_X,)),
+    OpSpec("median", T.median, np.median, (_V1,), grad=False),
+    OpSpec("logsumexp", T.logsumexp, _np_logsumexp, (_X,)),
+    OpSpec("logcumsumexp", T.logcumsumexp,
+           lambda x: np.log(np.cumsum(np.exp(x))), (_V1,)),
+    OpSpec("cumsum", T.cumsum, lambda x: np.cumsum(x), (_V1,)),
+    OpSpec("cumprod", lambda x: T.cumprod(x, dim=0),
+           lambda x: np.cumprod(x), (arr((6,), seed=11, **POS),)),
+    OpSpec("norm", T.norm, np.linalg.norm, (_X,)),
+    OpSpec("all", T.all, np.all, (_X > 0,), grad=False),
+    OpSpec("any", T.any, np.any, (_X > 0,), grad=False),
+    OpSpec("numel", T.numel, lambda x: np.asarray(x.size), (_X,),
+           grad=False),
+    OpSpec("quantile", T.quantile,
+           lambda x: np.quantile(x, 0.3), (_V1,),
+           kwargs=dict(q=0.3), grad=False),
+
+    # -- matmul family (test_matmul_v2_op.py, test_mul_op.py) -----------
+    OpSpec("matmul", T.matmul, np.matmul, (_M1, _M2), grad_wrt=(0, 1)),
+    OpSpec("mm", T.mm, np.matmul, (_M1, _M2), grad_wrt=(0, 1)),
+    OpSpec("bmm", T.bmm, np.matmul,
+           (arr((2, 3, 5), seed=12), arr((2, 5, 4), seed=13)),
+           grad_wrt=(0, 1)),
+    OpSpec("dot", T.dot, np.dot, (_V1, _V2), grad_wrt=(0, 1)),
+    OpSpec("inner", T.inner, np.inner, (_V1, _V2), grad_wrt=(0, 1)),
+    OpSpec("outer", T.outer, np.outer, (_V1, _V2), grad_wrt=(0, 1)),
+    OpSpec("cross", T.cross, np.cross,
+           (arr((3,), seed=14), arr((3,), seed=15)), grad_wrt=(0, 1)),
+    OpSpec("kron", T.kron, np.kron,
+           (arr((2, 2), seed=16), arr((2, 3), seed=17)),
+           grad_wrt=(0, 1)),
+    OpSpec("addmm", T.addmm,
+           lambda i, a, b: i + a @ b, (arr((3, 4), seed=18), _M1, _M2),
+           grad_wrt=(0, 1, 2)),
+    OpSpec("trace", T.trace, np.trace, (arr((4, 4), seed=19),)),
+    OpSpec("einsum", lambda a, b: T.einsum("ij,jk->ik", a, b),
+           np.matmul, (_M1, _M2), grad_wrt=(0, 1)),
+    OpSpec("linear", F.linear, lambda x, w: x @ w, (_M1, arr((5, 4),
+           seed=20)), grad_wrt=(0, 1)),
+
+    # -- softmax / losses (test_softmax_op.py, test_cross_entropy_op.py)
+    OpSpec("softmax", F.softmax, _np_softmax, (_X,)),
+    OpSpec("log_softmax", F.log_softmax,
+           lambda x: np.log(_np_softmax(x)), (_X,)),
+    OpSpec("cross_entropy", F.cross_entropy, _np_xent, (_LG, _LB),
+           grad_wrt=(0,)),
+    OpSpec("nll_loss", F.nll_loss,
+           lambda lp, t: -lp[np.arange(len(t)), t].mean(),
+           (np.log(_np_softmax(_LG)), _LB), grad_wrt=(0,)),
+    OpSpec("mse_loss", F.mse_loss,
+           lambda a, b: ((a - b) ** 2).mean(), (_X, _Y), grad_wrt=(0,)),
+    OpSpec("l1_loss", F.l1_loss,
+           lambda a, b: np.abs(a - b).mean(), (_X, _Y), grad_wrt=(0,)),
+    OpSpec("smooth_l1_loss", F.smooth_l1_loss,
+           lambda a, b: np.where(np.abs(a - b) < 1,
+                                 0.5 * (a - b) ** 2,
+                                 np.abs(a - b) - 0.5).mean(),
+           (_X, 3.0 + _Y), grad_wrt=(0,)),
+    OpSpec("kl_div", F.kl_div,
+           lambda lp, t: (t * (np.log(t) - lp)).mean(),
+           (np.log(_np_softmax(_LG)), _np_softmax(arr((6, 5), seed=21)),),
+           grad_wrt=(0,)),
+    OpSpec("binary_cross_entropy", F.binary_cross_entropy,
+           lambda p, t: -(t * np.log(p) + (1 - t) * np.log(1 - p)).mean(),
+           (sps.expit(_X), (arr(S, seed=22) > 0).astype(np.float32)),
+           grad_wrt=(0,)),
+    OpSpec("bce_with_logits", F.binary_cross_entropy_with_logits,
+           lambda x, t: (np.maximum(x, 0) - x * t +
+                         np.log1p(np.exp(-np.abs(x)))).mean(),
+           (_X, (arr(S, seed=23) > 0).astype(np.float32)),
+           grad_wrt=(0,)),
+    OpSpec("label_smooth", F.label_smooth,
+           lambda x: x * 0.9 + 0.1 / x.shape[-1],
+           (_np_softmax(_LG),), grad=False),
+    OpSpec("square_error_cost", F.square_error_cost,
+           lambda a, b: (a - b) ** 2, (_X, _Y), grad_wrt=(0,)),
+    OpSpec("cosine_similarity", F.cosine_similarity,
+           lambda a, b: (a * b).sum(-1) /
+           (np.linalg.norm(a, axis=-1) * np.linalg.norm(b, axis=-1)),
+           (_M1, arr((3, 5), seed=24)), grad_wrt=(0, 1)),
+
+    # -- norms ----------------------------------------------------------
+    OpSpec("layer_norm", lambda x: F.layer_norm(x, (4,)),
+           _np_layer_norm, (_X,)),
+    OpSpec("rms_norm", F.rms_norm,
+           lambda x: x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6),
+           (_X,), rtol=1e-4, atol=1e-4),
+    OpSpec("normalize", F.normalize,
+           lambda x: x / np.maximum(
+               np.linalg.norm(x, axis=-1, keepdims=True), 1e-12), (_X,)),
+
+    # -- shape / indexing (forward only where integer) ------------------
+    OpSpec("reshape", lambda x: T.reshape(x, [4, 3]),
+           lambda x: x.reshape(4, 3), (_X,)),
+    OpSpec("transpose", lambda x: T.transpose(x, [1, 0]),
+           lambda x: x.T, (_X,)),
+    OpSpec("flatten", T.flatten, lambda x: x.reshape(-1), (_X,)),
+    OpSpec("squeeze", T.squeeze, np.squeeze, (arr((3, 1, 4), seed=25),)),
+    OpSpec("unsqueeze", lambda x: T.unsqueeze(x, 1),
+           lambda x: x[:, None], (_X,)),
+    OpSpec("concat", lambda a, b: T.concat([a, b]),
+           lambda a, b: np.concatenate([a, b]), (_X, _Y),
+           grad_wrt=(0, 1)),
+    OpSpec("stack", lambda a, b: T.stack([a, b]),
+           lambda a, b: np.stack([a, b]), (_X, _Y), grad_wrt=(0, 1)),
+    OpSpec("split", lambda x: T.split(x, 2, axis=1),
+           lambda x: np.split(x, 2, axis=1), (_X,)),
+    OpSpec("chunk", lambda x: T.chunk(x, 2, axis=1),
+           lambda x: np.split(x, 2, axis=1), (_X,)),
+    OpSpec("tile", lambda x: T.tile(x, [2, 1]),
+           lambda x: np.tile(x, [2, 1]), (_X,)),
+    OpSpec("expand", lambda x: T.expand(x, [2, 3, 4]),
+           lambda x: np.broadcast_to(x, (2, 3, 4)), (_X,)),
+    OpSpec("broadcast_to", lambda x: T.broadcast_to(x, [2, 3, 4]),
+           lambda x: np.broadcast_to(x, (2, 3, 4)), (_X,)),
+    OpSpec("flip", lambda x: T.flip(x, axis=0),
+           lambda x: np.flip(x, axis=0), (_X,)),
+    OpSpec("roll", lambda x: T.roll(x, 1, axis=0),
+           lambda x: np.roll(x, 1, axis=0), (_X,)),
+    OpSpec("rot90", T.rot90, np.rot90, (_X,)),
+    OpSpec("tril", T.tril, np.tril, (arr((4, 4), seed=26),)),
+    OpSpec("triu", T.triu, np.triu, (arr((4, 4), seed=27),)),
+    OpSpec("diag", T.diag, np.diag, (_V1,)),
+    OpSpec("moveaxis", lambda x: T.moveaxis(x, 0, 1),
+           lambda x: np.moveaxis(x, 0, 1), (_X,)),
+    OpSpec("swapaxes", lambda x: T.swapaxes(x, 0, 1),
+           lambda x: np.swapaxes(x, 0, 1), (_X,)),
+    OpSpec("t", T.t, np.transpose, (_X,)),
+    OpSpec("gather", lambda x: T.gather(x, np.array([2, 0]), axis=0),
+           lambda x: x[[2, 0]], (_X,)),
+    OpSpec("index_select",
+           lambda x: T.index_select(x, np.array([2, 0]), axis=0),
+           lambda x: x[[2, 0]], (_X,)),
+    OpSpec("take_along_axis",
+           lambda x: T.take_along_axis(
+               x, np.array([[0, 1, 0, 1]]), 0),
+           lambda x: np.take_along_axis(
+               x, np.array([[0, 1, 0, 1]]), 0), (_X,)),
+    OpSpec("masked_fill",
+           lambda x: T.masked_fill(x, np.asarray(_X > 0), -1.0),
+           lambda x: np.where(_X > 0, -1.0, x), (_X,)),
+    OpSpec("where", lambda a, b: T.where(np.asarray(_X > 0), a, b),
+           lambda a, b: np.where(_X > 0, a, b), (_X, _Y),
+           grad_wrt=(0, 1)),
+    OpSpec("one_hot", lambda: F.one_hot(np.array([0, 2, 1]), 4),
+           lambda: np.eye(4, dtype=np.float32)[[0, 2, 1]], (),
+           grad=False),
+    OpSpec("diff", T.diff, lambda x: np.diff(x), (_V1,)),
+    OpSpec("sort", lambda x: T.sort(x, axis=0),
+           lambda x: np.sort(x, axis=0), (_X,)),
+    OpSpec("argsort", lambda x: T.argsort(x, axis=0),
+           lambda x: np.argsort(x, axis=0, kind="stable"), (_X,),
+           grad=False),
+    OpSpec("argmax", T.argmax, np.argmax, (_X,), grad=False),
+    OpSpec("argmin", T.argmin, np.argmin, (_X,), grad=False),
+
+    # -- integer / counting ---------------------------------------------
+    # dynamic output shape: eager-only on TPU (no static shape for XLA)
+    OpSpec("bincount", T.bincount, np.bincount,
+           (np.array([0, 1, 1, 3, 2, 1]),), grad=False, jit=False),
+    OpSpec("unique", T.unique, np.unique,
+           (np.array([3, 1, 2, 1, 3]),), grad=False, jit=False),
+    OpSpec("masked_select",
+           lambda x: T.masked_select(x, np.asarray(_X > 0)),
+           lambda x: x[_X > 0], (_X,), grad=False, jit=False),
+    OpSpec("nonzero", T.nonzero,
+           lambda x: np.stack(np.nonzero(x), -1),
+           ((_X > 0).astype(np.float32),), grad=False, jit=False),
+    OpSpec("histogram",
+           lambda x: T.histogram(x, bins=4, min=-1.0, max=1.0),
+           lambda x: np.histogram(x, bins=4, range=(-1, 1))[0], (_X,),
+           grad=False),
+    OpSpec("searchsorted", T.searchsorted, np.searchsorted,
+           (np.array([1.0, 3.0, 5.0]), np.array([0.5, 3.5])),
+           grad=False),
+]
+
+_IDS = []
+for s in SPECS:
+    n = s.name
+    while n in _IDS:
+        n += "'"
+    _IDS.append(n)
+
+
+@pytest.mark.parametrize("spec", SPECS, ids=_IDS)
+def test_op(spec):
+    run_spec(spec)
