@@ -23,5 +23,7 @@ from .pipeline import (LayerDesc, PipelineLayer, PipelineParallel,  # noqa
 from .recompute import (GradientMerge, RecomputeSequential,  # noqa
                         recompute)
 from .planner import ChipSpec, Plan, evaluate, plan  # noqa
+from .localsgd import (build_local_sgd_step, replicate_params,  # noqa
+                       unreplicate_params)
 from . import collective  # noqa
 from . import planner  # noqa

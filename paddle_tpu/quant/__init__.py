@@ -1,0 +1,289 @@
+"""paddle_tpu.quant — int8 quantization: PTQ and QAT.
+
+Reference being replaced: the slim quantization stack —
+``QuantizationTransformPass`` inserting fake_quantize/dequantize ops
+into the graph (fluid/contrib/slim/quantization/quantization_pass.py),
+``ImperativeQuantAware`` wrapping dygraph layers for QAT
+(slim/quantization/imperative/qat.py), and post-training calibration
+(slim/quantization/post_training_quantization.py) with absmax /
+moving-average-absmax observers.
+
+TPU-native redesign: there is no graph pass — quantization is a LAYER
+SWAP plus a straight-through-estimator primitive, and everything else
+falls out of tracing:
+
+- :func:`fake_quant` — quantize→dequantize with a custom VJP that
+  passes gradients straight through (inside the clip range), the same
+  op the reference's fake_quantize_abs_max kernel implements.
+- :class:`QuantizedLinear` — weights stored int8 (per-output-channel
+  absmax scales); the forward computes with dequantized weights, so the
+  traced/jit.saved program carries int8 weight arrays + dequant ops —
+  the existing native predictor serves quantized artifacts UNCHANGED
+  while params shrink 4x. On TPU the int8→bf16 convert fuses into the
+  matmul's operand load (XLA), so weight-only quant trades HBM
+  bandwidth for nothing.
+- :func:`quantize_post_training` — PTQ: swap eligible layers, optionally
+  observing activation ranges on calibration batches (absmax), storing
+  activation scales for int8 activation quant.
+- :func:`prepare_qat` / :func:`convert` — QAT: train with fake-quant on
+  weights (and activations), then convert to the real int8 layers.
+
+Explicitly out of scope (decision record, VERDICT r1 item 10):
+- ONNX export (reference python/paddle/onnx): the deployment IR here is
+  StableHLO via ``jit.save`` — it captures quantized programs exactly,
+  runs on the native PJRT predictor, and round-trips through
+  ``jax.export``. Translating to ONNX would target runtimes this
+  framework does not serve; a user needing ONNX can load the weights
+  into the torch/paddle reference and export there.
+- DGC gradient compression (fleet dgc_optimizer.py): DGC trades compute
+  (top-k select, momentum correction) for wire bytes on commodity
+  ethernet; TPU gradient reduction rides ICI where the dense
+  all-reduce is faster than the gather/scatter DGC needs. LocalSGD is
+  implemented instead (parallel/localsgd.py) as the comm-reduction
+  strategy that DOES make sense on TPU pods (fewer syncs, not sparser).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..nn import functional as F
+from ..nn.layer import Layer
+
+
+# ---------------------------------------------------------------------------
+# primitives
+# ---------------------------------------------------------------------------
+
+def absmax_scale(w, axis=None, bits: int = 8):
+    """Symmetric absmax scale: ref fake_quantize_abs_max semantics."""
+    qmax = float(2 ** (bits - 1) - 1)
+    amax = jnp.max(jnp.abs(w)) if axis is None else \
+        jnp.max(jnp.abs(w), axis=axis, keepdims=True)
+    return jnp.maximum(amax, 1e-8) / qmax
+
+
+def quantize_weight(w, axis=None, bits: int = 8):
+    """→ (int8 values, f32 scale); symmetric, optionally per-channel
+    (axis = dims REDUCED for the scale, e.g. 0 for [in, out] weights →
+    one scale per output channel, the reference's channel_wise_abs_max)."""
+    scale = absmax_scale(w, axis=axis, bits=bits)
+    qmax = 2 ** (bits - 1) - 1
+    q = jnp.clip(jnp.round(w / scale), -qmax - 1, qmax).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def dequantize_weight(q, scale, dtype=jnp.float32):
+    return q.astype(dtype) * scale.astype(dtype)
+
+
+@jax.custom_vjp
+def fake_quant(x, scale, bits: int = 8):
+    """quantize→dequantize with a straight-through estimator. Clips
+    symmetrically to [-qmax, qmax] like the reference's
+    fake_quantize_abs_max, so the backward pass-through mask and the
+    forward saturation boundary agree."""
+    qmax = 2 ** (bits - 1) - 1
+    q = jnp.clip(jnp.round(x / scale), -qmax, qmax)
+    return q * scale
+
+
+def _fq_fwd(x, scale, bits=8):
+    qmax = 2 ** (bits - 1) - 1
+    inside = jnp.abs(x) <= (qmax + 0.5) * scale
+    return fake_quant(x, scale, bits), inside
+
+
+def _fq_bwd(res, g):
+    inside = res
+    # straight-through inside the representable range, zero outside
+    return (jnp.where(inside, g, 0.0), None, None)
+
+
+fake_quant.defvjp(_fq_fwd, _fq_bwd)
+
+
+# ---------------------------------------------------------------------------
+# layers
+# ---------------------------------------------------------------------------
+
+class QuantizedLinear(Layer):
+    """Weight-only (optionally activation) int8 linear.
+
+    Weights live as an int8 buffer + per-output-channel f32 scales; the
+    dequant happens inside the traced program so ``jit.save`` artifacts
+    carry int8 params (4x smaller, HBM-bandwidth-bound layers speed up)
+    and serve on the unmodified native predictor."""
+
+    def __init__(self, in_features: int, out_features: int, bits: int = 8):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.bits = bits
+        self.register_buffer("qweight",
+                             jnp.zeros((in_features, out_features),
+                                       jnp.int8))
+        self.register_buffer("wscale",
+                             jnp.ones((1, out_features), jnp.float32))
+        self.register_buffer("bias", None)
+        self.register_buffer("act_scale", None)  # set by calibration
+
+    @classmethod
+    def from_linear(cls, lin, bits: int = 8,
+                    act_scale=None) -> "QuantizedLinear":
+        qlin = cls(lin.in_features, lin.out_features, bits=bits)
+        q, s = quantize_weight(lin.weight, axis=0, bits=bits)
+        qlin.qweight = q
+        qlin.wscale = s
+        qlin.bias = lin.bias
+        if act_scale is not None:
+            qlin.act_scale = jnp.asarray(act_scale, jnp.float32)
+        return qlin
+
+    def forward(self, x):
+        if self.act_scale is not None:
+            # full int8 path: quantize activations with the calibrated
+            # scale (symmetric, matching fake_quant's training-time
+            # clip); int8 x int8 → int32 rides the MXU's int path
+            qmax = 2 ** (self.bits - 1) - 1
+            qx = jnp.clip(jnp.round(x / self.act_scale),
+                          -qmax, qmax).astype(jnp.int8)
+            acc = jax.lax.dot_general(
+                qx, self.qweight,
+                (((qx.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            y = acc.astype(jnp.float32) * self.act_scale * self.wscale
+        else:
+            w = dequantize_weight(self.qweight, self.wscale, x.dtype)
+            y = x @ w
+        if self.bias is not None:
+            y = y + self.bias
+        return y
+
+
+class QATLinear(Layer):
+    """Training-time fake-quant linear (ref: ImperativeQuantAware
+    wrapping Linear with fake_quant on weight + input)."""
+
+    def __init__(self, lin, bits: int = 8, quant_act: bool = True,
+                 ema: float = 0.95):
+        super().__init__()
+        self.inner = lin
+        self.bits = bits
+        self.quant_act = quant_act
+        self.ema = ema
+        self.register_buffer("act_absmax", jnp.zeros(()), persistable=True)
+
+    def forward(self, x):
+        w = self.inner.weight
+        wq = fake_quant(w, absmax_scale(w, axis=0, bits=self.bits),
+                        self.bits)
+        if self.quant_act:
+            if self.training:
+                # moving-average absmax observer — training only, like
+                # the reference's moving_average_abs_max_scale op in
+                # is_test=False (eval must not pollute the range, and
+                # an eval trace must not leak tracers into the buffer)
+                amax = jnp.max(jnp.abs(jax.lax.stop_gradient(x)))
+                cur = jnp.where(self.act_absmax > 0,
+                                self.ema * self.act_absmax +
+                                (1 - self.ema) * amax, amax)
+                self.act_absmax = cur
+            else:
+                cur = self.act_absmax
+                # never-calibrated eval: fall back to the batch's own
+                # range without recording it
+                cur = jnp.where(
+                    cur > 0, cur,
+                    jnp.max(jnp.abs(jax.lax.stop_gradient(x))))
+            qmax = float(2 ** (self.bits - 1) - 1)
+            x = fake_quant(x, jnp.maximum(cur, 1e-8) / qmax, self.bits)
+        return F.linear(x, wq, self.inner.bias)
+
+
+# ---------------------------------------------------------------------------
+# model transforms
+# ---------------------------------------------------------------------------
+
+def _swap_layers(root: Layer, predicate, build) -> int:
+    n = 0
+    for parent in root.sublayers(include_self=True):
+        for name, child in list(parent._sublayers.items()):
+            if predicate(child):
+                parent._sublayers[name] = build(child)
+                n += 1
+    return n
+
+
+def quantize_post_training(net: Layer, calibration_batches=None,
+                           bits: int = 8,
+                           quant_act: Optional[bool] = None,
+                           skip=lambda layer: False) -> int:
+    """PTQ in place: swap every nn.Linear for QuantizedLinear
+    (ref: PostTrainingQuantization.quantize). Passing
+    ``calibration_batches`` runs them through the net first, observing
+    per-layer input absmax to set activation scales (absmax
+    calibration) — int8 activations, like the reference, which always
+    calibrates when given data. Without batches the result is
+    weight-only int8. Returns #layers swapped."""
+    from ..nn.layers.common import Linear
+
+    if quant_act is None:
+        quant_act = calibration_batches is not None
+    if quant_act and calibration_batches is None:
+        raise ValueError(
+            "quant_act=True needs calibration_batches to derive "
+            "activation scales")
+
+    act_scales: Dict[int, float] = {}
+    if quant_act:
+        qmax = float(2 ** (bits - 1) - 1)
+        observed: Dict[int, float] = {}
+        hooks = []
+        for layer in net.sublayers(include_self=True):
+            if isinstance(layer, Linear):
+                def hook(l, args, _observed=observed):
+                    x = args[0]
+                    m = float(jnp.max(jnp.abs(x)))
+                    key = id(l)
+                    _observed[key] = max(observed.get(key, 0.0), m)
+                hooks.append(layer.register_forward_pre_hook(hook))
+        net.eval()
+        for batch in calibration_batches:
+            net(*batch) if isinstance(batch, (tuple, list)) else net(batch)
+        for h in hooks:
+            h.remove()
+        act_scales = {k: max(v, 1e-8) / qmax for k, v in observed.items()}
+
+    return _swap_layers(
+        net, lambda l: isinstance(l, Linear) and not skip(l),
+        lambda l: QuantizedLinear.from_linear(
+            l, bits=bits, act_scale=act_scales.get(id(l))))
+
+
+def prepare_qat(net: Layer, bits: int = 8, quant_act: bool = True) -> int:
+    """Swap Linears for fake-quant QAT wrappers (ref:
+    ImperativeQuantAware.quantize). Returns #layers wrapped."""
+    from ..nn.layers.common import Linear
+    return _swap_layers(
+        net, lambda l: isinstance(l, Linear),
+        lambda l: QATLinear(l, bits=bits, quant_act=quant_act))
+
+
+def convert(net: Layer, bits: Optional[int] = None) -> int:
+    """QAT → deploy: replace QATLinear wrappers with real int8 layers
+    using the observed activation scales (ref:
+    ImperativeQuantAware.save_quantized_model)."""
+    def build(qat: QATLinear):
+        b = bits or qat.bits
+        qmax = float(2 ** (b - 1) - 1)
+        act_scale = None
+        if qat.quant_act and float(qat.act_absmax) > 0:
+            act_scale = max(float(qat.act_absmax), 1e-8) / qmax
+        return QuantizedLinear.from_linear(qat.inner, bits=b,
+                                           act_scale=act_scale)
+
+    return _swap_layers(net, lambda l: isinstance(l, QATLinear), build)

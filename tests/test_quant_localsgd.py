@@ -1,0 +1,284 @@
+"""Quantization (PTQ int8, QAT fake-quant) and LocalSGD.
+
+Analogs of the reference's slim quantization tests
+(slim/tests/test_imperative_qat.py, test_post_training_quantization_*)
+and the LocalSGD meta-optimizer tests (test_fleet_localsgd_meta_
+optimizer.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn, parallel, quant
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn.layer import functional_call, split_state
+
+
+def _mlp(seed=0):
+    pt.seed(seed)
+    return nn.Sequential(
+        ("fc1", nn.Linear(16, 32)),
+        ("act", nn.ReLU()),
+        ("fc2", nn.Linear(32, 8)),
+    )
+
+
+def _x(n=4, d=16, seed=0):
+    return jnp.asarray(np.random.RandomState(seed).randn(n, d),
+                       jnp.float32)
+
+
+# -- primitives -------------------------------------------------------------
+
+def test_quantize_dequantize_roundtrip_error_small():
+    w = _x(64, 32, seed=1)
+    q, s = quant.quantize_weight(w, axis=0)
+    assert q.dtype == jnp.int8 and s.shape == (1, 32)
+    back = quant.dequantize_weight(q, s)
+    # absmax int8: error bounded by scale/2 per element
+    assert float(jnp.max(jnp.abs(back - w))) <= float(jnp.max(s)) * 0.5 + 1e-6
+
+
+def test_fake_quant_straight_through_gradient():
+    x = jnp.asarray([0.3, -0.7, 2.0])
+    scale = jnp.asarray(0.01)
+    g = jax.grad(lambda v: quant.fake_quant(v, scale).sum())(x)
+    # inside the representable range (|x| <= 127.5*scale=1.275): grad 1;
+    # outside (2.0): clipped, grad 0
+    np.testing.assert_allclose(np.asarray(g), [1.0, 1.0, 0.0])
+
+
+# -- PTQ --------------------------------------------------------------------
+
+def test_ptq_weight_only_close_to_fp32():
+    net = _mlp()
+    x = _x()
+    ref = np.asarray(net(x))
+    n = quant.quantize_post_training(net)
+    assert n == 2
+    out = np.asarray(net(x))
+    assert out.shape == ref.shape
+    # int8 weight-only on a small MLP: sub-percent relative error
+    rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9)
+    assert rel < 0.02, rel
+
+
+def test_ptq_int8_activations_with_calibration():
+    net = _mlp()
+    x = _x()
+    ref = np.asarray(net(x))
+    n = quant.quantize_post_training(
+        net, calibration_batches=[x], quant_act=True)
+    assert n == 2
+    for l in net.sublayers():
+        if isinstance(l, quant.QuantizedLinear):
+            assert l.act_scale is not None
+    out = np.asarray(net(x))
+    rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9)
+    assert rel < 0.1, rel
+
+
+def test_ptq_artifact_serves_and_shrinks(tmp_path):
+    """jit.save of a quantized net carries int8 params — the artifact
+    shrinks ~4x and stays a valid StableHLO program."""
+    from paddle_tpu import jit
+    import os
+    net = _mlp()
+    x = np.asarray(_x())
+    spec = [jit.InputSpec([4, 16], "float32")]
+    p32 = str(tmp_path / "fp32")
+    jit.save(net, p32, input_spec=spec)
+    quant.quantize_post_training(net)
+    ref = np.asarray(net(x))
+    p8 = str(tmp_path / "int8")
+    jit.save(net, p8, input_spec=spec)
+    sz32 = os.path.getsize(os.path.join(p32, "params.pbin"))
+    sz8 = os.path.getsize(os.path.join(p8, "params.pbin"))
+    assert sz8 < 0.5 * sz32, (sz8, sz32)
+    loaded = jit.load(p8)
+    np.testing.assert_allclose(np.asarray(loaded(x)), ref,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_ptq_gpt_logits_close():
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    pt.seed(0)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_heads=2, max_position_embeddings=16,
+                    hidden_dropout=0.0, attention_dropout=0.0,
+                    use_flash=False)
+    net = GPTForCausalLM(cfg)
+    net.eval()
+    ids = np.random.RandomState(0).randint(0, 64, (2, 16))
+    ref = np.asarray(net(ids))
+    n = quant.quantize_post_training(net)
+    assert n > 0
+    out = np.asarray(net(ids))
+    # top-1 prediction agreement is the metric that matters
+    agree = (out.argmax(-1) == ref.argmax(-1)).mean()
+    assert agree > 0.95, agree
+
+
+# -- QAT --------------------------------------------------------------------
+
+def test_qat_trains_and_converts():
+    pt.seed(0)
+    net = _mlp()
+    n = quant.prepare_qat(net)
+    assert n == 2
+    x = _x(32, 16, seed=3)
+    y = jnp.asarray(
+        np.random.RandomState(4).randn(32, 8), jnp.float32)
+    params, buffers = split_state(net)
+
+    def loss_fn(p, b):
+        out, nb = functional_call(net, p, b, x)
+        return ((out - y) ** 2).mean(), nb
+
+    losses = []
+    for _ in range(60):
+        (l, buffers), g = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, buffers)
+        params = jax.tree_util.tree_map(
+            lambda p_, g_: p_ - 0.02 * g_, params, g)
+        losses.append(float(l))
+    # random-label regression has a high floor; require clear descent
+    assert losses[-1] < 0.75 * losses[0], losses[:3] + losses[-3:]
+
+    # write trained state back, convert to int8, outputs stay close
+    for k, v in params.items():
+        net._assign_by_path(k, v)
+    for k, v in buffers.items():
+        net._assign_by_path(k, v)
+    qat_out = np.asarray(net(x))
+    n = quant.convert(net)
+    assert n == 2
+    for l in net.sublayers():
+        assert not isinstance(l, quant.QATLinear)
+    int8_out = np.asarray(net(x))
+    # the QAT forward already simulated int8: conversion is faithful
+    rel = np.abs(int8_out - qat_out).max() / \
+        (np.abs(qat_out).max() + 1e-9)
+    assert rel < 0.15, rel
+
+
+def test_qat_observer_tracks_activation_range():
+    net = _mlp()
+    quant.prepare_qat(net)
+    big = 10.0 * _x(8, 16, seed=5)
+    net.train()
+    net(big)
+    for l in net.sublayers():
+        if isinstance(l, quant.QATLinear):
+            assert float(l.act_absmax) > 0
+
+
+def test_qat_observer_frozen_in_eval():
+    """eval() must not pollute the calibrated range (ref:
+    moving_average_abs_max_scale freezes in is_test mode)."""
+    net = _mlp()
+    quant.prepare_qat(net)
+    net.train()
+    net(_x(8, 16, seed=6))
+    before = [float(l.act_absmax) for l in net.sublayers()
+              if isinstance(l, quant.QATLinear)]
+    net.eval()
+    net(100.0 * _x(8, 16, seed=7))  # outlier eval batch
+    after = [float(l.act_absmax) for l in net.sublayers()
+             if isinstance(l, quant.QATLinear)]
+    assert before == after
+
+
+# -- LocalSGD ---------------------------------------------------------------
+
+def _grad_and_update(lr=0.1):
+    def grad_fn(params, batch):
+        x, y = batch
+
+        def loss(p):
+            h = jnp.maximum(x @ p["w1"], 0.0)
+            return ((h @ p["w2"] - y) ** 2).mean()
+
+        l, g = jax.value_and_grad(loss)(params)
+        return l, g
+
+    def update_fn(params, grads):
+        return jax.tree_util.tree_map(
+            lambda p, g: p - lr * g, params, grads)
+
+    return grad_fn, update_fn
+
+
+def _toy_params(seed=0):
+    r = np.random.RandomState(seed)
+    return {"w1": jnp.asarray(r.randn(8, 16) * 0.3, jnp.float32),
+            "w2": jnp.asarray(r.randn(16, 4) * 0.3, jnp.float32)}
+
+
+def test_local_sgd_sync_every_1_equals_dp():
+    """k=1 degenerates to synchronous data parallelism."""
+    mesh = parallel.init_mesh(dp=8)
+    try:
+        params = _toy_params()
+        grad_fn, update_fn = _grad_and_update()
+        r = np.random.RandomState(1)
+        x = jnp.asarray(r.randn(16, 8), jnp.float32)
+        y = jnp.asarray(r.randn(16, 4), jnp.float32)
+
+        # reference: plain full-batch DP sgd
+        ref = dict(params)
+        for i in range(3):
+            _, g = grad_fn(ref, (x, y))
+            ref = update_fn(ref, g)
+
+        stacked = parallel.replicate_params(params, mesh)
+        step = parallel.build_local_sgd_step(grad_fn, update_fn,
+                                             sync_every=1, mesh=mesh)
+        for i in range(3):
+            stacked, loss = step(stacked, (x, y), jnp.asarray(i))
+        got = parallel.unreplicate_params(stacked)
+        for k in ref:
+            np.testing.assert_allclose(np.asarray(got[k]),
+                                       np.asarray(ref[k]),
+                                       rtol=2e-4, atol=2e-5)
+    finally:
+        parallel.set_mesh(None)
+
+
+def test_local_sgd_sync_every_k_averages_local_runs():
+    """k=4: each replica trains alone on its shard for 4 steps, then
+    params equal the average of the 8 independent local runs."""
+    mesh = parallel.init_mesh(dp=8)
+    try:
+        params = _toy_params()
+        grad_fn, update_fn = _grad_and_update()
+        r = np.random.RandomState(2)
+        x = jnp.asarray(r.randn(16, 8), jnp.float32)
+        y = jnp.asarray(r.randn(16, 4), jnp.float32)
+        k = 4
+
+        # reference: 8 independent local runs on each shard, averaged
+        locals_ = []
+        for s in range(8):
+            p = dict(params)
+            xs, ys = x[2 * s:2 * s + 2], y[2 * s:2 * s + 2]
+            for _ in range(k):
+                _, g = grad_fn(p, (xs, ys))
+                p = update_fn(p, g)
+            locals_.append(p)
+        avg = {key: np.mean([np.asarray(p[key]) for p in locals_], 0)
+               for key in params}
+
+        stacked = parallel.replicate_params(params, mesh)
+        step = parallel.build_local_sgd_step(grad_fn, update_fn,
+                                             sync_every=k, mesh=mesh)
+        for i in range(k):
+            stacked, _ = step(stacked, (x, y), jnp.asarray(i))
+        got = parallel.unreplicate_params(stacked)
+        for key in avg:
+            np.testing.assert_allclose(np.asarray(got[key]), avg[key],
+                                       rtol=2e-4, atol=2e-5)
+    finally:
+        parallel.set_mesh(None)
