@@ -34,6 +34,8 @@ class _AmpState(threading.local):
         self.enabled = False
         self.dtype = jnp.bfloat16
         self.level = "O1"
+        self.white: frozenset = frozenset()
+        self.black: frozenset = frozenset()
 
 
 _state = _AmpState()
@@ -54,30 +56,53 @@ def auto_cast(enable: bool = True, dtype: str | None = None,
     """ref: python/paddle/amp/auto_cast.py:21. ``level``:
     O1 = cast per-op (matmul-like ops run in ``dtype``);
     O2 = the caller keeps params in bf16 (see Layer.astype) and O1 casting
-    also applies."""
-    prev = (_state.enabled, _state.dtype, _state.level)
+    also applies.
+
+    ``custom_white_list``: op names FORCED to the compute dtype beyond
+    the matmul-like defaults (e.g. "layer_norm", "softmax" skip their
+    fp32-statistics upcast). ``custom_black_list``: matmul-like ops
+    held in their input dtype (e.g. "conv2d" stays fp32). Same
+    semantics as the reference's amp_guard white/black lists
+    (fluid/dygraph/amp/auto_cast.py:210)."""
+    prev = (_state.enabled, _state.dtype, _state.level,
+            _state.white, _state.black)
     _state.enabled = enable
     _state.dtype = jnp.dtype(dtype) if dtype is not None else \
         jnp.dtype(flags.get_flag("amp_dtype"))
     _state.level = level
+    _state.white = frozenset(custom_white_list or ())
+    _state.black = frozenset(custom_black_list or ())
     try:
         yield
     finally:
-        _state.enabled, _state.dtype, _state.level = prev
+        (_state.enabled, _state.dtype, _state.level,
+         _state.white, _state.black) = prev
 
 
 amp_guard = auto_cast  # legacy alias (ref: fluid/dygraph/amp/auto_cast.py)
 
 
-def white_cast(*xs):
-    """Cast matmul-like operands to the AMP compute dtype when enabled.
-    Called by nn.functional matmul/conv/attention entry points."""
-    if not _state.enabled:
-        return xs if len(xs) > 1 else xs[0]
+def _cast_all(xs):
     dt = _state.dtype
     out = tuple(x.astype(dt) if x is not None and
                 jnp.issubdtype(x.dtype, jnp.floating) else x for x in xs)
     return out if len(out) > 1 else out[0]
+
+
+def white_cast(*xs, op: str = "matmul"):
+    """Cast matmul-like operands to the AMP compute dtype when enabled,
+    unless the op was custom_black_listed. Called by nn.functional
+    matmul/conv/attention entry points."""
+    if not _state.enabled or op in _state.black:
+        return xs if len(xs) > 1 else xs[0]
+    return _cast_all(xs)
+
+
+def op_in_white(op: str) -> bool:
+    """True when the user custom_white_listed ``op`` — fp32-by-default
+    ops (layer_norm, softmax, ...) check this to run in the compute
+    dtype instead of upcasting their statistics."""
+    return _state.enabled and op in _state.white
 
 
 def decorate(model, optimizer=None, level: str = "O2", dtype=None):

@@ -193,3 +193,103 @@ class PyLayer(metaclass=PyLayerMeta):
     @classmethod
     def apply(cls, *args):
         return cls._fn(*args)
+
+
+# ---------------------------------------------------------------------------
+# dygraph-style training bridge
+# ---------------------------------------------------------------------------
+
+class record:
+    """The dygraph ``loss.backward(); opt.step()`` idiom, tapelessly.
+
+    The reference records every op on an implicit tape so ``backward``
+    can walk it (fluid/dygraph tracer; python/paddle/fluid/dygraph/
+    varbase_patch_methods.py ``backward``). JAX has no implicit tape —
+    gradients come from transforming a FUNCTION — so the eager idiom is
+    expressed by handing the forward to the tape explicitly::
+
+        tape = autograd.record(net)
+        loss = tape.run(lambda: criterion(net(x), y))
+        tape.backward()            # populates tape.grads (by param name)
+        opt.step(tape.grads)       # same Optimizer.step as the reference
+
+    ``run`` executes the thunk under ``functional_call`` +
+    ``value_and_grad`` over the trainable parameters of the given
+    layers; mutated buffers (BN stats, observers) are written back.
+    Equivalent one-liner: ``optimizer.minimize(loss_fn)``.
+    """
+
+    def __init__(self, *layers):
+        from ..nn.layer import Layer
+        if not layers or not all(isinstance(l, Layer) for l in layers):
+            raise ValueError("record(*layers) needs at least one Layer")
+        self._layers = layers
+        self.grads = None
+        self.loss = None
+
+    def _named(self):
+        params, meta = {}, {}
+        buffers = {}
+        for i, l in enumerate(self._layers):
+            prefix = f"{i}~" if len(self._layers) > 1 else ""
+            m = l.param_meta()
+            for name, p in l.named_parameters():
+                (params if m[name].trainable else buffers)[
+                    prefix + name] = p
+            for name, b in l.named_buffers():
+                buffers[prefix + name] = b
+        return params, buffers
+
+    def _bind(self, tree):
+        for name, v in tree.items():
+            if "~" in name:
+                i, path = name.split("~", 1)
+                self._layers[int(i)]._assign_by_path(path, v)
+            else:
+                self._layers[0]._assign_by_path(name, v)
+
+    def run(self, thunk):
+        import jax as _jax
+
+        params, buffers = self._named()
+
+        def f(p):
+            self._bind(p)
+            out = thunk()
+            nb = {}
+            for name in buffers:
+                if "~" in name:
+                    i, path = name.split("~", 1)
+                    nb[name] = self._layers[int(i)]._get_by_path(path)
+                else:
+                    nb[name] = self._layers[0]._get_by_path(name)
+            return out, nb
+
+        try:
+            (loss, new_buffers), grads = _jax.value_and_grad(
+                f, has_aux=True)(params)
+        finally:
+            # the trace leaves tracers bound in the layers; always
+            # restore the concrete parameters
+            self._bind(params)
+        self._bind(new_buffers)  # persist mutated buffers (BN stats)
+        self.loss, self.grads = loss, grads
+        return loss
+
+    def backward(self):
+        """Grads were produced by ``run`` (one fused fwd+bwd); this
+        makes the idiom read like the reference."""
+        if self.grads is None:
+            raise RuntimeError("record.backward() before run()")
+        return self.grads
+
+    def layer_grads(self, i: int):
+        """Grads of layer ``i`` with unprefixed names — feed one
+        optimizer per layer when recording several layers."""
+        if self.grads is None:
+            raise RuntimeError("record.layer_grads() before run()")
+        if len(self._layers) == 1:
+            return dict(self.grads)
+        pre = f"{i}~"
+        return {k[len(pre):]: v for k, v in self.grads.items()
+                if k.startswith(pre)}

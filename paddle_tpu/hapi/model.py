@@ -141,8 +141,10 @@ class Model:
         level = cfg.get("level", "O1")
         if level == "O0":
             return contextlib.nullcontext()
-        return amp.auto_cast(enable=True, dtype=cfg.get("dtype"),
-                             level=level)
+        return amp.auto_cast(
+            enable=True, dtype=cfg.get("dtype"), level=level,
+            custom_white_list=cfg.get("custom_white_list"),
+            custom_black_list=cfg.get("custom_black_list"))
 
     # -- compiled steps -----------------------------------------------------
     def _build_train_step(self):
@@ -212,8 +214,14 @@ class Model:
         self._step_count += 1
         if flags.get_flag("check_nan_inf") and not np.isfinite(
                 np.asarray(loss)).all():
+            # attribute the blowup to named tensors before aborting
+            # (nan_inf_utils_detail's per-tensor report, host-side)
+            from ..amp.debugging import find_nonfinite
+            bad = find_nonfinite({"param": self._params,
+                                  "buffer": self._buffers})
             raise FloatingPointError(
-                f"NaN/Inf loss at step {self._step_count}")
+                f"NaN/Inf loss at step {self._step_count}; "
+                f"non-finite tensors: {bad or ['(loss only)']}")
         # keep the loss on device — no per-step host sync (the reference's
         # dygraph adapter also returns without waiting; a float() here
         # would serialize every step on the device stream). Callbacks /

@@ -16,3 +16,5 @@ from ..nn.layers.sparse_embedding import (MultiSlotEmbedding,  # noqa
 from ..nn.layers.transformer import (  # noqa
     MultiHeadAttention as FusedMultiHeadAttention,
     TransformerEncoderLayer as FusedTransformerEncoderLayer)
+
+from . import asp  # noqa  (n:m structured sparsity)

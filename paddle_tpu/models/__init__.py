@@ -12,4 +12,10 @@ from .mobilenet import (MobileNetV1, MobileNetV2,  # noqa
 from .resnet import (BasicBlock, BottleneckBlock, ResNet,  # noqa
                      resnet18, resnet34, resnet50, resnet101, resnet152)
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa
+from .vision_extra import (AlexNet, DenseNet, GoogLeNet,  # noqa
+                           ShuffleNetV2, SqueezeNet, alexnet,
+                           densenet121, densenet161, densenet201,
+                           googlenet,
+                           shufflenet_v2_x0_5, shufflenet_v2_x1_0,
+                           squeezenet1_0, squeezenet1_1)
 from .widedeep import DeepFM, WideDeep, synthetic_criteo  # noqa

@@ -1,0 +1,340 @@
+"""Additional vision families: AlexNet, SqueezeNet, DenseNet, GoogLeNet,
+ShuffleNetV2 (ref: python/paddle/vision/models/{alexnet,squeezenet,
+densenet,googlenet,shufflenetv2}.py — same topologies, same constructor
+surface).
+
+TPU notes: all convs route through F.conv2d (XLA picks MXU layouts);
+channel-shuffle is a reshape-transpose pair XLA fuses to a relayout;
+DenseNet's concatenations are pure layout ops under XLA."""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import functional as F
+
+
+# ---------------------------------------------------------------------------
+# AlexNet (ref: vision/models/alexnet.py)
+# ---------------------------------------------------------------------------
+
+class AlexNet(nn.Layer):
+    def __init__(self, num_classes: int = 1000):
+        super().__init__()
+        self.features = nn.Sequential(
+            nn.Conv2D(3, 64, 11, stride=4, padding=2), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2),
+            nn.Conv2D(64, 192, 5, padding=2), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2),
+            nn.Conv2D(192, 384, 3, padding=1), nn.ReLU(),
+            nn.Conv2D(384, 256, 3, padding=1), nn.ReLU(),
+            nn.Conv2D(256, 256, 3, padding=1), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2),
+        )
+        self.avgpool = nn.AdaptiveAvgPool2D((6, 6))
+        self.classifier = nn.Sequential(
+            nn.Dropout(0.5), nn.Linear(256 * 6 * 6, 4096), nn.ReLU(),
+            nn.Dropout(0.5), nn.Linear(4096, 4096), nn.ReLU(),
+            nn.Linear(4096, num_classes),
+        )
+
+    def forward(self, x):
+        x = self.avgpool(self.features(x))
+        return self.classifier(x.reshape(x.shape[0], -1))
+
+
+def alexnet(**kw):
+    return AlexNet(**kw)
+
+
+# ---------------------------------------------------------------------------
+# SqueezeNet (ref: vision/models/squeezenet.py)
+# ---------------------------------------------------------------------------
+
+class Fire(nn.Layer):
+    def __init__(self, in_ch, squeeze, e1, e3):
+        super().__init__()
+        self.squeeze = nn.Conv2D(in_ch, squeeze, 1)
+        self.expand1 = nn.Conv2D(squeeze, e1, 1)
+        self.expand3 = nn.Conv2D(squeeze, e3, 3, padding=1)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        s = self.relu(self.squeeze(x))
+        return jnp.concatenate(
+            [self.relu(self.expand1(s)), self.relu(self.expand3(s))],
+            axis=1)
+
+
+class SqueezeNet(nn.Layer):
+    """version '1.0'/'1.1' (ref: squeezenet.py SqueezeNet)."""
+
+    def __init__(self, version: str = "1.1", num_classes: int = 1000):
+        super().__init__()
+        if version == "1.0":
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 96, 7, stride=2), nn.ReLU(),
+                nn.MaxPool2D(3, stride=2),
+                Fire(96, 16, 64, 64), Fire(128, 16, 64, 64),
+                Fire(128, 32, 128, 128), nn.MaxPool2D(3, stride=2),
+                Fire(256, 32, 128, 128), Fire(256, 48, 192, 192),
+                Fire(384, 48, 192, 192), Fire(384, 64, 256, 256),
+                nn.MaxPool2D(3, stride=2), Fire(512, 64, 256, 256),
+            )
+        elif version == "1.1":
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 64, 3, stride=2), nn.ReLU(),
+                nn.MaxPool2D(3, stride=2),
+                Fire(64, 16, 64, 64), Fire(128, 16, 64, 64),
+                nn.MaxPool2D(3, stride=2),
+                Fire(128, 32, 128, 128), Fire(256, 32, 128, 128),
+                nn.MaxPool2D(3, stride=2),
+                Fire(256, 48, 192, 192), Fire(384, 48, 192, 192),
+                Fire(384, 64, 256, 256), Fire(512, 64, 256, 256),
+            )
+        else:
+            raise ValueError(f"unknown version {version!r}")
+        self.classifier = nn.Sequential(
+            nn.Dropout(0.5), nn.Conv2D(512, num_classes, 1), nn.ReLU(),
+            nn.AdaptiveAvgPool2D(1))
+
+    def forward(self, x):
+        x = self.classifier(self.features(x))
+        return x.reshape(x.shape[0], -1)
+
+
+def squeezenet1_0(**kw):
+    return SqueezeNet("1.0", **kw)
+
+
+def squeezenet1_1(**kw):
+    return SqueezeNet("1.1", **kw)
+
+
+# ---------------------------------------------------------------------------
+# DenseNet (ref: vision/models/densenet.py)
+# ---------------------------------------------------------------------------
+
+class _DenseLayer(nn.Layer):
+    def __init__(self, in_ch, growth, bn_size):
+        super().__init__()
+        self.bn1 = nn.BatchNorm2D(in_ch)
+        self.conv1 = nn.Conv2D(in_ch, bn_size * growth, 1,
+                               bias_attr=False)
+        self.bn2 = nn.BatchNorm2D(bn_size * growth)
+        self.conv2 = nn.Conv2D(bn_size * growth, growth, 3, padding=1,
+                               bias_attr=False)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        y = self.conv1(self.relu(self.bn1(x)))
+        y = self.conv2(self.relu(self.bn2(y)))
+        return jnp.concatenate([x, y], axis=1)
+
+
+class _Transition(nn.Layer):
+    def __init__(self, in_ch, out_ch):
+        super().__init__()
+        self.bn = nn.BatchNorm2D(in_ch)
+        self.conv = nn.Conv2D(in_ch, out_ch, 1, bias_attr=False)
+        self.relu = nn.ReLU()
+        self.pool = nn.AvgPool2D(2, stride=2)
+
+    def forward(self, x):
+        return self.pool(self.conv(self.relu(self.bn(x))))
+
+
+class DenseNet(nn.Layer):
+    """ref: densenet.py DenseNet(layers=121/161/169/201/264).
+    Per-config (block layout, growth rate, stem channels) — 161 is the
+    wide variant (growth 48, 96-channel stem)."""
+
+    CONFIGS = {121: ((6, 12, 24, 16), 32, 64),
+               161: ((6, 12, 36, 24), 48, 96),
+               169: ((6, 12, 32, 32), 32, 64),
+               201: ((6, 12, 48, 32), 32, 64),
+               264: ((6, 12, 64, 48), 32, 64)}
+
+    def __init__(self, layers: int = 121, growth_rate: int = None,
+                 bn_size: int = 4, num_classes: int = 1000):
+        super().__init__()
+        if layers not in self.CONFIGS:
+            raise ValueError(
+                f"DenseNet layers must be one of "
+                f"{sorted(self.CONFIGS)}, got {layers}")
+        block_cfg, default_growth, ch = self.CONFIGS[layers]
+        growth_rate = growth_rate or default_growth
+        feats = [nn.Conv2D(3, ch, 7, stride=2, padding=3,
+                           bias_attr=False),
+                 nn.BatchNorm2D(ch), nn.ReLU(),
+                 nn.MaxPool2D(3, stride=2, padding=1)]
+        for bi, n in enumerate(block_cfg):
+            for _ in range(n):
+                feats.append(_DenseLayer(ch, growth_rate, bn_size))
+                ch += growth_rate
+            if bi != len(block_cfg) - 1:
+                feats.append(_Transition(ch, ch // 2))
+                ch //= 2
+        feats += [nn.BatchNorm2D(ch), nn.ReLU()]
+        self.features = nn.Sequential(*feats)
+        self.avgpool = nn.AdaptiveAvgPool2D(1)
+        self.classifier = nn.Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = self.avgpool(self.features(x))
+        return self.classifier(x.reshape(x.shape[0], -1))
+
+
+def densenet121(**kw):
+    return DenseNet(121, **kw)
+
+
+def densenet161(**kw):
+    return DenseNet(161, **kw)
+
+
+def densenet201(**kw):
+    return DenseNet(201, **kw)
+
+
+# ---------------------------------------------------------------------------
+# GoogLeNet / Inception v1 (ref: vision/models/googlenet.py)
+# ---------------------------------------------------------------------------
+
+class Inception(nn.Layer):
+    def __init__(self, in_ch, c1, c3r, c3, c5r, c5, pp):
+        super().__init__()
+        self.b1 = nn.Sequential(nn.Conv2D(in_ch, c1, 1), nn.ReLU())
+        self.b2 = nn.Sequential(nn.Conv2D(in_ch, c3r, 1), nn.ReLU(),
+                                nn.Conv2D(c3r, c3, 3, padding=1),
+                                nn.ReLU())
+        self.b3 = nn.Sequential(nn.Conv2D(in_ch, c5r, 1), nn.ReLU(),
+                                nn.Conv2D(c5r, c5, 5, padding=2),
+                                nn.ReLU())
+        self.b4 = nn.Sequential(nn.MaxPool2D(3, stride=1, padding=1),
+                                nn.Conv2D(in_ch, pp, 1), nn.ReLU())
+
+    def forward(self, x):
+        return jnp.concatenate(
+            [self.b1(x), self.b2(x), self.b3(x), self.b4(x)], axis=1)
+
+
+class GoogLeNet(nn.Layer):
+    def __init__(self, num_classes: int = 1000):
+        super().__init__()
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, 64, 7, stride=2, padding=3), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2, padding=1),
+            nn.Conv2D(64, 64, 1), nn.ReLU(),
+            nn.Conv2D(64, 192, 3, padding=1), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2, padding=1))
+        self.blocks = nn.Sequential(
+            Inception(192, 64, 96, 128, 16, 32, 32),
+            Inception(256, 128, 128, 192, 32, 96, 64),
+            nn.MaxPool2D(3, stride=2, padding=1),
+            Inception(480, 192, 96, 208, 16, 48, 64),
+            Inception(512, 160, 112, 224, 24, 64, 64),
+            Inception(512, 128, 128, 256, 24, 64, 64),
+            Inception(512, 112, 144, 288, 32, 64, 64),
+            Inception(528, 256, 160, 320, 32, 128, 128),
+            nn.MaxPool2D(3, stride=2, padding=1),
+            Inception(832, 256, 160, 320, 32, 128, 128),
+            Inception(832, 384, 192, 384, 48, 128, 128))
+        self.avgpool = nn.AdaptiveAvgPool2D(1)
+        self.dropout = nn.Dropout(0.2)
+        self.fc = nn.Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.avgpool(self.blocks(self.stem(x)))
+        return self.fc(self.dropout(x.reshape(x.shape[0], -1)))
+
+
+def googlenet(**kw):
+    return GoogLeNet(**kw)
+
+
+# ---------------------------------------------------------------------------
+# ShuffleNetV2 (ref: vision/models/shufflenetv2.py)
+# ---------------------------------------------------------------------------
+
+def channel_shuffle(x, groups: int):
+    b, c, h, w = x.shape
+    x = x.reshape(b, groups, c // groups, h, w)
+    x = x.transpose(0, 2, 1, 3, 4)
+    return x.reshape(b, c, h, w)
+
+
+class _ShuffleUnit(nn.Layer):
+    def __init__(self, in_ch, out_ch, stride):
+        super().__init__()
+        self.stride = stride
+        branch_ch = out_ch // 2
+        if stride > 1:
+            self.branch1 = nn.Sequential(
+                nn.Conv2D(in_ch, in_ch, 3, stride=stride, padding=1,
+                          groups=in_ch, bias_attr=False),
+                nn.BatchNorm2D(in_ch),
+                nn.Conv2D(in_ch, branch_ch, 1, bias_attr=False),
+                nn.BatchNorm2D(branch_ch), nn.ReLU())
+            b2_in = in_ch
+        else:
+            self.branch1 = None
+            b2_in = in_ch // 2
+        self.branch2 = nn.Sequential(
+            nn.Conv2D(b2_in, branch_ch, 1, bias_attr=False),
+            nn.BatchNorm2D(branch_ch), nn.ReLU(),
+            nn.Conv2D(branch_ch, branch_ch, 3, stride=stride, padding=1,
+                      groups=branch_ch, bias_attr=False),
+            nn.BatchNorm2D(branch_ch),
+            nn.Conv2D(branch_ch, branch_ch, 1, bias_attr=False),
+            nn.BatchNorm2D(branch_ch), nn.ReLU())
+
+    def forward(self, x):
+        if self.stride == 1:
+            half = x.shape[1] // 2
+            x1, x2 = x[:, :half], x[:, half:]
+            out = jnp.concatenate([x1, self.branch2(x2)], axis=1)
+        else:
+            out = jnp.concatenate([self.branch1(x), self.branch2(x)],
+                                  axis=1)
+        return channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(nn.Layer):
+    SCALES = {0.5: (48, 96, 192, 1024), 1.0: (116, 232, 464, 1024),
+              1.5: (176, 352, 704, 1024), 2.0: (244, 488, 976, 2048)}
+
+    def __init__(self, scale: float = 1.0, num_classes: int = 1000):
+        super().__init__()
+        c2, c3, c4, c5 = self.SCALES[scale]
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, 24, 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(24), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2, padding=1))
+        stages = []
+        in_ch = 24
+        for out_ch, repeat in ((c2, 4), (c3, 8), (c4, 4)):
+            stages.append(_ShuffleUnit(in_ch, out_ch, 2))
+            for _ in range(repeat - 1):
+                stages.append(_ShuffleUnit(out_ch, out_ch, 1))
+            in_ch = out_ch
+        self.stages = nn.Sequential(*stages)
+        self.head = nn.Sequential(
+            nn.Conv2D(in_ch, c5, 1, bias_attr=False),
+            nn.BatchNorm2D(c5), nn.ReLU(), nn.AdaptiveAvgPool2D(1))
+        self.fc = nn.Linear(c5, num_classes)
+
+    def forward(self, x):
+        x = self.head(self.stages(self.stem(x)))
+        return self.fc(x.reshape(x.shape[0], -1))
+
+
+def shufflenet_v2_x1_0(**kw):
+    return ShuffleNetV2(1.0, **kw)
+
+
+def shufflenet_v2_x0_5(**kw):
+    return ShuffleNetV2(0.5, **kw)

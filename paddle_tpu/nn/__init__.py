@@ -25,6 +25,8 @@ from .layers.moe import (GShardGate, MoELayer, NaiveGate,  # noqa
                          SwitchGate, collect_aux_losses)
 from .layers.sparse_embedding import (MultiSlotEmbedding,  # noqa
                                       SparseEmbedding)
+from .layers.rnn import (GRU, LSTM, RNN, BiRNN, GRUCell, LSTMCell,  # noqa
+                         SimpleRNN, SimpleRNNCell)
 from .layers.transformer import (MultiHeadAttention, Transformer,  # noqa
                                  TransformerDecoder, TransformerDecoderLayer,
                                  TransformerEncoder, TransformerEncoderLayer)

@@ -88,6 +88,9 @@ def prelu(x, weight):
 
 
 def softmax(x, axis: int = -1):
+    from .. import amp
+    if amp.op_in_white("softmax"):
+        x = x.astype(amp.compute_dtype())
     return jax.nn.softmax(x, axis=axis)
 
 
@@ -118,7 +121,7 @@ def linear(x, weight, bias=None):
     ref: python/paddle/nn/functional/common.py linear). Under amp.auto_cast
     the matmul runs in the AMP compute dtype (bf16 → MXU)."""
     from .. import amp
-    x, weight = amp.white_cast(x, weight)
+    x, weight = amp.white_cast(x, weight, op="matmul")
     y = jnp.matmul(x, weight)
     if bias is not None:
         y = y + bias
@@ -169,7 +172,7 @@ def _conv_dim_numbers(ndim: int, channels_last: bool):
 def conv_nd(x, weight, bias=None, stride=1, padding=0, dilation=1,
             groups: int = 1, data_format: str = "NCHW"):
     from .. import amp
-    x, weight = amp.white_cast(x, weight)
+    x, weight = amp.white_cast(x, weight, op="conv2d")
     ndim = x.ndim - 2
     stride = _norm_tuple(stride, ndim)
     dilation = _norm_tuple(dilation, ndim)
@@ -342,9 +345,15 @@ def layer_norm(x, normalized_shape, weight=None, bias=None,
     if isinstance(normalized_shape, int):
         normalized_shape = (normalized_shape,)
     axes = tuple(range(x.ndim - len(normalized_shape), x.ndim))
-    # fp32 statistics for bf16 inputs (TPU numerics practice)
-    xf = x.astype(jnp.float32) if x.dtype in (jnp.bfloat16, jnp.float16) \
-        else x
+    # fp32 statistics for bf16 inputs (TPU numerics practice) — unless
+    # the user custom_white_listed layer_norm, which FORCES the compute
+    # dtype (consistent with the softmax white-list path)
+    from .. import amp
+    if amp.op_in_white("layer_norm"):
+        xf = x = x.astype(amp.compute_dtype())
+    else:
+        xf = x.astype(jnp.float32) if x.dtype in (
+            jnp.bfloat16, jnp.float16) else x
     mean = xf.mean(axis=axes, keepdims=True)
     var = jnp.square(xf - mean).mean(axis=axes, keepdims=True)
     y = (xf - mean) * lax.rsqrt(var + epsilon)
@@ -618,7 +627,7 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None,
     runs the XLA-fused reference math below.
     """
     from .. import amp
-    q, k, v = amp.white_cast(q, k, v)
+    q, k, v = amp.white_cast(q, k, v, op="attention")
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     from ..core import flags as _flags

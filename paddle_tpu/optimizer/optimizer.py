@@ -115,15 +115,27 @@ class Optimizer:
     def step(self, grads: Dict[str, jax.Array]) -> None:
         """Eager update: applies grads and writes params back into the
         bound Layer (analog of ``optimizer.step()`` after
-        ``loss.backward()`` — here grads come from jax.grad)."""
+        ``loss.backward()`` — here grads come from jax.grad). Only
+        parameters present in ``grads`` are updated, so frozen
+        (trainable=False) params — absent from autograd.record's grad
+        dict — pass through untouched instead of breaking the tree
+        match."""
         params = self._bound_params()
+        missing = [k for k in grads if k not in params]
+        if missing:
+            raise KeyError(
+                f"grads for unknown parameters {missing[:3]}... — for "
+                "autograd.record over multiple layers, use one "
+                "optimizer per layer with tape.layer_grads(i)")
+        upd = {k: params[k] for k in grads}
         if self._state is None:
-            self._state = self.init_state(params)
-        new_params, self._state = self.apply_gradients(
-            params, grads, self._state, self._step_count)
+            self._state = self.init_state(upd)
+        new_upd, self._state = self.apply_gradients(
+            upd, grads, self._state, self._step_count)
         self._step_count += 1
+        new_params = {**params, **new_upd}
         if self._layer is not None:
-            for name, v in new_params.items():
+            for name, v in new_upd.items():
                 self._layer._assign_by_path(name, v)
         else:
             self._params = new_params

@@ -102,3 +102,100 @@ def masked_matmul(a, b, mask: "SparseCooTensor"):
     vals = jnp.einsum("nk,nk->n", a[rows, :], b[:, cols].T)
     return SparseCooTensor(
         jsparse.BCOO((vals, idx), shape=(a.shape[0], b.shape[1])))
+
+
+# ---------------------------------------------------------------------------
+# value-wise math (ref: python/paddle/incubate/sparse/unary.py — phi
+# sparse_*_kernels apply the op to the values, pattern unchanged)
+# ---------------------------------------------------------------------------
+
+def _unary(fn, sp: SparseCooTensor) -> SparseCooTensor:
+    b = sp._bcoo
+    import jax.experimental.sparse as _js
+    return SparseCooTensor(_js.BCOO((fn(b.data), b.indices),
+                                    shape=b.shape))
+
+
+def relu(sp):
+    return _unary(lambda v: jnp.maximum(v, 0), sp)
+
+
+def tanh(sp):
+    return _unary(jnp.tanh, sp)
+
+
+def sin(sp):
+    return _unary(jnp.sin, sp)
+
+
+def asin(sp):
+    return _unary(jnp.arcsin, sp)
+
+
+def sqrt(sp):
+    return _unary(jnp.sqrt, sp)
+
+
+def square(sp):
+    return _unary(jnp.square, sp)
+
+
+def abs(sp):  # noqa: A001 — reference name
+    return _unary(jnp.abs, sp)
+
+
+def neg(sp):
+    return _unary(jnp.negative, sp)
+
+
+def expm1(sp):
+    return _unary(jnp.expm1, sp)
+
+
+def log1p(sp):
+    return _unary(jnp.log1p, sp)
+
+
+def pow(sp, factor):  # noqa: A001 — reference name
+    return _unary(lambda v: jnp.power(v, factor), sp)
+
+
+def cast(sp, dtype):
+    return _unary(lambda v: v.astype(dtype), sp)
+
+
+def scale(sp, scale_, bias: float = 0.0, bias_after_scale: bool = True):
+    if bias_after_scale:
+        return _unary(lambda v: v * scale_ + bias, sp)
+    return _unary(lambda v: (v + bias) * scale_, sp)
+
+
+def transpose(sp: SparseCooTensor, perm) -> SparseCooTensor:
+    """ref: incubate/sparse transpose — permute coordinate columns."""
+    import jax.experimental.sparse as _js
+    b = sp._bcoo
+    perm = list(perm)
+    idx = b.indices[:, jnp.asarray(perm)]
+    shape = tuple(b.shape[p] for p in perm)
+    return SparseCooTensor(_js.BCOO((b.data, idx), shape=shape))
+
+
+def coalesce(sp: SparseCooTensor) -> SparseCooTensor:
+    """Merge duplicate coordinates (ref: sparse_coo_tensor coalesce)."""
+    return SparseCooTensor(sp._bcoo.sum_duplicates())
+
+
+def mv(sp: SparseCooTensor, vec):
+    """Sparse matrix @ dense vector (ref: incubate/sparse mv)."""
+    return sp._bcoo @ jnp.asarray(vec)
+
+
+def is_sparse_coo(x) -> bool:
+    return isinstance(x, SparseCooTensor)
+
+
+def add(a, b):
+    """Sparse + sparse / sparse + dense (ref: incubate/sparse add)."""
+    if isinstance(a, SparseCooTensor):
+        return a + b
+    return b + a

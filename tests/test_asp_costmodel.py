@@ -1,0 +1,195 @@
+"""ASP n:m sparsity + XLA-backed cost model.
+
+Analogs: reference ASP tests (unittests/asp/test_asp_pruning_*,
+test_asp_optimize.py — masks survive optimizer steps) and the
+cost-model test (test_cost_model.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.incubate import asp
+
+
+def test_create_mask_2_4_pattern():
+    w = jnp.asarray(np.random.RandomState(0).randn(8, 16), jnp.float32)
+    mask = asp.create_mask(w, n=2, m=4)
+    groups = np.asarray(mask).reshape(-1, 4)
+    assert (groups.sum(axis=1) == 2).all()
+    # kept entries are the two largest magnitudes per group
+    aw = np.abs(np.asarray(w)).reshape(-1, 4)
+    for g in range(len(groups)):
+        kept = set(np.where(groups[g])[0])
+        top2 = set(np.argsort(aw[g])[-2:])
+        assert kept == top2
+
+
+def test_prune_model_and_density():
+    pt.seed(0)
+    net = nn.Sequential(("fc1", nn.Linear(16, 32)),
+                        ("fc2", nn.Linear(32, 8)))
+    assert asp.calculate_density(net.fc1.weight) == 1.0
+    masks = asp.prune_model(net)
+    assert set(masks) == {"fc1.weight", "fc2.weight"}
+    for name in masks:
+        w = net._get_by_path(name)
+        assert asp.check_sparsity(np.asarray(w))
+        np.testing.assert_allclose(asp.calculate_density(w), 0.5)
+    # biases untouched
+    assert asp.calculate_density(net.fc1.bias) in (0.0, 1.0)
+
+
+def test_decorated_optimizer_preserves_masks():
+    """Fine-tuning with asp.decorate keeps pruned weights at exactly 0
+    (ref: test_asp_optimize)."""
+    pt.seed(0)
+    net = nn.Sequential(("fc1", nn.Linear(8, 16)), ("act", nn.ReLU()),
+                        ("fc2", nn.Linear(16, 4)))
+    asp.prune_model(net)
+    opt = asp.decorate(pt.optimizer.Adam(learning_rate=0.05,
+                                         parameters=net))
+    from paddle_tpu import autograd
+    crit = nn.MSELoss()
+    r = np.random.RandomState(1)
+    x = jnp.asarray(r.randn(16, 8), jnp.float32)
+    y = jnp.asarray(r.randn(16, 4), jnp.float32)
+    losses = []
+    for _ in range(10):
+        tape = autograd.record(net)
+        losses.append(float(tape.run(lambda: crit(net(x), y))))
+        opt.step(tape.backward())
+    assert losses[-1] < losses[0]
+    for name in ("fc1.weight", "fc2.weight"):
+        w = np.asarray(net._get_by_path(name))
+        assert asp.check_sparsity(w), name
+        assert asp.calculate_density(w) <= 0.5 + 1e-6
+
+
+def test_embedding_weights_not_pruned():
+    pt.seed(0)
+
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.emb = nn.Embedding(32, 16)
+            self.fc = nn.Linear(16, 4)
+
+        def forward(self, ids):
+            return self.fc(self.emb(ids))
+
+    net = M()
+    masks = asp.prune_model(net)
+    assert "fc.weight" in masks and "emb.weight" not in masks
+
+
+def test_mask_2d_algorithms_rejected_with_rationale():
+    net = nn.Linear(8, 8)
+    with pytest.raises(NotImplementedError, match="tensor cores"):
+        asp.prune_model(net, mask_algo="mask_2d_best")
+
+
+# -- cost model -------------------------------------------------------------
+
+def test_cost_model_counts_matmul_flops():
+    cm = pt.cost_model.CostModel()
+    a = jnp.ones((128, 256), jnp.float32)
+    b = jnp.ones((256, 64), jnp.float32)
+    cost = cm.profile(lambda x, y: x @ y, (a, b))
+    # 2*M*N*K = 2*128*64*256 = 4.19 MFLOP (XLA counts fused extras too)
+    expected = 2 * 128 * 64 * 256
+    assert 0.5 * expected <= cost.flops <= 2.0 * expected, cost.flops
+    assert cost.bytes_accessed > 0
+    assert "GFLOP" in cost.describe()
+
+
+def test_cost_model_measures_wall_time():
+    cm = pt.cost_model.CostModel()
+    a = jnp.ones((64, 64), jnp.float32)
+    cost = cm.profile_measure(lambda x: x @ x, (a,), iters=3)
+    assert cost.measured_seconds is not None
+    assert cost.measured_seconds > 0
+
+
+def test_cost_model_ranks_big_vs_small():
+    cm = pt.cost_model.CostModel()
+    small = cm.profile(lambda x: x @ x, (jnp.ones((32, 32)),))
+    big = cm.profile(lambda x: x @ x, (jnp.ones((256, 256)),))
+    assert big.flops > 100 * small.flops
+
+
+def test_conv_weights_pruned_via_2d_view():
+    """Conv kernels [O, I, kh, kw] prune through the [O, I*kh*kw] view
+    (the reference's reshape-then-mask convention)."""
+    pt.seed(0)
+
+    class C(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.conv = nn.Conv2D(8, 16, 3)
+            self.fc = nn.Linear(16, 4)
+
+        def forward(self, x):
+            h = self.conv(x).mean(axis=(2, 3))
+            return self.fc(h)
+
+    net = C()
+    masks = asp.prune_model(net)
+    assert "conv.weight" in masks and "fc.weight" in masks
+    w = np.asarray(net.conv.weight)
+    assert asp.check_sparsity(w)
+    np.testing.assert_allclose(asp.calculate_density(w), 0.5)
+
+
+def test_asp_survives_jitted_model_fit():
+    """Masks must hold through the hapi Model's compiled train step
+    (decorate wraps apply_gradients, not just .step)."""
+    pt.seed(0)
+    net = nn.Sequential(("fc1", nn.Linear(8, 16)), ("act", nn.ReLU()),
+                        ("fc2", nn.Linear(16, 4)))
+    asp.prune_model(net)
+    opt = asp.decorate(pt.optimizer.Adam(learning_rate=0.05,
+                                         parameters=net))
+    model = pt.Model(net)
+    model.prepare(optimizer=opt, loss=nn.MSELoss())
+    r = np.random.RandomState(0)
+    for _ in range(3):
+        model.train_batch([r.randn(8, 8).astype("float32")],
+                          [r.randn(8, 4).astype("float32")])
+    # pull trained params back out of the compiled-step state
+    model._sync_state_out()
+    sd = model.network.state_dict()
+    for name in ("fc1.weight", "fc2.weight"):
+        w = np.asarray(sd[name])
+        assert asp.check_sparsity(w), name
+        assert abs(asp.calculate_density(w) - 0.5) < 1e-6
+
+
+def test_frozen_param_training_via_record():
+    """Optimizer.step updates only grad-bearing params — frozen
+    (trainable=False) weights survive the dygraph idiom untouched."""
+    from paddle_tpu import autograd
+    from paddle_tpu.nn.layer import Parameter
+    pt.seed(0)
+
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+            self.scale_frozen = Parameter(
+                jnp.ones((4,)), trainable=False)
+
+        def forward(self, x):
+            return self.fc(x) * self.scale_frozen
+
+    net = M()
+    opt = pt.optimizer.SGD(learning_rate=0.1, parameters=net)
+    x = jnp.ones((2, 4))
+    tape = autograd.record(net)
+    tape.run(lambda: net(x).sum())
+    assert "scale_frozen" not in tape.grads
+    opt.step(tape.backward())
+    np.testing.assert_allclose(np.asarray(net.scale_frozen),
+                               np.ones(4))

@@ -83,3 +83,53 @@ def test_resnet_train_step_grads():
     logs1 = model.train_batch([xs], [ys])
     logs2 = model.train_batch([xs], [ys])
     assert np.isfinite(logs1["loss"]) and np.isfinite(logs2["loss"])
+
+
+# -- round-2 extra families (ref: vision/models/{alexnet,squeezenet,
+#    densenet,googlenet,shufflenetv2}.py) ----------------------------------
+
+import pytest as _pytest
+
+
+@_pytest.mark.parametrize("ctor,size", [
+    ("alexnet", 224), ("squeezenet1_1", 64), ("densenet121", 64),
+    ("googlenet", 64), ("shufflenet_v2_x0_5", 64),
+])
+def test_extra_vision_family_forward(ctor, size):
+    import numpy as _np
+    import paddle_tpu as _pt
+    from paddle_tpu import models as _models
+    _pt.seed(0)
+    net = getattr(_models, ctor)(num_classes=10)
+    net.eval()
+    x = _np.random.RandomState(0).randn(2, 3, size, size).astype("float32")
+    out = net(x)
+    assert out.shape == (2, 10)
+    assert _np.all(_np.isfinite(_np.asarray(out)))
+
+
+def test_extra_vision_trains_one_step():
+    import numpy as _np
+    import paddle_tpu as _pt
+    from paddle_tpu import models as _models
+    _pt.seed(0)
+    net = _models.squeezenet1_1(num_classes=4)
+    model = _pt.Model(net)
+    model.prepare(
+        optimizer=_pt.optimizer.SGD(learning_rate=0.01, parameters=net),
+        loss=_pt.nn.CrossEntropyLoss())
+    x = _np.random.RandomState(0).randn(4, 3, 64, 64).astype("float32")
+    y = _np.array([0, 1, 2, 3])
+    logs = model.train_batch([x], [y])
+    assert _np.isfinite(logs["loss"])
+
+
+def test_channel_shuffle_inverts_grouping():
+    import numpy as _np
+    import jax.numpy as _jnp
+    from paddle_tpu.models.vision_extra import channel_shuffle
+    x = _jnp.arange(2 * 8 * 1 * 1, dtype=_jnp.float32).reshape(2, 8, 1, 1)
+    y = channel_shuffle(x, 2)
+    # interleaves the two halves: [0..3],[4..7] -> [0,4,1,5,2,6,3,7]
+    got = _np.asarray(y[0, :, 0, 0]).astype(int).tolist()
+    assert got == [0, 4, 1, 5, 2, 6, 3, 7]

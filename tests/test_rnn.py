@@ -1,0 +1,173 @@
+"""RNN/LSTM/GRU — numeric parity against torch's CPU reference (the
+same cuDNN gate conventions the reference's rnn.py implements) plus
+shape/state/mask behavior. Analog of unittests/rnn/test_rnn_nets.py
+(which compares against a numpy rnn_numpy.py reference)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+
+
+def _copy_lstm_weights_from_torch(tlstm, cell):
+    # torch packs gates i,f,g,o rows in weight_ih_l0 [4H, in]
+    cell.weight_ih = jnp.asarray(
+        tlstm.weight_ih_l0.detach().numpy().T)
+    cell.weight_hh = jnp.asarray(
+        tlstm.weight_hh_l0.detach().numpy().T)
+    cell.bias_ih = jnp.asarray(tlstm.bias_ih_l0.detach().numpy())
+    cell.bias_hh = jnp.asarray(tlstm.bias_hh_l0.detach().numpy())
+
+
+def test_lstm_matches_torch():
+    import torch
+    torch.manual_seed(0)
+    B, T, I, H = 3, 5, 4, 6
+    tl = torch.nn.LSTM(I, H, batch_first=True)
+    pt.seed(0)
+    ours = nn.LSTM(I, H)
+    _copy_lstm_weights_from_torch(tl, ours.layers[0].cell)
+    x = np.random.RandomState(0).randn(B, T, I).astype(np.float32)
+    with torch.no_grad():
+        t_out, (t_h, t_c) = tl(torch.from_numpy(x))
+    out, (h, c) = ours(jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(out), t_out.numpy(),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(h), t_h.numpy(),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(c), t_c.numpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_gru_matches_torch():
+    import torch
+    torch.manual_seed(1)
+    B, T, I, H = 2, 4, 3, 5
+    tg = torch.nn.GRU(I, H, batch_first=True)
+    pt.seed(0)
+    ours = nn.GRU(I, H)
+    cell = ours.layers[0].cell
+    cell.weight_ih = jnp.asarray(tg.weight_ih_l0.detach().numpy().T)
+    cell.weight_hh = jnp.asarray(tg.weight_hh_l0.detach().numpy().T)
+    cell.bias_ih = jnp.asarray(tg.bias_ih_l0.detach().numpy())
+    cell.bias_hh = jnp.asarray(tg.bias_hh_l0.detach().numpy())
+    x = np.random.RandomState(1).randn(B, T, I).astype(np.float32)
+    with torch.no_grad():
+        t_out, t_h = tg(torch.from_numpy(x))
+    out, h = ours(jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(out), t_out.numpy(),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(h), t_h.numpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_simple_rnn_matches_torch():
+    import torch
+    torch.manual_seed(2)
+    B, T, I, H = 2, 3, 4, 5
+    tr = torch.nn.RNN(I, H, batch_first=True)
+    pt.seed(0)
+    ours = nn.SimpleRNN(I, H)
+    cell = ours.layers[0].cell
+    cell.weight_ih = jnp.asarray(tr.weight_ih_l0.detach().numpy().T)
+    cell.weight_hh = jnp.asarray(tr.weight_hh_l0.detach().numpy().T)
+    cell.bias_ih = jnp.asarray(tr.bias_ih_l0.detach().numpy())
+    cell.bias_hh = jnp.asarray(tr.bias_hh_l0.detach().numpy())
+    x = np.random.RandomState(2).randn(B, T, I).astype(np.float32)
+    with torch.no_grad():
+        t_out, t_h = tr(torch.from_numpy(x))
+    out, h = ours(jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(out), t_out.numpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_bidirectional_multilayer_shapes():
+    pt.seed(0)
+    net = nn.LSTM(8, 16, num_layers=2, direction="bidirect")
+    x = jnp.asarray(np.random.RandomState(0).randn(4, 10, 8),
+                    jnp.float32)
+    out, (h, c) = net(x)
+    assert out.shape == (4, 10, 32)        # 2 directions concat
+    assert h.shape == (4, 4, 16)           # [L*D, B, H]
+    assert c.shape == (4, 4, 16)
+
+
+def test_time_major_and_initial_state():
+    pt.seed(0)
+    net = nn.GRU(4, 8, time_major=True)
+    x = jnp.asarray(np.random.RandomState(1).randn(6, 2, 4),
+                    jnp.float32)
+    h0 = jnp.ones((1, 2, 8), jnp.float32)
+    out, h = net(x, h0)
+    assert out.shape == (6, 2, 8) and h.shape == (1, 2, 8)
+    # initial state is actually consumed
+    out2, _ = net(x, jnp.zeros((1, 2, 8), jnp.float32))
+    assert not np.allclose(np.asarray(out), np.asarray(out2))
+
+
+def test_sequence_length_masks_padding():
+    """Final state of a padded sequence equals the final state of the
+    truncated sequence (the reference's mask semantics)."""
+    pt.seed(0)
+    net = nn.LSTM(4, 8)
+    r = np.random.RandomState(3)
+    x = jnp.asarray(r.randn(2, 6, 4), jnp.float32)
+    seq_len = jnp.asarray([6, 3])
+    out, (h, c) = net(x, sequence_length=seq_len)
+    out_t, (h_t, c_t) = net(x[1:2, :3])
+    np.testing.assert_allclose(np.asarray(h[0, 1]), np.asarray(h_t[0, 0]),
+                               rtol=1e-5, atol=1e-6)
+    # outputs past the valid length are zero
+    assert np.allclose(np.asarray(out[1, 3:]), 0.0)
+
+
+def test_rnn_cell_driver_and_birnn():
+    pt.seed(0)
+    cell = nn.LSTMCell(4, 6)
+    rnn = nn.RNN(cell)
+    x = jnp.asarray(np.random.RandomState(4).randn(2, 5, 4), jnp.float32)
+    out, (h, c) = rnn(x)
+    assert out.shape == (2, 5, 6) and h.shape == (2, 6)
+    bi = nn.BiRNN(nn.GRUCell(4, 6), nn.GRUCell(4, 6))
+    out, (hf, hb) = bi(x)
+    assert out.shape == (2, 5, 12)
+
+
+def test_lstm_trains_under_jit():
+    """End-to-end: LSTM regression under jit + grad converges."""
+    from paddle_tpu.nn.layer import functional_call, split_state
+    pt.seed(0)
+    net = nn.Sequential(("rnn", nn.LSTM(4, 16)),)
+
+    class Head(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.rnn = nn.LSTM(4, 16)
+            self.fc = nn.Linear(16, 1)
+
+        def forward(self, x):
+            out, _ = self.rnn(x)
+            return self.fc(out[:, -1])
+
+    net = Head()
+    params, buffers = split_state(net)
+    r = np.random.RandomState(0)
+    x = jnp.asarray(r.randn(16, 6, 4), jnp.float32)
+    y = jnp.asarray(x.sum(axis=(1, 2), keepdims=False)[:, None] * 0.1)
+
+    @jax.jit
+    def step(p):
+        def loss_fn(p):
+            out, _ = functional_call(net, p, buffers, x)
+            return ((out - y) ** 2).mean()
+        l, g = jax.value_and_grad(loss_fn)(p)
+        return l, jax.tree_util.tree_map(lambda a, b: a - 0.05 * b, p, g)
+
+    losses = []
+    for _ in range(60):
+        l, params = step(params)
+        losses.append(float(l))
+    assert losses[-1] < 0.4 * losses[0], losses[:2] + losses[-2:]
