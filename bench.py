@@ -221,8 +221,43 @@ def bench_bert(batch: int = 64, seq: int = 128, warmup: int = 3,
             "mfu": _mfu(sps * seq * flops_per_token)}
 
 
+def _device_watchdog(timeout_s: float = 300.0):
+    """Backend init on a tunneled TPU can block forever while another
+    client holds the chip; probe it on a daemon thread (a signal would
+    not interrupt the blocked C call) and fail loudly on timeout so the
+    driver records a diagnosis rather than a silent hang."""
+    import threading
+
+    done = threading.Event()
+    box = {}
+
+    def probe():
+        try:
+            import jax
+            jax.devices()  # forces backend/tunnel bring-up
+        except BaseException as e:  # surfaced below with the real cause
+            box["exc"] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    if not done.wait(timeout_s):
+        err = (f"device init exceeded {timeout_s:.0f}s — TPU tunnel "
+               f"busy or wedged")
+    elif "exc" in box:
+        err = f"device init failed: {box['exc']!r:.300}"
+    else:
+        return
+    print(json.dumps({"metric": "bench_error", "value": 0.0,
+                      "unit": "none", "vs_baseline": 0.0, "error": err}))
+    sys.stdout.flush()
+    raise SystemExit(3)
+
+
 def main():
     import jax
+    _device_watchdog()
     cpu_smoke = jax.default_backend() == "cpu"
     extra = {}
     for name, fn in (("resnet50", bench_resnet), ("bert", bench_bert)):

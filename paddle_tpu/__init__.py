@@ -53,6 +53,7 @@ from . import distributed  # noqa: F401
 from . import profiler  # noqa: F401
 from . import quant  # noqa: F401
 from . import cost_model  # noqa: F401
+from . import linalg  # noqa: F401
 from . import regularizer  # noqa: F401
 from . import static  # noqa: F401
 from . import fft  # noqa: F401

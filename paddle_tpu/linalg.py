@@ -1,0 +1,115 @@
+"""paddle_tpu.linalg — dense linear algebra (ref: python/paddle/tensor/
+linalg.py exported as ``paddle.linalg``; kernels phi/kernels/*_kernel.cc
+wrapping cuSOLVER/LAPACK).
+
+TPU-native: XLA owns the factorizations (QR/SVD/eigh lower to
+Householder/Jacobi routines the TPU backend implements; CPU uses
+LAPACK). These wrappers exist for name/signature parity — the math is
+``jnp.linalg``. Ops with no TPU lowering (nonsymmetric ``eig``) run via
+jax's CPU callback path, matching the reference's CPU-only kernels."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# direct re-exports where paddle's signature == numpy's
+cholesky = jnp.linalg.cholesky
+det = jnp.linalg.det
+slogdet = jnp.linalg.slogdet
+inv = jnp.linalg.inv
+pinv = jnp.linalg.pinv
+matrix_power = jnp.linalg.matrix_power
+matrix_rank = jnp.linalg.matrix_rank
+multi_dot = jnp.linalg.multi_dot
+qr = jnp.linalg.qr
+svd = jnp.linalg.svd
+svdvals = jnp.linalg.svdvals
+eig = jnp.linalg.eig
+eigvals = jnp.linalg.eigvals
+eigh = jnp.linalg.eigh
+eigvalsh = jnp.linalg.eigvalsh
+solve = jnp.linalg.solve
+lstsq = jnp.linalg.lstsq
+cond = jnp.linalg.cond
+norm = jnp.linalg.norm
+cov = jnp.cov
+corrcoef = jnp.corrcoef
+
+
+def cholesky_solve(b, l, upper: bool = False):  # noqa: E741
+    """Solve A x = b given A's Cholesky factor (ref: linalg.py
+    cholesky_solve; phi cholesky_solve_kernel)."""
+    y = lax.linalg.triangular_solve(l, b, left_side=True, lower=not upper,
+                                    transpose_a=upper)
+    return lax.linalg.triangular_solve(l, y, left_side=True,
+                                       lower=not upper,
+                                       transpose_a=not upper)
+
+
+def triangular_solve(a, b, upper: bool = True, transpose: bool = False,
+                     unitriangular: bool = False):
+    """ref: linalg.py triangular_solve."""
+    return lax.linalg.triangular_solve(
+        a, b, left_side=True, lower=not upper, transpose_a=transpose,
+        unit_diagonal=unitriangular)
+
+
+def lu(a, pivot: bool = True):
+    """ref: linalg.py lu → (LU packed, pivots, info). jax returns
+    (lu, pivots, permutation); info is always 0 on success here."""
+    lu_, piv, _ = lax.linalg.lu(a)
+    info = jnp.zeros(a.shape[:-2], jnp.int32)
+    # paddle returns 1-based pivots (LAPACK convention)
+    return lu_, piv.astype(jnp.int32) + 1, info
+
+
+def lu_unpack(lu_data, lu_pivots, unpack_ludata: bool = True,
+              unpack_pivots: bool = True):
+    """ref: linalg.py lu_unpack → (P, L, U); batched via vmap."""
+    lu_data = jnp.asarray(lu_data)
+    if lu_data.ndim > 2:
+        return jax.vmap(
+            lambda d, p: lu_unpack(d, p, unpack_ludata, unpack_pivots)
+        )(lu_data, jnp.asarray(lu_pivots))
+    n = lu_data.shape[-2]
+    l = jnp.tril(lu_data, -1) + jnp.eye(n, lu_data.shape[-1],  # noqa: E741
+                                        dtype=lu_data.dtype)
+    u = jnp.triu(lu_data)
+    # rebuild P from 1-based LAPACK row swaps
+    perm = jnp.arange(n)
+    piv = lu_pivots - 1
+
+    def body(i, p):
+        j = piv[i]
+        pi, pj = p[i], p[j]
+        return p.at[i].set(pj).at[j].set(pi)
+
+    perm = lax.fori_loop(0, lu_pivots.shape[-1], body, perm)
+    p_mat = jnp.eye(n, dtype=lu_data.dtype)[perm].T
+    return p_mat, l, u
+
+
+# paddle.linalg re-exports the paddle.tensor implementations — alias
+# them rather than duplicating (tensor.dot is paddle's row-wise dot)
+from .tensor import cross, dist, dot, matmul  # noqa: E402
+
+
+def householder_product(x, tau):
+    """ref: linalg.py householder_product (orgqr)."""
+    return lax.linalg.householder_product(x, tau)
+
+
+def pca_lowrank(x, q=None, center: bool = True, niter: int = 2):
+    """ref: linalg.py pca_lowrank → (U, S, V) of the (centered) data.
+    XLA's full SVD replaces the randomized iteration — at the sizes a
+    TPU program handles, exact SVD of the thin dimension is cheaper
+    than sketching."""
+    x = jnp.asarray(x)
+    if q is None:
+        q = min(6, *x.shape[-2:])
+    if center:
+        x = x - x.mean(axis=-2, keepdims=True)
+    u, s, vt = jnp.linalg.svd(x, full_matrices=False)
+    return u[..., :q], s[..., :q], jnp.swapaxes(vt, -1, -2)[..., :q]

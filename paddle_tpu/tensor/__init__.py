@@ -318,7 +318,16 @@ def kron(x, y):
     return jnp.kron(x, y)
 
 
-def cross(x, y, axis=-1):
+def cross(x, y, axis=None):
+    """ref: paddle.cross — axis=None means the FIRST axis of size 3
+    (the reference's default-axis sentinel), not the last axis."""
+    x = jnp.asarray(x)
+    if axis is None or axis == 9:  # 9: paddle's C-side sentinel
+        cands = [i for i, d in enumerate(x.shape) if d == 3]
+        if not cands:
+            raise ValueError(
+                f"cross: no axis of size 3 in shape {x.shape}")
+        axis = cands[0]
     return jnp.cross(x, y, axis=axis)
 
 

@@ -4,6 +4,7 @@ the data side."""
 
 from . import datasets  # noqa
 from . import transforms  # noqa
+from . import ops  # noqa
 from ..models import (LeNet, MobileNetV1, MobileNetV2, ResNet,  # noqa
                       VGG, mobilenet_v1, mobilenet_v2, resnet18,
                       resnet34, resnet50, resnet101, resnet152,

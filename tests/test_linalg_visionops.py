@@ -1,0 +1,189 @@
+"""paddle.linalg parity (vs numpy/scipy) and vision detection ops
+(vs torchvision's CPU reference when available).
+
+Analogs: reference unittests/test_linalg_*.py, test_nms_op.py,
+test_roi_align_op.py, test_deform_conv2d.py."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu import linalg
+from paddle_tpu.vision import ops as vops
+
+
+def _spd(n=6, seed=0):
+    a = np.random.RandomState(seed).randn(n, n)
+    return (a @ a.T + n * np.eye(n)).astype(np.float32)
+
+
+def test_cholesky_and_solve():
+    a = _spd()
+    l = np.asarray(linalg.cholesky(a))  # noqa: E741
+    np.testing.assert_allclose(l @ l.T, a, rtol=1e-4, atol=1e-4)
+    b = np.random.RandomState(1).randn(6, 2).astype(np.float32)
+    x = np.asarray(linalg.cholesky_solve(b, jnp.asarray(l)))
+    np.testing.assert_allclose(a @ x, b, rtol=1e-3, atol=1e-3)
+
+
+def test_qr_svd_eigh():
+    a = np.random.RandomState(2).randn(5, 4).astype(np.float32)
+    q, r = linalg.qr(a)
+    np.testing.assert_allclose(np.asarray(q) @ np.asarray(r), a,
+                               rtol=1e-4, atol=1e-4)
+    u, s, vt = linalg.svd(a, full_matrices=False)
+    np.testing.assert_allclose(
+        np.asarray(u) * np.asarray(s) @ np.asarray(vt), a,
+        rtol=1e-4, atol=1e-4)
+    w, v = linalg.eigh(jnp.asarray(_spd()))
+    assert np.all(np.asarray(w) > 0)  # SPD → positive spectrum
+
+
+def test_lu_roundtrip():
+    a = np.random.RandomState(3).randn(5, 5).astype(np.float32)
+    lu_packed, piv, info = linalg.lu(jnp.asarray(a))
+    assert np.all(np.asarray(info) == 0)
+    p, l, u = linalg.lu_unpack(lu_packed, piv)
+    np.testing.assert_allclose(
+        np.asarray(p) @ np.asarray(l) @ np.asarray(u), a,
+        rtol=1e-4, atol=1e-4)
+
+
+def test_solve_det_inv_norm():
+    a = _spd(4, seed=4)
+    b = np.random.RandomState(5).randn(4).astype(np.float32)
+    x = np.asarray(linalg.solve(a, b))
+    np.testing.assert_allclose(a @ x, b, rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(float(linalg.det(a)), np.linalg.det(a),
+                               rtol=1e-3)
+    np.testing.assert_allclose(np.asarray(linalg.inv(a)),
+                               np.linalg.inv(a), rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(float(linalg.norm(a)),
+                               np.linalg.norm(a), rtol=1e-5)
+
+
+def test_matmul_transpose_flags_and_misc():
+    a = np.random.RandomState(6).randn(3, 4).astype(np.float32)
+    b = np.random.RandomState(7).randn(3, 5).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(linalg.matmul(a, b, transpose_x=True)), a.T @ b,
+        rtol=1e-5, atol=1e-6)
+    u, s, v = linalg.pca_lowrank(np.random.RandomState(8)
+                                 .randn(20, 8).astype(np.float32), q=3)
+    assert u.shape == (20, 3) and s.shape == (3,) and v.shape == (8, 3)
+
+
+# -- vision ops -------------------------------------------------------------
+
+def _boxes():
+    return np.array([[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30],
+                     [21, 21, 29, 29], [50, 50, 60, 60]], np.float32)
+
+
+def test_nms_matches_torchvision():
+    scores = np.array([0.9, 0.8, 0.7, 0.95, 0.5], np.float32)
+    kept = np.asarray(vops.nms(_boxes(), 0.3, scores=scores))
+    try:
+        from torchvision.ops import nms as tv_nms
+        import torch
+        ref = tv_nms(torch.from_numpy(_boxes()),
+                     torch.from_numpy(scores), 0.3).numpy()
+        np.testing.assert_array_equal(kept, ref)
+    except ImportError:
+        # manual expectation: box3 (0.95) suppresses box2; box0 (0.9)
+        # suppresses box1; box4 kept
+        np.testing.assert_array_equal(kept, [3, 0, 4])
+
+
+def test_nms_categories_do_not_cross_suppress():
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11]], np.float32)
+    scores = np.array([0.9, 0.8], np.float32)
+    cats = np.array([0, 1])
+    kept = np.asarray(vops.nms(boxes, 0.3, scores=scores,
+                               category_idxs=cats,
+                               categories=[0, 1]))
+    assert sorted(kept.tolist()) == [0, 1]
+
+
+def test_roi_align_matches_torchvision():
+    r = np.random.RandomState(0)
+    x = r.randn(1, 3, 16, 16).astype(np.float32)
+    boxes = np.array([[2.0, 2.0, 10.0, 12.0],
+                      [0.0, 0.0, 8.0, 8.0]], np.float32)
+    out = np.asarray(vops.roi_align(x, boxes, [2], output_size=4,
+                                    sampling_ratio=2, aligned=True))
+    assert out.shape == (2, 3, 4, 4)
+    try:
+        import torch
+        from torchvision.ops import roi_align as tv_roi
+        ref = tv_roi(torch.from_numpy(x),
+                     [torch.from_numpy(boxes)], output_size=4,
+                     sampling_ratio=2, aligned=True).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+    except ImportError:
+        assert np.all(np.isfinite(out))
+
+
+def test_roi_align_adaptive_sampling_matches_torchvision():
+    """sampling_ratio=-1: ceil(roi/output) points per bin, per roi."""
+    r = np.random.RandomState(5)
+    x = r.randn(1, 2, 32, 32).astype(np.float32)
+    boxes = np.array([[1.0, 1.0, 30.0, 25.0],   # big roi -> many points
+                      [3.0, 3.0, 6.0, 6.0]], np.float32)
+    out = np.asarray(vops.roi_align(x, boxes, [2], output_size=4,
+                                    sampling_ratio=-1, aligned=True))
+    try:
+        import torch
+        from torchvision.ops import roi_align as tv_roi
+        ref = tv_roi(torch.from_numpy(x), [torch.from_numpy(boxes)],
+                     output_size=4, sampling_ratio=-1,
+                     aligned=True).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+    except ImportError:
+        assert np.all(np.isfinite(out))
+
+
+def test_cross_default_axis_is_first_dim3():
+    import paddle_tpu.tensor as T
+    x = np.random.RandomState(6).randn(3, 5).astype(np.float32)
+    y = np.random.RandomState(7).randn(3, 5).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(T.cross(x, y)),
+                               np.cross(x, y, axis=0), rtol=1e-5,
+                               atol=1e-6)
+    assert linalg.cross is T.cross  # linalg aliases tensor
+
+
+def test_lu_unpack_batched():
+    a = np.random.RandomState(8).randn(3, 4, 4).astype(np.float32)
+    lu_packed, piv, info = linalg.lu(jnp.asarray(a))
+    p, l, u = linalg.lu_unpack(lu_packed, piv)
+    np.testing.assert_allclose(
+        np.einsum("bij,bjk,bkl->bil", np.asarray(p), np.asarray(l),
+                  np.asarray(u)), a, rtol=1e-4, atol=1e-4)
+
+
+def test_deform_conv2d_zero_offset_equals_conv():
+    """With zero offsets (and no mask) deform_conv2d must equal a
+    standard convolution — the reference's defining identity."""
+    from paddle_tpu.nn import functional as F
+    r = np.random.RandomState(1)
+    x = r.randn(2, 3, 8, 8).astype(np.float32)
+    w = r.randn(6, 3, 3, 3).astype(np.float32)
+    oh = ow = 8 - 2
+    offset = np.zeros((2, 2 * 9, oh, ow), np.float32)
+    out = np.asarray(vops.deform_conv2d(x, offset, w))
+    ref = np.asarray(F.conv2d(jnp.asarray(x), jnp.asarray(w)))
+    np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-3)
+
+
+def test_deform_conv2d_mask_scales_contribution():
+    r = np.random.RandomState(2)
+    x = r.randn(1, 2, 6, 6).astype(np.float32)
+    w = r.randn(4, 2, 3, 3).astype(np.float32)
+    oh = ow = 4
+    offset = np.zeros((1, 18, oh, ow), np.float32)
+    mask_half = np.full((1, 9, oh, ow), 0.5, np.float32)
+    full = np.asarray(vops.deform_conv2d(x, offset, w))
+    half = np.asarray(vops.deform_conv2d(x, offset, w, mask=mask_half))
+    np.testing.assert_allclose(half, 0.5 * full, rtol=1e-4, atol=1e-4)
