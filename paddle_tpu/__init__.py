@@ -38,6 +38,7 @@ from . import amp  # noqa: F401
 from . import io  # noqa: F401
 from . import metric  # noqa: F401
 from .hapi.model import Model  # noqa: F401
+from .hapi.summary import flops, summary  # noqa: F401
 from . import hapi  # noqa: F401
 from . import parallel  # noqa: F401
 from . import models  # noqa: F401

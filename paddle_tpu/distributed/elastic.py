@@ -214,7 +214,11 @@ class ElasticManager:
     def _heartbeats_stale(self) -> bool:
         if self.heartbeat_timeout is None:
             return False
-        grace = max(3 * self.heartbeat_timeout, 5.0)
+        # spawn grace before the FIRST beat: rank boot includes the
+        # jax/framework import (many seconds on a loaded host) — a
+        # short grace here misreads slow boot as a stall and burns the
+        # restart budget on healthy generations
+        grace = max(3 * self.heartbeat_timeout, 30.0)
         now = time.time()
         # progress beats (manual, from the training loop) outrank the
         # liveness thread: a wedged device keeps the thread beating but

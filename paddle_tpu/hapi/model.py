@@ -396,15 +396,12 @@ class Model:
         return self.network.parameters()
 
     def summary(self, input_size=None, dtype=None) -> Dict[str, int]:
-        """Parameter count summary (ref: hapi/model.py summary)."""
-        total = 0
-        trainable = 0
-        meta = self.network.param_meta()
-        for name, p in self.network.named_parameters():
-            n = int(np.prod(p.shape))
-            total += n
-            if meta[name].trainable:
-                trainable += n
-        info = {"total_params": total, "trainable_params": trainable}
-        print(f"Total params: {total:,}\nTrainable params: {trainable:,}")
-        return info
+        """Per-layer table + parameter counts (ref: hapi/model.py
+        summary → model_summary.py; shapes come from a zero-cost
+        eval_shape probe)."""
+        from .summary import summary as _summary
+        multi = isinstance(input_size, (list, tuple)) and input_size \
+            and isinstance(input_size[0], (list, tuple))
+        n = len(input_size) if multi else 1
+        return _summary(self.network, input_size,
+                        [dtype] * n if dtype else None)

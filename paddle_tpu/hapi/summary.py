@@ -1,0 +1,144 @@
+"""Model summary + FLOPs counting (ref: python/paddle/hapi/
+model_summary.py ``summary`` — per-layer table via forward hooks;
+python/paddle/hapi/dynamic_flops.py ``flops`` — per-layer-type FLOP
+counters).
+
+TPU-native twist: the probe forward runs under ``jax.eval_shape``, so
+building the table costs zero compute and zero device memory — output
+shapes come from the tracer, and the same hook pass feeds the analytic
+FLOP counters. The reference materializes a real forward on device for
+the same information."""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn.layer import Layer
+
+
+def _leaf_layers(net: Layer):
+    for name, sub in net.named_sublayers(include_self=True):
+        if not sub._sublayers:  # leaves only, like the reference table
+            yield name or type(net).__name__, sub
+
+
+def _param_count(layer: Layer) -> Tuple[int, int]:
+    total = trainable = 0
+    meta = layer.param_meta()
+    for name, p in layer.named_parameters():
+        n = int(np.prod(p.shape)) if p.ndim else 1
+        total += n
+        if meta[name].trainable:
+            trainable += n
+    return total, trainable
+
+
+def _probe(net: Layer, input_size, dtypes=None):
+    """Trace one forward under eval_shape, recording per-layer output
+    shapes (+ inputs, for the FLOP counters) via forward hooks."""
+    if isinstance(input_size, tuple) and input_size and \
+            not isinstance(input_size[0], (tuple, list)):
+        input_size = [tuple(input_size)]
+    dtypes = dtypes or ["float32"] * len(input_size)
+    records: List[dict] = []
+    hooks = []
+    for name, sub in _leaf_layers(net):
+        def post(layer, args, out, _name=name):
+            records.append({
+                "name": _name, "layer": layer,
+                "in_shape": tuple(np.shape(args[0])) if args else (),
+                "out_shapes": [tuple(np.shape(leaf)) for leaf in
+                               jax.tree_util.tree_leaves(out)]})
+        hooks.append(sub.register_forward_post_hook(post))
+    training = net.training
+    try:
+        net.eval()
+        xs = [jnp.zeros(s, d) for s, d in zip(input_size, dtypes)]
+        jax.eval_shape(lambda *a: net(*a), *xs)
+    finally:
+        if training:
+            net.train()
+        for h in hooks:
+            h.remove()
+    return records
+
+
+def summary(net: Layer, input_size=None, dtypes=None,
+            print_table: bool = True) -> Dict[str, int]:
+    """ref: paddle.summary(net, input_size) → prints the layer table,
+    returns {'total_params', 'trainable_params'}."""
+    total, trainable = _param_count(net)
+    rows = []
+    if input_size is not None:
+        for r in _probe(net, input_size, dtypes):
+            p, _ = _param_count(r["layer"])
+            shapes = r["out_shapes"]
+            rows.append((r["name"], type(r["layer"]).__name__,
+                         str(shapes[0] if len(shapes) == 1 else shapes),
+                         p))
+    if print_table:
+        if rows:
+            w = max(len(r[0]) for r in rows) + 2
+            print(f"{'Layer':<{w}}{'Type':<24}{'Output Shape':<28}"
+                  f"{'Params':>12}")
+            print("-" * (w + 64))
+            for name, typ, shape, p in rows:
+                print(f"{name:<{w}}{typ:<24}{shape:<28}{p:>12,}")
+            print("-" * (w + 64))
+        print(f"Total params: {total:,}")
+        print(f"Trainable params: {trainable:,}")
+        print(f"Non-trainable params: {total - trainable:,}")
+    return {"total_params": total, "trainable_params": trainable}
+
+
+# -- FLOP counters (ref: hapi/dynamic_flops.py register_hooks table) -------
+
+def _conv_flops(layer, in_shape, out_shape) -> float:
+    """Any conv rank: 2 * N * prod(spatial_out) * Cout * Cin *
+    prod(kernel) / groups (NC... layout)."""
+    k = layer.kernel_size
+    k = k if isinstance(k, (tuple, list)) else (k,)
+    n, cout = out_shape[0], out_shape[1]
+    spatial = out_shape[2:]
+    return 2.0 * n * float(np.prod(spatial)) * cout * \
+        layer.in_channels * float(np.prod(k)) / layer.groups
+
+
+def _linear_flops(layer, in_shape, out_shape) -> float:
+    return 2.0 * float(np.prod(in_shape[:-1])) * layer.in_features * \
+        layer.out_features
+
+
+def flops(net: Layer, input_size, dtypes=None,
+          print_detail: bool = False) -> int:
+    """ref: paddle.flops(net, input_size) — analytic multiply-add count
+    over conv/linear/norm layers (one fwd pass, batch included)."""
+    from ..nn.layers.common import Linear
+    from ..nn.layers.conv import _ConvNd
+    from ..nn.layers import norm as norm_mod
+
+    total = 0.0
+    for r in _probe(net, input_size, dtypes):
+        layer = r["layer"]
+        out0 = r["out_shapes"][0] if r["out_shapes"] else ()
+        f = 0.0
+        if isinstance(layer, _ConvNd) and len(out0) >= 3:
+            f = _conv_flops(layer, r["in_shape"], out0)
+        elif isinstance(layer, Linear):
+            f = _linear_flops(layer, r["in_shape"], out0)
+        elif isinstance(layer, (norm_mod._BatchNormBase,
+                                norm_mod.LayerNorm, norm_mod.RMSNorm,
+                                norm_mod.GroupNorm,
+                                norm_mod.InstanceNorm2D)):
+            f = 2.0 * float(np.prod(out0)) if out0 else 0.0
+        if print_detail and f:
+            print(f"{r['name']:<40}{f / 1e6:>12.2f} MFLOPs")
+        total += f
+    if print_detail:
+        print(f"Total FLOPs: {total / 1e9:.3f} GFLOPs")
+    return int(total)

@@ -18,3 +18,4 @@ from ..nn.layers.transformer import (  # noqa
     TransformerEncoderLayer as FusedTransformerEncoderLayer)
 
 from . import asp  # noqa  (n:m structured sparsity)
+from . import autotune  # noqa  (kernel/layout/dataloader tuning facade)

@@ -386,6 +386,10 @@ class DataLoader:
                  return_list: bool = True, to_device: bool = True):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
+        if num_workers == "auto":
+            # ref: incubate/autotune.py dataloader tuner
+            from ..incubate.autotune import suggested_num_workers
+            num_workers = suggested_num_workers()
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
         self.to_device = to_device

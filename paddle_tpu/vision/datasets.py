@@ -113,6 +113,27 @@ class Cifar10(Dataset):
         return img, self.labels[idx]
 
 
+class Cifar100(Cifar10):
+    """CIFAR-100 (ref: vision/datasets/cifar.py Cifar100 — same pickle
+    format, 'train'/'test' files, b'fine_labels' key)."""
+
+    def __init__(self, root: str, mode: str = "train",
+                 transform: Optional[Callable] = None):
+        batch_dir = root
+        sub = os.path.join(root, "cifar-100-python")
+        if os.path.isdir(sub):
+            batch_dir = sub
+        name = "train" if mode == "train" else "test"
+        p = os.path.join(batch_dir, name)
+        if not os.path.exists(p):
+            _missing(p, "CIFAR-100 batch", "python pickle")
+        with open(p, "rb") as f:
+            d = pickle.load(f, encoding="bytes")
+        self.images = d[b"data"].reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(d[b"fine_labels"], np.int64)
+        self.transform = transform
+
+
 class DatasetFolder(Dataset):
     """class-per-subdirectory tree (ref: vision/datasets/folder.py)."""
 

@@ -1,0 +1,119 @@
+"""summary/flops table + autotune facade (ref: hapi/model_summary.py,
+hapi/dynamic_flops.py, incubate/autotune.py tests)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.incubate import autotune
+
+
+def _cnn():
+    pt.seed(0)
+    return nn.Sequential(
+        ("conv", nn.Conv2D(3, 8, 3, padding=1)),
+        ("bn", nn.BatchNorm2D(8)),
+        ("act", nn.ReLU()),
+        ("pool", nn.AdaptiveAvgPool2D(1)),
+        ("flat", nn.Flatten()),
+        ("fc", nn.Linear(8, 10)),
+    )
+
+
+def test_summary_counts_and_table(capsys):
+    net = _cnn()
+    info = pt.summary(net, (2, 3, 16, 16))
+    out = capsys.readouterr().out
+    expected = 3 * 8 * 9 + 8 + 2 * 8 + 8 * 10 + 10
+    assert info["total_params"] == expected
+    assert info["trainable_params"] == expected
+    assert "Conv2D" in out and "Linear" in out
+    assert "(2, 8, 16, 16)" in out  # conv output shape from eval_shape
+
+
+def test_model_summary_delegates():
+    net = _cnn()
+    model = pt.Model(net)
+    info = model.summary((1, 3, 8, 8))
+    assert info["total_params"] > 0
+
+
+def test_flops_analytic_counts():
+    net = _cnn()
+    total = pt.flops(net, (1, 3, 16, 16))
+    conv = 2 * 1 * 16 * 16 * 8 * 3 * 9
+    fc = 2 * 8 * 10
+    bn = 2 * 8 * 16 * 16
+    assert abs(total - (conv + fc + bn)) <= 1e-6 * (conv + fc + bn), \
+        (total, conv + fc + bn)
+
+
+def test_flops_scales_with_batch():
+    net = _cnn()
+    f1 = pt.flops(net, (1, 3, 16, 16))
+    f4 = pt.flops(net, (4, 3, 16, 16))
+    assert f4 > 3 * f1
+
+
+def test_summary_leaves_training_mode_intact():
+    net = _cnn()
+    net.train()
+    pt.summary(net, (1, 3, 8, 8), dtypes=None)
+    assert net.training
+
+
+def test_autotune_config_roundtrip():
+    autotune.set_config({"dataloader": {"enable": True}})
+    assert autotune.get_config()["dataloader"]["enable"]
+    assert autotune.suggested_num_workers() >= 1
+    autotune.set_config({"dataloader": {"enable": False}})
+    assert autotune.suggested_num_workers() == 0
+    with pytest.raises(ValueError, match="unknown autotune"):
+        autotune.set_config({"bogus": {}})
+
+
+def test_flops_counts_conv1d_and_bn1d():
+    pt.seed(0)
+    net = nn.Sequential(("c", nn.Conv1D(2, 4, 3, padding=1)),
+                        ("b", nn.BatchNorm1D(4)))
+    total = pt.flops(net, (1, 2, 16))
+    conv = 2 * 1 * 16 * 4 * 2 * 3
+    bn = 2 * 4 * 16
+    assert abs(total - (conv + bn)) <= 1, (total, conv + bn)
+
+
+def test_summary_failure_restores_train_mode():
+    net = _cnn()
+    net.train()
+    with pytest.raises(Exception):
+        pt.summary(net, (1, 7))  # wrong shape -> trace error
+    assert net.training
+
+
+def test_dataloader_num_workers_auto():
+    from paddle_tpu.io import DataLoader
+
+    class DS:
+        def __getitem__(self, i):
+            return np.zeros(2, np.float32)
+
+        def __len__(self):
+            return 4
+
+    from paddle_tpu.io import Dataset
+
+    class D(Dataset):
+        def __getitem__(self, i):
+            return np.zeros(2, np.float32), np.int64(0)
+
+        def __len__(self):
+            return 4
+
+    autotune.set_config({"dataloader": {"enable": False}})
+    assert DataLoader(D(), num_workers="auto").num_workers == 0
+    autotune.set_config({"dataloader": {"enable": True}})
+    try:
+        assert DataLoader(D(), num_workers="auto").num_workers >= 1
+    finally:
+        autotune.set_config({"dataloader": {"enable": False}})

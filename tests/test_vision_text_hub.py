@@ -158,3 +158,15 @@ def test_hub_local(tmp_path):
     assert m.weight.shape == (6, 6)
     with pytest.raises(NotImplementedError, match="zero-egress"):
         hub.load(str(tmp_path), "tiny_model", source="github")
+
+
+def test_cifar100_reader(tmp_path):
+    d = tmp_path / "cifar-100-python"
+    d.mkdir()
+    with open(d / "train", "wb") as f:
+        pickle.dump({b"data": np.zeros((7, 3072), np.uint8),
+                     b"fine_labels": list(range(7))}, f)
+    ds = datasets.Cifar100(str(tmp_path), mode="train")
+    assert len(ds) == 7
+    img, lbl = ds[2]
+    assert img.shape == (3, 32, 32) and int(lbl) == 2
