@@ -19,6 +19,17 @@ from .layers.loss import (BCELoss, BCEWithLogitsLoss,  # noqa: F401
 from .layers.norm import (BatchNorm, BatchNorm1D, BatchNorm2D,  # noqa
                           BatchNorm3D, GroupNorm, InstanceNorm2D, LayerNorm,
                           RMSNorm, SyncBatchNorm)
+from .layers.extra import (CELU, GLU, RReLU, AlphaDropout,  # noqa
+                           Bilinear, CosineSimilarity, Fold,
+                           Hardshrink, Hardtanh, LocalResponseNorm,
+                           Maxout, Pad1D, Pad2D, Pad3D,
+                           PairwiseDistance, PixelShuffle,
+                           PixelUnshuffle, Softshrink, Tanhshrink,
+                           ThresholdedReLU, Unfold, Upsample,
+                           UpsamplingBilinear2D, UpsamplingNearest2D,
+                           ZeroPad2D)
+from .layers.pooling import (AdaptiveAvgPool1D, AdaptiveAvgPool3D,  # noqa
+                             AdaptiveMaxPool1D, AvgPool3D, MaxPool3D)
 from .layers.pooling import (AdaptiveAvgPool2D, AdaptiveMaxPool2D,  # noqa
                              AvgPool1D, AvgPool2D, MaxPool1D, MaxPool2D)
 from .layers.moe import (GShardGate, MoELayer, NaiveGate,  # noqa

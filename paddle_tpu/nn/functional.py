@@ -301,6 +301,49 @@ def avg_pool1d(x, kernel_size, stride=None, padding=0,
                  count_include_pad=count_include_pad, average=True)
 
 
+def max_pool3d(x, kernel_size, stride=None, padding=0,
+               data_format="NCDHW"):
+    return _pool(x, -jnp.inf, lax.max, kernel_size, stride, padding,
+                 "NDHWC" if data_format == "NDHWC" else "NCHW")
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0,
+               count_include_pad=True, data_format="NCDHW"):
+    return _pool(x, 0.0, lax.add, kernel_size, stride, padding,
+                 "NDHWC" if data_format == "NDHWC" else "NCHW",
+                 count_include_pad=count_include_pad, average=True)
+
+
+def _adaptive_1d(x, output_size, reduce_name):
+    l = x.shape[-1]
+    if l % output_size:
+        raise ValueError(
+            f"adaptive 1d pooling needs length {l} divisible by "
+            f"output_size {output_size} (static-shape TPU constraint)")
+    k = l // output_size
+    xr = x.reshape(*x.shape[:-1], output_size, k)
+    return getattr(jnp, reduce_name)(xr, axis=-1)
+
+
+def adaptive_avg_pool1d(x, output_size):
+    return _adaptive_1d(x, output_size, "mean")
+
+
+def adaptive_max_pool1d(x, output_size):
+    return _adaptive_1d(x, output_size, "max")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW"):
+    out = _norm_tuple(output_size, 3)
+    d, h, w = x.shape[2:5] if data_format == "NCDHW" else x.shape[1:4]
+    if d % out[0] or h % out[1] or w % out[2]:
+        raise ValueError(
+            "adaptive 3d pooling needs divisible spatial dims "
+            f"({(d, h, w)} vs {out})")
+    k = (d // out[0], h // out[1], w // out[2])
+    return avg_pool3d(x, k, k, 0, data_format=data_format)
+
+
 def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
     out = _norm_tuple(output_size, 2)
     if data_format == "NCHW":
@@ -671,10 +714,15 @@ def pad(x, pad: Sequence[int], mode: str = "constant", value: float = 0.0,
     if len(pad) % 2 != 0:
         raise ValueError("pad length must be even")
     n = len(pad) // 2
-    # innermost dimension first: pad[0:2] applies to the LAST dim
-    # (matches the reference's (left, right, top, bottom) convention)
-    cfg = [(0, 0)] * (x.ndim - n) + \
-        [(pad[2 * i], pad[2 * i + 1]) for i in reversed(range(n))]
+    # innermost dimension first: pad[0:2] applies to the innermost
+    # SPATIAL dim (the reference's (left, right, top, bottom)
+    # convention); data_format says where the spatial dims live
+    pairs = [(pad[2 * i], pad[2 * i + 1]) for i in reversed(range(n))]
+    channels_last = data_format in ("NHWC", "NDHWC", "NLC", "NWC")
+    if channels_last and n == x.ndim - 2:
+        cfg = [(0, 0)] + pairs + [(0, 0)]
+    else:
+        cfg = [(0, 0)] * (x.ndim - n) + pairs
     jmode = {"constant": "constant", "reflect": "reflect",
              "replicate": "edge", "circular": "wrap"}[mode]
     if jmode == "constant":
@@ -733,3 +781,10 @@ def interpolate(x, size=None, scale_factor=None, mode: str = "nearest",
     xt = jnp.moveaxis(x, 1, -1)
     out = jax.image.resize(xt, (n, size[0], size[1], c), method=method)
     return jnp.moveaxis(out, -1, 1)
+
+
+# long-tail functionals live beside their layer wrappers
+from .layers.extra import (alpha_dropout, celu, fold,  # noqa: E402
+                           local_response_norm, maxout,
+                           pairwise_distance, pixel_shuffle,
+                           pixel_unshuffle, thresholded_relu)

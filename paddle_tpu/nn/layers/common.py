@@ -140,10 +140,19 @@ class Upsample(Layer):
 
 
 def _act_layer(name, fn, **fixed):
+    import inspect
+    try:
+        arg_names = list(inspect.signature(fn).parameters)[1:]
+    except (TypeError, ValueError):  # builtins without signatures
+        arg_names = []
+
     class _Act(Layer):
-        def __init__(self, **kwargs):
+        def __init__(self, *args, **kwargs):
             super().__init__()
-            self._kwargs = {**fixed, **kwargs}
+            # positional args map onto fn's params after x, so the
+            # reference's nn.CELU(0.2) / nn.Hardtanh(-2, 2) forms work
+            self._kwargs = {**fixed, **dict(zip(arg_names, args)),
+                            **kwargs}
 
         def forward(self, x):
             return fn(x, **self._kwargs)

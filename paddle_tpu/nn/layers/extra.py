@@ -1,0 +1,288 @@
+"""Long-tail nn layers (ref: python/paddle/nn/layer/{activation,common,
+pooling,vision,distance}.py) — wrappers over nn.functional plus the few
+ops with no functional yet (pixel_shuffle, fold, bilinear, pairwise
+distance, local response norm). All are shape/layout ops or elementwise
+math XLA fuses; nothing here needs a kernel."""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .. import functional as F
+from .. import initializer as I
+from ..layer import Layer
+
+
+# -- functional forms (exported through nn.functional too) ------------------
+
+def celu(x, alpha: float = 1.0):
+    return jnp.maximum(x, 0) + jnp.minimum(
+        0, alpha * jnp.expm1(x / alpha))
+
+
+def thresholded_relu(x, threshold: float = 1.0):
+    return jnp.where(x > threshold, x, 0.0)
+
+
+def maxout(x, groups: int, axis: int = 1):
+    axis = axis % x.ndim  # -1 is the reference's NHWC form
+    c = x.shape[axis]
+    if c % groups:
+        raise ValueError(f"channels {c} not divisible by {groups}")
+    shape = list(x.shape)
+    shape[axis:axis + 1] = [c // groups, groups]
+    return jnp.max(x.reshape(shape), axis=axis + 1)
+
+
+def pixel_shuffle(x, upscale_factor: int, data_format: str = "NCHW"):
+    r = upscale_factor
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = x.reshape(n, c // (r * r), r, r, h, w)
+        x = x.transpose(0, 1, 4, 2, 5, 3)
+        return x.reshape(n, c // (r * r), h * r, w * r)
+    n, h, w, c = x.shape
+    x = x.reshape(n, h, w, r, r, c // (r * r))
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(n, h * r, w * r, c // (r * r))
+
+
+def pixel_unshuffle(x, downscale_factor: int, data_format: str = "NCHW"):
+    r = downscale_factor
+    if data_format != "NCHW":
+        raise NotImplementedError("NHWC pixel_unshuffle")
+    n, c, h, w = x.shape
+    x = x.reshape(n, c, h // r, r, w // r, r)
+    x = x.transpose(0, 1, 3, 5, 2, 4)
+    return x.reshape(n, c * r * r, h // r, w // r)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0,
+         dilations=1):
+    """col2im — inverse of unfold (ref: functional/common.py fold).
+    x: [N, C*kh*kw, L] → [N, C, H, W] summing overlaps."""
+    kh, kw = (kernel_sizes if isinstance(kernel_sizes, (tuple, list))
+              else (kernel_sizes, kernel_sizes))
+    sh, sw = (strides if isinstance(strides, (tuple, list))
+              else (strides, strides))
+    ph, pw = (paddings if isinstance(paddings, (tuple, list))
+              else (paddings, paddings))
+    dh, dw = (dilations if isinstance(dilations, (tuple, list))
+              else (dilations, dilations))
+    oh, ow = output_sizes
+    n, ckk, L = x.shape
+    c = ckk // (kh * kw)
+    lh = (oh + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    lw = (ow + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    assert lh * lw == L, (lh, lw, L)
+    cols = x.reshape(n, c, kh, kw, lh, lw)
+    out = jnp.zeros((n, c, oh + 2 * ph, ow + 2 * pw), x.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            patch = cols[:, :, i, j]  # [n, c, lh, lw]
+            out = out.at[:, :, i * dh:i * dh + lh * sh:sh,
+                         j * dw:j * dw + lw * sw:sw].add(patch)
+    return out[:, :, ph:ph + oh, pw:pw + ow]
+
+
+def local_response_norm(x, size: int = 5, alpha: float = 1e-4,
+                        beta: float = 0.75, k: float = 1.0):
+    """ref: functional/norm.py local_response_norm — cross-channel
+    window on dim 1, any rank 3-5 (NCL/NCHW/NCDHW)."""
+    sq = jnp.square(x)
+    c = x.shape[1]
+    half = size // 2
+    pads = ((0, 0), (half, size - half - 1)) + \
+        ((0, 0),) * (x.ndim - 2)
+    padded = jnp.pad(sq, pads)
+    win = sum(padded[:, i:i + c] for i in range(size))
+    return x / jnp.power(k + alpha * win / size, beta)
+
+
+def pairwise_distance(x, y, p: float = 2.0, epsilon: float = 1e-6,
+                      keepdim: bool = False):
+    d = jnp.linalg.norm(x - y + epsilon, ord=p, axis=-1,
+                        keepdims=keepdim)
+    return d
+
+
+def alpha_dropout(x, p: float = 0.5, training: bool = True):
+    """SELU-preserving dropout (ref: functional/common.py
+    alpha_dropout)."""
+    if not training or p == 0.0:
+        return x
+    from ...core import rng
+    alpha_p = -1.7580993408473766
+    mask = jax.random.bernoulli(rng.next_key(), 1 - p, x.shape)
+    a = (1 - p + p * alpha_p ** 2) ** -0.5
+    b = -a * p * alpha_p
+    return a * jnp.where(mask, x, alpha_p) + b
+
+
+# -- layer wrappers ---------------------------------------------------------
+
+from .common import Pad2D, Upsample, _act_layer  # noqa: E402 — reuse
+
+CELU = _act_layer("CELU", celu)
+ThresholdedReLU = _act_layer("ThresholdedReLU", thresholded_relu)
+Tanhshrink = _act_layer("Tanhshrink", F.tanhshrink)
+Hardshrink = _act_layer("Hardshrink", F.hardshrink)
+Softshrink = _act_layer("Softshrink", F.softshrink)
+Hardtanh = _act_layer("Hardtanh", F.hardtanh)
+GLU = _act_layer("GLU", F.glu)
+LocalResponseNorm = _act_layer("LocalResponseNorm", local_response_norm)
+
+
+class RReLU(Layer):
+    """Randomized leaky ReLU (ref: activation.py RReLU) — random slope
+    in [lower, upper] when training, mean slope in eval."""
+
+    def __init__(self, lower: float = 1 / 8., upper: float = 1 / 3.):
+        super().__init__()
+        self.lower, self.upper = lower, upper
+
+    def forward(self, x):
+        if self.training:
+            from ...core import rng
+            slope = jax.random.uniform(
+                rng.next_key(), x.shape, x.dtype, self.lower, self.upper)
+        else:
+            slope = (self.lower + self.upper) / 2
+        return jnp.where(x >= 0, x, slope * x)
+
+
+class Maxout(Layer):
+    def __init__(self, groups: int, axis: int = 1):
+        super().__init__()
+        self.groups, self.axis = groups, axis
+
+    def forward(self, x):
+        return maxout(x, self.groups, self.axis)
+
+
+class PixelShuffle(Layer):
+    def __init__(self, upscale_factor: int, data_format: str = "NCHW"):
+        super().__init__()
+        self.r = upscale_factor
+        self.data_format = data_format
+
+    def forward(self, x):
+        return pixel_shuffle(x, self.r, self.data_format)
+
+
+class PixelUnshuffle(Layer):
+    def __init__(self, downscale_factor: int, data_format: str = "NCHW"):
+        super().__init__()
+        self.r = downscale_factor
+        self.data_format = data_format
+
+    def forward(self, x):
+        return pixel_unshuffle(x, self.r, self.data_format)
+
+
+class Unfold(Layer):
+    def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1):
+        super().__init__()
+        self.args = (kernel_sizes, strides, paddings, dilations)
+
+    def forward(self, x):
+        return F.unfold(x, *self.args)
+
+
+class Fold(Layer):
+    def __init__(self, output_sizes, kernel_sizes, strides=1,
+                 paddings=0, dilations=1):
+        super().__init__()
+        self.output_sizes = output_sizes
+        self.args = (kernel_sizes, strides, paddings, dilations)
+
+    def forward(self, x):
+        return fold(x, self.output_sizes, *self.args)
+
+
+class Pad1D(Pad2D):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCL"):
+        super().__init__(padding, mode, value, data_format)
+
+
+class Pad3D(Pad2D):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCDHW"):
+        super().__init__(padding, mode, value, data_format)
+
+
+class ZeroPad2D(Pad2D):
+    def __init__(self, padding, data_format="NCHW"):
+        super().__init__(padding, "constant", 0.0, data_format)
+
+
+class CosineSimilarity(Layer):
+    def __init__(self, axis: int = 1, eps: float = 1e-8):
+        super().__init__()
+        self.axis, self.eps = axis, eps
+
+    def forward(self, x1, x2):
+        num = (x1 * x2).sum(axis=self.axis)
+        den = jnp.linalg.norm(x1, axis=self.axis) * \
+            jnp.linalg.norm(x2, axis=self.axis)
+        return num / jnp.maximum(den, self.eps)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p: float = 2.0, epsilon: float = 1e-6,
+                 keepdim: bool = False):
+        super().__init__()
+        self.p, self.epsilon, self.keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        return pairwise_distance(x, y, self.p, self.epsilon,
+                                 self.keepdim)
+
+
+class Bilinear(Layer):
+    """out[k] = x1 W_k x2 + b (ref: common.py Bilinear)."""
+
+    def __init__(self, in1_features: int, in2_features: int,
+                 out_features: int, weight_attr=None, bias_attr=None):
+        super().__init__()
+        init = weight_attr if callable(weight_attr) else \
+            I.XavierUniform()
+        self.weight = self.create_parameter(
+            [out_features, in1_features, in2_features], initializer=init)
+        self.bias = None if bias_attr is False else \
+            self.create_parameter([out_features],
+                                  initializer=I.Constant(0.0))
+
+    def forward(self, x1, x2):
+        out = jnp.einsum("bi,oij,bj->bo", x1, self.weight, x2)
+        if self.bias is not None:
+            out = out + self.bias
+        return out
+
+
+class AlphaDropout(Layer):
+    def __init__(self, p: float = 0.5):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return alpha_dropout(x, self.p, training=self.training)
+
+
+class UpsamplingBilinear2D(Upsample):
+    def __init__(self, size=None, scale_factor=None,
+                 data_format="NCHW"):
+        super().__init__(size, scale_factor, mode="bilinear",
+                         align_corners=True, data_format=data_format)
+
+
+class UpsamplingNearest2D(Upsample):
+    def __init__(self, size=None, scale_factor=None,
+                 data_format="NCHW"):
+        super().__init__(size, scale_factor, mode="nearest",
+                         data_format=data_format)

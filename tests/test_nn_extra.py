@@ -1,0 +1,178 @@
+"""Long-tail nn layers (ref: test_activation_op.py, test_pixel_shuffle.py,
+test_fold_op.py, test_bilinear_api.py, test_pool3d_op.py families)."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+
+
+def _x(*s, seed=0):
+    return jnp.asarray(np.random.RandomState(seed).randn(*s), jnp.float32)
+
+
+def test_pixel_shuffle_roundtrip():
+    x = _x(2, 8, 4, 4)
+    up = nn.PixelShuffle(2)(x)
+    assert up.shape == (2, 2, 8, 8)
+    back = nn.PixelUnshuffle(2)(up)
+    np.testing.assert_allclose(np.asarray(back), np.asarray(x))
+
+
+def test_pixel_shuffle_matches_torch():
+    import torch
+    x = np.random.RandomState(1).randn(1, 4, 3, 3).astype(np.float32)
+    ours = np.asarray(nn.PixelShuffle(2)(jnp.asarray(x)))
+    ref = torch.nn.functional.pixel_shuffle(torch.from_numpy(x), 2).numpy()
+    np.testing.assert_allclose(ours, ref)
+
+
+def test_fold_inverts_unfold_nonoverlapping():
+    x = _x(2, 3, 8, 8)
+    cols = F.unfold(x, 2, strides=2)
+    back = nn.Fold((8, 8), 2, strides=2)(cols)
+    np.testing.assert_allclose(np.asarray(back), np.asarray(x),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_fold_sums_overlaps_like_torch():
+    import torch
+    x = np.random.RandomState(2).randn(1, 2 * 9, 9).astype(np.float32)
+    ours = np.asarray(nn.Fold((5, 5), 3, strides=1)(jnp.asarray(x)))
+    ref = torch.nn.functional.fold(torch.from_numpy(x), (5, 5), 3).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_bilinear_layer():
+    pt.seed(0)
+    b = nn.Bilinear(4, 5, 3)
+    x1, x2 = _x(6, 4, seed=3), _x(6, 5, seed=4)
+    out = b(x1, x2)
+    assert out.shape == (6, 3)
+    w = np.asarray(b.weight)
+    ref = np.einsum("bi,oij,bj->bo", np.asarray(x1), w,
+                    np.asarray(x2)) + np.asarray(b.bias)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_distance_layers():
+    x, y = _x(4, 8, seed=5), _x(4, 8, seed=6)
+    cs = nn.CosineSimilarity(axis=1)(x, y)
+    ref = (np.asarray(x) * np.asarray(y)).sum(1) / (
+        np.linalg.norm(x, axis=1) * np.linalg.norm(y, axis=1))
+    np.testing.assert_allclose(np.asarray(cs), ref, rtol=1e-5,
+                               atol=1e-6)
+    pd = nn.PairwiseDistance()(x, y)
+    np.testing.assert_allclose(
+        np.asarray(pd), np.linalg.norm(np.asarray(x) - np.asarray(y)
+                                       + 1e-6, axis=-1), rtol=1e-5)
+
+
+def test_maxout_and_celu():
+    x = _x(2, 6, 4, 4, seed=7)
+    out = nn.Maxout(3)(x)
+    assert out.shape == (2, 2, 4, 4)
+    import torch
+    tx = torch.from_numpy(np.asarray(x))
+    np.testing.assert_allclose(
+        np.asarray(nn.CELU()(x)),
+        torch.nn.functional.celu(tx).numpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_rrelu_modes():
+    pt.seed(0)
+    l = nn.RReLU(0.1, 0.3)
+    x = -jnp.ones((64,))
+    l.eval()
+    np.testing.assert_allclose(np.asarray(l(x)), -0.2, rtol=1e-6)
+    l.train()
+    out = np.asarray(l(x))
+    assert (out <= -0.1 + 1e-6).all() and (out >= -0.3 - 1e-6).all()
+    assert np.unique(out).size > 1
+
+
+def test_pads_and_upsample():
+    x = _x(1, 2, 4, 4, seed=8)
+    padded = nn.ZeroPad2D([1, 1, 2, 2])(x)
+    assert padded.shape == (1, 2, 8, 6)
+    up = nn.UpsamplingBilinear2D(scale_factor=2)(x)
+    assert up.shape == (1, 2, 8, 8)
+    near = nn.Upsample(scale_factor=2)(x)
+    assert near.shape == (1, 2, 8, 8)
+
+
+def test_local_response_norm_matches_torch():
+    import torch
+    x = np.abs(np.random.RandomState(9).randn(2, 8, 4, 4)
+               ).astype(np.float32)
+    ours = np.asarray(nn.LocalResponseNorm(size=5)(jnp.asarray(x)))
+    ref = torch.nn.functional.local_response_norm(
+        torch.from_numpy(x), 5).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_pool3d_and_adaptive():
+    x = _x(1, 2, 4, 8, 8, seed=10)
+    out = nn.MaxPool3D(2)(x)
+    assert out.shape == (1, 2, 2, 4, 4)
+    out = nn.AvgPool3D(2)(x)
+    assert out.shape == (1, 2, 2, 4, 4)
+    out = nn.AdaptiveAvgPool3D(2)(x)
+    assert out.shape == (1, 2, 2, 2, 2)
+    x1 = _x(2, 3, 12, seed=11)
+    assert nn.AdaptiveAvgPool1D(4)(x1).shape == (2, 3, 4)
+    assert nn.AdaptiveMaxPool1D(3)(x1).shape == (2, 3, 3)
+    # adaptive mean == reshape-mean reference
+    np.testing.assert_allclose(
+        np.asarray(nn.AdaptiveAvgPool1D(4)(x1)),
+        np.asarray(x1).reshape(2, 3, 4, 3).mean(-1), rtol=1e-6)
+
+
+def test_alpha_dropout_preserves_moments():
+    pt.seed(0)
+    l = nn.AlphaDropout(0.3)
+    l.train()
+    x = jnp.asarray(np.random.RandomState(12).randn(20000),
+                    jnp.float32)
+    out = np.asarray(l(x))
+    assert abs(out.mean()) < 0.05
+    assert abs(out.std() - 1.0) < 0.1
+    l.eval()
+    np.testing.assert_allclose(np.asarray(l(x)), np.asarray(x))
+
+
+def test_fold_with_dilation_matches_torch():
+    import torch
+    x = np.random.RandomState(13).randn(1, 2 * 4, 9).astype(np.float32)
+    ours = np.asarray(nn.Fold((7, 7), 2, strides=2,
+                              dilations=2)(jnp.asarray(x)))
+    ref = torch.nn.functional.fold(torch.from_numpy(x), (7, 7), 2,
+                                   dilation=2, stride=2).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_pad_channels_last():
+    x = jnp.ones((1, 4, 4, 2))
+    out = F.pad(x, [1, 1, 2, 2], data_format="NHWC")
+    assert out.shape == (1, 8, 6, 2)  # H+4, W+2, C untouched
+    out = nn.Pad2D([1, 1, 2, 2], data_format="NHWC")(x)
+    assert out.shape == (1, 8, 6, 2)
+
+
+def test_activation_positional_args():
+    assert float(nn.CELU(0.2)(jnp.asarray(-1.0))) == pytest.approx(
+        0.2 * np.expm1(-1.0 / 0.2), rel=1e-5)
+    assert float(nn.Hardtanh(-2.0, 2.0)(jnp.asarray(3.0))) == 2.0
+
+
+def test_maxout_axis_minus_one():
+    from paddle_tpu.nn.layers.extra import maxout
+    x = _x(2, 4, 4, 6, seed=14)
+    out = maxout(x, 3, axis=-1)
+    assert out.shape == (2, 4, 4, 2)
+    ref = np.asarray(x).reshape(2, 4, 4, 2, 3).max(-1)
+    np.testing.assert_allclose(np.asarray(out), ref)
