@@ -17,4 +17,5 @@ from ..parallel import (AXIS_ORDER, DataParallel, DeviceMesh,  # noqa
                         shard_params)
 from . import launch  # noqa
 from . import elastic  # noqa
+from . import fleet  # noqa
 from .elastic import ElasticManager, ElasticStatus, Heartbeat  # noqa

@@ -1,0 +1,161 @@
+"""paddle_tpu.distributed.fleet — the fleet facade.
+
+Reference being replaced: ``paddle.distributed.fleet``
+(python/paddle/distributed/fleet/__init__.py re-exporting
+fleet/base/fleet_base.py:110 ``Fleet`` — ``init`` :211,
+``distributed_optimizer`` :947, ``distributed_model`` :1000, worker/
+server role queries, PS worker lifecycle) over role makers
+(base/role_maker.py PaddleCloudRoleMaker) and etcd/gloo rendezvous.
+
+TPU-native mapping: ``init(is_collective=True)`` is
+``parallel.init_parallel_env`` (the coordination service replaces gloo
+rendezvous and role makers — PJRT discovers the topology, so a role
+maker only carries indices). ``distributed_model`` attaches mesh
+shardings (hapi Model) or wraps a Layer in DataParallel — the same two
+shapes the reference handles. ``distributed_optimizer`` records the
+strategy; the graph rewrites it configures in the reference (AMP pass,
+recompute pass, gradient merge) are jit-trace behaviors here, applied
+by the trainer from the strategy object. Parameter-server lifecycle
+calls (init_worker/init_server/run_server) raise with guidance — the
+CTR/sparse path is SparseEmbedding + dp sharding (SURVEY §7 step 8),
+not a parameter server.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+
+from ..parallel import (DataParallel, DistributedStrategy,
+                        distributed_model as _distributed_model,
+                        get_mesh, init_parallel_env)
+from ..parallel.strategy import DistributedStrategy as _Strategy
+
+_state: dict = {"initialized": False, "strategy": None,
+                "is_collective": False}
+
+
+class UserDefinedRoleMaker:
+    """ref: base/role_maker.py UserDefinedRoleMaker — carries explicit
+    rank/world-size (PJRT still owns device topology)."""
+
+    def __init__(self, current_id: int = 0, worker_num: int = 1,
+                 role: Any = "worker", **kw):
+        self.current_id = current_id
+        self.worker_num_ = worker_num
+
+
+class PaddleCloudRoleMaker:
+    """ref: base/role_maker.py PaddleCloudRoleMaker — reads the launcher
+    environment (PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM)."""
+
+    def __init__(self, is_collective: bool = False, **kw):
+        import os
+        self.current_id = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+        self.worker_num_ = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+        self.is_collective = is_collective
+
+
+def init(role_maker=None, is_collective: bool = False,
+         strategy: Optional[DistributedStrategy] = None) -> None:
+    """ref: fleet_base.py:211 Fleet.init."""
+    if is_collective or role_maker is None:
+        init_parallel_env()
+    _state.update(initialized=True, strategy=strategy,
+                  is_collective=is_collective,
+                  role_maker=role_maker or PaddleCloudRoleMaker(
+                      is_collective=is_collective))
+
+
+def _require_init():
+    if not _state["initialized"]:
+        raise RuntimeError("call fleet.init() first "
+                           "(ref: fleet_base.py raises the same)")
+
+
+def is_first_worker() -> bool:
+    return worker_index() == 0
+
+
+def worker_index() -> int:
+    rm = _state.get("role_maker")
+    if isinstance(rm, UserDefinedRoleMaker):
+        return rm.current_id  # explicit user-managed launch
+    return jax.process_index()
+
+
+def worker_num() -> int:
+    rm = _state.get("role_maker")
+    if isinstance(rm, UserDefinedRoleMaker):
+        return rm.worker_num_
+    return jax.process_count()
+
+
+def is_worker() -> bool:
+    return True  # collective mode: every process trains
+
+
+def barrier_worker() -> None:
+    from ..parallel import barrier
+    barrier()
+
+
+def distributed_optimizer(optimizer,
+                          strategy: Optional[DistributedStrategy] = None):
+    """ref: fleet_base.py:947. Records the strategy; trainer-side
+    behaviors (amp/recompute/gradient-merge) read it from here or from
+    Model.prepare. The optimizer itself is returned unwrapped — under
+    SPMD the gradient all-reduce is compiled into the step, there is no
+    optimizer-level hook to install."""
+    _require_init()
+    if strategy is not None:
+        _state["strategy"] = strategy
+    optimizer._fleet_strategy = _state["strategy"]
+    return optimizer
+
+
+def distributed_model(model):
+    """ref: fleet_base.py:1000 — hapi Model gets mesh shardings, a raw
+    Layer gets the DataParallel wrapper (the reference's two shapes)."""
+    _require_init()
+    from ..hapi.model import Model as HapiModel
+    from ..nn.layer import Layer
+    if isinstance(model, HapiModel):
+        return _distributed_model(model, strategy=_state["strategy"])
+    if isinstance(model, Layer):
+        from ..parallel import init_mesh
+        if get_mesh(required=False) is None:
+            axes = (_state["strategy"].mesh_axes()
+                    if _state["strategy"] else None) or {"dp": -1}
+            init_mesh(**axes)
+        return DataParallel(model)
+    raise TypeError(f"cannot distribute {type(model).__name__}")
+
+
+def get_strategy() -> Optional[DistributedStrategy]:
+    return _state["strategy"]
+
+
+# -- parameter-server lifecycle (deliberately unsupported) ------------------
+
+_PS_MSG = ("the parameter-server runtime is replaced by sharded "
+           "SparseEmbedding tables over the mesh (nn.SparseEmbedding; "
+           "SURVEY §7 step 8) — run collective mode: "
+           "fleet.init(is_collective=True)")
+
+
+def init_worker(*a, **kw):
+    raise NotImplementedError(_PS_MSG)
+
+
+def init_server(*a, **kw):
+    raise NotImplementedError(_PS_MSG)
+
+
+def run_server(*a, **kw):
+    raise NotImplementedError(_PS_MSG)
+
+
+def stop_worker(*a, **kw):
+    raise NotImplementedError(_PS_MSG)

@@ -120,3 +120,152 @@ class UCIHousing:
 
     def __getitem__(self, i):
         return self.x[i], self.y[i]
+
+
+def _build_word_idx(counts, min_freq: int, extra=("<unk>",)):
+    """Frequency-cutoff vocab, deterministic order (-count, word); the
+    literal special tokens are stripped from the corpus counts first so
+    their appended ids stay in range (the reference deletes '<unk>'
+    from word_freq the same way, text/datasets/imikolov.py)."""
+    for tok in ("<unk>", "<s>", "<e>"):
+        counts.pop(tok, None)
+    vocab = [w for w, c in sorted(counts.items(),
+                                  key=lambda t: (-t[1], t[0]))
+             if c >= min_freq]
+    word_idx = {w: i for i, w in enumerate(vocab)}
+    for tok in extra:
+        word_idx[tok] = len(word_idx)
+    return word_idx
+
+
+class Imikolov:
+    """PTB-style n-gram language-model dataset (ref: text/datasets/
+    imikolov.py — builds a word dict from train, yields n-grams).
+    Reads the standard ptb.{train,valid}.txt files locally."""
+
+    def __init__(self, root: str, data_type: str = "NGRAM", window_size:
+                 int = 5, mode: str = "train", min_word_freq: int = 50):
+        import collections
+        import os
+        train_p = os.path.join(root, "ptb.train.txt")
+        path = os.path.join(
+            root, "ptb.train.txt" if mode == "train" else
+            "ptb.valid.txt")
+        for p in {train_p, path}:
+            if not os.path.exists(p):
+                raise FileNotFoundError(
+                    f"{p} not found; zero-egress environment needs the "
+                    "ptb text files on disk")
+        counts = collections.Counter()
+        with open(train_p) as f:
+            for line in f:
+                counts.update(line.split())
+        # sentinels live in the dict like the reference's word dict
+        self.word_idx = _build_word_idx(
+            counts, min_word_freq, extra=("<s>", "<e>", "<unk>"))
+        unk = self.word_idx["<unk>"]
+        self.data = []
+        with open(path) as f:
+            for line in f:
+                ids = [self.word_idx.get(w, unk) for w in
+                       ["<s>"] + line.split() + ["<e>"]]
+                if data_type == "NGRAM":
+                    for i in range(len(ids) - window_size + 1):
+                        self.data.append(
+                            np.asarray(ids[i:i + window_size],
+                                       np.int64))
+                else:  # SEQ
+                    if len(ids) >= 2:
+                        self.data.append(np.asarray(ids, np.int64))
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, i):
+        return self.data[i]
+
+
+class Imdb:
+    """IMDB sentiment (ref: text/datasets/imdb.py — aclImdb directory
+    tree pos/neg of .txt reviews; builds a word dict, yields
+    (ids, label))."""
+
+    def __init__(self, root: str, mode: str = "train", cutoff: int = 150):
+        import collections
+        import os
+        import re
+        base = os.path.join(root, "aclImdb")
+        if not os.path.isdir(base):
+            raise FileNotFoundError(
+                f"{base} not found; zero-egress environment needs the "
+                "extracted aclImdb tree on disk")
+        tok = re.compile(r"[A-Za-z']+").findall
+
+        def read(split, label):
+            out = []
+            d = os.path.join(base, split, label)
+            for fn in sorted(os.listdir(d)):
+                with open(os.path.join(d, fn), errors="ignore") as f:
+                    out.append([w.lower() for w in tok(f.read())])
+            return out
+
+        train_pos = read("train", "pos")
+        train_neg = read("train", "neg")
+        counts = collections.Counter(
+            w for doc in train_pos + train_neg for w in doc)
+        self.word_idx = _build_word_idx(counts, cutoff)
+        unk = self.word_idx["<unk>"]
+        if mode == "train":  # vocab pass already read these files
+            pos, neg = train_pos, train_neg
+        else:
+            pos, neg = read(mode, "pos"), read(mode, "neg")
+        self.docs = [np.asarray([self.word_idx.get(w, unk) for w in d],
+                                np.int64) for d in pos + neg]
+        self.labels = np.asarray([0] * len(pos) + [1] * len(neg),
+                                 np.int64)
+
+    def __len__(self):
+        return len(self.docs)
+
+    def __getitem__(self, i):
+        return self.docs[i], self.labels[i]
+
+
+class Movielens:
+    """MovieLens-1M ratings (ref: text/datasets/movielens.py — ::
+    -separated users.dat/movies.dat/ratings.dat)."""
+
+    def __init__(self, root: str, mode: str = "train",
+                 test_ratio: float = 0.1, rand_seed: int = 0):
+        import os
+        base = root
+        sub = os.path.join(root, "ml-1m")
+        if os.path.isdir(sub):
+            base = sub
+        paths = {n: os.path.join(base, f"{n}.dat")
+                 for n in ("users", "movies", "ratings")}
+        for p in paths.values():
+            if not os.path.exists(p):
+                raise FileNotFoundError(
+                    f"{p} not found; zero-egress environment needs the "
+                    "ml-1m .dat files on disk")
+
+        def rows(p):
+            with open(p, errors="ignore") as f:
+                return [ln.rstrip("\n").split("::") for ln in f if ln.strip()]
+
+        self.users = {int(r[0]): r[1:] for r in rows(paths["users"])}
+        self.movies = {int(r[0]): r[1:] for r in rows(paths["movies"])}
+        ratings = rows(paths["ratings"])
+        rng_ = np.random.RandomState(rand_seed)
+        mask = rng_.rand(len(ratings)) < test_ratio
+        keep = mask if mode == "test" else ~mask
+        self.data = [(int(u), int(m), float(s))
+                     for (u, m, s, _), k in zip(ratings, keep) if k]
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, i):
+        u, m, s = self.data[i]
+        return (np.int64(u), np.int64(m), np.float32(s))

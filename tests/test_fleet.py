@@ -1,0 +1,68 @@
+"""fleet facade (ref: unittests test_fleet_base.py — init/worker
+queries/distributed_model shapes)."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu import nn, parallel
+from paddle_tpu.distributed import fleet
+
+
+def test_init_and_worker_queries():
+    fleet.init(is_collective=True)
+    assert fleet.worker_num() >= 1
+    assert fleet.worker_index() == 0
+    assert fleet.is_first_worker()
+    assert fleet.is_worker()
+
+
+def test_distributed_model_layer_and_hapi():
+    fleet.init(is_collective=True)
+    try:
+        net = nn.Linear(4, 2)
+        wrapped = fleet.distributed_model(net)
+        assert isinstance(wrapped, parallel.DataParallel)
+        out = wrapped(jnp.ones((8, 4)))
+        assert out.shape == (8, 2)
+
+        pt.seed(0)
+        net2 = nn.Linear(4, 2)
+        model = pt.Model(net2)
+        model.prepare(
+            optimizer=pt.optimizer.SGD(learning_rate=0.1,
+                                       parameters=net2),
+            loss=nn.MSELoss())
+        got = fleet.distributed_model(model)
+        assert got is model and model._mesh is not None
+        logs = model.train_batch([np.ones((8, 4), np.float32)],
+                                 [np.zeros((8, 2), np.float32)])
+        assert np.isfinite(logs["loss"])
+    finally:
+        parallel.set_mesh(None)
+
+
+def test_distributed_optimizer_records_strategy():
+    strat = parallel.DistributedStrategy()
+    fleet.init(is_collective=True, strategy=strat)
+    net = nn.Linear(2, 2)
+    opt = fleet.distributed_optimizer(
+        pt.optimizer.SGD(learning_rate=0.1, parameters=net))
+    assert opt._fleet_strategy is strat
+    assert fleet.get_strategy() is strat
+
+
+def test_ps_lifecycle_guides_to_collective():
+    fleet.init(is_collective=True)
+    with pytest.raises(NotImplementedError, match="SparseEmbedding"):
+        fleet.init_worker()
+    with pytest.raises(NotImplementedError, match="collective"):
+        fleet.run_server()
+
+
+def test_role_makers():
+    rm = fleet.PaddleCloudRoleMaker(is_collective=True)
+    assert rm.current_id == 0 and rm.worker_num_ >= 1
+    rm2 = fleet.UserDefinedRoleMaker(current_id=1, worker_num=4)
+    assert rm2.current_id == 1 and rm2.worker_num_ == 4

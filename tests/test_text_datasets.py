@@ -1,0 +1,107 @@
+"""Text datasets against synthetic standard-format files
+(ref: unittests test_datasets.py imdb/imikolov/movielens cases)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu import text
+
+
+def test_imikolov_ngram(tmp_path):
+    (tmp_path / "ptb.train.txt").write_text(
+        "the cat sat on the mat\nthe dog sat on the rug\n" * 30)
+    (tmp_path / "ptb.valid.txt").write_text("the cat sat on the mat\n")
+    ds = text.Imikolov(str(tmp_path), window_size=3, mode="train",
+                       min_word_freq=10)
+    assert len(ds) > 0
+    gram = ds[0]
+    assert gram.shape == (3,) and gram.dtype == np.int64
+    assert "the" in ds.word_idx and "<unk>" in ds.word_idx
+    # valid split shares the train vocab
+    dv = text.Imikolov(str(tmp_path), window_size=3, mode="valid",
+                       min_word_freq=10)
+    assert dv.word_idx == ds.word_idx
+
+
+def test_imdb_reader(tmp_path):
+    for split in ("train", "test"):
+        for label in ("pos", "neg"):
+            d = tmp_path / "aclImdb" / split / label
+            os.makedirs(d)
+            for i in range(3):
+                (d / f"{i}.txt").write_text(
+                    ("great movie loved it " if label == "pos" else
+                     "terrible movie hated it ") * 5)
+    ds = text.Imdb(str(tmp_path), mode="train", cutoff=1)
+    assert len(ds) == 6
+    ids, label = ds[0]
+    assert ids.dtype == np.int64 and label in (0, 1)
+    # pos docs come first with label 0 (reference convention)
+    assert ds.labels[:3].tolist() == [0, 0, 0]
+    assert "movie" in ds.word_idx
+
+
+def test_movielens_reader(tmp_path):
+    d = tmp_path / "ml-1m"
+    d.mkdir()
+    (d / "users.dat").write_text("1::F::1::10::48067\n2::M::56::16::70072\n")
+    (d / "movies.dat").write_text("1::Toy Story (1995)::Animation\n"
+                                  "2::Jumanji (1995)::Adventure\n")
+    (d / "ratings.dat").write_text(
+        "\n".join(f"{u}::{m}::{3 + (u + m) % 3}::97830{u}{m}"
+                  for u in (1, 2) for m in (1, 2)) + "\n")
+    tr = text.Movielens(str(tmp_path), mode="train", test_ratio=0.5,
+                        rand_seed=0)
+    te = text.Movielens(str(tmp_path), mode="test", test_ratio=0.5,
+                        rand_seed=0)
+    assert len(tr) + len(te) == 4
+    u, m, s = tr[0]
+    assert u.dtype == np.int64 and s.dtype == np.float32
+    assert 1.0 <= float(s) <= 5.0
+
+
+def test_ucihousing(tmp_path):
+    rows = np.random.RandomState(0).rand(20, 14)
+    np.savetxt(tmp_path / "housing.data", rows)
+    tr = text.UCIHousing(str(tmp_path), mode="train")
+    te = text.UCIHousing(str(tmp_path), mode="test")
+    assert len(tr) == 16 and len(te) == 4
+    x, y = tr[0]
+    assert x.shape == (13,) and y.shape == (1,)
+
+
+def test_missing_files_raise(tmp_path):
+    with pytest.raises(FileNotFoundError, match="zero-egress"):
+        text.Imdb(str(tmp_path))
+    with pytest.raises(FileNotFoundError, match="zero-egress"):
+        text.Movielens(str(tmp_path))
+
+
+def test_imikolov_sentinels_and_unk_in_range(tmp_path):
+    # literal <unk> in the corpus must not push ids out of range
+    (tmp_path / "ptb.train.txt").write_text(
+        "the cat <unk> on the mat\n" * 40)
+    (tmp_path / "ptb.valid.txt").write_text("the cat sat\n")
+    ds = text.Imikolov(str(tmp_path), window_size=3, mode="train",
+                       min_word_freq=10)
+    V = len(ds.word_idx)
+    for g in ds.data:
+        assert (g < V).all() and (g >= 0).all()
+    # sentinels are real vocab entries and appear in the grams
+    s, e = ds.word_idx["<s>"], ds.word_idx["<e>"]
+    flat = np.concatenate(ds.data)
+    assert s in flat and e in flat
+
+
+def test_user_role_maker_indices_consulted():
+    from paddle_tpu.distributed import fleet
+    fleet.init(role_maker=fleet.UserDefinedRoleMaker(
+        current_id=1, worker_num=4), is_collective=True)
+    try:
+        assert fleet.worker_index() == 1
+        assert fleet.worker_num() == 4
+        assert not fleet.is_first_worker()
+    finally:
+        fleet.init(is_collective=True)  # restore default role maker
