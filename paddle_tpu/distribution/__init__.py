@@ -389,3 +389,12 @@ def _kl_uniform_normal(p: Uniform, q: Normal):
             + jnp.log(q.scale) + 0.5 * math.log(2 * math.pi)
             + (e_x2 - 2 * q.loc * mean + q.loc ** 2)
             / (2 * q.scale ** 2))
+
+
+from .transform import (AbsTransform, AffineTransform,  # noqa: E402
+                        ChainTransform, ExpTransform, Independent,
+                        IndependentTransform, PowerTransform,
+                        ReshapeTransform, SigmoidTransform,
+                        SoftmaxTransform, StackTransform,
+                        StickBreakingTransform, TanhTransform,
+                        Transform, TransformedDistribution)

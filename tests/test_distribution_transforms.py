@@ -1,0 +1,154 @@
+"""Distribution transforms (ref: unittests/distribution/test_transform*.py
+— forward/inverse roundtrips + log-det checked against autodiff)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu import distribution as D
+
+
+def _x(*s, seed=0, lo=-2.0, hi=2.0):
+    return jnp.asarray(np.random.RandomState(seed).uniform(lo, hi, s),
+                       jnp.float32)
+
+
+@pytest.mark.parametrize("t,dom", [
+    (D.AffineTransform(1.5, 2.0), (-2, 2)),
+    (D.ExpTransform(), (-2, 2)),
+    (D.PowerTransform(3.0), (0.1, 2)),
+    (D.SigmoidTransform(), (-3, 3)),
+    (D.TanhTransform(), (-2, 2)),
+])
+def test_roundtrip_and_logdet_vs_autodiff(t, dom):
+    x = _x(7, seed=1, lo=dom[0], hi=dom[1])
+    y = t.forward(x)
+    np.testing.assert_allclose(np.asarray(t.inverse(y)), np.asarray(x),
+                               rtol=1e-4, atol=1e-5)
+    # analytic log|J| == log|d forward/dx| from autodiff, elementwise
+    grads = jax.vmap(jax.grad(lambda v: t.forward(v).sum()))(x)
+    np.testing.assert_allclose(np.asarray(t.forward_log_det_jacobian(x)),
+                               np.log(np.abs(np.asarray(grads))),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_chain_compose():
+    chain = D.ChainTransform([D.AffineTransform(0.0, 2.0),
+                              D.ExpTransform()])
+    x = _x(5, seed=2)
+    y = chain.forward(x)
+    np.testing.assert_allclose(np.asarray(y), np.exp(2 * np.asarray(x)),
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(chain.inverse(y)),
+                               np.asarray(x), rtol=1e-4, atol=1e-5)
+    ldj = chain.forward_log_det_jacobian(x)
+    grads = jax.vmap(jax.grad(lambda v: chain.forward(v).sum()))(x)
+    np.testing.assert_allclose(np.asarray(ldj),
+                               np.log(np.abs(np.asarray(grads))),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_stick_breaking_simplex():
+    t = D.StickBreakingTransform()
+    x = _x(4, 3, seed=3)
+    y = t.forward(x)
+    assert y.shape == (4, 4)
+    np.testing.assert_allclose(np.asarray(y.sum(-1)), 1.0, rtol=1e-5)
+    assert (np.asarray(y) > 0).all()
+    np.testing.assert_allclose(np.asarray(t.inverse(y)), np.asarray(x),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_reshape_and_stack():
+    t = D.ReshapeTransform((4,), (2, 2))
+    x = _x(3, 4, seed=4)
+    assert t.forward(x).shape == (3, 2, 2)
+    np.testing.assert_allclose(np.asarray(t.inverse(t.forward(x))),
+                               np.asarray(x))
+    st = D.StackTransform([D.ExpTransform(),
+                           D.AffineTransform(0.0, 2.0)], axis=1)
+    x2 = _x(3, 2, seed=5)
+    y2 = st.forward(x2)
+    np.testing.assert_allclose(np.asarray(y2[:, 0]),
+                               np.exp(np.asarray(x2[:, 0])), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(y2[:, 1]),
+                               2 * np.asarray(x2[:, 1]), rtol=1e-5)
+
+
+def test_transformed_distribution_lognormal():
+    """Normal pushed through Exp == LogNormal: log_prob matches the
+    closed form."""
+    base = D.Normal(loc=0.0, scale=1.0)
+    ln = D.TransformedDistribution(base, [D.ExpTransform()])
+    v = jnp.asarray([0.5, 1.0, 2.0])
+    got = np.asarray(ln.log_prob(v))
+    ref = -np.log(np.asarray(v)) - 0.5 * np.log(2 * np.pi) - \
+        0.5 * np.log(np.asarray(v)) ** 2
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+    s = ln.sample((1000,))
+    assert (np.asarray(s) > 0).all()
+
+
+def test_transform_call_on_distribution():
+    out = D.ExpTransform()(D.Normal(loc=0.0, scale=1.0))
+    assert isinstance(out, D.TransformedDistribution)
+
+
+def test_independent_sums_event_dims():
+    base = D.Normal(loc=jnp.zeros((3, 4)), scale=jnp.ones((3, 4)))
+    ind = D.Independent(base, 1)
+    v = _x(3, 4, seed=6)
+    lp = ind.log_prob(v)
+    assert lp.shape == (3,)
+    np.testing.assert_allclose(np.asarray(lp),
+                               np.asarray(base.log_prob(v).sum(-1)),
+                               rtol=1e-6)
+
+
+def test_chain_with_shape_changing_member():
+    """Reshape then Exp: jacobians reduce to the chain's batch dims."""
+    chain = D.ChainTransform([D.ReshapeTransform((4,), (2, 2)),
+                              D.ExpTransform()])
+    x = _x(3, 4, seed=7)
+    ldj = chain.forward_log_det_jacobian(x)
+    assert ldj.shape == (3,)
+    # exp's elementwise jacobian summed over the event: sum(x)
+    np.testing.assert_allclose(np.asarray(ldj),
+                               np.asarray(x).sum(-1), rtol=1e-5)
+    assert chain.forward_shape((3, 4)) == (3, 2, 2)
+    assert chain.inverse_shape((3, 2, 2)) == (3, 4)
+
+
+def test_transformed_distribution_event_base():
+    """Elementwise transform over an event-shaped base (Dirichlet):
+    ldj must reduce over the event dim."""
+    base = D.Dirichlet(jnp.ones(3))
+    td = D.TransformedDistribution(base, [D.AffineTransform(0.0, 2.0)])
+    v = jnp.asarray([0.4, 0.6, 1.0])  # = 2 * simplex point
+    lp = td.log_prob(v)
+    assert np.ndim(lp) == 0
+    ref = float(base.log_prob(v / 2)) - 3 * np.log(2.0)
+    np.testing.assert_allclose(float(lp), ref, rtol=1e-5)
+
+
+def test_transformed_distribution_shapes_stick_breaking():
+    base = D.Normal(loc=jnp.zeros(3), scale=jnp.ones(3))
+    td = D.TransformedDistribution(base, [D.StickBreakingTransform()])
+    assert td.event_shape == (4,) and td.batch_shape == ()
+    s = td.sample((5,))
+    assert s.shape == (5, 4)
+    np.testing.assert_allclose(np.asarray(s.sum(-1)), 1.0, rtol=1e-5)
+
+
+def test_independent_rank_validated():
+    base = D.Normal(loc=jnp.zeros(3), scale=jnp.ones(3))
+    with pytest.raises(ValueError, match="out of range"):
+        D.Independent(base, 2)
+
+
+def test_transform_shape_queries():
+    assert D.StickBreakingTransform().forward_shape((5, 3)) == (5, 4)
+    assert D.StickBreakingTransform().inverse_shape((5, 4)) == (5, 3)
+    assert D.ReshapeTransform((4,), (2, 2)).forward_shape((3, 4)) == \
+        (3, 2, 2)
