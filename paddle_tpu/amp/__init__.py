@@ -212,3 +212,6 @@ class GradScaler:
         self._state = {"scale": jnp.asarray(sd["scale"], jnp.float32),
                        "good": jnp.asarray(int(sd["good"]), jnp.int32),
                        "bad": jnp.asarray(int(sd["bad"]), jnp.int32)}
+
+
+from . import debugging  # noqa: E402  (TensorChecker / NaN-Inf tools)

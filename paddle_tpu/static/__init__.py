@@ -58,3 +58,7 @@ class Executor:
             list(feed)
         out = program(*inputs)
         return out if isinstance(out, (list, tuple)) else [out]
+
+
+# ref: paddle.static.sparsity re-exports the ASP API (static/sparsity)
+from ..incubate import asp as sparsity  # noqa: E402
