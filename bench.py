@@ -109,23 +109,29 @@ def bench_gpt(batch: int = 8, seq: int = 1024, warmup: int = 3,
               iters: int = 20, cpu_smoke: bool = False):
     import paddle_tpu as paddle
     from paddle_tpu.models.gpt import (GPTForCausalLM,
-                                       GPTPretrainingCriterion, gpt_config)
+                                       GPTFusedPretrainingCriterion,
+                                       gpt_config)
 
     paddle.seed(0)
+    # fused vocab path: loss streams over vocab chunks, [b,s,V] logits
+    # never hit HBM (ops/fused_xent.py; equality with the dense path is
+    # asserted in tests/test_fused_xent.py)
     if cpu_smoke:
         cfg = gpt_config("gpt2-small", num_layers=2, hidden_size=256,
                          num_heads=4, max_position_embeddings=seq,
-                         hidden_dropout=0.0, attention_dropout=0.0)
+                         hidden_dropout=0.0, attention_dropout=0.0,
+                         fused_loss=True)
         batch, iters = 2, 5
     else:
         cfg = gpt_config("gpt2-small", max_position_embeddings=seq,
-                         hidden_dropout=0.0, attention_dropout=0.0)
+                         hidden_dropout=0.0, attention_dropout=0.0,
+                         fused_loss=True)
     net = GPTForCausalLM(cfg)
     model = paddle.Model(net)
     model.prepare(
         optimizer=paddle.optimizer.AdamW(learning_rate=1e-4, parameters=net,
                                          weight_decay=0.01),
-        loss=GPTPretrainingCriterion(),
+        loss=GPTFusedPretrainingCriterion(),
         amp_configs="O1")
     n_params = param_count(net)
 

@@ -49,6 +49,10 @@ class GPTConfig:
     tie_word_embeddings: bool = True
     use_flash: bool = True
     remat: bool = False  # rematerialize each block (jax.checkpoint)
+    # fused vocab path: forward returns (hidden, tied weight) and
+    # GPTFusedPretrainingCriterion streams the loss over vocab chunks —
+    # the [b, s, vocab] logits never exist in the train graph (PERF.md)
+    fused_loss: bool = False
 
     def __post_init__(self):
         if self.ffn_hidden_size is None:
@@ -293,6 +297,15 @@ class GPTForCausalLM(Layer):
         if caches is not None:
             hidden, new_caches = out
             return self._logits(hidden), new_caches
+        if self.cfg.fused_loss and self.training:
+            # hand (hidden, W [vocab, hidden]) to the fused criterion;
+            # W rides the output so its gradient flows through
+            # value_and_grad. NOTE: metrics attached to Model.prepare
+            # see the hidden states during fused training — compute
+            # accuracy-style metrics in eval (logits path) instead.
+            if not self.cfg.tie_word_embeddings:
+                return out, self.lm_head.weight.T  # Linear stores [H,V]
+            return out, self.gpt.embeddings.word_embeddings.weight
         return self._logits(out)
 
     # -- decode-time KV cache -------------------------------------------
@@ -411,3 +424,33 @@ class GPTPretrainingCriterion(Layer):
         lg = logits[:, :-1].reshape(-1, logits.shape[-1])
         lb = labels[:, 1:].reshape(-1)
         return F.cross_entropy(lg, lb, ignore_index=self.ignore_index)
+
+
+class GPTFusedPretrainingCriterion(Layer):
+    """Streaming vocab-path loss for cfg.fused_loss=True models: takes
+    (hidden [b, s, h], weight [v, h]) from the model's forward and
+    computes shifted next-token cross entropy over vocab chunks —
+    no [b, s, v] logits in HBM (ops/fused_xent.py)."""
+
+    def __init__(self, ignore_index: int = -100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, *args):
+        if len(args) == 2:
+            # eval mode: the model emits dense logits — fall back to
+            # the standard shifted cross entropy so evaluate()/fit with
+            # eval_data works on fused_loss models
+            logits, labels = args
+            lg = logits[:, :-1].reshape(-1, logits.shape[-1])
+            lb = labels[:, 1:].reshape(-1)
+            return F.cross_entropy(lg, lb,
+                                   ignore_index=self.ignore_index)
+        hidden, weight, labels = args
+        from .. import amp
+        from ..ops.fused_xent import fused_linear_cross_entropy
+        hidden, weight = amp.white_cast(hidden, weight, op="matmul")
+        h = hidden[:, :-1].reshape(-1, hidden.shape[-1])
+        lb = labels[:, 1:].reshape(-1)
+        return fused_linear_cross_entropy(
+            h, weight, lb, self.ignore_index)
