@@ -178,3 +178,30 @@ def test_mixed_dtype_operands():
     gw = jax.grad(lambda b: fused_linear_cross_entropy(
         hid.astype(jnp.bfloat16), b, lb), argnums=0)(w)
     assert gw.dtype == w.dtype
+
+
+def test_fused_gpt_trains_on_sharded_mesh():
+    """fused_loss composes with dp x tp GSPMD sharding."""
+    from paddle_tpu import parallel
+    from paddle_tpu.models.gpt import (GPTConfig, GPTForCausalLM,
+                                       GPTFusedPretrainingCriterion)
+    mesh = parallel.init_mesh(dp=4, tp=2)
+    try:
+        pt.seed(0)
+        cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                        num_heads=2, max_position_embeddings=16,
+                        hidden_dropout=0.0, attention_dropout=0.0,
+                        use_flash=False, fused_loss=True)
+        net = GPTForCausalLM(cfg)
+        model = pt.Model(net)
+        model.prepare(
+            optimizer=pt.optimizer.AdamW(learning_rate=1e-3,
+                                         parameters=net),
+            loss=GPTFusedPretrainingCriterion())
+        parallel.distributed_model(model, mesh=mesh)
+        ids = np.random.RandomState(0).randint(0, 128, (8, 16))
+        losses = [float(model.train_batch([ids], [ids])["loss"])
+                  for _ in range(4)]
+        assert losses[-1] < losses[0]
+    finally:
+        parallel.set_mesh(None)
