@@ -410,6 +410,54 @@ class GPTForCausalLMPipe(Layer):
         return self._logits(x)
 
 
+class GPTGreedyDecoder(Layer):
+    """AOT-servable generation: the whole greedy decode loop — prefill,
+    KV cache, ``lax.scan`` over new tokens — compiles into ONE program,
+    exportable with ``jit.save`` and served by the native predictor.
+
+    The reference serves generation by re-entering AnalysisPredictor
+    once per token from host code (inference/api/analysis_predictor.h),
+    paying a host round-trip each step; here the loop lives on-device
+    and the artifact's signature is prompt ids → generated ids."""
+
+    def __init__(self, model: GPTForCausalLM, max_new_tokens: int):
+        super().__init__()
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1 (the prefill "
+                             "always argmaxes one token)")
+        self.model = model
+        self.max_new_tokens = max_new_tokens
+
+    def forward(self, input_ids):
+        self.eval()  # decoding is inference (mirrors generate())
+        cfg = self.model.cfg
+        b, s = input_ids.shape
+        max_len = s + self.max_new_tokens
+        # symbolic s (shape-polymorphic export) defers this to runtime
+        if isinstance(s, int) and max_len > cfg.max_position_embeddings:
+            raise ValueError(
+                f"prompt {s} + {self.max_new_tokens} new tokens exceeds "
+                f"max_position_embeddings {cfg.max_position_embeddings}")
+        caches = self.model.init_caches(b, max_len)
+        logits, caches = self.model(input_ids, caches=caches)
+        first = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+
+        def step(carry, i):
+            tok, caches = carry
+            pos = jnp.full((b, 1), s, jnp.int32) + i
+            lg, caches = self.model(tok[:, None], position_ids=pos,
+                                    caches=caches)
+            nxt = jnp.argmax(lg[:, -1], axis=-1).astype(jnp.int32)
+            return (nxt, caches), tok
+
+        (last, _), toks = jax.lax.scan(
+            step, (first, caches), jnp.arange(self.max_new_tokens - 1))
+        new = jnp.concatenate(
+            [jnp.moveaxis(toks, 0, 1), last[:, None]], axis=1)
+        return jnp.concatenate([input_ids.astype(jnp.int32), new],
+                               axis=1)
+
+
 class GPTPretrainingCriterion(Layer):
     """Shifted next-token cross entropy; the TP analog of the reference's
     ParallelCrossEntropy (mp_layers.py:251 / c_softmax_with_cross_entropy)

@@ -205,3 +205,29 @@ def test_fused_gpt_trains_on_sharded_mesh():
         assert losses[-1] < losses[0]
     finally:
         parallel.set_mesh(None)
+
+
+def test_greedy_decoder_exports_and_matches_generate(tmp_path):
+    """The whole decode loop compiles into one servable artifact."""
+    from paddle_tpu import jit
+    from paddle_tpu.models.gpt import (GPTConfig, GPTForCausalLM,
+                                       GPTGreedyDecoder)
+    pt.seed(0)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_heads=2, max_position_embeddings=32,
+                    hidden_dropout=0.0, attention_dropout=0.0,
+                    use_flash=False)
+    net = GPTForCausalLM(cfg)
+    net.eval()
+    ids = np.random.RandomState(0).randint(0, 64, (2, 8)).astype(
+        np.int64)
+    ref = np.asarray(net.generate(jnp.asarray(ids), max_new_tokens=6))
+
+    dec = GPTGreedyDecoder(net, max_new_tokens=6)
+    out = np.asarray(dec(jnp.asarray(ids)))
+    np.testing.assert_array_equal(out, ref)
+
+    path = str(tmp_path / "decoder")
+    jit.save(dec, path, input_spec=[jit.InputSpec([2, 8], "int64")])
+    loaded = jit.load(path)
+    np.testing.assert_array_equal(np.asarray(loaded(ids)), ref)
