@@ -18,4 +18,5 @@ from ..nn.layers.transformer import (  # noqa
     TransformerEncoderLayer as FusedTransformerEncoderLayer)
 
 from . import asp  # noqa  (n:m structured sparsity)
+from . import nn  # noqa  (fused-layer namespace)
 from . import autotune  # noqa  (kernel/layout/dataloader tuning facade)

@@ -1,0 +1,10 @@
+"""incubate.nn — fused-layer namespace (ref: python/paddle/incubate/nn/
+layer/fused_transformer.py). On TPU "fused" is the compiler's job: the
+classes alias the standard layers (whose attention dispatches to the
+Pallas flash kernel) and the functionals compose ops XLA fuses into
+single kernels — there is no separate fused-op registry to maintain."""
+
+from ...nn.layers.transformer import (  # noqa
+    MultiHeadAttention as FusedMultiHeadAttention,
+    TransformerEncoderLayer as FusedTransformerEncoderLayer)
+from . import functional  # noqa

@@ -41,3 +41,5 @@ from .layers.rnn import (GRU, LSTM, RNN, BiRNN, GRUCell, LSTMCell,  # noqa
 from .layers.transformer import (MultiHeadAttention, Transformer,  # noqa
                                  TransformerDecoder, TransformerDecoderLayer,
                                  TransformerEncoder, TransformerEncoderLayer)
+
+from . import utils  # noqa  (weight_norm/spectral_norm/vector packing)

@@ -96,6 +96,12 @@ class Layer:
         for store in ("_parameters", "_buffers", "_sublayers"):
             if store in d and name in d[store]:
                 return d[store][name]
+        # derived attributes (weight_norm / spectral_norm): recomputed
+        # from the live parameters on every access, so no stale value —
+        # and no leaked tracer after a jitted functional_call
+        derived = d.get("_derived")
+        if derived and name in derived:
+            return derived[name](self)
         raise AttributeError(
             f"{type(self).__name__!r} object has no attribute {name!r}")
 

@@ -1,0 +1,164 @@
+"""nn.utils — weight reparameterizations + parameter vector packing.
+
+Reference being replaced: python/paddle/nn/utils/weight_norm_hook.py
+(``weight_norm``/``remove_weight_norm`` — splits a weight into
+direction ``v`` and magnitude ``g``, recomputed in a forward pre-hook)
+and python/paddle/nn/utils/spectral_norm_hook.py (``spectral_norm`` —
+divides the weight by its largest singular value estimated with one
+power-iteration step per forward); transform_parameters.py
+``parameters_to_vector``/``vector_to_parameters``.
+
+TPU-native notes: the reparameterized weight is a DERIVED attribute —
+recomputed from the live v/g parameters on every access (Layer.
+__getattr__), so there is no cached value to go stale and no tracer to
+leak out of a jitted ``functional_call``; XLA CSEs the recomputation
+into the consumer matmul's prologue. The power-iteration vector ``u``
+is a persistent buffer advanced once per forward (pre-hook), threaded
+through ``functional_call`` like BN statistics, so spectral norm
+trains correctly under jit."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .layer import Layer, Parameter
+
+
+def _norm_except(v, dim: int):
+    dim = dim % v.ndim
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    return jnp.sqrt(jnp.square(v).sum(axis=axes, keepdims=True))
+
+
+def _register_derived(layer: Layer, name: str, fn) -> None:
+    derived = layer.__dict__.get("_derived")
+    if derived is None:
+        derived = {}
+        object.__setattr__(layer, "_derived", derived)
+    derived[name] = fn
+
+
+def weight_norm(layer: Layer, name: str = "weight", dim: int = 0
+                ) -> Layer:
+    """w = g * v / ||v||  (ref: weight_norm_hook.py weight_norm).
+    Registers ``{name}_v`` (direction) and ``{name}_g`` (magnitude);
+    ``{name}`` becomes a derived attribute recomputed from them on
+    every access."""
+    if name not in layer._parameters:
+        raise ValueError(f"{name!r} is not a parameter of the layer")
+    if f"{name}_v" in layer._parameters:
+        raise ValueError(f"weight_norm already applied to {name!r}")
+    w = layer._parameters[name]
+    dim = dim % w.ndim
+    meta = layer._param_meta.get(name)
+    trainable = getattr(meta, "trainable", True)
+    axes = getattr(meta, "axes", None)
+    g = _norm_except(w, dim)
+    del layer._parameters[name]
+    layer._param_meta.pop(name, None)
+    layer.add_parameter(f"{name}_v",
+                        Parameter(w, trainable=trainable, axes=axes))
+    layer.add_parameter(f"{name}_g", Parameter(g, trainable=trainable))
+
+    def _derive(l):
+        v = l._parameters[f"{name}_v"]
+        g_ = l._parameters[f"{name}_g"]
+        return g_ * v / jnp.maximum(_norm_except(v, dim), 1e-12)
+
+    _register_derived(layer, name, _derive)
+    layer._weight_norm_dims = getattr(layer, "_weight_norm_dims", {})
+    layer._weight_norm_dims[name] = dim
+    return layer
+
+
+def remove_weight_norm(layer: Layer, name: str = "weight") -> Layer:
+    """Fold g*v/||v|| back into a single parameter, preserving the
+    trainable flag and sharding axes
+    (ref: weight_norm_hook.py remove_weight_norm)."""
+    dims = getattr(layer, "_weight_norm_dims", {})
+    if name not in dims:
+        raise ValueError(f"weight_norm not applied to {name!r}")
+    dim = dims.pop(name)
+    v = layer._parameters.pop(f"{name}_v")
+    g = layer._parameters.pop(f"{name}_g")
+    meta = layer._param_meta.pop(f"{name}_v", None)
+    layer._param_meta.pop(f"{name}_g", None)
+    layer.__dict__.get("_derived", {}).pop(name, None)
+    layer.add_parameter(name, Parameter(
+        g * v / jnp.maximum(_norm_except(v, dim), 1e-12),
+        trainable=getattr(meta, "trainable", True),
+        axes=getattr(meta, "axes", None)))
+    return layer
+
+
+def spectral_norm(layer: Layer, name: str = "weight",
+                  n_power_iterations: int = 1, eps: float = 1e-12,
+                  dim: int = 0) -> Layer:
+    """w / sigma_max(w), sigma estimated by power iteration
+    (ref: spectral_norm_hook.py spectral_norm; SpectralNorm layer
+    paddle/nn/layer/norm.py). The iteration vector ``u`` is a
+    persistent buffer advanced once per forward; the normalized weight
+    itself is a derived attribute using the current estimate."""
+    if name not in layer._parameters:
+        raise ValueError(f"{name!r} is not a parameter of the layer")
+    if n_power_iterations < 1:
+        raise ValueError("n_power_iterations must be >= 1")
+    w = layer._parameters[name]
+    dim = dim % w.ndim
+    mat = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+    u0 = jax.random.normal(jax.random.key(0), (mat.shape[0],))
+    layer.register_buffer(f"{name}_u", u0 / jnp.linalg.norm(u0))
+    meta = layer._param_meta.pop(name, None)
+    orig = layer._parameters.pop(name)
+    layer.add_parameter(f"{name}_orig", Parameter(
+        orig, trainable=getattr(meta, "trainable", True),
+        axes=getattr(meta, "axes", None)))
+
+    def _mat(w_):
+        return jnp.moveaxis(w_, dim, 0).reshape(w_.shape[dim], -1)
+
+    def _advance(l, args):
+        m = _mat(l._parameters[f"{name}_orig"])
+        u = l._buffers[f"{name}_u"]
+        for _ in range(n_power_iterations):
+            v = m.T @ u
+            v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+            u = m @ v
+            u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+        l._buffers[f"{name}_u"] = jax.lax.stop_gradient(u)
+
+    def _derive(l):
+        w_ = l._parameters[f"{name}_orig"]
+        m = _mat(w_)
+        u = jax.lax.stop_gradient(l._buffers[f"{name}_u"])
+        v = m.T @ u
+        v = jax.lax.stop_gradient(
+            v / jnp.maximum(jnp.linalg.norm(v), eps))
+        sigma = u @ (m @ v)
+        return w_ / sigma
+
+    layer.register_forward_pre_hook(_advance)
+    _register_derived(layer, name, _derive)
+    return layer
+
+
+def parameters_to_vector(parameters) -> jax.Array:
+    """Flatten a parameter list into one vector
+    (ref: transform_parameters.py parameters_to_vector)."""
+    return jnp.concatenate([jnp.ravel(p) for p in parameters])
+
+
+def vector_to_parameters(vec, parameters):
+    """Split a vector back into arrays shaped like ``parameters``
+    (returned as a list — arrays are immutable here, unlike the
+    reference's in-place copy)."""
+    out = []
+    off = 0
+    for p in parameters:
+        n = int(jnp.size(p))
+        out.append(vec[off:off + n].reshape(jnp.shape(p)))
+        off += n
+    return out
