@@ -192,24 +192,24 @@ def bench_bert(batch: int = 64, seq: int = 128, warmup: int = 3,
                iters: int = 30, cpu_smoke: bool = False):
     import paddle_tpu as paddle
     from paddle_tpu.models.bert import (BertForPretraining,
-                                        BertPretrainingCriterion,
+                                        BertFusedPretrainingCriterion,
                                         bert_config)
 
     paddle.seed(0)
     if cpu_smoke:
         cfg = bert_config("bert-base", num_layers=2, hidden_size=128,
                           num_heads=2, hidden_dropout=0.0,
-                          attention_dropout=0.0)
+                          attention_dropout=0.0, fused_loss=True)
         batch, iters = 2, 3
     else:
         cfg = bert_config("bert-base", hidden_dropout=0.0,
-                          attention_dropout=0.0)
+                          attention_dropout=0.0, fused_loss=True)
     net = BertForPretraining(cfg)
     model = paddle.Model(net)
     model.prepare(
         optimizer=paddle.optimizer.AdamW(learning_rate=1e-4, parameters=net,
                                          weight_decay=0.01),
-        loss=BertPretrainingCriterion(),
+        loss=BertFusedPretrainingCriterion(),
         amp_configs="O1")
     n_params = param_count(net)
     rng = np.random.RandomState(0)

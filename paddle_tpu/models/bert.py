@@ -41,6 +41,10 @@ class BertConfig:
     layer_norm_epsilon: float = 1e-12
     pad_token_id: int = 0
     use_flash: bool = True
+    # fused MLM vocab path (see ops/fused_xent.py): the pretraining
+    # forward returns the transformed hidden states + tied weight +
+    # decoder bias instead of [b, s, vocab] logits
+    fused_loss: bool = False
 
     def __post_init__(self):
         if self.intermediate_size is None:
@@ -174,10 +178,16 @@ class BertLMHead(Layer):
         self.decoder_bias = self.create_parameter(
             [cfg.vocab_size], initializer=I.Constant(0.0), axes=("vocab",))
 
+    def transformed(self, hidden):
+        return self.layer_norm(self.act(self.transform(hidden)))
+
+    def tied_weight(self):
+        return self._embeddings[0].word_embeddings.weight  # [V, H]
+
     def forward(self, hidden):
         from .. import amp
-        h = self.layer_norm(self.act(self.transform(hidden)))
-        w = self._embeddings[0].word_embeddings.weight  # [V, H] tied
+        h = self.transformed(hidden)
+        w = self.tied_weight()
         h, w = amp.white_cast(h, w)
         return jnp.einsum("bsh,vh->bsv", h, w,
                           preferred_element_type=jnp.float32) \
@@ -199,6 +209,11 @@ class BertForPretraining(Layer):
     def forward(self, input_ids, token_type_ids=None, attn_mask=None):
         seq, pooled = self.bert(input_ids, token_type_ids,
                                 attn_mask=attn_mask)
+        if self.cfg.fused_loss and self.training:
+            return (self.lm_head.transformed(seq),
+                    self.lm_head.tied_weight(),
+                    self.lm_head.decoder_bias,
+                    self.nsp_head(pooled))
         return self.lm_head(seq), self.nsp_head(pooled)
 
 
@@ -215,6 +230,41 @@ class BertPretrainingCriterion(Layer):
             loss = loss + F.cross_entropy(nsp_logits,
                                           nsp_labels.reshape(-1))
         return loss
+
+
+class BertFusedPretrainingCriterion(Layer):
+    """Streaming MLM loss for cfg.fused_loss=True models: consumes
+    (hidden, tied weight, decoder bias, nsp_logits) and never builds
+    the [b, s, vocab] logits (ops/fused_xent.py). Falls back to the
+    dense criterion signature in eval."""
+
+    def __init__(self, ignore_index: int = -100):
+        super().__init__()
+        self.ignore_index = ignore_index
+        self._dense = BertPretrainingCriterion(ignore_index)
+
+    def forward(self, *args):
+        # training: (hidden, weight, bias, nsp_logits, mlm_labels
+        #            [, nsp_labels]); eval: the dense criterion arity.
+        # NOTE: hapi metrics attached via Model.prepare would see the
+        # hidden states during fused training — compute accuracy-style
+        # metrics in eval (dense logits) instead.
+        if len(args) >= 5:
+            hidden, weight, bias, nsp_logits, mlm_labels = args[:5]
+            nsp_labels = args[5] if len(args) > 5 else None
+            from .. import amp
+            from ..ops.fused_xent import fused_linear_cross_entropy
+            hidden, weight = amp.white_cast(hidden, weight, op="matmul")
+            h = hidden.reshape(-1, hidden.shape[-1])
+            loss = fused_linear_cross_entropy(
+                h, weight, mlm_labels.reshape(-1), self.ignore_index,
+                None, bias)
+            if nsp_labels is not None:
+                loss = loss + F.cross_entropy(nsp_logits,
+                                              nsp_labels.reshape(-1))
+            return loss
+        # eval mode: dense logits path
+        return self._dense(*args)
 
 
 class BertForSequenceClassification(Layer):

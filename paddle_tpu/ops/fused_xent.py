@@ -59,19 +59,21 @@ def _chunks(weight, chunk):
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def fused_linear_cross_entropy(hidden, weight, labels,
                                ignore_index: int = -100,
-                               chunk: Optional[int] = None):
-    """Mean cross-entropy of ``softmax(hidden @ weight.T)`` against
-    ``labels`` without materialising the logits.
+                               chunk: Optional[int] = None,
+                               bias=None):
+    """Mean cross-entropy of ``softmax(hidden @ weight.T + bias)``
+    against ``labels`` without materialising the logits.
 
     hidden: [T, H] (callers flatten batch/seq); weight: [V, H] (the
-    tied-embedding layout); labels: [T] int. ``ignore_index`` rows are
-    masked out of the mean (reference cross_entropy semantics).
+    tied-embedding layout); labels: [T] int; bias: optional [V] logits
+    bias (BERT's decoder bias). ``ignore_index`` rows are masked out of
+    the mean (reference cross_entropy semantics).
     """
-    loss, _ = _fwd(hidden, weight, labels, ignore_index, chunk)
+    loss, _ = _fwd(hidden, weight, labels, ignore_index, chunk, bias)
     return loss
 
 
-def _fwd(hidden, weight, labels, ignore_index, chunk):
+def _fwd(hidden, weight, labels, ignore_index, chunk, bias=None):
     t, h = hidden.shape
     v = weight.shape[0]
     # AMP O1 hands bf16 activations + f32 params: compute in the
@@ -83,14 +85,22 @@ def _fwd(hidden, weight, labels, ignore_index, chunk):
         weight.astype(hidden.dtype)
     c = chunk or _pick_chunk(v)
     wc = _chunks(w_compute, c)
+    # bias handling is a STATIC branch: None callers (GPT) pay nothing
+    bc = None if bias is None else \
+        _chunks(bias.astype(jnp.float32)[:, None], c)[..., 0]  # [K, C]
     labels = labels.astype(jnp.int32)
 
     def body(carry, args):
         m, l, picked = carry
-        w_c, off = args
+        if bc is None:
+            w_c, off = args
+        else:
+            w_c, b_c, off = args
         logits = lax.dot_general(
             hidden, w_c, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)        # [T, C] f32
+        if bc is not None:
+            logits = logits + b_c[None, :]
         # mask vocab-pad columns out of the statistics
         col_ok = off + jax.lax.broadcasted_iota(
             jnp.int32, (1, c), 1) < v
@@ -113,17 +123,18 @@ def _fwd(hidden, weight, labels, ignore_index, chunk):
     carry0 = (m0, jnp.zeros((t,), jnp.float32),
               jnp.zeros((t,), jnp.float32))
     offsets = jnp.arange(wc.shape[0], dtype=jnp.int32) * c
-    (m, l, picked), _ = lax.scan(body, carry0, (wc, offsets))
+    xs = (wc, offsets) if bc is None else (wc, bc, offsets)
+    (m, l, picked), _ = lax.scan(body, carry0, xs)
     lse = m + jnp.log(l)
     valid = labels != ignore_index
     per_tok = jnp.where(valid, lse - picked, 0.0)
     n = jnp.maximum(valid.sum(), 1)
     loss = per_tok.sum() / n
-    return loss, (hidden, weight, labels, lse, valid, n)
+    return loss, (hidden, weight, labels, bias, lse, valid, n)
 
 
 def _bwd(ignore_index, chunk, res, g):
-    hidden, weight, labels, lse, valid, n = res
+    hidden, weight, labels, bias, lse, valid, n = res
     t, h = hidden.shape
     v = weight.shape[0]
     out_w_dtype = weight.dtype
@@ -131,15 +142,22 @@ def _bwd(ignore_index, chunk, res, g):
         weight = weight.astype(hidden.dtype)
     c = chunk or _pick_chunk(v)
     wc = _chunks(weight, c)
+    bc = None if bias is None else \
+        _chunks(bias.astype(jnp.float32)[:, None], c)[..., 0]
     labels = labels.astype(jnp.int32)
     # d(loss)/d(logits) = (softmax - onehot) * g / n, zeroed on ignored
     scale = (jnp.where(valid, 1.0, 0.0) * g / n).astype(jnp.float32)
 
     def body(dh, args):
-        w_c, off = args
+        if bc is None:
+            w_c, off = args
+        else:
+            w_c, b_c, off = args
         logits = lax.dot_general(
             hidden, w_c, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
+        if bc is not None:
+            logits = logits + b_c[None, :]
         col_ok = off + jax.lax.broadcasted_iota(
             jnp.int32, (1, c), 1) < v
         logits = jnp.where(col_ok, logits, -jnp.inf)
@@ -151,9 +169,11 @@ def _bwd(ignore_index, chunk, res, g):
             inside[:, None] &
             (jax.lax.broadcasted_iota(jnp.int32, (t, c), 1) ==
              onehot_col[:, None]), 1.0, 0.0)
+        dlog_f = p * scale[:, None]                     # [T, C] f32
+        db_c = None if bc is None else dlog_f.sum(axis=0)  # [C]
         # grad matmuls run in the params' dtype (bf16 MXU path); f32
         # accumulation via preferred_element_type
-        dlog = (p * scale[:, None]).astype(weight.dtype)  # [T, C]
+        dlog = dlog_f.astype(weight.dtype)
         dh = dh + lax.dot_general(
             dlog, w_c, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)         # [T, H]
@@ -161,17 +181,23 @@ def _bwd(ignore_index, chunk, res, g):
             dlog, hidden.astype(weight.dtype),
             (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)         # [C, H]
-        return dh, dw_c
+        return dh, (dw_c if bc is None else (dw_c, db_c))
 
     offsets = jnp.arange(wc.shape[0], dtype=jnp.int32) * c
-    dh, dw_chunks = lax.scan(body, jnp.zeros((t, h), jnp.float32),
-                             (wc, offsets))
+    xs = (wc, offsets) if bc is None else (wc, bc, offsets)
+    dh, stacked = lax.scan(body, jnp.zeros((t, h), jnp.float32), xs)
+    if bc is None:
+        dw_chunks, dbias = stacked, None
+    else:
+        dw_chunks, db_chunks = stacked
+        dbias = db_chunks.reshape(-1)[:v].astype(bias.dtype)
     dw = dw_chunks.reshape(-1, h)[:v]
-    return (dh.astype(hidden.dtype), dw.astype(out_w_dtype), None)
+    return (dh.astype(hidden.dtype), dw.astype(out_w_dtype), None,
+            dbias)
 
 
-def _fwd_rule(hidden, weight, labels, ignore_index, chunk):
-    loss, res = _fwd(hidden, weight, labels, ignore_index, chunk)
+def _fwd_rule(hidden, weight, labels, ignore_index, chunk, bias):
+    loss, res = _fwd(hidden, weight, labels, ignore_index, chunk, bias)
     return loss, res
 
 

@@ -231,3 +231,50 @@ def test_greedy_decoder_exports_and_matches_generate(tmp_path):
     jit.save(dec, path, input_spec=[jit.InputSpec([2, 8], "int64")])
     loaded = jit.load(path)
     np.testing.assert_array_equal(np.asarray(loaded(ids)), ref)
+
+
+def test_fused_bias_matches_dense():
+    hid, w, lb = _data(seed=7)
+    bias = jnp.asarray(np.random.RandomState(8).randn(40) * 0.3,
+                       jnp.float32)
+    got = fused_linear_cross_entropy(hid, w, lb, -100, 8, bias)
+    ref = F.cross_entropy(hid @ w.T + bias, lb)
+    np.testing.assert_allclose(float(got), float(ref), rtol=1e-5)
+    g_f = jax.grad(lambda b: fused_linear_cross_entropy(
+        hid, w, lb, -100, 8, b))(bias)
+    g_d = jax.grad(lambda b: F.cross_entropy(hid @ w.T + b, lb))(bias)
+    np.testing.assert_allclose(np.asarray(g_f), np.asarray(g_d),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_bert_fused_pretraining_matches_dense():
+    from paddle_tpu.models.bert import (BertConfig,
+                                        BertForPretraining,
+                                        BertFusedPretrainingCriterion,
+                                        BertPretrainingCriterion)
+    pt.seed(0)
+    kw = dict(vocab_size=64, hidden_size=32, num_layers=2, num_heads=2,
+              max_position_embeddings=16, hidden_dropout=0.0,
+              attention_dropout=0.0, use_flash=False)
+    r = np.random.RandomState(0)
+    ids = r.randint(0, 64, (2, 16))
+    mlm = np.where(r.rand(2, 16) < 0.2, r.randint(0, 64, (2, 16)),
+                   -100)
+    nsp = np.array([0, 1])
+
+    pt.seed(0)
+    dnet = BertForPretraining(BertConfig(**kw))
+    dm = pt.Model(dnet)
+    dm.prepare(optimizer=pt.optimizer.SGD(learning_rate=0.0,
+                                          parameters=dnet),
+               loss=BertPretrainingCriterion())
+    dense = float(dm.train_batch([ids], [mlm, nsp])["loss"])
+
+    pt.seed(0)
+    fnet = BertForPretraining(BertConfig(fused_loss=True, **kw))
+    fm = pt.Model(fnet)
+    fm.prepare(optimizer=pt.optimizer.SGD(learning_rate=0.0,
+                                          parameters=fnet),
+               loss=BertFusedPretrainingCriterion())
+    fused = float(fm.train_batch([ids], [mlm, nsp])["loss"])
+    np.testing.assert_allclose(fused, dense, rtol=1e-4)
