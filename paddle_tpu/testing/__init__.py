@@ -137,6 +137,40 @@ def check_grad(spec: OpSpec) -> None:
                     f"{analytic} vs finite-difference {fd}")
 
 
+def check_forward_bf16(spec: OpSpec, rtol: float = 3e-2,
+                       atol: float = 3e-2) -> None:
+    """Forward check with bf16 inputs against the f32 NumPy reference —
+    the dtype half of the reference's per-dtype OpTest sweep (op_test.py
+    convert_float_to_uint16 bf16 paths). Inputs are rounded through
+    bf16 first so the reference sees the same quantized values."""
+    if spec.ref is None or not spec.jit:
+        return
+    cast = []
+    for x in spec.inputs:
+        arr_ = np.asarray(x)
+        if arr_.dtype == np.float32:
+            cast.append(jnp.asarray(arr_).astype(jnp.bfloat16))
+        else:
+            cast.append(x)
+    if not any(isinstance(x, jax.Array) and x.dtype == jnp.bfloat16
+               for x in cast):
+        return  # no float inputs: nothing dtype-specific to test
+    out = jax.jit(lambda *a: spec.fn(*a, **spec.kwargs))(*cast)
+    ref_in = [np.asarray(x.astype(jnp.float32))
+              if isinstance(x, jax.Array) and x.dtype == jnp.bfloat16
+              else np.asarray(x) for x in cast]
+    expect = spec.ref(*ref_in)
+    for o, e in zip(jax.tree_util.tree_leaves(out),
+                    jax.tree_util.tree_leaves(expect)):
+        o = np.asarray(o)
+        if o.dtype.kind != "f" and np.asarray(e).dtype.kind != "f":
+            continue  # int/bool outputs compared exactly in f32 sweep
+        np.testing.assert_allclose(
+            o.astype(np.float32), np.asarray(e, np.float32),
+            rtol=rtol, atol=atol,
+            err_msg=f"{spec.name} bf16 forward mismatch")
+
+
 def run_spec(spec: OpSpec) -> None:
     check_forward(spec)
     check_grad(spec)

@@ -390,3 +390,26 @@ for s in SPECS:
 @pytest.mark.parametrize("spec", SPECS, ids=_IDS)
 def test_op(spec):
     run_spec(spec)
+
+
+# bf16 sweep over the differentiable numeric ops: same table, inputs
+# quantized through bfloat16, loose tolerances (the reference's
+# per-dtype OpTest dimension)
+_BF16_SPECS = [s for s in SPECS
+               if s.grad and s.ref is not None and s.jit]
+_BF16_IDS = []
+for s in _BF16_SPECS:
+    n = s.name + "-bf16"
+    while n in _BF16_IDS:
+        n += "'"
+    _BF16_IDS.append(n)
+
+
+@pytest.mark.parametrize("spec", _BF16_SPECS, ids=_BF16_IDS)
+def test_op_bf16(spec):
+    from paddle_tpu.testing import check_forward_bf16
+    if spec.name in ("digamma", "lgamma", "acosh", "atanh", "tan",
+                     "expm1", "cumprod", "logcumsumexp", "dist",
+                     "norm", "prod"):
+        pytest.skip("ill-conditioned at bf16 input resolution")
+    check_forward_bf16(spec)
