@@ -9,7 +9,7 @@ subpackages.
 
 from __future__ import annotations
 
-__version__ = "0.2.0"
+from .version import full_version as __version__  # noqa: E402
 
 from .core import dtype as _dtype_mod
 from .core import flags as _flags_mod
@@ -55,6 +55,8 @@ from . import profiler  # noqa: F401
 from . import quant  # noqa: F401
 from . import cost_model  # noqa: F401
 from . import linalg  # noqa: F401
+from . import sysconfig  # noqa: F401
+from . import version  # noqa: F401
 from . import regularizer  # noqa: F401
 from . import static  # noqa: F401
 from . import fft  # noqa: F401
@@ -88,3 +90,16 @@ def set_device(spec: str = "tpu") -> None:
     Under JAX devices are implicit; this validates the spec only."""
     if spec.split(":")[0] not in ("tpu", "cpu", "gpu", "axon"):
         raise ValueError(f"unknown device {spec!r}")
+
+
+def iinfo(dtype):
+    """ref: paddle.iinfo — integer dtype range info."""
+    import numpy as _np
+    return _np.iinfo(_np.dtype(dtype))
+
+
+def finfo(dtype):
+    """ref: paddle.finfo — float dtype info (works for bfloat16 via
+    jax's ml_dtypes-backed finfo)."""
+    import jax.numpy as _jnp
+    return _jnp.finfo(dtype)

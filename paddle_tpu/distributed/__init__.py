@@ -19,3 +19,4 @@ from . import launch  # noqa
 from . import elastic  # noqa
 from . import fleet  # noqa
 from .elastic import ElasticManager, ElasticStatus, Heartbeat  # noqa
+from .spawn import ProcessContext, spawn  # noqa

@@ -38,7 +38,7 @@ import sys
 import threading
 import time
 
-from .launch import find_free_port
+from .launch import find_free_port, trainer_env
 from typing import Dict, List, Optional
 
 HB_DIR_ENV = "PADDLE_ELASTIC_HB_DIR"
@@ -163,13 +163,7 @@ class ElasticManager:
         for rank in range(self.nproc):
             env = dict(os.environ)
             env.update(self.env_extra)
-            env["PADDLE_MASTER"] = self.master
-            env["MASTER_ADDR"], env["MASTER_PORT"] = \
-                self.master.split(":")
-            env["PADDLE_TRAINER_ID"] = str(rank)
-            env["PADDLE_TRAINERS_NUM"] = str(self.nproc)
-            env["RANK"] = str(rank)
-            env["WORLD_SIZE"] = str(self.nproc)
+            env.update(trainer_env(rank, self.nproc, self.master))
             env[RESTART_COUNT_ENV] = str(self.restarts)
             if self.heartbeat_timeout is not None:
                 env[HB_DIR_ENV] = self._hb_dir

@@ -31,6 +31,16 @@ def find_free_port() -> int:
         return s.getsockname()[1]
 
 
+def trainer_env(rank: int, nprocs: int, master: str) -> dict:
+    """The rendezvous environment every worker-launch path sets
+    (launcher generations, elastic restarts, spawn)."""
+    host, port = master.split(":")
+    return {"PADDLE_MASTER": master, "MASTER_ADDR": host,
+            "MASTER_PORT": port, "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(nprocs), "RANK": str(rank),
+            "WORLD_SIZE": str(nprocs)}
+
+
 def launch(nproc: int, training_script: str,
            script_args: List[str],
            master: Optional[str] = None,

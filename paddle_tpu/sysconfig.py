@@ -1,0 +1,15 @@
+"""paddle.sysconfig parity (ref: python/paddle/sysconfig.py)."""
+
+import os
+
+
+def get_include() -> str:
+    """Directory of the package's headers (native sources double as the
+    public native interface here)."""
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "native")
+
+
+def get_lib() -> str:
+    """Directory of the package's shared libraries."""
+    return get_include()

@@ -1,0 +1,13 @@
+"""paddle.version parity (ref: python/paddle/version.py, generated at
+build time). Single source of truth for the version string —
+``paddle_tpu.__version__`` reads from here."""
+
+full_version = "0.2.0"
+major, minor, patch = (int(x) for x in full_version.split("."))
+rc = 0
+istaged = False
+commit = "unknown"
+
+
+def show() -> None:
+    print(f"paddle-tpu {full_version} (commit {commit})")
