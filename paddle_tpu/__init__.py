@@ -56,6 +56,7 @@ from . import quant  # noqa: F401
 from . import cost_model  # noqa: F401
 from . import linalg  # noqa: F401
 from . import sysconfig  # noqa: F401
+from . import callbacks  # noqa: F401
 from . import version  # noqa: F401
 from . import regularizer  # noqa: F401
 from . import static  # noqa: F401

@@ -1,0 +1,6 @@
+"""paddle.callbacks namespace (ref: python/paddle/hapi/callbacks.py is
+re-exported as ``paddle.callbacks``)."""
+
+from .hapi.callbacks import (Callback, CallbackList, CSVLogger,  # noqa
+                             EarlyStopping, LRScheduler,
+                             ModelCheckpoint, ProgBarLogger)

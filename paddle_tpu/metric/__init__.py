@@ -166,3 +166,14 @@ class Auc(Metric):
         if tot_pos == 0 or tot_neg == 0:
             return 0.0
         return area / (tot_pos * tot_neg)
+
+
+def accuracy(input, label, k: int = 1):
+    """Functional top-k accuracy (ref: python/paddle/metric/metrics.py
+    accuracy): fraction of rows whose label is within the top-k logits."""
+    import jax.numpy as jnp
+    input = jnp.asarray(input)
+    label = jnp.asarray(label).reshape(input.shape[0], -1)
+    topk = jnp.argsort(-input, axis=-1)[:, :k]
+    hit = (topk[:, :, None] == label[:, None, :]).any(axis=(1, 2))
+    return hit.mean(dtype=jnp.float32)

@@ -47,3 +47,14 @@ def test_version_and_sysconfig():
     assert os.path.isdir(pt.sysconfig.get_include())
     assert any(f.endswith(".cc") for f in
                os.listdir(pt.sysconfig.get_include()))
+
+
+def test_callbacks_namespace_and_metric_accuracy():
+    import jax.numpy as jnp
+    assert pt.callbacks.EarlyStopping is not None
+    logits = jnp.asarray([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]])
+    labels = jnp.asarray([1, 0, 0])
+    acc = float(pt.metric.accuracy(logits, labels))
+    assert acc == pytest.approx(2 / 3)
+    acc2 = float(pt.metric.accuracy(logits, labels, k=2))
+    assert acc2 == 1.0
