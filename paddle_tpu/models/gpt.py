@@ -407,6 +407,13 @@ class GPTForCausalLMPipe(Layer):
         x = self.embeddings(input_ids, position_ids)
         x = self.pipe(x)
         x = self.ln_f(x)
+        if self.cfg.fused_loss and self.training:
+            # compose pp with the streaming vocab path: the pipeline's
+            # output arrives batch-sharded over pp, and the fused loss
+            # keeps logits out of HBM on top of it
+            if not self.cfg.tie_word_embeddings:
+                return x, self.lm_head.weight.T
+            return x, self.embeddings.word_embeddings.weight
         return self._logits(x)
 
 

@@ -278,3 +278,32 @@ def test_bert_fused_pretraining_matches_dense():
                loss=BertFusedPretrainingCriterion())
     fused = float(fm.train_batch([ids], [mlm, nsp])["loss"])
     np.testing.assert_allclose(fused, dense, rtol=1e-4)
+
+
+def test_pipeline_composes_with_fused_loss():
+    """pp x dp mesh + streaming vocab path: logits never in HBM while
+    the decoder trunk is pipelined."""
+    from paddle_tpu import parallel
+    from paddle_tpu.models.gpt import (GPTConfig, GPTForCausalLMPipe,
+                                       GPTFusedPretrainingCriterion)
+    mesh = parallel.init_mesh(pp=2, dp=4)
+    try:
+        pt.seed(0)
+        cfg = GPTConfig(vocab_size=64, hidden_size=16, num_layers=4,
+                        num_heads=2, max_position_embeddings=32,
+                        hidden_dropout=0.0, attention_dropout=0.0,
+                        use_flash=False, fused_loss=True)
+        net = GPTForCausalLMPipe(cfg, num_microbatches=2,
+                                 virtual_pp_degree=2, mesh=mesh)
+        model = pt.Model(net)
+        model.prepare(
+            optimizer=pt.optimizer.AdamW(learning_rate=3e-3,
+                                         parameters=net),
+            loss=GPTFusedPretrainingCriterion())
+        parallel.distributed_model(model, mesh=mesh)
+        ids = np.random.RandomState(0).randint(0, 64, (8, 32))
+        losses = [float(model.train_batch([ids], [ids])["loss"])
+                  for _ in range(5)]
+        assert losses[-1] < losses[0], losses
+    finally:
+        parallel.set_mesh(None)
