@@ -107,3 +107,77 @@ def host_all_reduce(stacked, op: str = "sum"):
     if op not in _REDUCERS:
         raise ValueError(f"unknown reduce op {op!r}")
     return _REDUCERS[op](jnp.asarray(stacked), axis=0)
+
+
+def quantized_ring_allreduce(x, axis: AxisName, bits: int = 8):
+    """Bandwidth-compressed gradient all-reduce: a hand-rolled ring
+    whose wire format is int8 blocks + one f32 scale per hop, ~1/4 the
+    bytes of a dense f32 ring (technique shape: EQuARX — quantized
+    all-reduce inside XLA; here expressed AS jax collectives since the
+    XLA implementation is not user-extensible).
+
+    Use inside shard_map on the gradient axis when ICI/DCN bandwidth —
+    not latency — dominates (multi-host DCN reductions; the in-repo
+    decision record for DGC explains why SPARSE compression is the
+    wrong trade on TPU, parallel/localsgd.py). Accumulation stays f32;
+    each hop requantizes, so error grows O(hops * q_eps) — bounded and
+    tested against the exact psum.
+
+    reduce-scatter phase: each rank accumulates one block; all-gather
+    phase: the reduced blocks circulate once more, quantized once.
+    """
+    if not 2 <= bits <= 8:
+        raise ValueError(
+            f"bits={bits}: the wire dtype is int8, so 2..8 bits only")
+    n = jax.lax.axis_size(axis)
+    if n == 1:
+        return x
+    qmax = float(2 ** (bits - 1) - 1)
+    flat = x.reshape(-1)
+    pad = (-flat.shape[0]) % n
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    blocks = flat.reshape(n, -1).astype(jnp.float32)
+    rank = jax.lax.axis_index(axis)
+    ring = [(i, (i + 1) % n) for i in range(n)]  # == shift()'s perm
+
+    def quant(b):
+        scale = jnp.maximum(jnp.max(jnp.abs(b)), 1e-20) / qmax
+        q = jnp.clip(jnp.round(b / scale), -qmax, qmax).astype(jnp.int8)
+        return q, scale
+
+    def dequant(q, scale):
+        return q.astype(jnp.float32) * scale
+
+    # reduce-scatter: at step s, send block (rank - s) and accumulate
+    # the incoming block (rank - s - 1)
+    acc = blocks
+    for s in range(n - 1):
+        send_idx = (rank - s) % n
+        q, scale = quant(jnp.take(acc, send_idx, axis=0))
+        q_in = jax.lax.ppermute(q, axis, ring)
+        s_in = jax.lax.ppermute(scale, axis, ring)
+        recv_idx = (rank - s - 1) % n
+        updated = jnp.take(acc, recv_idx, axis=0) + dequant(q_in, s_in)
+        acc = jax.lax.dynamic_update_index_in_dim(
+            acc, updated, recv_idx, 0)
+    # all-gather: each fully-reduced block is quantized ONCE at its
+    # owner and the SAME payload circulates the ring, so every rank —
+    # including the owner, which adopts its own dequantized broadcast —
+    # ends with bit-identical values (replicated params must not
+    # diverge across replicas)
+    own_idx = (rank + 1) % n
+    q_send, s_send = quant(jnp.take(acc, own_idx, axis=0))
+    acc = jax.lax.dynamic_update_index_in_dim(
+        acc, dequant(q_send, s_send), own_idx, 0)
+    for s in range(n - 1):
+        q_in = jax.lax.ppermute(q_send, axis, ring)
+        s_in = jax.lax.ppermute(s_send, axis, ring)
+        recv_idx = (rank - s) % n
+        acc = jax.lax.dynamic_update_index_in_dim(
+            acc, dequant(q_in, s_in), recv_idx, 0)
+        q_send, s_send = q_in, s_in
+    out = acc.reshape(-1)
+    if pad:
+        out = out[:-pad]
+    return out.reshape(x.shape).astype(x.dtype)
