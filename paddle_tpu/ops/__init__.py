@@ -10,3 +10,4 @@ fallback so the same kernels run (slowly) on CPU test meshes.
 from .flash_attention import flash_attention  # noqa
 from .ring_attention import ring_attention  # noqa: F401
 from .fused_xent import fused_linear_cross_entropy  # noqa
+from .paged_attention import PagedKVCache, paged_attention  # noqa

@@ -1,0 +1,127 @@
+"""Paged attention: decode-time attention over a block-paged KV cache.
+
+Reference context: the reference's serving attention keeps one dense
+[b, max_len, h, d] cache per request (fused_multi_transformer_op.cu);
+continuous batching then wastes HBM on the padding between each
+request's true length and max_len. The paged formulation (vLLM;
+"Ragged Paged Attention" for TPU, arXiv:2604.15464 in PAPERS.md) stores
+KV in fixed-size PAGES shared across requests, with a per-request block
+table mapping logical positions to pages — HBM waste bounded by one
+page per sequence.
+
+TPU-native design: pages are gathered per request with one take() (XLA
+lowers to a dynamic-gather the TPU does well at page granularity —
+contiguous [page_size, kv_heads, d] blocks), then attention runs as
+dense SDPA with a context-length mask. Static shapes throughout
+(pages_per_seq is the compiled maximum; short sequences mask). The
+fancy kernel in the paper fuses the gather into the attention loop —
+that is a later Pallas optimization; this implementation fixes the
+MEMORY model, which is the serving win, and is numerically exact.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class PagedKVCache:
+    """Page-pool KV storage + per-request block tables.
+
+    k/v pages: [num_pages, page_size, kv_heads, head_dim]; block table
+    [max_seqs, pages_per_seq] of page ids (-1 = unallocated);
+    context_lens [max_seqs]. Host-side allocation (serving control
+    plane), device-side tensors."""
+
+    def __init__(self, num_pages: int, page_size: int, kv_heads: int,
+                 head_dim: int, max_seqs: int, pages_per_seq: int,
+                 dtype=jnp.float32):
+        self.page_size = page_size
+        self.k_pages = jnp.zeros((num_pages, page_size, kv_heads,
+                                  head_dim), dtype)
+        self.v_pages = jnp.zeros_like(self.k_pages)
+        self.block_tables = jnp.full((max_seqs, pages_per_seq), -1,
+                                     jnp.int32)
+        self.context_lens = jnp.zeros((max_seqs,), jnp.int32)
+        self._free = list(range(num_pages - 1, -1, -1))
+
+    def allocate(self, seq: int, n_tokens: int) -> None:
+        """Reserve pages for n_tokens of sequence ``seq``."""
+        need = -(-n_tokens // self.page_size)
+        if need > self.block_tables.shape[1]:
+            raise ValueError(
+                f"sequence {seq} needs {need} pages but the block "
+                f"table holds {self.block_tables.shape[1]} "
+                f"(pages_per_seq); raise pages_per_seq or evict")
+        have = int((self.block_tables[seq] >= 0).sum())
+        for slot in range(have, need):
+            if not self._free:
+                raise RuntimeError("KV page pool exhausted")
+            page = self._free.pop()
+            self.block_tables = self.block_tables.at[seq, slot].set(page)
+
+    def free(self, seq: int) -> None:
+        for pid in [int(p) for p in self.block_tables[seq] if p >= 0]:
+            self._free.append(pid)
+        self.block_tables = self.block_tables.at[seq].set(-1)
+        self.context_lens = self.context_lens.at[seq].set(0)
+
+    def append(self, seq: int, k_new, v_new) -> None:
+        """Write [t, kv_heads, d] new tokens at the sequence's end.
+        Tokens are written one contiguous slice per TOUCHED PAGE (a
+        per-token .at[].set would copy the whole pool per token)."""
+        t = int(k_new.shape[0])
+        start = int(self.context_lens[seq])
+        self.allocate(seq, start + t)
+        ps = self.page_size
+        i = 0
+        while i < t:
+            pos = start + i
+            page = int(self.block_tables[seq, pos // ps])
+            off = pos % ps
+            span = min(ps - off, t - i)
+            self.k_pages = self.k_pages.at[page, off:off + span].set(
+                k_new[i:i + span])
+            self.v_pages = self.v_pages.at[page, off:off + span].set(
+                v_new[i:i + span])
+            i += span
+        self.context_lens = self.context_lens.at[seq].set(start + t)
+
+
+def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
+                    scale: Optional[float] = None):
+    """Single-query attention over paged KV (the decode step).
+
+    q: [B, heads, d]; k/v_pages: [num_pages, page_size, kv_heads, d];
+    block_tables: [B, pages_per_seq] page ids (-1 pads);
+    context_lens: [B] valid token counts. Returns [B, heads, d].
+    GQA: heads may be a multiple of kv_heads."""
+    b, n_heads, d = q.shape
+    _, page_size, kv_heads, _ = k_pages.shape
+    pages_per_seq = block_tables.shape[1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+
+    tables = jnp.clip(block_tables, 0)               # [B, P]
+    k = jnp.take(k_pages, tables, axis=0)            # [B, P, ps, KVH, d]
+    v = jnp.take(v_pages, tables, axis=0)
+    L = pages_per_seq * page_size
+    k = k.reshape(b, L, kv_heads, d)
+    v = v.reshape(b, L, kv_heads, d)
+    if n_heads != kv_heads:
+        rep = n_heads // kv_heads
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+
+    logits = jnp.einsum("bhd,blhd->bhl", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    mask = jnp.arange(L)[None, :] < context_lens[:, None]    # [B, L]
+    logits = jnp.where(mask[:, None, :], logits, -jnp.inf)
+    p = jax.nn.softmax(logits, axis=-1)
+    # an empty sequence (context_len 0, e.g. a freed batch slot) has an
+    # all -inf row; return zeros instead of softmax's NaN
+    p = jnp.where(context_lens[:, None, None] > 0, p, 0.0)
+    out = jnp.einsum("bhl,blhd->bhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
