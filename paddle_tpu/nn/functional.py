@@ -788,3 +788,11 @@ from .layers.extra import (alpha_dropout, celu, fold,  # noqa: E402
                            local_response_norm, maxout,
                            pairwise_distance, pixel_shuffle,
                            pixel_unshuffle, thresholded_relu)
+
+
+def swiglu(x, gate=None):
+    """SwiGLU (ref: later-version incubate fused_swiglu; standard LLM
+    MLP gate): silu(x) * gate, or split the last dim when gate is None."""
+    if gate is None:
+        x, gate = jnp.split(x, 2, axis=-1)
+    return jax.nn.silu(x) * gate

@@ -1,0 +1,51 @@
+"""Rotary position embeddings (RoPE).
+
+Reference context: the reference ships RoPE via its ecosystem
+(PaddleNLP fused_rope / incubate fused_rotary_position_embedding in
+later versions); the core op rotates each head-dim pair (x_{2i},
+x_{2i+1}) by position-dependent angles so attention scores depend only
+on relative positions.
+
+TPU-native notes: implemented in the half-split convention
+(rotate_half, the LLaMA/NeoX layout) — two VPU multiplies and one
+add per element, fused by XLA into the attention prologue; cos/sin
+tables are precomputed once per max length and gathered per position
+(static shapes, KV-cache offsets supported via ``position_ids``)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+
+def rope_tables(head_dim: int, max_len: int, base: float = 10000.0,
+                dtype=jnp.float32) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """cos/sin tables [max_len, head_dim] (half-split convention)."""
+    inv = 1.0 / (base ** (jnp.arange(0, head_dim, 2,
+                                     dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv)                       # [L, D/2]
+    emb = jnp.concatenate([freqs, freqs], axis=-1)  # [L, D]
+    return jnp.cos(emb).astype(dtype), jnp.sin(emb).astype(dtype)
+
+
+def _rotate_half(x):
+    half = x.shape[-1] // 2
+    return jnp.concatenate([-x[..., half:], x[..., :half]], axis=-1)
+
+
+def apply_rotary_pos_emb(q, k, cos, sin, position_ids=None):
+    """Rotate q/k ([B, S, H, D]) by the table entries at
+    ``position_ids`` ([B, S], default arange — pass the absolute
+    positions when decoding with a KV cache)."""
+    s = q.shape[1]
+    if position_ids is None:
+        cos_g = cos[None, :s, None, :]
+        sin_g = sin[None, :s, None, :]
+    else:
+        cos_g = cos[position_ids][:, :, None, :]
+        sin_g = sin[position_ids][:, :, None, :]
+    q_out = q * cos_g + _rotate_half(q) * sin_g
+    k_out = k * cos_g + _rotate_half(k) * sin_g
+    return q_out.astype(q.dtype), k_out.astype(k.dtype)

@@ -1,0 +1,85 @@
+"""RoPE + SwiGLU (parity vs the standard formulas / torch reference)."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from paddle_tpu.nn import functional as F
+from paddle_tpu.ops.rotary import apply_rotary_pos_emb, rope_tables
+
+
+def test_rope_relative_position_property():
+    """<RoPE(q,m), RoPE(k,n)> depends only on m-n."""
+    d, L = 8, 32
+    cos, sin = rope_tables(d, L)
+    r = np.random.RandomState(0)
+    q = jnp.asarray(r.randn(1, L, 1, d), jnp.float32)
+    k = jnp.asarray(r.randn(1, L, 1, d), jnp.float32)
+    # use the same q/k vector at every position
+    q = jnp.broadcast_to(q[:, :1], q.shape)
+    k = jnp.broadcast_to(k[:, :1], k.shape)
+    qr, kr = apply_rotary_pos_emb(q, k, cos, sin)
+    dots = np.asarray(jnp.einsum("bshd,bthd->st", qr, kr))
+    # all pairs with the same offset m-n share the same score
+    for off in (1, 3, 7):
+        diag = np.diagonal(dots, offset=off)
+        np.testing.assert_allclose(diag, diag[0], rtol=1e-4, atol=1e-5)
+
+
+def test_rope_norm_preserved():
+    d, L = 16, 8
+    cos, sin = rope_tables(d, L)
+    q = jnp.asarray(np.random.RandomState(1).randn(2, L, 3, d),
+                    jnp.float32)
+    qr, _ = apply_rotary_pos_emb(q, q, cos, sin)
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(qr), axis=-1),
+        np.linalg.norm(np.asarray(q), axis=-1), rtol=1e-5)
+
+
+def test_rope_position_ids_decode_offset():
+    """Decoding one token at absolute position p equals slicing the
+    full-sequence application — the KV-cache contract."""
+    d, L = 8, 16
+    cos, sin = rope_tables(d, L)
+    r = np.random.RandomState(2)
+    q = jnp.asarray(r.randn(1, L, 2, d), jnp.float32)
+    full, _ = apply_rotary_pos_emb(q, q, cos, sin)
+    p = 5
+    one, _ = apply_rotary_pos_emb(
+        q[:, p:p + 1], q[:, p:p + 1], cos, sin,
+        position_ids=jnp.asarray([[p]]))
+    np.testing.assert_allclose(np.asarray(one), np.asarray(full[:, p:p + 1]),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_rope_matches_torch_convention():
+    torch = pytest.importorskip("torch")
+    d, L = 8, 6
+    cos, sin = rope_tables(d, L)
+    r = np.random.RandomState(3)
+    x = r.randn(1, L, 1, d).astype(np.float32)
+
+    # the LLaMA rotate_half reference implementation
+    tc = np.asarray(cos)[None, :, None, :]
+    ts = np.asarray(sin)[None, :, None, :]
+    def rot(v):
+        return np.concatenate([-v[..., d // 2:], v[..., :d // 2]], -1)
+    ref = x * tc + rot(x) * ts
+    got, _ = apply_rotary_pos_emb(jnp.asarray(x), jnp.asarray(x), cos,
+                                  sin)
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_swiglu():
+    r = np.random.RandomState(4)
+    x = jnp.asarray(r.randn(3, 8), jnp.float32)
+    out = F.swiglu(x)
+    a, g = np.split(np.asarray(x), 2, axis=-1)
+    ref = a / (1 + np.exp(-a)) * g
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5,
+                               atol=1e-6)
+    out2 = F.swiglu(x[:, :4], x[:, 4:])
+    np.testing.assert_allclose(np.asarray(out2), ref, rtol=1e-5,
+                               atol=1e-6)
