@@ -43,7 +43,10 @@ class GPTConfig:
     max_position_embeddings: int = 1024
     hidden_dropout: float = 0.1
     attention_dropout: float = 0.1
-    activation: str = "gelu"
+    activation: str = "gelu"   # "swiglu" selects the gated MLP
+    norm_type: str = "layer"   # "rms" selects RMSNorm (LLaMA-style)
+    use_rope: bool = False     # rotary positions instead of learned
+    rope_base: float = 10000.0
     initializer_range: float = 0.02
     layer_norm_epsilon: float = 1e-5
     tie_word_embeddings: bool = True
@@ -78,10 +81,35 @@ PRESETS = {
 }
 
 
+def llama_config(hidden_size: int = 2048, num_layers: int = 22,
+                 num_heads: int = 16, num_kv_heads: int = 4,
+                 vocab_size: int = 32000,
+                 max_position_embeddings: int = 2048,
+                 **overrides) -> GPTConfig:
+    """LLaMA-style decoder: RoPE + RMSNorm + SwiGLU + GQA + untied
+    head — the modern-LLM configuration of the same GPT skeleton."""
+    base = dict(vocab_size=vocab_size, hidden_size=hidden_size,
+                num_layers=num_layers, num_heads=num_heads,
+                num_kv_heads=num_kv_heads,
+                ffn_hidden_size=int(hidden_size * 8 / 3) // 128 * 128,
+                max_position_embeddings=max_position_embeddings,
+                hidden_dropout=0.0, attention_dropout=0.0,
+                activation="swiglu", norm_type="rms", use_rope=True,
+                tie_word_embeddings=False)
+    base.update(overrides)
+    return GPTConfig(**base)
+
+
 def gpt_config(name: str, **overrides) -> GPTConfig:
     cfg = dict(PRESETS[name])
     cfg.update(overrides)
     return GPTConfig(**cfg)
+
+
+def _norm(cfg: GPTConfig):
+    if cfg.norm_type == "rms":
+        return nn.RMSNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
+    return nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
 
 
 class GPTAttention(Layer):
@@ -106,7 +134,8 @@ class GPTAttention(Layer):
             0.0, cfg.initializer_range / math.sqrt(2 * cfg.num_layers)),
             axes=("heads", "embed"), bias_axes=(None,))
 
-    def forward(self, x, attn_mask=None, cache=None):
+    def forward(self, x, attn_mask=None, cache=None,
+                position_ids=None):
         b, s, h = x.shape
         hd = self.cfg.head_dim
         qkv = self.qkv_proj(x)
@@ -115,6 +144,19 @@ class GPTAttention(Layer):
         q = q.reshape(b, s, self.num_heads, hd)
         k = k.reshape(b, s, self.num_kv_heads, hd)
         v = v.reshape(b, s, self.num_kv_heads, hd)
+        if self.cfg.use_rope:
+            # rotate BEFORE the cache write so cached keys carry their
+            # absolute positions (decode-offset contract,
+            # ops/rotary.py); tables fold to trace-time constants
+            from ..ops.rotary import apply_rotary_pos_emb, rope_tables
+            cos, sin = rope_tables(hd, self.cfg.max_position_embeddings,
+                                   self.cfg.rope_base)
+            if position_ids is None:
+                start = cache[2] if cache is not None else 0
+                position_ids = jnp.broadcast_to(
+                    start + jnp.arange(s)[None, :], (b, s))
+            q, k = apply_rotary_pos_emb(q, k, cos, sin,
+                                        position_ids=position_ids)
         if cache is not None:
             k_cache, v_cache, idx = cache
             k_cache = jax.lax.dynamic_update_slice_in_dim(
@@ -156,13 +198,16 @@ class GPTMLP(Layer):
         init = I.Normal(0.0, cfg.initializer_range)
         init_out = I.Normal(
             0.0, cfg.initializer_range / math.sqrt(2 * cfg.num_layers))
-        self.fc_in = nn.Linear(cfg.hidden_size, cfg.ffn_hidden_size,
+        self._swiglu = cfg.activation == "swiglu"
+        in_width = 2 * cfg.ffn_hidden_size if self._swiglu \
+            else cfg.ffn_hidden_size
+        self.fc_in = nn.Linear(cfg.hidden_size, in_width,
                                weight_attr=init,
                                axes=("embed", "mlp"), bias_axes=("mlp",))
         self.fc_out = nn.Linear(cfg.ffn_hidden_size, cfg.hidden_size,
                                 weight_attr=init_out,
                                 axes=("mlp", "embed"), bias_axes=(None,))
-        self.act = getattr(F, cfg.activation)
+        self.act = F.swiglu if self._swiglu else getattr(F, cfg.activation)
         self.dropout = nn.Dropout(cfg.hidden_dropout)
 
     def forward(self, x):
@@ -174,16 +219,16 @@ class GPTDecoderLayer(Layer):
 
     def __init__(self, cfg: GPTConfig):
         super().__init__()
-        self.ln_1 = nn.LayerNorm(cfg.hidden_size,
-                                 epsilon=cfg.layer_norm_epsilon)
+        self.ln_1 = _norm(cfg)
         self.attn = GPTAttention(cfg)
-        self.ln_2 = nn.LayerNorm(cfg.hidden_size,
-                                 epsilon=cfg.layer_norm_epsilon)
+        self.ln_2 = _norm(cfg)
         self.mlp = GPTMLP(cfg)
         self.dropout = nn.Dropout(cfg.hidden_dropout)
 
-    def forward(self, x, attn_mask=None, cache=None):
-        a = self.attn(self.ln_1(x), attn_mask=attn_mask, cache=cache)
+    def forward(self, x, attn_mask=None, cache=None,
+                position_ids=None):
+        a = self.attn(self.ln_1(x), attn_mask=attn_mask, cache=cache,
+                      position_ids=position_ids)
         if cache is not None:
             a, cache = a
         x = x + self.dropout(a)
@@ -202,23 +247,28 @@ class GPTEmbeddings(Layer):
         self.word_embeddings = nn.Embedding(
             cfg.vocab_size, cfg.hidden_size, weight_attr=init,
             axes=("vocab", "embed"))
-        self.position_embeddings = nn.Embedding(
-            cfg.max_position_embeddings, cfg.hidden_size, weight_attr=init,
-            axes=(None, "embed"))
+        if not cfg.use_rope:  # rotary encodes positions in attention
+            self.position_embeddings = nn.Embedding(
+                cfg.max_position_embeddings, cfg.hidden_size,
+                weight_attr=init, axes=(None, "embed"))
         self.dropout = nn.Dropout(cfg.hidden_dropout)
+        self._use_rope = cfg.use_rope
+        self._max_pos = cfg.max_position_embeddings
 
     def forward(self, input_ids, position_ids=None):
         s = input_ids.shape[1]
-        max_pos = self.position_embeddings.num_embeddings
+        max_pos = self._max_pos
         if s > max_pos:
             raise ValueError(
                 f"sequence length {s} exceeds max_position_embeddings "
                 f"{max_pos} (an out-of-range gather would silently clamp)")
-        if position_ids is None:
-            position_ids = jnp.arange(s)[None, :]
         from ..parallel.sharding import with_logical_constraint
         tok = with_logical_constraint(
             self.word_embeddings(input_ids), ("batch", "seq", None))
+        if self._use_rope:
+            return self.dropout(tok)
+        if position_ids is None:
+            position_ids = jnp.arange(s)[None, :]
         pos = with_logical_constraint(
             self.position_embeddings(position_ids), (None, "seq", None))
         return self.dropout(tok + pos)
@@ -233,8 +283,7 @@ class GPTModel(Layer):
         self.embeddings = GPTEmbeddings(cfg)
         self.layers = LayerList(
             [GPTDecoderLayer(cfg) for _ in range(cfg.num_layers)])
-        self.ln_f = nn.LayerNorm(cfg.hidden_size,
-                                 epsilon=cfg.layer_norm_epsilon)
+        self.ln_f = _norm(cfg)
 
     def forward(self, input_ids, position_ids=None, attn_mask=None,
                 caches=None):
@@ -245,17 +294,20 @@ class GPTModel(Layer):
         # (ZeRO-3), rather than letting fsdp leak into activation hidden
         # dims (which forced full-remat reshards in the partitioner)
         x = with_logical_constraint(x, ("batch", "seq", None))
+        rope_pos = position_ids if self.cfg.use_rope else None
         new_caches = [] if caches is not None else None
         for i, layer in enumerate(self.layers):
             if caches is not None:
-                x, c = layer(x, attn_mask=attn_mask, cache=caches[i])
+                x, c = layer(x, attn_mask=attn_mask, cache=caches[i],
+                             position_ids=rope_pos)
                 new_caches.append(c)
             elif self.cfg.remat:
                 # trade FLOPs for HBM: recompute the block in backward
                 x = jax.checkpoint(
-                    lambda x, l=layer: l(x, attn_mask=attn_mask))(x)
+                    lambda x, l=layer: l(x, attn_mask=attn_mask,
+                                         position_ids=rope_pos))(x)
             else:
-                x = layer(x, attn_mask=attn_mask)
+                x = layer(x, attn_mask=attn_mask, position_ids=rope_pos)
             x = with_logical_constraint(x, ("batch", "seq", None))
         x = self.ln_f(x)
         if caches is not None:
@@ -392,8 +444,7 @@ class GPTForCausalLMPipe(Layer):
             virtual_pp_degree=virtual_pp_degree,
             mesh=mesh, mb_spec=mb_spec if mb_spec is not None else P(),
             remat=True)
-        self.ln_f = nn.LayerNorm(cfg.hidden_size,
-                                 epsilon=cfg.layer_norm_epsilon)
+        self.ln_f = _norm(cfg)
         if not cfg.tie_word_embeddings:
             self.lm_head = nn.Linear(cfg.hidden_size, cfg.vocab_size,
                                      bias_attr=False,

@@ -14,14 +14,17 @@ tables are precomputed once per max length and gathered per position
 
 from __future__ import annotations
 
+import functools
 from typing import Optional, Tuple
 
 import jax.numpy as jnp
 
 
+@functools.lru_cache(maxsize=16)
 def rope_tables(head_dim: int, max_len: int, base: float = 10000.0,
                 dtype=jnp.float32) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """cos/sin tables [max_len, head_dim] (half-split convention)."""
+    """cos/sin tables [max_len, head_dim] (half-split convention).
+    Cached: eager decode loops call this per token per layer."""
     inv = 1.0 / (base ** (jnp.arange(0, head_dim, 2,
                                      dtype=jnp.float32) / head_dim))
     t = jnp.arange(max_len, dtype=jnp.float32)

@@ -1,0 +1,111 @@
+"""LLaMA-style GPT variant: RoPE + RMSNorm + SwiGLU + GQA, with
+cache-correct rotary decode."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.models.gpt import (GPTConfig, GPTForCausalLM,
+                                   GPTPretrainingCriterion, llama_config)
+
+
+def _tiny_llama(**kw):
+    pt.seed(0)
+    return GPTForCausalLM(llama_config(
+        hidden_size=32, num_layers=2, num_heads=4, num_kv_heads=2,
+        vocab_size=64, max_position_embeddings=32, use_flash=False,
+        **kw))
+
+
+def test_structure():
+    net = _tiny_llama()
+    names = dict(net.named_parameters())
+    # no learned position table under rope
+    assert not any("position_embeddings" in n for n in names)
+    # untied head exists; swiglu doubles fc_in width
+    assert "lm_head.weight" in names
+    assert names["gpt.layers.0.mlp.fc_in.weight"].shape[1] == \
+        2 * names["gpt.layers.0.mlp.fc_out.weight"].shape[0]
+    # rms norms have no bias
+    assert "gpt.ln_f.bias" not in names and "gpt.ln_f.weight" in names
+
+
+def test_cache_decode_matches_full_forward():
+    """Incremental RoPE decode == full forward (the decode-offset
+    contract through the KV cache)."""
+    net = _tiny_llama()
+    net.eval()
+    ids = np.random.RandomState(0).randint(0, 64, (2, 10))
+    full = np.asarray(net(ids))
+
+    caches = net.init_caches(2, 10)
+    lg, caches = net(jnp.asarray(ids[:, :6]), caches=caches)
+    np.testing.assert_allclose(np.asarray(lg), full[:, :6], rtol=2e-4,
+                               atol=2e-5)
+    for t in range(6, 10):
+        lg, caches = net(jnp.asarray(ids[:, t:t + 1]),
+                         caches=caches)
+        np.testing.assert_allclose(np.asarray(lg)[:, 0], full[:, t],
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_generate_and_train():
+    net = _tiny_llama()
+    net.eval()
+    ids = np.random.RandomState(1).randint(0, 64, (1, 6))
+    out = net.generate(jnp.asarray(ids), max_new_tokens=4)
+    assert out.shape == (1, 10)
+
+    model = pt.Model(net)
+    model.prepare(
+        optimizer=pt.optimizer.AdamW(learning_rate=3e-3,
+                                     parameters=net),
+        loss=GPTPretrainingCriterion())
+    batch = np.random.RandomState(2).randint(0, 64, (4, 16))
+    losses = [float(model.train_batch([batch], [batch])["loss"])
+              for _ in range(8)]
+    assert losses[-1] < losses[0], losses
+
+
+def test_default_gpt_unchanged():
+    """The flags default off: classic GPT still has learned positions,
+    LayerNorm with bias, and 4h gelu MLP."""
+    pt.seed(0)
+    net = GPTForCausalLM(GPTConfig(
+        vocab_size=64, hidden_size=32, num_layers=1, num_heads=2,
+        max_position_embeddings=16, use_flash=False))
+    names = dict(net.named_parameters())
+    assert any("position_embeddings" in n for n in names)
+    assert "gpt.ln_f.bias" in names
+    assert names["gpt.layers.0.mlp.fc_in.weight"].shape[1] == 4 * 32
+
+
+def test_rope_honors_explicit_position_ids():
+    """Left-padded batches pass custom position_ids; rope must use
+    them, not arange."""
+    net = _tiny_llama()
+    net.eval()
+    ids = np.random.RandomState(3).randint(0, 64, (1, 6))
+    full = np.asarray(net(ids))
+    # a UNIFORM shift leaves outputs unchanged (rope is relative) —
+    # this also proves the explicit ids actually reach the rotation
+    shifted = np.asarray(net(ids, position_ids=jnp.arange(2, 8)[None]))
+    np.testing.assert_allclose(shifted, full, rtol=1e-4, atol=1e-5)
+    # a NON-uniform layout (gap => different relative distances) must
+    # change the result
+    gapped = np.asarray(net(
+        ids, position_ids=jnp.asarray([[0, 1, 2, 10, 11, 12]])))
+    assert not np.allclose(gapped, full, atol=1e-4)
+
+
+def test_pipe_ln_f_honors_norm_type():
+    from paddle_tpu.models.gpt import GPTForCausalLMPipe
+    pt.seed(0)
+    cfg = llama_config(hidden_size=16, num_layers=2, num_heads=2,
+                       num_kv_heads=2, vocab_size=32,
+                       max_position_embeddings=16, use_flash=False)
+    net = GPTForCausalLMPipe(cfg, num_microbatches=1)
+    assert isinstance(net.ln_f, nn.RMSNorm)
