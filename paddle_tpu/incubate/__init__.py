@@ -20,3 +20,4 @@ from ..nn.layers.transformer import (  # noqa
 from . import asp  # noqa  (n:m structured sparsity)
 from . import nn  # noqa  (fused-layer namespace)
 from . import autotune  # noqa  (kernel/layout/dataloader tuning facade)
+from . import data_generator  # noqa  (PS MultiSlot authoring protocol)
