@@ -74,6 +74,10 @@ PRESETS = {
                        max_position_embeddings=1024),
     "gpt2-medium": dict(hidden_size=1024, num_layers=24, num_heads=16,
                         max_position_embeddings=1024),
+    "gpt2-large": dict(hidden_size=1280, num_layers=36, num_heads=20,
+                       max_position_embeddings=1024),
+    "gpt2-xl": dict(hidden_size=1600, num_layers=48, num_heads=25,
+                    max_position_embeddings=1024),
     "gpt3-1.3b": dict(hidden_size=2048, num_layers=24, num_heads=16,
                       max_position_embeddings=2048),
     "gpt3-6.7b": dict(hidden_size=4096, num_layers=32, num_heads=32,
