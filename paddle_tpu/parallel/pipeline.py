@@ -50,13 +50,24 @@ With remat the per-rank residual is smaller than 1F1B's whenever
 transformer blocks at realistic microbatch counts; the recompute cost is
 one extra forward, the standard TPU trade.
 
+Tensor parallelism INSIDE the pipeline (the reference's mp×pp hybrid,
+fleet/meta_optimizers/sharding_optimizer.py:123-135 wrap order): the
+shard_map is *partially manual* — manual over ``pp`` only
+(``axis_names={"pp"}``), every other mesh axis stays in GSPMD "auto"
+mode. The stacked stage params keep their per-dim logical shardings
+(``mlp``/``heads``/``vocab`` → tp) on the non-stage dims, and XLA's SPMD
+partitioner inserts the Megatron-style tp collectives inside the scan
+body exactly as it does in the dense path; microbatch dp sharding rides
+the same way. No hand-written tp collectives, no nested shard_map — the
+pipeline schedule is manual where it must be (the ppermute ring) and
+compiler-partitioned everywhere else.
+
 Constraints (same as GSPMD-style pipelining everywhere): all stage-chunks
 run one shared computation graph, so chunks must be structurally
 identical, and the trunk must be buffer-free (no BatchNorm running
 stats). Embedding/head layers stay outside the pipelined trunk
 (pp-replicated), which is how ``models.gpt.GPTForCausalLMPipe`` composes
-it. Tensor parallelism inside the shard_map body is not yet supported —
-use pp × dp meshes (tp composes with dp/fsdp in the non-pp path).
+it.
 """
 
 from __future__ import annotations
@@ -169,9 +180,17 @@ def pipeline_spmd(stage_fn: Callable, stacked_params, x,
     m_pad = -(-m // pp) * pp  # output buffer rounded up to a pp multiple
     c_sz = m_pad // pp
 
+    # Partial-manual shard_map: only ``axis`` (pp) is manual, so in/out
+    # specs may reference only it. The microbatch dims' dp sharding and
+    # the params' tp shardings live on the AUTO axes — they flow in from
+    # the arguments' shardings and GSPMD partitions the body over them.
+    # ``mb_spec`` is applied as a constraint to anchor the intended
+    # microbatch layout rather than as a manual split.
     param_specs = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
-    in_mb_spec = P(None, *mb_spec)
-    out_spec = P(axis, *mb_spec)
+    if tuple(mb_spec) != ():
+        from jax.sharding import NamedSharding
+        xm = lax.with_sharding_constraint(
+            xm, NamedSharding(mesh.mesh, P(None, *mb_spec)))
 
     # Per-tick randomness: the scan body is traced ONCE, so an ambient
     # next_key() inside it would freeze one dropout mask for every tick/
@@ -247,8 +266,9 @@ def pipeline_spmd(stage_fn: Callable, stacked_params, x,
 
     mapped = jax.shard_map(
         per_shard, mesh=mesh.mesh,
-        in_specs=(param_specs, in_mb_spec),
-        out_specs=out_spec,
+        in_specs=(param_specs, P()),
+        out_specs=P(axis),
+        axis_names=frozenset({axis}),
         check_vma=False,
     )
     ym = mapped(stacked_params, xm)
