@@ -330,6 +330,39 @@ class Laplace(Distribution):
                                 self.batch_shape)
 
 
+class Exponential(Distribution):
+    """ref: kernel ``exponential_`` (legacy_api.yaml); paddle gained the
+    python class later — rate parameterization, mean 1/rate."""
+
+    def __init__(self, rate):
+        self.rate = jnp.asarray(rate, jnp.float32)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return 1.0 / self.rate
+
+    @property
+    def variance(self):
+        return 1.0 / jnp.square(self.rate)
+
+    def rsample(self, shape=()):
+        return jax.random.exponential(
+            self._key(), _shape(shape, self.batch_shape)) / self.rate
+
+    sample = rsample
+
+    def log_prob(self, value):
+        return jnp.log(self.rate) - self.rate * value
+
+    def cdf(self, value):
+        return -jnp.expm1(-self.rate * value)
+
+    def entropy(self):
+        return jnp.broadcast_to(1.0 - jnp.log(self.rate),
+                                self.batch_shape)
+
+
 class Gumbel(Distribution):
     """ref: distribution/gumbel.py."""
 

@@ -245,6 +245,16 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
     return out
 
 
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCDHW"):
+    """ref: python/paddle/nn/functional/conv.py conv3d_transpose — the
+    2d transposed-conv path is rank-generic (lhs_dilation upsample)."""
+    return conv2d_transpose(x, weight, bias, stride, padding,
+                            output_padding, dilation, groups,
+                            "NDHWC" if data_format == "NDHWC" else "NCDHW")
+
+
 # ---------------------------------------------------------------------------
 # Pooling (ref: python/paddle/nn/functional/pooling.py)
 # ---------------------------------------------------------------------------
@@ -640,6 +650,19 @@ def kl_div(input, label, reduction: str = "mean"):
     return _reduce(loss, reduction)
 
 
+def log_loss(input, label, epsilon: float = 1e-4):
+    """ref: python/paddle/nn/functional/loss.py log_loss — elementwise
+    negative log likelihood of a probability input (no reduction)."""
+    return -(label * jnp.log(input + epsilon) +
+             (1 - label) * jnp.log(1 - input + epsilon))
+
+
+def log_sigmoid(x):
+    """ref: python/paddle/nn/functional/activation.py log_sigmoid —
+    stable -softplus(-x) form."""
+    return -softplus(-x)
+
+
 def cosine_similarity(x1, x2, axis: int = 1, eps: float = 1e-8):
     dot = (x1 * x2).sum(axis=axis)
     n1 = jnp.linalg.norm(x1, axis=axis)
@@ -728,6 +751,17 @@ def pad(x, pad: Sequence[int], mode: str = "constant", value: float = 0.0,
     if jmode == "constant":
         return jnp.pad(x, cfg, mode="constant", constant_values=value)
     return jnp.pad(x, cfg, mode=jmode)
+
+
+def pad3d(x, paddings, mode: str = "constant", value: float = 0.0,
+          data_format: str = "NCDHW"):
+    """5-D pad (ref: legacy_api.yaml pad3d; nn/functional/common.py pad
+    dispatches here for NCDHW). ``paddings``: 6 ints, innermost first
+    (w_before, w_after, h_before, h_after, d_before, d_after)."""
+    if x.ndim != 5:
+        raise ValueError(f"pad3d expects a 5-D tensor, got {x.ndim}-D")
+    return pad(x, list(paddings), mode=mode, value=value,
+               data_format=data_format)
 
 
 def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):

@@ -9,6 +9,8 @@ the reference (``x``, ``axis``, ``keepdim``), returning ``jax.Array``.
 
 from __future__ import annotations
 
+import builtins
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -644,3 +646,308 @@ def norm(x, p=2, axis=None, keepdim=False):
 
 def dist(x, y, p=2):
     return norm(x - y, p=p)
+
+
+def mv(x, vec):
+    """Matrix-vector product (ref: python/paddle/tensor/linalg.py mv)."""
+    return jnp.matmul(x, vec)
+
+
+def inverse(x):
+    """Batched matrix inverse (ref: legacy_api.yaml inverse)."""
+    return jnp.linalg.inv(x)
+
+
+def frobenius_norm(x, axis=None, keepdim=False):
+    """ref: legacy_api.yaml frobenius_norm — norm(p='fro') kernel form."""
+    axis = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return norm(x, p="fro", axis=axis, keepdim=keepdim)
+
+
+def p_norm(x, porder=2.0, axis=None, keepdim=False):
+    """ref: legacy_api.yaml p_norm — the vector-norm kernel behind
+    paddle.norm(p=float)."""
+    return norm(x, p=porder, axis=axis, keepdim=keepdim)
+
+
+# ---------------------------------------------------------------------------
+# complex (ref: python/paddle/tensor/attribute.py real/imag,
+# creation.py complex; kernels legacy_api.yaml angle/conj/complex)
+# ---------------------------------------------------------------------------
+
+def conj(x):
+    return jnp.conj(x)
+
+
+def real(x):
+    return jnp.real(x)
+
+
+def imag(x):
+    return jnp.imag(x)
+
+
+def angle(x):
+    return jnp.angle(x)
+
+
+def complex(real, imag):  # noqa: A002 — paddle API name
+    return jax.lax.complex(real, imag)
+
+
+# ---------------------------------------------------------------------------
+# search/statistic extras (ref: python/paddle/tensor/search.py kthvalue,
+# stat.py mode)
+# ---------------------------------------------------------------------------
+
+def kthvalue(x, k, axis=-1, keepdim=False):
+    """k-th SMALLEST value + its index along ``axis`` (1-based k, the
+    paddle convention; ref: python/paddle/tensor/search.py kthvalue)."""
+    idxs = jnp.argsort(x, axis=axis)
+    i = jnp.take(idxs, k - 1, axis=axis)
+    v = jnp.squeeze(jnp.take_along_axis(
+        x, jnp.expand_dims(i, axis % x.ndim), axis=axis), axis % x.ndim)
+    if keepdim:
+        v = jnp.expand_dims(v, axis)
+        i = jnp.expand_dims(i, axis)
+    return v, i
+
+
+def mode(x, axis=-1, keepdim=False):
+    """Most frequent value + an index of it along ``axis`` (ref: kernel
+    ``mode``, legacy_api.yaml). Sorted run-length scan: O(n log n),
+    static shapes, jit-safe. Ties resolve to the smallest tied value
+    (torch.mode convention); the index is the LAST occurrence in x."""
+    ax = axis % x.ndim
+    n = x.shape[ax]
+    xs = jnp.sort(x, axis=ax)
+    first = jnp.ones_like(jnp.take(xs, jnp.asarray([0]), axis=ax),
+                          dtype=bool)
+    is_new = jnp.concatenate([first, jnp.diff(xs, axis=ax) != 0], axis=ax)
+    idx_along = jnp.cumsum(jnp.ones(xs.shape, jnp.int32), axis=ax) - 1
+    run_start = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(is_new, idx_along, 0), axis=ax)
+    run_len = idx_along - run_start + 1
+    # max run ends at its last element; first argmax → smallest tied value
+    best = jnp.argmax(run_len, axis=ax)
+    mode_val = jnp.take_along_axis(xs, jnp.expand_dims(best, ax), axis=ax)
+    matches = x == jnp.broadcast_to(mode_val, x.shape)
+    mode_idx = n - 1 - jnp.argmax(jnp.flip(matches, axis=ax), axis=ax)
+    if keepdim:
+        mode_idx = jnp.expand_dims(mode_idx, ax)
+    else:
+        mode_val = jnp.squeeze(mode_val, ax)
+    return mode_val, mode_idx
+
+
+# ---------------------------------------------------------------------------
+# manipulation extras (ref: python/paddle/tensor/manipulation.py)
+# ---------------------------------------------------------------------------
+
+def diagonal(x, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    """Batched vectors → batched diagonal matrices (ref: python/paddle/
+    tensor/creation.py diag_embed)."""
+    n = x.shape[-1] + builtins.abs(offset)  # NB: module-level abs=jnp.abs
+    base = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+    idx = jnp.arange(x.shape[-1])
+    rows = idx + (-offset if offset < 0 else 0)
+    cols = idx + (offset if offset > 0 else 0)
+    out = base.at[..., rows, cols].set(x)
+    if (dim1, dim2) != (-2, -1):
+        out = jnp.moveaxis(out, (-2, -1), (dim1, dim2))
+    return out
+
+
+def expand_as(x, y):
+    return jnp.broadcast_to(x, y.shape)
+
+
+def increment(x, value=1.0):
+    return x + value
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None):
+    """Deduplicate CONSECUTIVE repeats (ref: python/paddle/tensor/
+    manipulation.py unique_consecutive). Output size is data-dependent —
+    host-side op (like unique), not for use under jit."""
+    xs = np.asarray(x)
+    if axis is None:
+        flat = xs.reshape(-1)
+        keep = np.concatenate([[True], flat[1:] != flat[:-1]])
+        out = flat[keep]
+        results = [jnp.asarray(out)]
+        if return_inverse:
+            results.append(jnp.asarray(np.cumsum(keep) - 1))
+        if return_counts:
+            idx = np.nonzero(keep)[0]
+            results.append(jnp.asarray(
+                np.diff(np.append(idx, flat.size))))
+        return results[0] if len(results) == 1 else tuple(results)
+    xs_m = np.moveaxis(xs, axis, 0)
+    neq = np.any(xs_m[1:] != xs_m[:-1],
+                 axis=tuple(range(1, xs_m.ndim)))
+    keep = np.concatenate([[True], neq])
+    out = np.moveaxis(xs_m[keep], 0, axis)
+    results = [jnp.asarray(out)]
+    if return_inverse:
+        results.append(jnp.asarray(np.cumsum(keep) - 1))
+    if return_counts:
+        idx = np.nonzero(keep)[0]
+        results.append(jnp.asarray(np.diff(np.append(idx, len(keep)))))
+    return results[0] if len(results) == 1 else tuple(results)
+
+
+def tril_indices(row, col=None, offset=0):
+    r, c = jnp.tril_indices(row, k=offset, m=col)
+    return jnp.stack([r, c])
+
+
+def triu_indices(row, col=None, offset=0):
+    r, c = jnp.triu_indices(row, k=offset, m=col)
+    return jnp.stack([r, c])
+
+
+# ---------------------------------------------------------------------------
+# creation extras (ref: python/paddle/tensor/creation.py empty/empty_like)
+# ---------------------------------------------------------------------------
+
+def empty(shape, dtype=None):
+    """XLA has no uninitialized-memory op; zeros is the honest lowering
+    (same cost after fusion) with paddle's empty() signature."""
+    return jnp.zeros(shape, _default_float(dtype))
+
+
+def empty_like(x, dtype=None):
+    return jnp.zeros_like(x, dtype and dtype_mod.dtype(dtype))
+
+
+# ---------------------------------------------------------------------------
+# math/misc kernel-parity ops (ref: paddle/phi/api/yaml/legacy_api.yaml)
+# ---------------------------------------------------------------------------
+
+erfinv = jax.lax.erf_inv
+
+
+def add_n(inputs):
+    """Sum a list of tensors (ref: legacy_api.yaml add_n / sum_op)."""
+    out = inputs[0]
+    for t in inputs[1:]:
+        out = out + t
+    return out
+
+
+def clip_by_norm(x, max_norm):
+    """Scale x so its L2 norm is at most ``max_norm`` (ref:
+    legacy_api.yaml clip_by_norm; fluid/layers clip_by_norm)."""
+    n = jnp.sqrt(jnp.sum(jnp.square(x)))
+    return x * (max_norm / jnp.maximum(n, max_norm))
+
+
+def logit(x, eps=None):
+    """log(p / (1-p)) (ref: legacy_api.yaml logit). With ``eps``, p is
+    clipped into [eps, 1-eps]; without, out-of-range p gives nan."""
+    if eps is not None:
+        x = jnp.clip(x, eps, 1.0 - eps)
+    return jnp.log(x) - jnp.log1p(-x)
+
+
+def poisson(x):
+    """Elementwise Poisson sample with rate x (ref: legacy_api.yaml
+    poisson); key drawn from the ambient rng stream like rand/randn."""
+    return jax.random.poisson(rng.next_key(), x).astype(x.dtype)
+
+
+def shape(x):
+    """Runtime shape as an int tensor (ref: paddle.shape; under jit
+    shapes are static, so this is a constant — the XLA contract)."""
+    return jnp.asarray(np.asarray(x.shape, np.int64))
+
+
+def slice(x, axes, starts, ends):  # noqa: A001 — paddle API name
+    """Static multi-axis slice (ref: legacy_api.yaml slice). ``starts``/
+    ``ends`` are python ints (negative allowed, ends clamped), matching
+    the reference's most common use; tensor indices are not supported —
+    under XLA a data-dependent slice is ``dynamic_slice`` with fixed
+    sizes, which paddle expresses via separate ops."""
+    idx = [builtins.slice(None)] * x.ndim
+    for ax, s, e in zip(axes, starts, ends):
+        idx[ax] = builtins.slice(s, e)
+    return x[tuple(idx)]
+
+
+def strided_slice(x, axes, starts, ends, strides):
+    """ref: legacy_api.yaml strided_slice (negative strides supported)."""
+    idx = [builtins.slice(None)] * x.ndim
+    for ax, s, e, st in zip(axes, starts, ends, strides):
+        idx[ax] = builtins.slice(s, e, st)
+    return x[tuple(idx)]
+
+
+def multiplex(inputs, index):
+    """Row-wise select among candidate tensors: out[i] =
+    inputs[index[i]][i] (ref: legacy_api.yaml multiplex)."""
+    stacked = jnp.stack(inputs)                      # [K, N, ...]
+    idx = jnp.asarray(index).reshape(-1)             # [N]
+    return stacked[idx, jnp.arange(stacked.shape[1])]
+
+
+def gather_tree(ids, parents):
+    """Beam-search back-trace (ref: legacy_api.yaml gather_tree;
+    fluid/layers/nn.py gather_tree). ``ids``/``parents``:
+    [max_time, batch, beam]; walks parent pointers backwards from the
+    final step so each output beam is a full, consistent sequence."""
+    ids = jnp.asarray(ids)
+    parents = jnp.asarray(parents)
+    T = ids.shape[0]
+    beam_idx0 = jnp.broadcast_to(jnp.arange(ids.shape[2]),
+                                 ids.shape[1:])       # [batch, beam]
+
+    def step(beam_idx, t):
+        out_t = jnp.take_along_axis(ids[t], beam_idx, axis=-1)
+        parent = jnp.take_along_axis(parents[t], beam_idx, axis=-1)
+        return parent, out_t
+
+    _, rev = jax.lax.scan(step, beam_idx0, jnp.arange(T - 1, -1, -1))
+    return jnp.flip(rev, axis=0)
+
+
+# ---------------------------------------------------------------------------
+# segment ops (ref: legacy_api.yaml segment_pool / graph_send_recv;
+# python/paddle/incubate/tensor/math.py segment_{sum,mean,max,min}).
+# ``num_segments`` static → jit-safe; default (None) reads the max id on
+# host (eager), matching the reference's data-dependent output size.
+# ---------------------------------------------------------------------------
+
+def _num_segments(segment_ids, num_segments):
+    if num_segments is not None:
+        return int(num_segments)
+    return int(np.asarray(segment_ids).max()) + 1
+
+
+def segment_sum(data, segment_ids, num_segments=None):
+    return jax.ops.segment_sum(data, segment_ids,
+                               _num_segments(segment_ids, num_segments))
+
+
+def segment_mean(data, segment_ids, num_segments=None):
+    n = _num_segments(segment_ids, num_segments)
+    s = jax.ops.segment_sum(data, segment_ids, n)
+    cnt = jax.ops.segment_sum(jnp.ones_like(segment_ids, data.dtype),
+                              segment_ids, n)
+    return s / jnp.maximum(cnt, 1).reshape(
+        (-1,) + (1,) * (data.ndim - 1))
+
+
+def segment_max(data, segment_ids, num_segments=None):
+    return jax.ops.segment_max(data, segment_ids,
+                               _num_segments(segment_ids, num_segments))
+
+
+def segment_min(data, segment_ids, num_segments=None):
+    return jax.ops.segment_min(data, segment_ids,
+                               _num_segments(segment_ids, num_segments))

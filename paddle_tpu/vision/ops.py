@@ -157,6 +157,150 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
     return jnp.stack(outs)
 
 
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0):
+    """Quantized max-pool RoI pooling (ref: vision/ops.py roi_pool;
+    phi/kernels roi_pool_kernel). Eager like roi_align's adaptive mode:
+    bin pixel counts are data-dependent, and rois are concrete in eval
+    pipelines. Empty bins yield 0."""
+    import numpy as np
+    x = jnp.asarray(x, jnp.float32)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    h, w = x.shape[2], x.shape[3]
+    b_np = np.round(np.asarray(boxes, np.float64) * spatial_scale)
+    img_idx = np.repeat(np.arange(len(boxes_num)), np.asarray(boxes_num))
+    outs = []
+    for k in range(b_np.shape[0]):
+        x1, y1, x2, y2 = b_np[k]
+        rh = max(y2 - y1 + 1, 1.0)
+        rw = max(x2 - x1 + 1, 1.0)
+        feat = x[int(img_idx[k])]
+        out = jnp.zeros((x.shape[1], ph, pw), x.dtype)
+        for i in range(ph):
+            hs = int(np.clip(np.floor(i * rh / ph) + y1, 0, h))
+            he = int(np.clip(np.ceil((i + 1) * rh / ph) + y1, 0, h))
+            for j in range(pw):
+                ws = int(np.clip(np.floor(j * rw / pw) + x1, 0, w))
+                we = int(np.clip(np.ceil((j + 1) * rw / pw) + x1, 0, w))
+                if he > hs and we > ws:
+                    out = out.at[:, i, j].set(
+                        feat[:, hs:he, ws:we].max(axis=(1, 2)))
+        outs.append(out)
+    return jnp.stack(outs)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size,
+               spatial_scale: float = 1.0, output_channels=None):
+    """Position-sensitive RoI average pooling (ref: vision/ops.py
+    psroi_pool; phi/kernels psroi_pool_kernel): input channels are
+    out_c * ph * pw; output bin (i, j) of channel c averages input
+    channel c*ph*pw + i*pw + j over the bin. Eager (see roi_pool)."""
+    import numpy as np
+    x = jnp.asarray(x, jnp.float32)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    c_in, h, w = x.shape[1], x.shape[2], x.shape[3]
+    if output_channels is None:
+        output_channels = c_in // (ph * pw)
+    if output_channels * ph * pw != c_in:
+        raise ValueError(
+            f"psroi_pool: channels {c_in} != out_c*{ph}*{pw}")
+    b_np = np.asarray(boxes, np.float64) * spatial_scale
+    img_idx = np.repeat(np.arange(len(boxes_num)), np.asarray(boxes_num))
+    outs = []
+    for k in range(b_np.shape[0]):
+        # reference rounds the roi to bin edges on the feature map
+        x1 = np.floor(b_np[k, 0]); y1 = np.floor(b_np[k, 1])
+        x2 = np.ceil(b_np[k, 2]); y2 = np.ceil(b_np[k, 3])
+        rh = max(y2 - y1, 0.1)
+        rw = max(x2 - x1, 0.1)
+        feat = x[int(img_idx[k])].reshape(output_channels, ph, pw, h, w)
+        out = jnp.zeros((output_channels, ph, pw), x.dtype)
+        for i in range(ph):
+            hs = int(np.clip(np.floor(y1 + i * rh / ph), 0, h))
+            he = int(np.clip(np.ceil(y1 + (i + 1) * rh / ph), 0, h))
+            for j in range(pw):
+                ws = int(np.clip(np.floor(x1 + j * rw / pw), 0, w))
+                we = int(np.clip(np.ceil(x1 + (j + 1) * rw / pw), 0, w))
+                if he > hs and we > ws:
+                    out = out.at[:, i, j].set(
+                        feat[:, i, j, hs:he, ws:we].mean(axis=(1, 2)))
+        outs.append(out)
+    return jnp.stack(outs)
+
+
+def temporal_shift(x, seg_num: int, shift_ratio: float = 0.25,
+                   data_format: str = "NCHW"):
+    """TSM channel shift along time (ref: legacy_api.yaml temporal_shift;
+    phi/kernels temporal_shift_kernel). x: [N*T, C, H, W]; the first
+    C*ratio channels take their value from t-1, the next C*ratio from
+    t+1, the rest stay — zero padded at the sequence ends. Pure
+    reshape/pad/slice: jittable, fuses to a copy."""
+    if data_format == "NHWC":
+        x = jnp.moveaxis(x, -1, 1)
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    xr = x.reshape(n, seg_num, c, h, w)
+    c1 = int(c * shift_ratio)
+    c2 = int(c * 2 * shift_ratio)
+    fwd = jnp.pad(xr[:, :-1, :c1], ((0, 0), (1, 0), (0, 0), (0, 0),
+                                    (0, 0)))          # from t-1
+    bwd = jnp.pad(xr[:, 1:, c1:c2], ((0, 0), (0, 1), (0, 0), (0, 0),
+                                     (0, 0)))         # from t+1
+    out = jnp.concatenate([fwd, bwd, xr[:, :, c2:]], axis=2)
+    out = out.reshape(nt, c, h, w)
+    if data_format == "NHWC":
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+def yolo_box(x, img_size, anchors, class_num: int, conf_thresh: float,
+             downsample_ratio: int, clip_bbox: bool = True,
+             scale_x_y: float = 1.0):
+    """YOLOv3 box decode (ref: vision/ops.py yolo_box; phi/kernels
+    yolo_box_kernel). x: [N, an*(5+class_num), H, W]; img_size: [N, 2]
+    (h, w). Returns (boxes [N, H*W*an, 4] xyxy in image coords,
+    scores [N, H*W*an, class_num]); predictions below ``conf_thresh``
+    get score 0 (static shapes — no host-side filtering)."""
+    x = jnp.asarray(x, jnp.float32)
+    n, _, h, w = x.shape
+    an = len(anchors) // 2
+    aw = jnp.asarray(anchors[0::2], jnp.float32)
+    ah = jnp.asarray(anchors[1::2], jnp.float32)
+    feats = x.reshape(n, an, 5 + class_num, h, w)
+    tx, ty, tw, th, tconf = (feats[:, :, i] for i in range(5))
+    tcls = feats[:, :, 5:]                      # [N, an, cls, H, W]
+    gx = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+    gy = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+    bias = 0.5 * (scale_x_y - 1.0)
+    cx = (jax.nn.sigmoid(tx) * scale_x_y - bias + gx) / w
+    cy = (jax.nn.sigmoid(ty) * scale_x_y - bias + gy) / h
+    input_w = float(downsample_ratio) * w
+    input_h = float(downsample_ratio) * h
+    bw = jnp.exp(tw) * aw[None, :, None, None] / input_w
+    bh = jnp.exp(th) * ah[None, :, None, None] / input_h
+    img_h = jnp.asarray(img_size, jnp.float32)[:, 0][:, None, None, None]
+    img_w = jnp.asarray(img_size, jnp.float32)[:, 1][:, None, None, None]
+    x1 = (cx - bw / 2) * img_w
+    y1 = (cy - bh / 2) * img_h
+    x2 = (cx + bw / 2) * img_w
+    y2 = (cy + bh / 2) * img_h
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0, img_w - 1)
+        y1 = jnp.clip(y1, 0, img_h - 1)
+        x2 = jnp.clip(x2, 0, img_w - 1)
+        y2 = jnp.clip(y2, 0, img_h - 1)
+    conf = jax.nn.sigmoid(tconf)
+    keep = conf > conf_thresh
+    scores = jax.nn.sigmoid(tcls) * jnp.where(keep, conf, 0.0)[:, :, None]
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1)      # [N, an, H, W, 4]
+    boxes = boxes.transpose(0, 2, 3, 1, 4).reshape(n, -1, 4)
+    scores = scores.transpose(0, 3, 4, 1, 2).reshape(n, -1, class_num)
+    return boxes, scores
+
+
 def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
                   dilation=1, deformable_groups: int = 1, groups: int = 1,
                   mask=None):
