@@ -1,0 +1,97 @@
+"""Worker functions for the real multi-process distributed tests
+(tests/test_dist_multiprocess.py). Top-level module so spawn's pickle
+can import them in the child.
+
+Every worker pins the CPU backend IN-CODE before any device query —
+the sandbox's sitecustomize pre-imports jax with the TPU plugin and a
+child process must never touch the (single-client) TPU tunnel."""
+
+import json
+import os
+
+
+def _pin_cpu_single_device():
+    import jax
+    # in-code config beats inherited XLA_FLAGS/JAX_PLATFORMS (those are
+    # too late/too weak once sitecustomize has imported jax)
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 1)
+    return jax
+
+
+def allreduce_and_dp_train(result_dir: str, steps: int = 10):
+    """Rank body: cross-process all-reduce + a short DP training run.
+    The analog of the reference's subprocess trainer bodies
+    (fluid/tests/unittests/test_dist_base.py:786 TestDistRunnerBase /
+    test_collective_api_base.py:19) — rank 0 records results for the
+    parent to compare against a single-process baseline."""
+    jax = _pin_cpu_single_device()
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu import nn, parallel
+    from paddle_tpu.parallel import collective
+
+    parallel.init_parallel_env()   # PADDLE_* env → jax.distributed
+    rank = jax.process_index()
+    nproc = jax.process_count()
+    assert nproc == 2, nproc
+    assert jax.device_count() == 2, jax.devices()
+
+    mesh = parallel.init_mesh(dp=2)
+
+    # 1) cross-process all-reduce (psum over the dp axis): each process
+    # contributes its local shard of a global [2] array
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    local = np.asarray([float(rank + 1)], np.float32)
+    x = jax.make_array_from_process_local_data(
+        NamedSharding(mesh.mesh, P("dp")), local)
+
+    summed = jax.jit(
+        jax.shard_map(lambda v: collective.psum(v, "dp"),
+                      mesh=mesh.mesh, in_specs=P("dp"), out_specs=P("dp")),
+    )(x)
+    allreduce_val = float(np.asarray(
+        summed.addressable_data(0)).ravel()[0])   # 1 + 2 = 3 everywhere
+
+    # 2) short DP training run, loss parity with single process
+    pt.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.GELU(), nn.Linear(16, 4))
+    model = pt.Model(net)
+    model.prepare(optimizer=pt.optimizer.AdamW(learning_rate=1e-2,
+                                               parameters=net),
+                  loss=nn.CrossEntropyLoss())
+    parallel.distributed_model(model, mesh=mesh)
+    rng = np.random.RandomState(0)
+    xs = rng.randn(steps, 8, 8).astype(np.float32)
+    ys = rng.randint(0, 4, (steps, 8, 1))
+    losses = []
+    for i in range(steps):
+        logs = model.train_batch([xs[i]], [ys[i]])
+        losses.append(float(logs["loss"]))
+
+    if rank == 0:
+        with open(os.path.join(result_dir, "rank0.json"), "w") as f:
+            json.dump({"allreduce": allreduce_val, "losses": losses}, f)
+
+
+def baseline_losses(steps: int = 10):
+    """Single-process dense reference for the DP parity check — run in
+    the PARENT process (already CPU-pinned by conftest)."""
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu import nn
+
+    pt.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.GELU(), nn.Linear(16, 4))
+    model = pt.Model(net)
+    model.prepare(optimizer=pt.optimizer.AdamW(learning_rate=1e-2,
+                                               parameters=net),
+                  loss=nn.CrossEntropyLoss())
+    rng = np.random.RandomState(0)
+    xs = rng.randn(steps, 8, 8).astype(np.float32)
+    ys = rng.randint(0, 4, (steps, 8, 1))
+    return [float(model.train_batch([xs[i]], [ys[i]])["loss"])
+            for i in range(steps)]
