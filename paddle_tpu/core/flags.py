@@ -131,6 +131,11 @@ define_flag("deterministic", False,
             "Prefer deterministic XLA lowerings "
             "(ref: FLAGS_cudnn_deterministic, platform/flags.cc:190).")
 define_flag("log_compiles", False, "Log XLA compilations of train steps.")
+define_flag("recompile_warn_threshold", 8,
+            "Warn when Model train/eval steps have seen more than this "
+            "many distinct input shapes (each one is a full XLA "
+            "recompile; pad or bucket variable-length data — see "
+            "io.sequence). 0 disables the guard.")
 define_flag("flash_attention", True,
             "Dispatch scaled_dot_product_attention to the Pallas flash "
             "kernel when the configuration supports it (analog of the "

@@ -65,6 +65,8 @@ class Model:
         # sharding hooks (set by parallel.DistributedModel)
         self._shard_params = None     # fn(params) -> sharded params
         self._shard_batch = None      # fn(batch) -> sharded batch
+        # recompile guard: distinct (shape, dtype) signatures seen
+        self._shape_signatures = set()
 
     # -- preparation --------------------------------------------------------
     def prepare(self, optimizer: Optional[Optimizer] = None, loss=None,
@@ -195,6 +197,30 @@ class Model:
         n_labels = len(self._labels_spec) if self._labels_spec else 1
         return batch[:-n_labels], batch[-n_labels:]
 
+    def _guard_recompiles(self, inputs, labels) -> None:
+        """Every distinct input shape recompiles the jitted step (XLA
+        static shapes — SURVEY §7 hard parts). Track the signatures seen
+        and warn once past FLAGS.recompile_warn_threshold, pointing at
+        the padding/bucketing tools (io.sequence)."""
+        thresh = flags.get_flag("recompile_warn_threshold")
+        if not thresh:
+            return
+        sig = tuple((tuple(np.shape(a)), str(getattr(a, "dtype", type(a))))
+                    for a in (*inputs, *labels))
+        seen = self._shape_signatures
+        if sig in seen:
+            return
+        seen.add(sig)
+        if len(seen) == thresh + 1:
+            import warnings
+            warnings.warn(
+                f"Model step has now seen {len(seen)} distinct input "
+                f"shapes; each one is a full XLA recompile. Pad or "
+                f"bucket variable-length data (io.sequence.pad_sequence "
+                f"/ LengthBucketBatchSampler), or raise "
+                f"FLAGS.recompile_warn_threshold if intentional.",
+                stacklevel=3)
+
     # -- batch-level API ----------------------------------------------------
     def train_batch(self, inputs, labels=None) -> Dict[str, Any]:
         """ref: hapi/model.py:1055."""
@@ -203,6 +229,7 @@ class Model:
             self._train_step_fn = self._build_train_step()
         inputs = _as_tuple(inputs)
         labels = _as_tuple(labels) if labels is not None else ()
+        self._guard_recompiles(inputs, labels)
         if self._shard_batch is not None:
             inputs = self._shard_batch(inputs)
             labels = self._shard_batch(labels)
@@ -241,6 +268,7 @@ class Model:
             self._eval_step_fn = self._build_eval_step()
         inputs = _as_tuple(inputs)
         labels = _as_tuple(labels) if labels is not None else ()
+        self._guard_recompiles(inputs, labels)
         if self._shard_batch is not None:
             inputs = self._shard_batch(inputs)
             labels = self._shard_batch(labels)

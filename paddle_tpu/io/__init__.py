@@ -527,3 +527,8 @@ class DataLoader:
         if self._iterable:
             raise TypeError("IterableDataset DataLoader has no len()")
         return len(self.batch_sampler)
+
+
+# variable-length sequence tools (XLA static-shape policy; SURVEY §7)
+from .sequence import (LengthBucketBatchSampler, bucket_collate,  # noqa: E402
+                       default_boundaries, pad_sequence)

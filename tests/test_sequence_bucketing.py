@@ -1,0 +1,141 @@
+"""Dynamic-shape policy (VERDICT r2 item 5): pad_sequence + length
+bucketing bound the distinct-XLA-compile count for variable-length data,
+and Model warns when a pipeline recompiles unboundedly.
+
+Reference being replaced: LoDTensor ragged batches
+(paddle/fluid/framework/lod_tensor.h) — on TPU the policy is dense
+padding over a finite bucket set (paddle_tpu/io/sequence.py)."""
+
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import io, nn
+from paddle_tpu.core import flags
+
+
+def test_pad_sequence_shapes_mask_truncation():
+    seqs = [np.arange(3), np.arange(7), np.arange(5)]
+    x, m = io.pad_sequence(seqs, return_mask=True)
+    assert x.shape == (3, 7)
+    np.testing.assert_allclose(m.sum(1), [3, 7, 5])
+    assert io.pad_sequence(seqs, max_len=4).shape == (3, 4)
+    np.testing.assert_allclose(io.pad_sequence(seqs, max_len=4)[1],
+                               [0, 1, 2, 3])  # truncated
+    assert io.pad_sequence(seqs, pad_to_multiple=8).shape == (3, 8)
+    # trailing feature dims pass through
+    x2 = io.pad_sequence([np.ones((2, 4)), np.ones((5, 4))])
+    assert x2.shape == (2, 5, 4)
+
+
+def test_bucket_sampler_batches_are_single_bucket():
+    lengths = [3, 30, 5, 60, 7, 62, 4, 31, 6, 61]
+    data = list(range(len(lengths)))
+    s = io.LengthBucketBatchSampler(data, lengths, batch_size=2,
+                                    boundaries=[8, 32, 64])
+    batches = list(s)
+    assert sum(len(b) for b in batches) == len(data)
+    for b in batches:
+        bl = {s.bucket_of[i] for i in b}
+        assert len(bl) == 1, f"mixed-bucket batch {b}"
+    assert len(s) == len(batches)
+    with pytest.raises(ValueError, match="exceeds"):
+        io.LengthBucketBatchSampler(data, [100], 2, boundaries=[8])
+
+
+def _imdb_tree(tmp_path):
+    rng = np.random.RandomState(0)
+    words_pos = "great movie loved it wonderful superb".split()
+    words_neg = "terrible movie hated it awful poor".split()
+    for split in ("train", "test"):
+        for label, words in (("pos", words_pos), ("neg", words_neg)):
+            d = tmp_path / "aclImdb" / split / label
+            os.makedirs(d)
+            for i in range(16):
+                n = int(rng.randint(3, 40))  # variable lengths
+                (d / f"{i}.txt").write_text(
+                    " ".join(rng.choice(words, n)))
+
+
+def test_imdb_bucketed_training_bounded_compiles(tmp_path):
+    """Imdb with ragged reviews: bucketed batches keep the jitted train
+    step at <= n_buckets distinct shapes while the loss trains."""
+    from paddle_tpu import text
+
+    _imdb_tree(tmp_path)
+    ds = text.Imdb(str(tmp_path), mode="train", cutoff=0)
+    vocab = len(ds.word_idx)
+    boundaries = [8, 16, 64]
+    sampler = io.LengthBucketBatchSampler(
+        ds, lengths=lambda item: len(item[0]), batch_size=4,
+        boundaries=boundaries, shuffle=True, drop_last=True)
+    loader = io.DataLoader(ds, batch_sampler=sampler,
+                           collate_fn=io.bucket_collate(sampler))
+
+    class Clf(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.emb = nn.Embedding(vocab, 16)
+            self.fc = nn.Linear(16, 2)
+
+        def forward(self, ids):
+            return self.fc(self.emb(ids).mean(axis=1))
+
+    pt.seed(0)
+    model = pt.Model(Clf())
+    model.prepare(optimizer=pt.optimizer.Adam(learning_rate=5e-3,
+                                              parameters=model.network),
+                  loss=nn.CrossEntropyLoss())
+    losses = []
+    for _ in range(4):
+        for batch in loader:
+            ids, label = batch
+            logs = model.train_batch([ids], [np.asarray(label)[:, None]])
+            losses.append(float(logs["loss"]))
+    # the compile-count bound: one signature per bucket, nothing else
+    assert len(model._shape_signatures) <= len(boundaries), \
+        model._shape_signatures
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-4:]) < np.mean(losses[:4])
+
+
+def test_recompile_guard_warns_on_unbounded_shapes():
+    pt.seed(0)
+    net = nn.Linear(4, 2)
+    model = pt.Model(net)
+    model.prepare(optimizer=pt.optimizer.SGD(learning_rate=0.1,
+                                             parameters=net),
+                  loss=nn.MSELoss())
+    old = flags.get_flag("recompile_warn_threshold")
+    flags.set_flags({"recompile_warn_threshold": 3})
+    try:
+        with pytest.warns(UserWarning, match="distinct input shapes"):
+            for b in range(1, 6):   # 5 distinct batch sizes
+                x = np.ones((b, 4), np.float32)
+                y = np.zeros((b, 2), np.float32)
+                model.train_batch([x], [y])
+    finally:
+        flags.set_flags({"recompile_warn_threshold": old})
+
+
+def test_recompile_guard_silent_when_shapes_stable():
+    pt.seed(0)
+    net = nn.Linear(4, 2)
+    model = pt.Model(net)
+    model.prepare(optimizer=pt.optimizer.SGD(learning_rate=0.1,
+                                             parameters=net),
+                  loss=nn.MSELoss())
+    old = flags.get_flag("recompile_warn_threshold")
+    flags.set_flags({"recompile_warn_threshold": 3})
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            for _ in range(8):
+                x = np.ones((2, 4), np.float32)
+                y = np.zeros((2, 2), np.float32)
+                model.train_batch([x], [y])
+    finally:
+        flags.set_flags({"recompile_warn_threshold": old})
