@@ -139,10 +139,13 @@ def get_strategy() -> Optional[DistributedStrategy]:
 
 # -- parameter-server lifecycle (deliberately unsupported) ------------------
 
-_PS_MSG = ("the parameter-server runtime is replaced by sharded "
+_PS_MSG = ("the parameter-server runtime is replaced by (a) sharded "
            "SparseEmbedding tables over the mesh (nn.SparseEmbedding; "
-           "SURVEY §7 step 8) — run collective mode: "
-           "fleet.init(is_collective=True)")
+           "SURVEY §7 step 8) for tables that fit pod HBM, and (b) "
+           "host-RAM tables with streamed pull/push for beyond-HBM "
+           "vocabularies (nn.HostOffloadedEmbedding — the "
+           "MemorySparseTable/communicator redesign) — run collective "
+           "mode: fleet.init(is_collective=True)")
 
 
 def init_worker(*a, **kw):

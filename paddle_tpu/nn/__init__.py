@@ -36,6 +36,7 @@ from .layers.moe import (GShardGate, MoELayer, NaiveGate,  # noqa
                          SwitchGate, collect_aux_losses)
 from .layers.sparse_embedding import (MultiSlotEmbedding,  # noqa
                                       SparseEmbedding)
+from .layers.host_embedding import HostOffloadedEmbedding  # noqa
 from .layers.rnn import (GRU, LSTM, RNN, BiRNN, GRUCell, LSTMCell,  # noqa
                          SimpleRNN, SimpleRNNCell)
 from .layers.transformer import (MultiHeadAttention, Transformer,  # noqa

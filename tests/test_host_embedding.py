@@ -1,0 +1,157 @@
+"""Beyond-HBM embedding path (VERDICT r2 item 4): host-RAM table with
+streamed pull/push (paddle_tpu/nn/layers/host_embedding.py) — the
+MemorySparseTable / communicator / sparse_sgd_rule redesign.
+
+Key claims tested mechanically:
+- device memory of the compiled train step is INDEPENDENT of table size
+  (the whole point of beyond-HBM),
+- per-row accessor rules match hand math, duplicates merge before the
+  rule step,
+- lazy init is deterministic regardless of touch order,
+- snapshot/restore resumes training losslessly,
+- WideDeep-style training with a table far larger than any batch works
+  end to end under jit."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.nn.layers.host_embedding import HostOffloadedEmbedding
+
+import jax
+import jax.numpy as jnp
+
+
+def test_lookup_matches_host_rows_and_lazy_init_deterministic():
+    pt.seed(0)
+    a = HostOffloadedEmbedding(1000, 8, seed=7)
+    b = HostOffloadedEmbedding(1000, 8, seed=7)
+    ids1 = np.array([[5, 9], [3, 5]])
+    ids2 = np.array([[3, 9], [5, 3]])
+    out_a = np.asarray(a(ids1))           # a touches 5,9,3 in this order
+    _ = np.asarray(b(ids2))               # b touches 3,9,5 first
+    out_b2 = np.asarray(b(ids1))
+    np.testing.assert_allclose(out_a, out_b2, rtol=1e-6)
+    assert a.touched_rows == 3
+
+
+def test_pull_under_jit_and_grad_updates_host_table():
+    """Differentiating the model params (the real training shape) fires
+    the push: each touched row steps by -lr * dL/drow."""
+    from paddle_tpu.nn.layer import functional_call, split_state
+
+    pt.seed(0)
+    e = HostOffloadedEmbedding(100, 4, optimizer="sgd", learning_rate=1.0,
+                               padding_idx=None, combiner="sum")
+    params, _ = split_state(e)
+    ids = jnp.asarray([[1, 2]])
+    before = e._pull(np.array([1, 2])).copy()
+
+    @jax.jit
+    def loss(p, ids):
+        out, _ = functional_call(e, p, {}, ids)
+        return out.sum()
+
+    g = jax.grad(loss)(params, ids)
+    jax.effects_barrier()
+    # anchor's own grad is exactly zero (it never moves)
+    np.testing.assert_allclose(np.asarray(g["push_anchor"]), 0.0)
+    # d(sum of pooled)/d(row) = 1 per touched id; lr=1 → row -= 1
+    after = e._pull(np.array([1, 2]))
+    np.testing.assert_allclose(after, before - 1.0, rtol=1e-6)
+
+
+def test_adagrad_rule_and_duplicate_merge():
+    e = HostOffloadedEmbedding(100, 2, optimizer="adagrad",
+                               learning_rate=0.5, initial_accumulator=0.1,
+                               padding_idx=None)
+    row = e._pull(np.array([4]))[0].copy()
+    # duplicate id in one batch: grads merge BEFORE the rule step
+    e._push(np.array([4, 4]), np.array([[1.0, 0.0], [1.0, 0.0]]))
+    acc = 0.1 + 2.0 ** 2
+    expect = row - 0.5 * np.array([2.0, 0.0]) / np.sqrt([acc, 1e30])
+    got = e._pull(np.array([4]))[0]
+    np.testing.assert_allclose(got[0], expect[0], rtol=1e-6)
+    np.testing.assert_allclose(got[1], row[1], rtol=1e-6)  # zero grad dim
+    assert pytest.raises(ValueError, HostOffloadedEmbedding, 10, 2,
+                         optimizer="ftrl")
+
+
+def test_device_memory_independent_of_table_size():
+    """The compiled step's device buffers must not scale with
+    num_embeddings — the table never lands in HBM."""
+    def step_bytes(n_table):
+        e = HostOffloadedEmbedding(n_table, 16)
+        fc = nn.Linear(16, 1)
+        from paddle_tpu.nn.layer import functional_call, split_state
+        params, _ = split_state(fc)
+
+        def loss(p, ids):
+            pooled = e(ids)
+            out, _ = functional_call(fc, p, {}, pooled)
+            return out.sum()
+
+        ids = jnp.asarray(np.random.RandomState(0).randint(
+            1, n_table, (8, 4)))
+        compiled = jax.jit(jax.grad(loss)).lower(params, ids).compile()
+        mem = compiled.memory_analysis()
+        return (mem.temp_size_in_bytes + mem.argument_size_in_bytes +
+                mem.output_size_in_bytes)
+
+    small = step_bytes(10_000)
+    huge = step_bytes(50_000_000)   # 50M x 16 f32 = 3.2 GB if dense
+    assert huge == small, (small, huge)
+
+
+def test_widedeep_style_training_with_large_table(tmp_path):
+    """End-to-end: wide (host-offloaded sparse) + deep tower trains under
+    Model.train_batch, loss decreases, snapshot/restore is lossless."""
+    pt.seed(0)
+    table = HostOffloadedEmbedding(1_000_000, 8, optimizer="adagrad",
+                                   learning_rate=0.1, hash_ids=True)
+
+    class WideDeep(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.sparse = table
+            self.deep = nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                                      nn.Linear(16, 1))
+
+        def forward(self, ids, dense):
+            return self.deep(dense) + self.sparse(ids) @ jnp.ones((8, 1))
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(1, 1_000_000, (64, 4))
+    dense = rng.randn(64, 8).astype(np.float32)
+    y = ((ids.sum(1, keepdims=True) % 7) > 3).astype(np.float32)
+
+    model = pt.Model(WideDeep())
+    model.prepare(optimizer=pt.optimizer.Adam(
+        learning_rate=5e-3, parameters=model.network),
+        loss=nn.BCEWithLogitsLoss())
+    # probe the host table via folded ids (hash_ids maps raw -> range);
+    # an eager forward would read the donated anchor buffer post-train
+    folded = np.asarray(table._fold_ids(jnp.asarray(ids[:1])))
+    rows_before = table._pull(folded).copy()
+    losses = [float(model.train_batch([ids, dense], [y])["loss"])
+              for _ in range(30)]
+    jax.effects_barrier()
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-5:]) < 0.8 * np.mean(losses[:5]), losses[:3]
+    assert table.touched_rows > 0
+    # the HOST table itself trained (push fired), not just the deep tower
+    assert not np.allclose(table._pull(folded), rows_before)
+
+    # snapshot → clear → restore → identical lookup
+    snap = str(tmp_path / "table.npz")
+    table.snapshot(snap)
+    probe_ids = np.asarray(table._fold_ids(jnp.asarray(ids[:2])))
+    probe = table._pull(probe_ids).copy()
+    fresh = HostOffloadedEmbedding(1_000_000, 8, optimizer="adagrad",
+                                   learning_rate=0.1, hash_ids=True)
+    fresh.restore(snap)
+    np.testing.assert_allclose(fresh._pull(probe_ids), probe, rtol=1e-6)
+    bad = HostOffloadedEmbedding(999, 8)
+    with pytest.raises(ValueError, match="snapshot shape"):
+        bad.restore(snap)
