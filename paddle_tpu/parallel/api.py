@@ -183,9 +183,14 @@ def distributed_model(model, strategy: Optional[DistributedStrategy] = None,
                         "auto-parallel planner predicts an OOM on every "
                         f"layout; using the smallest footprint: "
                         f"{best.describe()}")
-                mesh = init_mesh(**{k: v for k, v in best.axes.items()
-                                    if v > 1} or {"dp": -1})
+                from .mesh import init_mesh_from_axes
+                mesh = init_mesh_from_axes(best.axes)
                 model._plan = best
+                # context for verify_plan's measured-memory re-plan loop
+                model._planner_ctx = {
+                    "n_devices": jax.device_count(),
+                    "global_batch": global_batch, "seq_len": seq_len,
+                    "rules": rules, "chip": None}
             else:
                 axes = strategy.mesh_axes() if strategy else {"dp": -1}
                 mesh = init_mesh(**(axes or {"dp": -1}))

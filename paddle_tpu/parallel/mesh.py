@@ -152,6 +152,13 @@ def init_mesh(devices: Optional[Sequence] = None,
     return m
 
 
+def init_mesh_from_axes(axes: Dict[str, int]) -> DeviceMesh:
+    """Install a mesh from a planner-style axes dict, dropping size-1
+    axes (falls back to a full-width dp axis when nothing is >1)."""
+    live = {k: v for k, v in axes.items() if v > 1}
+    return init_mesh(**(live or {"dp": -1}))
+
+
 def get_mesh(required: bool = True) -> Optional[DeviceMesh]:
     if _current_mesh is None and required:
         raise RuntimeError(

@@ -167,6 +167,7 @@ def _stats_for(shapes, logical, hints, axes: Dict[str, int],
 
     param_total = 0.0
     param_sharded = 0.0
+    used_axes = set()
     for name, shape in shapes.items():
         size = math.prod(shape) or 1
         spec = rules.mesh_axes(logical[name], shape, mesh)
@@ -175,6 +176,7 @@ def _stats_for(shapes, logical, hints, axes: Dict[str, int],
             for ax in (entry if isinstance(entry, tuple) else (entry,)):
                 if ax is not None:
                     div *= axes.get(ax, 1)
+                    used_axes.add(ax)
         param_total += size * param_dtype_bytes
         param_sharded += size * param_dtype_bytes / div
 
@@ -200,7 +202,12 @@ def _stats_for(shapes, logical, hints, axes: Dict[str, int],
 
     n_params = param_total / param_dtype_bytes
     tokens_local = b_local * seq_len
-    flops_per_chip = 6.0 * n_params * tokens_local / tp
+    # tp divides compute ONLY when the rule table actually sharded a
+    # param over it — a tp axis no rule binds replicates work, it does
+    # not split it (the old unconditional /tp made tp look free on
+    # models it cannot shard)
+    tp_eff = tp if "tp" in used_axes else 1
+    flops_per_chip = 6.0 * n_params * tokens_local / tp_eff
 
     # ICI bytes per step per chip (ring costs):
     comm = 0.0
@@ -217,6 +224,10 @@ def _stats_for(shapes, logical, hints, axes: Dict[str, int],
         # 2 in backward, on [b_local, s, h]
         act_blk = b_local * seq_len * h * act_dtype_bytes
         comm += 4.0 * layers * 2.0 * (tp - 1) / tp * act_blk
+    elif tp_eff > 1:
+        # non-transformer fallback: row-parallel matmuls still allreduce
+        # their activations; charge one fwd+bwd pair on the act estimate
+        comm += 4.0 * (tp - 1) / tp * act_bytes
 
     return ModelStats(param_sharded, param_total, grad_sharded,
                       opt_sharded, act_bytes, flops_per_chip, comm)
@@ -234,11 +245,11 @@ def _infer_seq_len(seq_len: Optional[int], hints: Dict[str, float]) -> int:
 def _evaluate(shapes, logical, hints, axes: Dict[str, int],
               global_batch: int, seq_len: int, chip: ChipSpec,
               rules: LogicalRules, param_dtype_bytes: int,
-              act_dtype_bytes: int) -> Plan:
+              act_dtype_bytes: int, hbm_scale: float = 1.0) -> Plan:
     s = _stats_for(shapes, logical, hints, axes, global_batch, seq_len,
                    rules, param_dtype_bytes, act_dtype_bytes)
-    hbm = s.param_bytes_sharded + s.grad_bytes_sharded + \
-        s.opt_bytes_sharded + s.act_bytes
+    hbm = (s.param_bytes_sharded + s.grad_bytes_sharded +
+           s.opt_bytes_sharded + s.act_bytes) * hbm_scale
     limit = chip.hbm_bytes * chip.hbm_headroom
     compute_t = s.flops_per_chip / chip.peak_flops
     comm_t = s.comm_bytes / chip.ici_bytes_per_s
@@ -277,7 +288,8 @@ def plan(net, n_devices: int, global_batch: int,
          rules: Optional[LogicalRules] = None,
          param_dtype_bytes: int = 4,
          act_dtype_bytes: int = 2,
-         return_all: bool = False):
+         return_all: bool = False,
+         hbm_scale: float = 1.0):
     """Choose (dp, fsdp, tp) for ``net`` on ``n_devices`` chips.
 
     Enumerates every factorization, drops layouts that exceed HBM or that
@@ -298,7 +310,8 @@ def plan(net, n_devices: int, global_batch: int,
             continue
         cands.append(_evaluate(shapes, logical, hints, axes,
                                global_batch, seq, chip, rules,
-                               param_dtype_bytes, act_dtype_bytes))
+                               param_dtype_bytes, act_dtype_bytes,
+                               hbm_scale))
     if not cands:
         raise ValueError(
             f"no mesh factorization of {n_devices} devices divides "
@@ -309,3 +322,99 @@ def plan(net, n_devices: int, global_batch: int,
     else:
         best = min(cands, key=lambda p: p.hbm_bytes)
     return (best, cands) if return_all else best
+
+
+# ---------------------------------------------------------------------------
+# closing the loop: analytic plan vs XLA's compiled memory analysis
+# (ref: auto_parallel/cost_model.py — the reference calibrates its cost
+# model from measured op benchmarks; here the calibration source is the
+# compiler's own memory analysis of the ACTUAL compiled step)
+# ---------------------------------------------------------------------------
+
+def measured_step_bytes(model, inputs, labels=()) -> float:
+    """Per-device bytes of the compiled train step (arguments + XLA
+    temporaries; outputs alias donated inputs and are not re-counted).
+    Compiles (cached) without executing."""
+    from ..core import rng
+    model._sync_state_in()
+    if model._train_step_fn is None:
+        model._train_step_fn = model._build_train_step()
+    inputs = tuple(inputs)
+    labels = tuple(labels)
+    if model._shard_batch is not None:
+        inputs = model._shard_batch(inputs)
+        labels = model._shard_batch(labels)
+    key = rng.split_for_step(0)
+    lowered = model._train_step_fn.lower(
+        model._params, model._frozen, model._opt_state, model._buffers,
+        0, key, inputs, labels)
+    mem = lowered.compile().memory_analysis()
+    # memory_analysis reports PER-DEVICE sizes (replicated arguments
+    # count at full size on each device, sharded ones at shard size)
+    return float(mem.temp_size_in_bytes + mem.argument_size_in_bytes)
+
+
+def verify_plan(model, inputs, labels=(), tolerance: float = 2.0,
+                replan: bool = True, chip: Optional[ChipSpec] = None):
+    """Check the auto-parallel plan against the compiled step and
+    re-plan if the analytic estimate was badly off.
+
+    Compares ``model._plan.hbm_bytes`` (prediction) with the compiled
+    step's measured per-device bytes. If measured exceeds
+    ``tolerance × predicted`` or the chip budget, the planner re-runs
+    with ``hbm_scale = measured/predicted`` (every candidate's footprint
+    corrected by the observed calibration factor); a changed layout is
+    re-installed on the model (state re-shards on the next step).
+    Returns (report dict, plan-in-effect)."""
+    import warnings
+
+    plan_obj = getattr(model, "_plan", None)
+    ctx = getattr(model, "_planner_ctx", None)
+    if plan_obj is None or ctx is None:
+        raise ValueError(
+            "model has no auto-parallel plan; use "
+            "distributed_model(model, global_batch=...) first")
+    chip = chip or ctx.get("chip") or ChipSpec()
+    measured = measured_step_bytes(model, inputs, labels)
+    predicted = max(plan_obj.hbm_bytes, 1.0)
+    ratio = measured / predicted
+    report = {"predicted_bytes": predicted, "measured_bytes": measured,
+              "ratio": ratio, "replanned": False}
+    over_budget = measured > chip.hbm_bytes * chip.hbm_headroom
+    if ratio <= tolerance and not over_budget:
+        return report, plan_obj
+    warnings.warn(
+        f"auto-parallel plan mis-estimate: predicted "
+        f"{predicted / _GiB:.2f} GiB/chip, compiled step uses "
+        f"{measured / _GiB:.2f} GiB/chip (x{ratio:.1f})"
+        + ("; over the HBM budget" if over_budget else "")
+        + ("; re-planning with the measured calibration"
+           if replan else ""))
+    if not replan:
+        return report, plan_obj
+    from . import api as _api
+    from .mesh import init_mesh_from_axes
+    new = plan(model.network, n_devices=ctx["n_devices"],
+               global_batch=ctx["global_batch"], seq_len=ctx["seq_len"],
+               chip=chip, rules=ctx["rules"], hbm_scale=ratio)
+    report["replanned"] = True
+    report["new_axes"] = dict(new.axes)
+    if not new.fits:
+        warnings.warn(
+            "re-planned layout still exceeds the calibrated HBM budget "
+            f"on every factorization (best: {new.describe()}); "
+            "installing the smallest footprint — expect OOM unless the "
+            "model shrinks or devices are added")
+    model._plan = new
+    if new.axes == plan_obj.axes:
+        return report, new
+    # install the corrected layout; device state re-shards lazily
+    model._sync_state_out()
+    model._params = None
+    model._opt_state = None
+    model._train_step_fn = None
+    model._eval_step_fn = None
+    _api.distributed_model(model, mesh=init_mesh_from_axes(new.axes),
+                           rules=ctx["rules"])
+    model._plan = new
+    return report, new

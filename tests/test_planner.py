@@ -121,3 +121,68 @@ def test_distributed_model_auto_plans_mesh():
         assert np.isfinite(logs["loss"])
     finally:
         parallel.set_mesh(None)
+
+
+def test_verify_plan_corrects_bad_estimate():
+    """VERDICT r2 item 7: close the planner loop. A model whose
+    activations the fallback estimator badly understates gets planned
+    dp-only; verify_plan measures the compiled step via XLA's memory
+    analysis, detects the mis-estimate against a tight chip budget, and
+    re-plans with the measured calibration — landing on a sharded layout
+    that actually fits."""
+    from paddle_tpu import nn
+    from paddle_tpu.parallel import planner
+
+    pt.seed(0)
+
+    class WideMLP(nn.Layer):
+        """Params tiny, activations huge: the non-transformer fallback
+        (act ~ 2x params) underestimates by >2x."""
+
+        def __init__(self):
+            super().__init__()
+            self.up = nn.Linear(8, 4096, axes=(None, "embed"))
+            self.down = nn.Linear(4096, 8, axes=("embed", None))
+
+        def forward(self, x):
+            return self.down(pt.nn.functional.gelu(self.up(x)))
+
+    def fresh_model():
+        net = WideMLP()
+        m = pt.Model(net)
+        m.prepare(optimizer=pt.optimizer.AdamW(learning_rate=1e-3,
+                                               parameters=net),
+                  loss=nn.MSELoss())
+        return m
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(512, 8).astype(np.float32)
+    y = rng.randn(512, 8).astype(np.float32)
+
+    try:
+        # pass 1: learn this model's true compiled footprint
+        probe = fresh_model()
+        parallel.distributed_model(probe, global_batch=512)
+        measured = planner.measured_step_bytes(probe, (x,), (y,))
+        predicted = probe._plan.hbm_bytes
+        assert measured > 2.0 * predicted, (measured, predicted)
+        parallel.set_mesh(None)
+
+        # pass 2: a chip whose budget the dp-only layout exceeds
+        chip = planner.ChipSpec(hbm_bytes=measured * 0.7)
+        model = fresh_model()
+        parallel.distributed_model(model, global_batch=512)
+        old_axes = dict(model._plan.axes)
+        with pytest.warns(UserWarning, match="mis-estimate"):
+            report, new_plan = planner.verify_plan(
+                model, (x,), (y,), tolerance=2.0, chip=chip)
+        assert report["replanned"]
+        assert new_plan.axes != old_axes, new_plan.axes
+        # the corrected layout shards the model/data axes
+        assert max(new_plan.axes.get("fsdp", 1),
+                   new_plan.axes.get("tp", 1)) > 1
+        # and the model still trains under the re-installed mesh
+        logs = model.train_batch([x], [y])
+        assert np.isfinite(float(logs["loss"]))
+    finally:
+        parallel.set_mesh(None)
