@@ -44,6 +44,12 @@ from typing import Dict, List, Optional
 HB_DIR_ENV = "PADDLE_ELASTIC_HB_DIR"
 RESTART_COUNT_ENV = "PADDLE_ELASTIC_RESTART_COUNT"
 
+# A rank exiting with this code means "I was preempted, my state is
+# checkpointed, restart me" — the launcher restarts WITHOUT burning the
+# failure budget (the reference maps etcd scale-down events to
+# ElasticStatus.RESTART the same way, manager.py:248-252).
+RESTART_EXIT_CODE = 67
+
 
 class ElasticStatus(enum.Enum):
     """ref: elastic/manager.py ElasticStatus."""
@@ -109,6 +115,64 @@ def restart_count() -> int:
     return int(os.environ.get(RESTART_COUNT_ENV, 0))
 
 
+class PreemptionGuard:
+    """Graceful-preemption handler — THE TPU preemption story: the
+    platform delivers SIGTERM with a grace period before evicting a VM;
+    the rank must reach a step boundary, checkpoint, and exit asking to
+    be restarted (:data:`RESTART_EXIT_CODE`).
+
+    ref: the reference handles the analogous etcd scale-down signal in
+    fleet/elastic/manager.py:131 (watcher → ElasticStatus.RESTART) and
+    relies on auto_checkpoint for state; here the signal is POSIX and
+    the checkpoint hook runs in the training loop's own thread (a
+    signal handler must not serialize device state itself — it only
+    sets a flag, so a mid-step signal never corrupts a save).
+
+    Usage::
+
+        guard = PreemptionGuard()
+        acp = AutoCheckpoint(dir, model, ...)
+        for step in acp.epochs(total_steps):     # any granularity
+            model.train_batch(...)
+            guard.check(save=lambda: acp.commit(step))  # exits 67 if hit
+    """
+
+    def __init__(self, signals=(signal.SIGTERM,), install: bool = True):
+        self._triggered = threading.Event()
+        self._prev = {}
+        if install:
+            for s in signals:
+                self._prev[s] = signal.signal(s, self._handler)
+
+    def _handler(self, signum, frame):
+        self._triggered.set()
+
+    def trigger(self) -> None:
+        """Programmatic preemption (tests; cloud notice pollers)."""
+        self._triggered.set()
+
+    @property
+    def triggered(self) -> bool:
+        return self._triggered.is_set()
+
+    def uninstall(self) -> None:
+        for s, prev in self._prev.items():
+            signal.signal(s, prev)
+        self._prev = {}
+
+    def check(self, save=None, exit: bool = True) -> bool:
+        """At a step boundary: if preemption was signalled, run ``save``
+        (the final checkpoint), then exit with RESTART_EXIT_CODE. With
+        ``exit=False`` returns True instead (caller drains and exits)."""
+        if not self._triggered.is_set():
+            return False
+        if save is not None:
+            save()
+        if exit:
+            sys.exit(RESTART_EXIT_CODE)
+        return True
+
+
 # ---------------------------------------------------------------------------
 # launcher side
 # ---------------------------------------------------------------------------
@@ -136,7 +200,8 @@ class ElasticManager:
         self.heartbeat_timeout = heartbeat_timeout
         self.env_extra = env_extra or {}
         self.poll_interval = poll_interval
-        self.restarts = 0
+        self.restarts = 0      # failure-budget consumption only
+        self.generation = 0    # every respawn (failures AND preemptions)
 
     # -- one generation ------------------------------------------------
     def _spawn(self) -> None:
@@ -146,12 +211,12 @@ class ElasticManager:
         if self.heartbeat_timeout is not None:
             if self.log_dir:
                 self._hb_dir = os.path.join(
-                    self.log_dir, f"elastic_hb_gen{self.restarts}")
+                    self.log_dir, f"elastic_hb_gen{self.generation}")
             else:
                 import tempfile
                 self._hb_dir = os.path.join(
                     tempfile.gettempdir(),
-                    f"pt_elastic_hb_{os.getpid()}_{self.restarts}")
+                    f"pt_elastic_hb_{os.getpid()}_{self.generation}")
             os.makedirs(self._hb_dir, exist_ok=True)
             # leftover beats from a previous run sharing this dir would
             # read as instantly-stale and restart a healthy generation
@@ -164,7 +229,7 @@ class ElasticManager:
             env = dict(os.environ)
             env.update(self.env_extra)
             env.update(trainer_env(rank, self.nproc, self.master))
-            env[RESTART_COUNT_ENV] = str(self.restarts)
+            env[RESTART_COUNT_ENV] = str(self.generation)
             if self.heartbeat_timeout is not None:
                 env[HB_DIR_ENV] = self._hb_dir
             stdout = None
@@ -244,19 +309,50 @@ class ElasticManager:
             self._teardown()
 
     # -- the job -------------------------------------------------------
-    def run(self) -> int:
-        """Run to completion with restarts; return the exit code."""
+    def run(self, max_preemptions: int = 64) -> int:
+        """Run to completion with restarts; return the exit code.
+
+        A rank exiting :data:`RESTART_EXIT_CODE` (graceful preemption:
+        checkpoint written, asking to be rescheduled) restarts WITHOUT
+        consuming the failure budget, bounded only by
+        ``max_preemptions`` as a runaway backstop."""
+        preemptions = 0
         while True:
             self._spawn()
             status, code = self._watch_generation()
             if status is ElasticStatus.COMPLETED:
                 return 0
-            self.restarts += 1
-            if self.restarts > self.max_restarts:
-                return code if code != 0 else 1
-            print(f"[elastic] restart {self.restarts}/{self.max_restarts}"
-                  f" after {'stall' if code == -1 else f'exit {code}'}",
-                  file=sys.stderr)
+            self.generation += 1
+            if code == RESTART_EXIT_CODE:
+                preemptions += 1
+                if preemptions > max_preemptions:
+                    # NOT 67: exiting 67 here would tell any outer
+                    # supervisor "restart me for free", defeating the
+                    # runaway backstop the moment it fires
+                    return 1
+                print(f"[elastic] preempted rank checkpointed; restart "
+                      f"{preemptions} (budget-free)", file=sys.stderr)
+            else:
+                self.restarts += 1
+                if self.restarts > self.max_restarts:
+                    return code if code != 0 else 1
+                print(f"[elastic] restart "
+                      f"{self.restarts}/{self.max_restarts} after "
+                      f"{'stall' if code == -1 else f'exit {code}'}",
+                      file=sys.stderr)
             # fresh rendezvous for the new generation (the reference
             # re-registers under a new etcd index the same way)
             self.master = f"127.0.0.1:{find_free_port()}"
+
+    def install_signal_forwarding(self) -> None:
+        """Launcher-level grace: when the LAUNCHER receives SIGTERM (the
+        platform preempting the whole VM), forward it to every rank and
+        wait for their graceful exits before leaving (ref: the launch
+        controller's signal trap, launch/controllers/controller.py)."""
+
+        def handler(signum, frame):
+            if getattr(self, "_procs", None):  # may fire before _spawn
+                self._teardown()  # SIGTERM ranks, 30s grace, then kill
+            sys.exit(RESTART_EXIT_CODE)
+
+        signal.signal(signal.SIGTERM, handler)

@@ -135,6 +135,38 @@ class AutoCheckpoint:
         self.optimizer_restore_fn = optimizer_restore_fn
         self.mgr = CheckpointManager(directory, max_to_keep=max_to_keep,
                                      async_save=False)
+        self._hapi_model = None
+
+    @classmethod
+    def for_model(cls, directory: str, model, max_to_keep: int = 2):
+        """AutoCheckpoint over a hapi ``Model``: snapshots the network
+        params AND the optimizer state + step counter, restores both —
+        the full lossless-resume bundle (pairs with
+        ``distributed.elastic.PreemptionGuard`` for the SIGTERM →
+        checkpoint → restart flow)."""
+
+        def state_fn():
+            model._sync_state_in()
+            return {"opt": jax.tree_util.tree_map(np.asarray,
+                                                  model._opt_state),
+                    "step_count": np.asarray(model._step_count)}
+
+        def restore_fn(tree):
+            # drop any device state already synced in: _sync_state_in
+            # only reads the network when _params is None, so leaving it
+            # set would train restored optimizer moments against
+            # UN-restored weights (same invalidation Model.load does)
+            model._params = None
+            model._frozen = None
+            model._buffers = None
+            model._opt_state = tree["opt"]
+            model._step_count = int(tree["step_count"])
+
+        acp = cls(directory, model.network, optimizer_state_fn=state_fn,
+                  optimizer_restore_fn=restore_fn,
+                  max_to_keep=max_to_keep)
+        acp._hapi_model = model
+        return acp
 
     def epochs(self, total: int):
         start = self.mgr.latest_step()
@@ -153,6 +185,8 @@ class AutoCheckpoint:
             yield e
 
     def commit(self, epoch: int) -> None:
+        if self._hapi_model is not None:
+            self._hapi_model._sync_state_out()  # device → network attrs
         tree = {"model": {k: np.asarray(v)
                           for k, v in self.model.state_dict().items()}}
         if self.optimizer_state_fn is not None:
