@@ -817,11 +817,129 @@ def interpolate(x, size=None, scale_factor=None, mode: str = "nearest",
     return jnp.moveaxis(out, -1, 1)
 
 
+def upsample(x, size=None, scale_factor=None, mode: str = "nearest",
+             align_corners: bool = False, data_format: str = "NCHW"):
+    """ref: nn/functional/common.py upsample — interpolate alias."""
+    return interpolate(x, size=size, scale_factor=scale_factor, mode=mode,
+                       align_corners=align_corners,
+                       data_format=data_format)
+
+
+def sequence_mask(lengths, maxlen=None, dtype="bool"):
+    """[..., maxlen] mask of positions < length (ref: fluid/layers
+    sequence_mask — the LoD → dense-mask bridge; pairs with
+    io.pad_sequence)."""
+    from ..core import dtype as dtype_mod
+    lengths = jnp.asarray(lengths)
+    if maxlen is None:
+        maxlen = int(jnp.max(lengths))  # host read; pass maxlen under jit
+    pos = jnp.arange(maxlen, dtype=lengths.dtype)
+    mask = pos < lengths[..., None]
+    return mask if dtype == "bool" else mask.astype(dtype_mod.dtype(dtype))
+
+
+def channel_shuffle(x, groups: int, data_format: str = "NCHW"):
+    """ref: nn/functional/vision.py channel_shuffle (ShuffleNet)."""
+    if data_format == "NHWC":
+        x = jnp.moveaxis(x, -1, 1)
+    n, c, h, w = x.shape
+    if c % groups:
+        raise ValueError(f"channels {c} not divisible by {groups} groups")
+    out = x.reshape(n, groups, c // groups, h, w)
+    out = out.swapaxes(1, 2).reshape(n, c, h, w)
+    if data_format == "NHWC":
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+def affine_grid(theta, out_shape, align_corners: bool = True):
+    """Sampling grid from batched 2x3 affine matrices (ref:
+    nn/functional/vision.py affine_grid; spatial transformer)."""
+    theta = jnp.asarray(theta, jnp.float32)
+    n, _, _ = theta.shape
+    _, _, h, w = out_shape
+    if align_corners:
+        ys = jnp.linspace(-1.0, 1.0, h)
+        xs = jnp.linspace(-1.0, 1.0, w)
+    else:
+        ys = (jnp.arange(h) + 0.5) * 2.0 / h - 1.0
+        xs = (jnp.arange(w) + 0.5) * 2.0 / w - 1.0
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # [h, w, 3]
+    grid = jnp.einsum("hwk,nck->nhwc", base, theta)         # [n, h, w, 2]
+    return grid
+
+
+def grid_sample(x, grid, mode: str = "bilinear",
+                padding_mode: str = "zeros", align_corners: bool = True):
+    """Sample input at grid locations in [-1, 1] (ref:
+    nn/functional/vision.py grid_sample). Vectorized gather4 + lerp —
+    the same formulation as vision.ops roi_align's sampler, batched."""
+    x = jnp.asarray(x, jnp.float32)
+    grid = jnp.asarray(grid, jnp.float32)
+    n, c, h, w = x.shape
+    gx, gy = grid[..., 0], grid[..., 1]                     # [n, ho, wo]
+    if align_corners:
+        fx = (gx + 1.0) * (w - 1) / 2.0
+        fy = (gy + 1.0) * (h - 1) / 2.0
+    else:
+        fx = ((gx + 1.0) * w - 1.0) / 2.0
+        fy = ((gy + 1.0) * h - 1.0) / 2.0
+    if padding_mode == "border":
+        fx = jnp.clip(fx, 0, w - 1)
+        fy = jnp.clip(fy, 0, h - 1)
+    elif padding_mode == "reflection":
+        # triangle wave with period 2*span: in-range values unchanged,
+        # out-of-range values reflected back across the edges
+        span_x = float(w - 1) if align_corners else float(w)
+        span_y = float(h - 1) if align_corners else float(h)
+        fx = span_x - jnp.abs(jnp.mod(fx, 2 * span_x) - span_x)
+        fy = span_y - jnp.abs(jnp.mod(fy, 2 * span_y) - span_y)
+        fx = jnp.clip(fx, 0, w - 1)
+        fy = jnp.clip(fy, 0, h - 1)
+    elif padding_mode != "zeros":
+        raise ValueError(f"unknown padding_mode {padding_mode!r}")
+    if mode not in ("nearest", "bilinear"):
+        raise ValueError(f"grid_sample mode {mode!r} not supported "
+                         f"(nearest | bilinear)")
+
+    if mode == "nearest":
+        yi = jnp.round(fy).astype(jnp.int32)
+        xi = jnp.round(fx).astype(jnp.int32)
+        batch = jnp.arange(n)[:, None, None]
+        valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+        v = x[batch, :, jnp.clip(yi, 0, h - 1), jnp.clip(xi, 0, w - 1)]
+        v = jnp.where(valid[..., None], v, 0.0)
+        return jnp.moveaxis(v, -1, 1)
+    y0 = jnp.floor(fy).astype(jnp.int32)
+    x0 = jnp.floor(fx).astype(jnp.int32)
+    wy1, wx1 = fy - y0, fx - x0
+    batch = jnp.arange(n)[:, None, None]
+    out = 0.0
+    for (yi, xi, wgt) in (
+            (y0, x0, (1 - wy1) * (1 - wx1)),
+            (y0, x0 + 1, (1 - wy1) * wx1),
+            (y0 + 1, x0, wy1 * (1 - wx1)),
+            (y0 + 1, x0 + 1, wy1 * wx1)):
+        valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+        v = x[batch, :, jnp.clip(yi, 0, h - 1), jnp.clip(xi, 0, w - 1)]
+        v = jnp.where(valid[..., None], v, 0.0)
+        out = out + v * wgt[..., None]
+    return jnp.moveaxis(out, -1, 1)
+
+
 # long-tail functionals live beside their layer wrappers
 from .layers.extra import (alpha_dropout, celu, fold,  # noqa: E402
                            local_response_norm, maxout,
                            pairwise_distance, pixel_shuffle,
                            pixel_unshuffle, thresholded_relu)
+# detection-adjacent functionals shared with vision.ops — lazy to avoid
+# the nn <-> vision import cycle
+def __getattr__(name):
+    if name == "temporal_shift":
+        from ..vision.ops import temporal_shift
+        return temporal_shift
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 def swiglu(x, gate=None):

@@ -1,0 +1,74 @@
+"""Round-3 functional fills: sequence_mask, channel_shuffle, upsample,
+affine_grid, grid_sample (ref: python/paddle/nn/functional/vision.py,
+fluid sequence_mask)."""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from paddle_tpu.nn import functional as F
+
+
+def test_sequence_mask():
+    m = F.sequence_mask(np.array([1, 3, 2]), maxlen=4)
+    np.testing.assert_array_equal(
+        np.asarray(m),
+        [[1, 0, 0, 0], [1, 1, 1, 0], [1, 1, 0, 0]])
+    mf = F.sequence_mask(np.array([2]), maxlen=3, dtype="float32")
+    assert np.asarray(mf).dtype == np.float32
+    assert F.sequence_mask(np.array([2, 5])).shape == (2, 5)
+
+
+def test_channel_shuffle():
+    x = jnp.arange(8, dtype=jnp.float32).reshape(1, 8, 1, 1)
+    out = np.asarray(F.channel_shuffle(x, 2)).ravel()
+    np.testing.assert_allclose(out, [0, 4, 1, 5, 2, 6, 3, 7])
+
+
+def test_upsample_aliases_interpolate():
+    x = jnp.arange(4, dtype=jnp.float32).reshape(1, 1, 2, 2)
+    out = F.upsample(x, size=(4, 4), mode="nearest")
+    assert out.shape == (1, 1, 4, 4)
+    np.testing.assert_allclose(np.asarray(out)[0, 0],
+                               [[0, 0, 1, 1], [0, 0, 1, 1],
+                                [2, 2, 3, 3], [2, 2, 3, 3]])
+
+
+def test_affine_grid_identity_and_grid_sample_roundtrip():
+    n, c, h, w = 2, 3, 5, 7
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(n, c, h, w), jnp.float32)
+    theta = jnp.asarray(
+        np.tile(np.array([[1.0, 0, 0], [0, 1.0, 0]], np.float32),
+                (n, 1, 1)))
+    grid = F.affine_grid(theta, (n, c, h, w), align_corners=True)
+    assert grid.shape == (n, h, w, 2)
+    # identity transform + bilinear sampling reproduces the input
+    out = F.grid_sample(x, grid, align_corners=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x), atol=1e-5)
+    # nearest mode too
+    out_n = F.grid_sample(x, grid, mode="nearest", align_corners=True)
+    np.testing.assert_allclose(np.asarray(out_n), np.asarray(x),
+                               atol=1e-6)
+
+
+def test_grid_sample_out_of_range_padding():
+    x = jnp.ones((1, 1, 4, 4))
+    far = jnp.full((1, 2, 2, 2), 3.0)   # way outside [-1, 1]
+    np.testing.assert_allclose(
+        np.asarray(F.grid_sample(x, far, padding_mode="zeros")), 0.0)
+    np.testing.assert_allclose(
+        np.asarray(F.grid_sample(x, far, padding_mode="border")), 1.0)
+    np.testing.assert_allclose(
+        np.asarray(F.grid_sample(x, far, padding_mode="reflection")), 1.0)
+
+
+def test_grid_sample_translation():
+    """Shift right by one pixel via the grid: out[..., j] = x[..., j-1]."""
+    h = w = 4
+    x = jnp.arange(h * w, dtype=jnp.float32).reshape(1, 1, h, w)
+    theta = jnp.asarray([[[1.0, 0.0, -2.0 / (w - 1)], [0.0, 1.0, 0.0]]])
+    grid = F.affine_grid(theta, (1, 1, h, w), align_corners=True)
+    out = np.asarray(F.grid_sample(x, grid, align_corners=True))
+    np.testing.assert_allclose(out[0, 0, :, 1:],
+                               np.asarray(x)[0, 0, :, :-1], atol=1e-5)
