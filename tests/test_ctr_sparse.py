@@ -37,10 +37,17 @@ def test_hash_ids_folds_out_of_range():
     emb = SparseEmbedding(10, 4, hash_ids=True)
     huge = jnp.asarray([[2000000001, 0]])  # out of range + padding
     out = emb(huge)
-    expected_row = 1 + 2000000001 % 9
+    # multiply-shift (Fibonacci) whitening before the modulo — a bare
+    # id % N clusters structured CTR key spaces onto hot rows
+    h = (np.uint32(2000000001) * np.uint32(0x9E3779B9)) & 0xFFFFFFFF
+    h ^= h >> 16
+    expected_row = 1 + h % 9
     np.testing.assert_allclose(out[0],
                                np.asarray(emb.weight[expected_row]),
                                atol=1e-6)
+    # padding id maps to itself: a row of ONLY padding pools to zero
+    only_pad = emb(jnp.asarray([[0, 0]]))
+    np.testing.assert_allclose(np.asarray(only_pad), 0.0, atol=1e-7)
     # without hashing, gather clamps (documented XLA semantics)
     emb2 = SparseEmbedding(10, 4, hash_ids=False)
     out2 = emb2(huge)

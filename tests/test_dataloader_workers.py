@@ -10,6 +10,9 @@ import numpy as np
 from paddle_tpu.io import (DataLoader, Dataset, IterableDataset,
                            get_worker_info)
 
+import pytest
+pytestmark = pytest.mark.slow  # smoke tier skips (tools/ci.sh --smoke)
+
 
 class _SlowDataset(Dataset):
     def __init__(self, n=32, delay=0.02):

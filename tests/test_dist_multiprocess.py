@@ -16,6 +16,8 @@ sys.path.insert(0, os.path.dirname(__file__))
 
 import dist_worker  # noqa: E402
 
+pytestmark = pytest.mark.slow  # smoke tier skips (tools/ci.sh --smoke)
+
 
 def test_two_process_allreduce_and_dp_parity(tmp_path):
     from paddle_tpu import distributed

@@ -11,10 +11,12 @@ import os
 import textwrap
 
 import numpy as np
-import pytest
 
 from paddle_tpu.distributed.elastic import (ElasticManager, ElasticStatus,
                                             Heartbeat)
+
+import pytest
+pytestmark = pytest.mark.slow  # smoke tier skips (tools/ci.sh --smoke)
 
 # A tiny "training" script that needs no jax in the subprocess: a
 # counter parameter trained for 6 epochs with an epoch-granular
@@ -27,20 +29,17 @@ _TRAIN = textwrap.dedent("""
     kill_mode = sys.argv[2]   # "exit" | "stall" | "none"
     rank = os.environ["PADDLE_TRAINER_ID"]
     incarnation = int(os.environ.get("PADDLE_ELASTIC_RESTART_COUNT", 0))
-
     hb = None
     if os.environ.get("PADDLE_ELASTIC_HB_DIR"):
         sys.path.insert(0, {repo!r})
         from paddle_tpu.distributed.elastic import Heartbeat
         Heartbeat(mode="thread", interval=0.2)  # liveness (auto path)
         hb = Heartbeat(mode="manual")   # progress beats from the loop
-
     ckpt = os.path.join(work, f"state.{{rank}}.json")
     state = {{"epoch": -1, "weight": 0.0}}
     if os.path.exists(ckpt):
         state = json.load(open(ckpt))
     start = state["epoch"] + 1
-
     for epoch in range(start, 6):
         state = {{"epoch": epoch, "weight": state["weight"] + 1.0}}
         with open(os.path.join(work, f"log.{{rank}}.txt"), "a") as f:

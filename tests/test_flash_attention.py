@@ -14,6 +14,8 @@ import pytest
 
 from paddle_tpu.ops.flash_attention import flash_attention
 
+pytestmark = pytest.mark.slow  # smoke tier skips (tools/ci.sh --smoke)
+
 
 def _reference(q, k, v, causal=False):
     d = q.shape[-1]

@@ -66,3 +66,36 @@ def test_role_makers():
     assert rm.current_id == 0 and rm.worker_num_ >= 1
     rm2 = fleet.UserDefinedRoleMaker(current_id=1, worker_num=4)
     assert rm2.current_id == 1 and rm2.worker_num_ == 4
+
+
+def test_local_fs_roundtrip(tmp_path):
+    """distributed.fs.LocalFS — the functional half of the reference's
+    fleet/utils/fs.py; HDFS/AFS are declined with decision records."""
+    import pytest
+
+    from paddle_tpu.distributed import fs
+
+    lfs = fs.LocalFS()
+    d = str(tmp_path / "a")
+    lfs.mkdirs(d)
+    assert lfs.is_dir(d) and lfs.is_exist(d)
+    f = str(tmp_path / "a" / "x.txt")
+    lfs.touch(f)
+    assert lfs.is_file(f)
+    with open(f, "w") as fh:
+        fh.write("hello")
+    assert lfs.cat(f) == "hello"
+    dirs, files = lfs.ls_dir(str(tmp_path))
+    assert dirs == ["a"] and files == []
+    lfs.mv(f, str(tmp_path / "y.txt"))
+    assert lfs.is_file(str(tmp_path / "y.txt"))
+    with pytest.raises(fs.FSFileNotExistsError):
+        lfs.mv(str(tmp_path / "missing"), str(tmp_path / "z"))
+    lfs.delete(d)
+    assert not lfs.is_exist(d)
+    assert not lfs.need_upload_download()
+    with pytest.raises(NotImplementedError, match="orbax"):
+        fs.HDFSClient()
+    # fleet.utils namespace parity
+    from paddle_tpu.distributed import fleet
+    assert fleet.utils.LocalFS is fs.LocalFS

@@ -10,6 +10,8 @@ import paddle_tpu as pt
 from paddle_tpu.nn import functional as F
 from paddle_tpu.ops.fused_xent import fused_linear_cross_entropy
 
+pytestmark = pytest.mark.slow  # smoke tier skips (tools/ci.sh --smoke)
+
 
 def _data(t=12, h=16, v=40, seed=0):
     r = np.random.RandomState(seed)

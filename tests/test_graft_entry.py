@@ -7,6 +7,10 @@ import sys
 import jax
 import numpy as np
 
+import pytest
+
+pytestmark = pytest.mark.slow  # smoke tier skips (tools/ci.sh --smoke)
+
 
 def _load():
     sys.path.insert(0, "/root/repo")

@@ -12,6 +12,8 @@ from paddle_tpu.metric import Accuracy
 from paddle_tpu.models.lenet import LeNet
 from paddle_tpu.optimizer import Adam
 
+pytestmark = pytest.mark.slow  # smoke tier skips (tools/ci.sh --smoke)
+
 
 def synthetic_mnist(n=256, seed=0):
     """Class-dependent blob patterns: learnable quickly, MNIST-shaped."""

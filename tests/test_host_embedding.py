@@ -22,6 +22,8 @@ from paddle_tpu.nn.layers.host_embedding import HostOffloadedEmbedding
 import jax
 import jax.numpy as jnp
 
+pytestmark = pytest.mark.slow  # smoke tier skips (tools/ci.sh --smoke)
+
 
 def test_lookup_matches_host_rows_and_lazy_init_deterministic():
     pt.seed(0)
@@ -155,3 +157,24 @@ def test_widedeep_style_training_with_large_table(tmp_path):
     bad = HostOffloadedEmbedding(999, 8)
     with pytest.raises(ValueError, match="snapshot shape"):
         bad.restore(snap)
+
+
+def test_geo_merge_averages_held_rows(tmp_path):
+    """Geo-SGD periodic merge: rows average over the replicas that hold
+    them; rows unique to one replica pass through unchanged."""
+    a = HostOffloadedEmbedding(100, 2, seed=1)
+    b = HostOffloadedEmbedding(100, 2, seed=2)
+    a._rows = {1: np.array([1.0, 1.0], np.float32),
+               2: np.array([2.0, 2.0], np.float32)}
+    b._rows = {1: np.array([3.0, 3.0], np.float32),
+               5: np.array([5.0, 5.0], np.float32)}
+    b._accum = {1: np.array([0.5, 0.5], np.float32)}
+    snap = str(tmp_path / "b.npz")
+    b.snapshot(snap)
+    a.geo_merge(snap)
+    np.testing.assert_allclose(a._rows[1], [2.0, 2.0])   # mean(1, 3)
+    np.testing.assert_allclose(a._rows[2], [2.0, 2.0])   # only in a
+    np.testing.assert_allclose(a._rows[5], [5.0, 5.0])   # adopted from b
+    np.testing.assert_allclose(a._accum[1], [0.5, 0.5])  # max-merge
+    with pytest.raises(ValueError, match="mismatch"):
+        HostOffloadedEmbedding(99, 2).geo_merge(snap)

@@ -29,8 +29,11 @@ def _plugin_available() -> bool:
         return False
 
 
-pytestmark = pytest.mark.skipif(
-    not _plugin_available(), reason="no PJRT plugin .so on this machine")
+pytestmark = [
+    pytest.mark.skipif(not _plugin_available(),
+                       reason="no PJRT plugin .so on this machine"),
+    pytest.mark.slow,  # smoke tier skips (tools/ci.sh --smoke)
+]
 
 
 def _save_and_serve(net, x, tmp_path, atol):

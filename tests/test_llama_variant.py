@@ -2,7 +2,6 @@
 cache-correct rotary decode."""
 
 import numpy as np
-import pytest
 import jax
 import jax.numpy as jnp
 
@@ -10,6 +9,9 @@ import paddle_tpu as pt
 from paddle_tpu import nn
 from paddle_tpu.models.gpt import (GPTConfig, GPTForCausalLM,
                                    GPTPretrainingCriterion, llama_config)
+
+import pytest
+pytestmark = pytest.mark.slow  # smoke tier skips (tools/ci.sh --smoke)
 
 
 def _tiny_llama(**kw):

@@ -19,6 +19,8 @@ from paddle_tpu.models.gpt import (GPTConfig, GPTForCausalLM,
                                    GPTPretrainingCriterion, gpt_config)
 from paddle_tpu.nn.layer import functional_call, split_state
 
+pytestmark = pytest.mark.slow  # smoke tier skips (tools/ci.sh --smoke)
+
 TINY_GPT = dict(vocab_size=97, hidden_size=32, num_layers=2, num_heads=4,
                 max_position_embeddings=64, hidden_dropout=0.0,
                 attention_dropout=0.0)

@@ -10,6 +10,9 @@ from paddle_tpu import models
 from paddle_tpu.nn.layer import functional_call, split_state
 
 
+pytestmark = pytest.mark.slow  # smoke tier skips (tools/ci.sh --smoke)
+
+
 def _x(n=1, size=64):
     return jnp.asarray(
         np.random.RandomState(0).randn(n, 3, size, size), jnp.float32)

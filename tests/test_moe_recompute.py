@@ -12,6 +12,8 @@ from paddle_tpu import nn, parallel
 from paddle_tpu.nn.layer import functional_call, split_state
 from paddle_tpu.nn.layers.moe import MoELayer, collect_aux_losses
 
+pytestmark = pytest.mark.slow  # smoke tier skips (tools/ci.sh --smoke)
+
 
 def _x(b=2, s=16, d=8, seed=0):
     return jnp.asarray(

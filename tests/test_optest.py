@@ -16,6 +16,8 @@ import paddle_tpu.tensor as T
 from paddle_tpu.nn import functional as F
 from paddle_tpu.testing import OpSpec, arr, run_spec
 
+pytestmark = pytest.mark.slow  # smoke tier skips (tools/ci.sh --smoke)
+
 S = (3, 4)          # default shape
 POS = dict(low=0.1, high=2.0)      # positive domain (log, sqrt, ...)
 SAFE = dict(low=-0.9, high=0.9)    # inside (-1, 1) (asin, atanh, ...)

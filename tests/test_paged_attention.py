@@ -12,6 +12,8 @@ import jax.numpy as jnp
 
 from paddle_tpu.ops.paged_attention import PagedKVCache, paged_attention
 
+pytestmark = pytest.mark.slow  # smoke tier skips (tools/ci.sh --smoke)
+
 
 def _dense_ref(q, k, v, lens):
     b, h, d = q.shape

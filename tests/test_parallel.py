@@ -14,6 +14,8 @@ import paddle_tpu as paddle
 from paddle_tpu import nn, parallel
 from paddle_tpu.parallel import collective
 
+pytestmark = pytest.mark.slow  # smoke tier skips (tools/ci.sh --smoke)
+
 
 @pytest.fixture(autouse=True)
 def _clean_mesh():

@@ -8,7 +8,6 @@ interleaving ref: hybrid_parallel_pp_transformer with virtual stages)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 from jax.sharding import PartitionSpec as P
 
 import paddle_tpu as pt
@@ -17,6 +16,9 @@ from paddle_tpu.nn import functional as F
 from paddle_tpu.nn.layer import functional_call, split_state
 from paddle_tpu.parallel.pipeline import (LayerDesc, PipelineLayer,
                                           PipelineParallel, pipeline_spmd)
+
+import pytest
+pytestmark = pytest.mark.slow  # smoke tier skips (tools/ci.sh --smoke)
 
 
 class Block(nn.Layer):

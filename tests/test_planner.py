@@ -14,6 +14,8 @@ from paddle_tpu.parallel import planner
 from paddle_tpu.models.gpt import (GPTConfig, GPTForCausalLM,
                                    GPTPretrainingCriterion, gpt_config)
 
+pytestmark = pytest.mark.slow  # smoke tier skips (tools/ci.sh --smoke)
+
 _GiB = float(1 << 30)
 
 

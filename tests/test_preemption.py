@@ -11,6 +11,10 @@ import time
 
 import numpy as np
 
+import pytest
+
+pytestmark = pytest.mark.slow  # smoke tier skips (tools/ci.sh --smoke)
+
 WORKER = os.path.join(os.path.dirname(__file__), "preemption_worker.py")
 TOTAL = 30
 

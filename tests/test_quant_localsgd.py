@@ -15,6 +15,8 @@ from paddle_tpu import nn, parallel, quant
 from paddle_tpu.nn import functional as F
 from paddle_tpu.nn.layer import functional_call, split_state
 
+pytestmark = pytest.mark.slow  # smoke tier skips (tools/ci.sh --smoke)
+
 
 def _mlp(seed=0):
     pt.seed(seed)

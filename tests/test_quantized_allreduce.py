@@ -8,6 +8,10 @@ from jax.sharding import PartitionSpec as P
 from paddle_tpu import parallel
 from paddle_tpu.parallel import collective as C
 
+import pytest
+
+pytestmark = pytest.mark.slow  # smoke tier skips (tools/ci.sh --smoke)
+
 
 def _run(fn, x, mesh):
     mapped = jax.shard_map(fn, mesh=mesh.mesh, in_specs=P("dp"),

@@ -9,6 +9,8 @@ import pytest
 from paddle_tpu import parallel
 from paddle_tpu.ops.ring_attention import ring_attention
 
+pytestmark = pytest.mark.slow  # smoke tier skips (tools/ci.sh --smoke)
+
 
 def _reference(q, k, v, causal=False):
     d = q.shape[-1]

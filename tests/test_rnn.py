@@ -11,6 +11,8 @@ import jax.numpy as jnp
 import paddle_tpu as pt
 from paddle_tpu import nn
 
+pytestmark = pytest.mark.slow  # smoke tier skips (tools/ci.sh --smoke)
+
 
 def _copy_lstm_weights_from_torch(tlstm, cell):
     # torch packs gates i,f,g,o rows in weight_ih_l0 [4H, in]

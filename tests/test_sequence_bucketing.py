@@ -16,6 +16,8 @@ import paddle_tpu as pt
 from paddle_tpu import io, nn
 from paddle_tpu.core import flags
 
+pytestmark = pytest.mark.slow  # smoke tier skips (tools/ci.sh --smoke)
+
 
 def test_pad_sequence_shapes_mask_truncation():
     seqs = [np.arange(3), np.arange(7), np.arange(5)]

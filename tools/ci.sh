@@ -6,8 +6,17 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== unit + integration suite (8-device CPU mesh)"
-python -m pytest tests/ -q -o faulthandler_timeout=300
+# --smoke: fast tier only (skips @pytest.mark.slow — compile-bound model
+# zoo sweeps, multi-process tests); full suite remains the merge gate.
+PYTEST_ARGS=()
+TIER=""
+if [[ "${1:-}" == "--smoke" ]]; then
+  PYTEST_ARGS=(-m "not slow")
+  TIER=" [smoke]"
+fi
+
+echo "== unit + integration suite (8-device CPU mesh)${TIER}"
+python -m pytest tests/ -q -o faulthandler_timeout=300 "${PYTEST_ARGS[@]}"
 
 echo "== multichip dryrun (n=8 and n=4)"
 python -c "import jax; jax.config.update('jax_platforms','cpu'); \
