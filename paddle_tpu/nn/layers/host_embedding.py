@@ -154,12 +154,8 @@ class HostOffloadedEmbedding(Layer):
     def _fold_ids(self, ids):
         if not self.hash_ids:
             return ids
-        folded = 1 + (ids % jnp.asarray(self.num_embeddings - 1, ids.dtype))
-        if self.padding_idx is not None:
-            folded = jnp.where(ids == self.padding_idx,
-                               jnp.asarray(self.padding_idx, ids.dtype),
-                               folded)
-        return folded
+        from .sparse_embedding import fold_hash_ids
+        return fold_hash_ids(ids, self.num_embeddings, self.padding_idx)
 
     def _lookup(self, ids):
         """Differentiable host-table lookup: pure_callback pull forward,
@@ -224,9 +220,13 @@ class HostOffloadedEmbedding(Layer):
             accs = np.stack([self._accum[i] for i in acc_ids.tolist()]) \
                 if len(acc_ids) else np.zeros((0, self.embedding_dim),
                                               np.float32)
+        # fold=2: rows keyed by multiply-shift-folded ids (hash_ids);
+        # fold=0: raw ids. Restore refuses a mismatched fold scheme —
+        # silently remapping every id would corrupt a restored model.
         np.savez(path, ids=ids, values=vals, acc_ids=acc_ids, accs=accs,
                  meta=np.asarray([self.num_embeddings,
-                                  self.embedding_dim]))
+                                  self.embedding_dim]),
+                 fold=np.asarray(2 if self.hash_ids else 0))
 
     def restore(self, path: str) -> None:
         z = np.load(path if str(path).endswith(".npz") else path + ".npz")
@@ -234,8 +234,49 @@ class HostOffloadedEmbedding(Layer):
             raise ValueError(
                 f"snapshot shape {tuple(z['meta'])} != table "
                 f"({self.num_embeddings}, {self.embedding_dim})")
+        self._check_fold(z, path)
         with self._lock:
             self._rows = {int(i): v for i, v in
                           zip(z["ids"], z["values"])}
             self._accum = {int(i): v for i, v in
                            zip(z["acc_ids"], z["accs"])}
+
+    def _check_fold(self, z, path) -> None:
+        want = 2 if self.hash_ids else 0
+        have = int(z["fold"]) if "fold" in z.files else None
+        if have != want:
+            raise ValueError(
+                f"snapshot {path} uses id-fold scheme {have} but this "
+                f"table expects {want} (hash_ids={self.hash_ids}); "
+                f"restoring would silently remap every id to a "
+                f"different row — re-train or migrate the snapshot")
+
+    def geo_merge(self, *snapshot_paths: str) -> None:
+        """Geo-SGD style periodic merge (ref: the reference's GeoSGD
+        communicator mode, service/communicator.h GeoCommunicator —
+        workers train on local table replicas and periodically push
+        deltas): average each row over every replica that HOLDS it
+        (this table + the given peer snapshots). Per-host tables
+        between merges behave like geo-async local views; the merge is
+        the synchronization point. Accumulators take the elementwise
+        max (the conservative adagrad merge)."""
+        replicas = [(self._rows, self._accum)]
+        for p in snapshot_paths:
+            z = np.load(p if str(p).endswith(".npz") else p + ".npz")
+            if tuple(z["meta"]) != (self.num_embeddings,
+                                    self.embedding_dim):
+                raise ValueError(f"snapshot {p} shape mismatch")
+            self._check_fold(z, p)
+            replicas.append((
+                {int(i): v for i, v in zip(z["ids"], z["values"])},
+                {int(i): v for i, v in zip(z["acc_ids"], z["accs"])}))
+        with self._lock:
+            all_ids = set()
+            for rows, _ in replicas:
+                all_ids.update(rows)
+            for r in all_ids:
+                held = [rows[r] for rows, _ in replicas if r in rows]
+                self._rows[r] = np.mean(held, axis=0)
+                accs = [acc[r] for _, acc in replicas if r in acc]
+                if accs:
+                    self._accum[r] = np.max(accs, axis=0)

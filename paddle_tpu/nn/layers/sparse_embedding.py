@@ -38,6 +38,26 @@ from .. import initializer as I
 from ..layer import Layer
 
 
+def fold_hash_ids(ids, num_embeddings: int, padding_idx):
+    """Map raw feature ids into table range, preserving the padding id.
+
+    Multiply-shift (Fibonacci) hash before the modulo: a bare ``id % N``
+    maps arithmetically-structured CTR key spaces (ids striped by slot,
+    sequential ranges) onto clustered rows — at Criteo-scale
+    vocabularies that concentrates collisions on hot rows. Multiplying
+    by the golden-ratio constant first whitens the bits (the PS
+    key-shard hash served this role, ps/table/memory_sparse_table.h
+    shard_idx). uint32 arithmetic so the result is identical with and
+    without jax x64 mode."""
+    h = ids.astype(jnp.uint32) * jnp.uint32(0x9E3779B9)
+    h = h ^ (h >> jnp.uint32(16))
+    folded = (1 + (h % jnp.uint32(num_embeddings - 1))).astype(ids.dtype)
+    if padding_idx is not None:
+        folded = jnp.where(ids == padding_idx,
+                           jnp.asarray(padding_idx, ids.dtype), folded)
+    return folded
+
+
 class SparseEmbedding(Layer):
     """Pooled sparse-slot embedding (ref: paddle.static.nn.sparse_embedding
     + fluid MultiSlot semantics).
@@ -63,16 +83,9 @@ class SparseEmbedding(Layer):
             axes=("vocab", "embed"))
 
     def _fold_ids(self, ids):
-        """Map raw ids into table range, preserving the padding id."""
         if not self.hash_ids:
             return ids
-        folded = 1 + (ids % jnp.asarray(self.num_embeddings - 1,
-                                        ids.dtype))
-        if self.padding_idx is not None:
-            folded = jnp.where(ids == self.padding_idx,
-                               jnp.asarray(self.padding_idx, ids.dtype),
-                               folded)
-        return folded
+        return fold_hash_ids(ids, self.num_embeddings, self.padding_idx)
 
     def forward(self, ids):
         ids = self._fold_ids(jnp.asarray(ids))
