@@ -18,5 +18,6 @@ from ..parallel import (AXIS_ORDER, DataParallel, DeviceMesh,  # noqa
 from . import launch  # noqa
 from . import elastic  # noqa
 from . import fleet  # noqa
+from . import fs  # noqa
 from .elastic import ElasticManager, ElasticStatus, Heartbeat  # noqa
 from .spawn import ProcessContext, spawn  # noqa

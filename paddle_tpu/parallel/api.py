@@ -19,6 +19,7 @@ from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..nn.layer import Layer, split_state
 from .mesh import DeviceMesh, get_mesh, init_mesh, set_mesh
@@ -71,9 +72,19 @@ def get_rank() -> int:
 
 def barrier() -> None:
     """Host-level barrier (ref: operators/collective/barrier_op.cc): a
-    tiny all-reduce over all devices forces every process to sync."""
-    x = jnp.ones((jax.local_device_count(),))
-    jax.pmap(lambda v: jax.lax.psum(v, "i"), axis_name="i")(x).block_until_ready()
+    tiny all-device psum forces every process to sync — jit + shard_map
+    over a throwaway 1-axis mesh (pmap is the deprecated path)."""
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    devs = jax.devices()
+    mesh = Mesh(np.asarray(devs), ("all",))
+    x = jax.device_put(jnp.ones((len(devs),)),
+                       NamedSharding(mesh, P("all")))
+    out = jax.jit(jax.shard_map(
+        lambda v: jax.lax.psum(v, "all"), mesh=mesh,
+        in_specs=P("all"), out_specs=P()))(x)
+    out.block_until_ready()
 
 
 # ---------------------------------------------------------------------------
