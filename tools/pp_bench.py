@@ -1,0 +1,160 @@
+"""Pipeline-parallel measurement (VERDICT r2 item 6): quantify the pp
+bubble + remat overhead vs dense, and the pp memory win, on the
+8-device virtual CPU mesh (wall-clock proxy — relative numbers; the
+absolute story needs the real chip, bench.py).
+
+Run: python tools/pp_bench.py [--steps 8] [--json]
+Writes nothing; prints a table + one JSON line for PERF.md.
+
+Also benchmarks the beyond-HBM host-offloaded embedding lookup against
+the dense mesh-sharded table (VERDICT r2 item 4's measurement ask).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import numpy as np  # noqa: E402
+
+
+def _time_steps(fn, args, steps):
+    out = fn(*args)                      # compile + warm
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / steps
+
+
+def _mem_bytes(jitted, *args):
+    m = jitted.lower(*args).compile().memory_analysis()
+    return float(m.temp_size_in_bytes + m.argument_size_in_bytes)
+
+
+def gpt_pp_vs_dense(steps: int):
+    import paddle_tpu as pt
+    from paddle_tpu import parallel
+    from paddle_tpu.models.gpt import (GPTConfig, GPTForCausalLM,
+                                       GPTForCausalLMPipe,
+                                       GPTPretrainingCriterion)
+
+    cfg_kw = dict(vocab_size=512, hidden_size=128, num_layers=8,
+                  num_heads=4, max_position_embeddings=128,
+                  hidden_dropout=0.0, attention_dropout=0.0,
+                  use_flash=False)
+    batch, seq = 16, 128
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 512, (batch, seq))
+    results = {}
+
+    def build(pipe: bool, mesh, **pipe_kw):
+        pt.seed(0)
+        cfg = GPTConfig(**cfg_kw)
+        net = (GPTForCausalLMPipe(cfg, mesh=mesh, **pipe_kw)
+               if pipe else GPTForCausalLM(cfg))
+        model = pt.Model(net)
+        model.prepare(optimizer=pt.optimizer.AdamW(
+            learning_rate=1e-4, parameters=net, weight_decay=0.01),
+            loss=GPTPretrainingCriterion())
+        parallel.distributed_model(model, mesh=mesh)
+        return model
+
+    def measure(name, model):
+        model._sync_state_in()
+        if model._train_step_fn is None:
+            model._train_step_fn = model._build_train_step()
+        from paddle_tpu.core import rng as rng_mod
+        inputs, labels = ([ids], [ids])
+        inputs = model._shard_batch(tuple(inputs))
+        labels = model._shard_batch(tuple(labels))
+        key = rng_mod.split_for_step(0)
+        step_args = (model._params, model._frozen, model._opt_state,
+                     model._buffers, 0, key, inputs, labels)
+        mem = _mem_bytes(model._train_step_fn, *step_args)
+
+        def run():
+            logs = model.train_batch([ids], [ids])
+            return logs["loss"]
+
+        run()  # compile
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = run()
+        float(np.asarray(loss))
+        dt = (time.perf_counter() - t0) / steps
+        results[name] = {"step_s": round(dt, 4),
+                         "mem_mib_per_dev": round(mem / 2**20, 1)}
+        print(f"{name:28s} step {dt*1e3:8.1f} ms   "
+              f"mem/dev {mem/2**20:8.1f} MiB")
+
+    try:
+        mesh = parallel.init_mesh(dp=8)
+        measure("dense dp=8", build(False, mesh))
+        parallel.set_mesh(None)
+
+        for pp, v, m in ((2, 1, 8), (2, 2, 8), (4, 1, 8), (4, 2, 8)):
+            mesh = parallel.init_mesh(pp=pp, dp=8 // pp)
+            measure(f"pp={pp} v={v} m={m} dp={8//pp}",
+                    build(True, mesh, num_microbatches=m,
+                          virtual_pp_degree=v))
+            parallel.set_mesh(None)
+
+        # tp inside pp (the round-3 capability)
+        mesh = parallel.init_mesh(pp=2, tp=2, dp=2)
+        measure("pp=2 tp=2 dp=2 v=1 m=8",
+                build(True, mesh, num_microbatches=8))
+        parallel.set_mesh(None)
+    finally:
+        parallel.set_mesh(None)
+    return results
+
+
+def host_embedding_vs_dense(steps: int):
+    import paddle_tpu as pt
+    from paddle_tpu.nn.layers.host_embedding import HostOffloadedEmbedding
+    from paddle_tpu.nn.layers.sparse_embedding import SparseEmbedding
+
+    pt.seed(0)
+    n, d, batch, k = 200_000, 64, 256, 16
+    rng = np.random.RandomState(0)
+    ids = rng.randint(1, n, (batch, k))
+
+    dense = SparseEmbedding(n, d)
+    f_dense = jax.jit(lambda i: dense(i).sum())
+    t_dense = _time_steps(f_dense, (ids,), steps)
+
+    host = HostOffloadedEmbedding(n, d)
+    f_host = jax.jit(lambda i: host(i).sum())
+    t_host = _time_steps(f_host, (ids,), steps)
+
+    res = {"dense_lookup_s": round(t_dense, 5),
+           "host_lookup_s": round(t_host, 5),
+           "host_overhead_x": round(t_host / t_dense, 2),
+           "lookups_per_s_host": round(batch * k / t_host, 0)}
+    print(f"embedding lookup  dense {t_dense*1e3:.2f} ms   "
+          f"host-offloaded {t_host*1e3:.2f} ms   "
+          f"({res['host_overhead_x']}x)")
+    return res
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+    pp = gpt_pp_vs_dense(args.steps)
+    emb = host_embedding_vs_dense(max(args.steps, 16))
+    line = {"pp": pp, "embedding": emb}
+    print(json.dumps(line))
+
+
+if __name__ == "__main__":
+    main()
