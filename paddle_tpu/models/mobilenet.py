@@ -155,3 +155,124 @@ def mobilenet_v1(scale=1.0, **kwargs):
 
 def mobilenet_v2(scale=1.0, **kwargs):
     return MobileNetV2(scale=scale, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# MobileNetV3 (ref: vision/models/mobilenetv3.py — inverted residuals
+# with squeeze-excitation and hardswish; Small/Large configs)
+# ---------------------------------------------------------------------------
+
+class _SEModule(nn.Layer):
+    """Squeeze-excitation with the MBV3 gating (relu → hardsigmoid)."""
+
+    def __init__(self, ch, reduction=4):
+        super().__init__()
+        mid = _make_divisible(ch // reduction)
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Conv2D(ch, mid, 1)
+        self.fc2 = nn.Conv2D(mid, ch, 1)
+
+    def forward(self, x):
+        s = F.relu(self.fc1(self.pool(x)))
+        return x * F.hardsigmoid(self.fc2(s))
+
+
+class _InvertedResidualV3(nn.Layer):
+    def __init__(self, in_c, exp_c, out_c, kernel, stride, use_se, act):
+        super().__init__()
+        self.use_res = stride == 1 and in_c == out_c
+        layers = []
+        if exp_c != in_c:
+            layers.append(ConvBNLayer(in_c, exp_c, 1, act=act))
+        layers.append(ConvBNLayer(exp_c, exp_c, kernel, stride=stride,
+                                  padding=kernel // 2, groups=exp_c,
+                                  act=act))
+        if use_se:
+            layers.append(_SEModule(exp_c))
+        layers.append(ConvBNLayer(exp_c, out_c, 1, act=None))
+        self.block = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+# (kernel, expanded, out, SE, act, stride) — mobilenetv3.py cfg tables
+_V3_LARGE = [
+    (3, 16, 16, False, "relu", 1),
+    (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1),
+    (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1),
+    (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hardswish", 2),
+    (3, 200, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1),
+    (3, 480, 112, True, "hardswish", 1),
+    (3, 672, 112, True, "hardswish", 1),
+    (5, 672, 160, True, "hardswish", 2),
+    (5, 960, 160, True, "hardswish", 1),
+    (5, 960, 160, True, "hardswish", 1),
+]
+_V3_SMALL = [
+    (3, 16, 16, True, "relu", 2),
+    (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1),
+    (5, 96, 40, True, "hardswish", 2),
+    (5, 240, 40, True, "hardswish", 1),
+    (5, 240, 40, True, "hardswish", 1),
+    (5, 120, 48, True, "hardswish", 1),
+    (5, 144, 48, True, "hardswish", 1),
+    (5, 288, 96, True, "hardswish", 2),
+    (5, 576, 96, True, "hardswish", 1),
+    (5, 576, 96, True, "hardswish", 1),
+]
+
+
+class _MobileNetV3(nn.Layer):
+    def __init__(self, cfg, last_exp, hidden, scale=1.0,
+                 num_classes: int = 1000):
+        super().__init__()
+        in_c = _make_divisible(16 * scale)
+        feats = [ConvBNLayer(3, in_c, 3, stride=2, padding=1,
+                             act="hardswish")]
+        for k, exp, out, se, act, s in cfg:
+            exp_c = _make_divisible(exp * scale)
+            out_c = _make_divisible(out * scale)
+            feats.append(_InvertedResidualV3(in_c, exp_c, out_c, k, s,
+                                             se, act))
+            in_c = out_c
+        last_c = _make_divisible(last_exp * scale)
+        feats.append(ConvBNLayer(in_c, last_c, 1, act="hardswish"))
+        self.features = nn.Sequential(*feats)
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.head = nn.Sequential(
+            nn.Linear(last_c, hidden), nn.Hardswish(),
+            nn.Dropout(0.2), nn.Linear(hidden, num_classes))
+
+    def forward(self, x):
+        x = self.pool(self.features(x))
+        return self.head(x.reshape(x.shape[0], -1))
+
+
+class MobileNetV3Large(_MobileNetV3):
+    """ref: mobilenetv3.py MobileNetV3Large(scale, num_classes)."""
+
+    def __init__(self, scale: float = 1.0, num_classes: int = 1000):
+        super().__init__(_V3_LARGE, 960, 1280, scale, num_classes)
+
+
+class MobileNetV3Small(_MobileNetV3):
+    """ref: mobilenetv3.py MobileNetV3Small(scale, num_classes)."""
+
+    def __init__(self, scale: float = 1.0, num_classes: int = 1000):
+        super().__init__(_V3_SMALL, 576, 1024, scale, num_classes)
+
+
+def mobilenet_v3_large(scale=1.0, **kw):
+    return MobileNetV3Large(scale=scale, **kw)
+
+
+def mobilenet_v3_small(scale=1.0, **kw):
+    return MobileNetV3Small(scale=scale, **kw)

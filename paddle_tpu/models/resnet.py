@@ -18,8 +18,12 @@ class BasicBlock(nn.Layer):
     expansion = 1
 
     def __init__(self, inplanes, planes, stride=1, downsample=None,
-                 norm_layer=nn.BatchNorm2D):
+                 norm_layer=nn.BatchNorm2D, groups=1, base_width=64):
         super().__init__()
+        if groups != 1 or base_width != 64:
+            raise ValueError(
+                "BasicBlock only supports groups=1, base_width=64 "
+                "(ref: vision/models/resnet.py BasicBlock)")
         self.conv1 = nn.Conv2D(inplanes, planes, 3, stride=stride,
                                padding=1, bias_attr=False)
         self.bn1 = norm_layer(planes)
@@ -43,14 +47,17 @@ class BottleneckBlock(nn.Layer):
     expansion = 4
 
     def __init__(self, inplanes, planes, stride=1, downsample=None,
-                 norm_layer=nn.BatchNorm2D):
+                 norm_layer=nn.BatchNorm2D, groups=1, base_width=64):
         super().__init__()
-        self.conv1 = nn.Conv2D(inplanes, planes, 1, bias_attr=False)
-        self.bn1 = norm_layer(planes)
-        self.conv2 = nn.Conv2D(planes, planes, 3, stride=stride,
-                               padding=1, bias_attr=False)
-        self.bn2 = norm_layer(planes)
-        self.conv3 = nn.Conv2D(planes, planes * self.expansion, 1,
+        # grouped/widened bottleneck (ResNeXt / WideResNet; ref:
+        # vision/models/resnet.py BottleneckBlock width arithmetic)
+        width = int(planes * (base_width / 64.0)) * groups
+        self.conv1 = nn.Conv2D(inplanes, width, 1, bias_attr=False)
+        self.bn1 = norm_layer(width)
+        self.conv2 = nn.Conv2D(width, width, 3, stride=stride,
+                               padding=1, groups=groups, bias_attr=False)
+        self.bn2 = norm_layer(width)
+        self.conv3 = nn.Conv2D(width, planes * self.expansion, 1,
                                bias_attr=False)
         self.bn3 = norm_layer(planes * self.expansion)
         self.relu = nn.ReLU()
@@ -73,8 +80,11 @@ class ResNet(nn.Layer):
 
     def __init__(self, block: Type[Union[BasicBlock, BottleneckBlock]],
                  depth: int = 50, num_classes: int = 1000,
-                 with_pool: bool = True):
+                 with_pool: bool = True, groups: int = 1,
+                 width_per_group: int = 64):
         super().__init__()
+        self.groups = groups
+        self.base_width = width_per_group
         layer_cfg = {18: [2, 2, 2, 2], 34: [3, 4, 6, 3],
                      50: [3, 4, 6, 3], 101: [3, 4, 23, 3],
                      152: [3, 8, 36, 3]}
@@ -104,10 +114,13 @@ class ResNet(nn.Layer):
                           stride=stride, bias_attr=False),
                 nn.BatchNorm2D(planes * block.expansion),
             )
-        layers = [block(self.inplanes, planes, stride, downsample)]
+        layers = [block(self.inplanes, planes, stride, downsample,
+                        groups=self.groups, base_width=self.base_width)]
         self.inplanes = planes * block.expansion
         for _ in range(1, blocks):
-            layers.append(block(self.inplanes, planes))
+            layers.append(block(self.inplanes, planes,
+                                groups=self.groups,
+                                base_width=self.base_width))
         return nn.Sequential(*layers)
 
     def forward(self, x):
@@ -143,3 +156,43 @@ def resnet101(**kwargs):
 
 def resnet152(**kwargs):
     return _resnet(BottleneckBlock, 152, **kwargs)
+
+
+# ResNeXt / WideResNet variants (ref: vision/models/resnet.py
+# resnext50_32x4d ... wide_resnet101_2 — same trunk, grouped/widened
+# bottlenecks)
+
+def resnext50_32x4d(**kw):
+    return _resnet(BottleneckBlock, 50, groups=32, width_per_group=4, **kw)
+
+
+def resnext50_64x4d(**kw):
+    return _resnet(BottleneckBlock, 50, groups=64, width_per_group=4, **kw)
+
+
+def resnext101_32x4d(**kw):
+    return _resnet(BottleneckBlock, 101, groups=32, width_per_group=4,
+                   **kw)
+
+
+def resnext101_64x4d(**kw):
+    return _resnet(BottleneckBlock, 101, groups=64, width_per_group=4,
+                   **kw)
+
+
+def resnext152_32x4d(**kw):
+    return _resnet(BottleneckBlock, 152, groups=32, width_per_group=4,
+                   **kw)
+
+
+def resnext152_64x4d(**kw):
+    return _resnet(BottleneckBlock, 152, groups=64, width_per_group=4,
+                   **kw)
+
+
+def wide_resnet50_2(**kw):
+    return _resnet(BottleneckBlock, 50, width_per_group=128, **kw)
+
+
+def wide_resnet101_2(**kw):
+    return _resnet(BottleneckBlock, 101, width_per_group=128, **kw)

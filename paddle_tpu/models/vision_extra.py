@@ -196,8 +196,16 @@ def densenet161(**kw):
     return DenseNet(161, **kw)
 
 
+def densenet169(**kw):
+    return DenseNet(169, **kw)
+
+
 def densenet201(**kw):
     return DenseNet(201, **kw)
+
+
+def densenet264(**kw):
+    return DenseNet(264, **kw)
 
 
 # ---------------------------------------------------------------------------
@@ -268,8 +276,9 @@ def channel_shuffle(x, groups: int):
 
 
 class _ShuffleUnit(nn.Layer):
-    def __init__(self, in_ch, out_ch, stride):
+    def __init__(self, in_ch, out_ch, stride, act: str = "relu"):
         super().__init__()
+        act_layer = nn.Swish if act == "swish" else nn.ReLU
         self.stride = stride
         branch_ch = out_ch // 2
         if stride > 1:
@@ -278,19 +287,19 @@ class _ShuffleUnit(nn.Layer):
                           groups=in_ch, bias_attr=False),
                 nn.BatchNorm2D(in_ch),
                 nn.Conv2D(in_ch, branch_ch, 1, bias_attr=False),
-                nn.BatchNorm2D(branch_ch), nn.ReLU())
+                nn.BatchNorm2D(branch_ch), act_layer())
             b2_in = in_ch
         else:
             self.branch1 = None
             b2_in = in_ch // 2
         self.branch2 = nn.Sequential(
             nn.Conv2D(b2_in, branch_ch, 1, bias_attr=False),
-            nn.BatchNorm2D(branch_ch), nn.ReLU(),
+            nn.BatchNorm2D(branch_ch), act_layer(),
             nn.Conv2D(branch_ch, branch_ch, 3, stride=stride, padding=1,
                       groups=branch_ch, bias_attr=False),
             nn.BatchNorm2D(branch_ch),
             nn.Conv2D(branch_ch, branch_ch, 1, bias_attr=False),
-            nn.BatchNorm2D(branch_ch), nn.ReLU())
+            nn.BatchNorm2D(branch_ch), act_layer())
 
     def forward(self, x):
         if self.stride == 1:
@@ -304,27 +313,31 @@ class _ShuffleUnit(nn.Layer):
 
 
 class ShuffleNetV2(nn.Layer):
-    SCALES = {0.5: (48, 96, 192, 1024), 1.0: (116, 232, 464, 1024),
+    # ref: shufflenetv2.py stage_out_channels per scale (x0_25 ... x2_0)
+    SCALES = {0.25: (24, 48, 96, 512), 0.33: (32, 64, 128, 512),
+              0.5: (48, 96, 192, 1024), 1.0: (116, 232, 464, 1024),
               1.5: (176, 352, 704, 1024), 2.0: (244, 488, 976, 2048)}
 
-    def __init__(self, scale: float = 1.0, num_classes: int = 1000):
+    def __init__(self, scale: float = 1.0, num_classes: int = 1000,
+                 act: str = "relu"):
         super().__init__()
         c2, c3, c4, c5 = self.SCALES[scale]
+        act_layer = nn.Swish if act == "swish" else nn.ReLU
         self.stem = nn.Sequential(
             nn.Conv2D(3, 24, 3, stride=2, padding=1, bias_attr=False),
-            nn.BatchNorm2D(24), nn.ReLU(),
+            nn.BatchNorm2D(24), act_layer(),
             nn.MaxPool2D(3, stride=2, padding=1))
         stages = []
         in_ch = 24
         for out_ch, repeat in ((c2, 4), (c3, 8), (c4, 4)):
-            stages.append(_ShuffleUnit(in_ch, out_ch, 2))
+            stages.append(_ShuffleUnit(in_ch, out_ch, 2, act=act))
             for _ in range(repeat - 1):
-                stages.append(_ShuffleUnit(out_ch, out_ch, 1))
+                stages.append(_ShuffleUnit(out_ch, out_ch, 1, act=act))
             in_ch = out_ch
         self.stages = nn.Sequential(*stages)
         self.head = nn.Sequential(
             nn.Conv2D(in_ch, c5, 1, bias_attr=False),
-            nn.BatchNorm2D(c5), nn.ReLU(), nn.AdaptiveAvgPool2D(1))
+            nn.BatchNorm2D(c5), act_layer(), nn.AdaptiveAvgPool2D(1))
         self.fc = nn.Linear(c5, num_classes)
 
     def forward(self, x):
@@ -338,3 +351,181 @@ def shufflenet_v2_x1_0(**kw):
 
 def shufflenet_v2_x0_5(**kw):
     return ShuffleNetV2(0.5, **kw)
+
+
+def shufflenet_v2_x0_25(**kw):
+    return ShuffleNetV2(0.25, **kw)
+
+
+def shufflenet_v2_x0_33(**kw):
+    return ShuffleNetV2(0.33, **kw)
+
+
+def shufflenet_v2_x1_5(**kw):
+    return ShuffleNetV2(1.5, **kw)
+
+
+def shufflenet_v2_x2_0(**kw):
+    return ShuffleNetV2(2.0, **kw)
+
+
+def shufflenet_v2_swish(**kw):
+    return ShuffleNetV2(1.0, act="swish", **kw)
+
+
+# ---------------------------------------------------------------------------
+# InceptionV3 (ref: vision/models/inceptionv3.py — factorized
+# convolutions, 299x299 input, 2048-d head)
+# ---------------------------------------------------------------------------
+
+class _BasicConv2d(nn.Layer):
+    def __init__(self, in_c, out_c, kernel, stride=1, padding=0):
+        super().__init__()
+        self.conv = nn.Conv2D(in_c, out_c, kernel, stride=stride,
+                              padding=padding, bias_attr=False)
+        self.bn = nn.BatchNorm2D(out_c)
+
+    def forward(self, x):
+        from ..nn import functional as F
+        return F.relu(self.bn(self.conv(x)))
+
+
+class _InceptionA(nn.Layer):
+    def __init__(self, in_c, pool_features):
+        super().__init__()
+        self.b1 = _BasicConv2d(in_c, 64, 1)
+        self.b5 = nn.Sequential(_BasicConv2d(in_c, 48, 1),
+                                _BasicConv2d(48, 64, 5, padding=2))
+        self.b3 = nn.Sequential(_BasicConv2d(in_c, 64, 1),
+                                _BasicConv2d(64, 96, 3, padding=1),
+                                _BasicConv2d(96, 96, 3, padding=1))
+        self.pool_proj = _BasicConv2d(in_c, pool_features, 1)
+        self.pool = nn.AvgPool2D(3, stride=1, padding=1)
+
+    def forward(self, x):
+        return jnp.concatenate(
+            [self.b1(x), self.b5(x), self.b3(x),
+             self.pool_proj(self.pool(x))], axis=1)
+
+
+class _InceptionB(nn.Layer):
+    def __init__(self, in_c):
+        super().__init__()
+        self.b3 = _BasicConv2d(in_c, 384, 3, stride=2)
+        self.b3dbl = nn.Sequential(_BasicConv2d(in_c, 64, 1),
+                                   _BasicConv2d(64, 96, 3, padding=1),
+                                   _BasicConv2d(96, 96, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return jnp.concatenate(
+            [self.b3(x), self.b3dbl(x), self.pool(x)], axis=1)
+
+
+class _InceptionC(nn.Layer):
+    def __init__(self, in_c, c7):
+        super().__init__()
+        self.b1 = _BasicConv2d(in_c, 192, 1)
+        self.b7 = nn.Sequential(
+            _BasicConv2d(in_c, c7, 1),
+            _BasicConv2d(c7, c7, (1, 7), padding=(0, 3)),
+            _BasicConv2d(c7, 192, (7, 1), padding=(3, 0)))
+        self.b7dbl = nn.Sequential(
+            _BasicConv2d(in_c, c7, 1),
+            _BasicConv2d(c7, c7, (7, 1), padding=(3, 0)),
+            _BasicConv2d(c7, c7, (1, 7), padding=(0, 3)),
+            _BasicConv2d(c7, c7, (7, 1), padding=(3, 0)),
+            _BasicConv2d(c7, 192, (1, 7), padding=(0, 3)))
+        self.pool_proj = _BasicConv2d(in_c, 192, 1)
+        self.pool = nn.AvgPool2D(3, stride=1, padding=1)
+
+    def forward(self, x):
+        return jnp.concatenate(
+            [self.b1(x), self.b7(x), self.b7dbl(x),
+             self.pool_proj(self.pool(x))], axis=1)
+
+
+class _InceptionD(nn.Layer):
+    def __init__(self, in_c):
+        super().__init__()
+        self.b3 = nn.Sequential(_BasicConv2d(in_c, 192, 1),
+                                _BasicConv2d(192, 320, 3, stride=2))
+        self.b7x3 = nn.Sequential(
+            _BasicConv2d(in_c, 192, 1),
+            _BasicConv2d(192, 192, (1, 7), padding=(0, 3)),
+            _BasicConv2d(192, 192, (7, 1), padding=(3, 0)),
+            _BasicConv2d(192, 192, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return jnp.concatenate(
+            [self.b3(x), self.b7x3(x), self.pool(x)], axis=1)
+
+
+class _InceptionE(nn.Layer):
+    def __init__(self, in_c):
+        super().__init__()
+        self.b1 = _BasicConv2d(in_c, 320, 1)
+        self.b3_stem = _BasicConv2d(in_c, 384, 1)
+        self.b3_a = _BasicConv2d(384, 384, (1, 3), padding=(0, 1))
+        self.b3_b = _BasicConv2d(384, 384, (3, 1), padding=(1, 0))
+        self.b3dbl_stem = nn.Sequential(
+            _BasicConv2d(in_c, 448, 1),
+            _BasicConv2d(448, 384, 3, padding=1))
+        self.b3dbl_a = _BasicConv2d(384, 384, (1, 3), padding=(0, 1))
+        self.b3dbl_b = _BasicConv2d(384, 384, (3, 1), padding=(1, 0))
+        self.pool_proj = _BasicConv2d(in_c, 192, 1)
+        self.pool = nn.AvgPool2D(3, stride=1, padding=1)
+
+    def forward(self, x):
+        s = self.b3_stem(x)
+        d = self.b3dbl_stem(x)
+        return jnp.concatenate(
+            [self.b1(x),
+             self.b3_a(s), self.b3_b(s),
+             self.b3dbl_a(d), self.b3dbl_b(d),
+             self.pool_proj(self.pool(x))], axis=1)
+
+
+class InceptionV3(nn.Layer):
+    """ref: vision/models/inceptionv3.py InceptionV3(num_classes,
+    with_pool). 299x299 input canonical; any size >= 75 works."""
+
+    def __init__(self, num_classes: int = 1000, with_pool: bool = True):
+        super().__init__()
+        self.stem = nn.Sequential(
+            _BasicConv2d(3, 32, 3, stride=2),
+            _BasicConv2d(32, 32, 3),
+            _BasicConv2d(32, 64, 3, padding=1),
+            nn.MaxPool2D(3, stride=2),
+            _BasicConv2d(64, 80, 1),
+            _BasicConv2d(80, 192, 3),
+            nn.MaxPool2D(3, stride=2))
+        self.blocks = nn.Sequential(
+            _InceptionA(192, 32), _InceptionA(256, 64),
+            _InceptionA(288, 64),
+            _InceptionB(288),
+            _InceptionC(768, 128), _InceptionC(768, 160),
+            _InceptionC(768, 160), _InceptionC(768, 192),
+            _InceptionD(768),
+            _InceptionE(1280), _InceptionE(2048))
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.5)
+            self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.dropout(x.reshape(x.shape[0], -1))
+            x = self.fc(x)
+        return x
+
+
+def inception_v3(**kw):
+    return InceptionV3(**kw)

@@ -97,6 +97,11 @@ import pytest as _pytest
 @_pytest.mark.parametrize("ctor,size", [
     ("alexnet", 224), ("squeezenet1_1", 64), ("densenet121", 64),
     ("googlenet", 64), ("shufflenet_v2_x0_5", 64),
+    # round-3 zoo completions (ref: vision/models/__init__.py __all__)
+    ("resnext50_32x4d", 64), ("wide_resnet50_2", 64),
+    ("mobilenet_v3_small", 64), ("mobilenet_v3_large", 64),
+    ("shufflenet_v2_x0_25", 64), ("shufflenet_v2_swish", 64),
+    ("densenet169", 64), ("inception_v3", 75),
 ])
 def test_extra_vision_family_forward(ctor, size):
     import numpy as _np
