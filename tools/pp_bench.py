@@ -34,12 +34,7 @@ def _time_steps(fn, args, steps):
     return (time.perf_counter() - t0) / steps
 
 
-def _mem_bytes(jitted, *args):
-    m = jitted.lower(*args).compile().memory_analysis()
-    return float(m.temp_size_in_bytes + m.argument_size_in_bytes)
-
-
-def gpt_pp_vs_dense(steps: int):
+def gpt_pp_vs_dense(steps: int, quiet: bool = False):
     import paddle_tpu as pt
     from paddle_tpu import parallel
     from paddle_tpu.models.gpt import (GPTConfig, GPTForCausalLM,
@@ -67,7 +62,7 @@ def gpt_pp_vs_dense(steps: int):
         parallel.distributed_model(model, mesh=mesh)
         return model
 
-    def measure(name, model):
+    def measure(name, model, quiet=False):
         model._sync_state_in()
         if model._train_step_fn is None:
             model._train_step_fn = model._build_train_step()
@@ -78,46 +73,53 @@ def gpt_pp_vs_dense(steps: int):
         key = rng_mod.split_for_step(0)
         step_args = (model._params, model._frozen, model._opt_state,
                      model._buffers, 0, key, inputs, labels)
-        mem = _mem_bytes(model._train_step_fn, *step_args)
-
-        def run():
-            logs = model.train_batch([ids], [ids])
-            return logs["loss"]
-
-        run()  # compile
+        # ONE AOT compilation serves both the memory analysis and the
+        # timing loop (donated state threads output -> input each step)
+        compiled = model._train_step_fn.lower(*step_args).compile()
+        m = compiled.memory_analysis()
+        mem = float(m.temp_size_in_bytes + m.argument_size_in_bytes)
+        params, opt, bufs = (model._params, model._opt_state,
+                             model._buffers)
+        loss, params, opt, bufs, _ = compiled(
+            params, model._frozen, opt, bufs, 0, key, inputs, labels)
+        bufs = dict(bufs)  # step returns OrderedDict; AOT pytree is dict
+        jax.block_until_ready(loss)
         t0 = time.perf_counter()
         for _ in range(steps):
-            loss = run()
+            loss, params, opt, bufs, _ = compiled(
+                params, model._frozen, opt, bufs, 0, key, inputs, labels)
+            bufs = dict(bufs)
         float(np.asarray(loss))
         dt = (time.perf_counter() - t0) / steps
         results[name] = {"step_s": round(dt, 4),
                          "mem_mib_per_dev": round(mem / 2**20, 1)}
-        print(f"{name:28s} step {dt*1e3:8.1f} ms   "
-              f"mem/dev {mem/2**20:8.1f} MiB")
+        if not quiet:
+            print(f"{name:28s} step {dt*1e3:8.1f} ms   "
+                  f"mem/dev {mem/2**20:8.1f} MiB")
 
     try:
         mesh = parallel.init_mesh(dp=8)
-        measure("dense dp=8", build(False, mesh))
+        measure("dense dp=8", build(False, mesh), quiet)
         parallel.set_mesh(None)
 
         for pp, v, m in ((2, 1, 8), (2, 2, 8), (4, 1, 8), (4, 2, 8)):
             mesh = parallel.init_mesh(pp=pp, dp=8 // pp)
             measure(f"pp={pp} v={v} m={m} dp={8//pp}",
                     build(True, mesh, num_microbatches=m,
-                          virtual_pp_degree=v))
+                          virtual_pp_degree=v), quiet)
             parallel.set_mesh(None)
 
         # tp inside pp (the round-3 capability)
         mesh = parallel.init_mesh(pp=2, tp=2, dp=2)
         measure("pp=2 tp=2 dp=2 v=1 m=8",
-                build(True, mesh, num_microbatches=8))
+                build(True, mesh, num_microbatches=8), quiet)
         parallel.set_mesh(None)
     finally:
         parallel.set_mesh(None)
     return results
 
 
-def host_embedding_vs_dense(steps: int):
+def host_embedding_vs_dense(steps: int, quiet: bool = False):
     import paddle_tpu as pt
     from paddle_tpu.nn.layers.host_embedding import HostOffloadedEmbedding
     from paddle_tpu.nn.layers.sparse_embedding import SparseEmbedding
@@ -139,9 +141,10 @@ def host_embedding_vs_dense(steps: int):
            "host_lookup_s": round(t_host, 5),
            "host_overhead_x": round(t_host / t_dense, 2),
            "lookups_per_s_host": round(batch * k / t_host, 0)}
-    print(f"embedding lookup  dense {t_dense*1e3:.2f} ms   "
-          f"host-offloaded {t_host*1e3:.2f} ms   "
-          f"({res['host_overhead_x']}x)")
+    if not quiet:
+        print(f"embedding lookup  dense {t_dense*1e3:.2f} ms   "
+              f"host-offloaded {t_host*1e3:.2f} ms   "
+              f"({res['host_overhead_x']}x)")
     return res
 
 
@@ -150,10 +153,10 @@ def main():
     ap.add_argument("--steps", type=int, default=8)
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args()
-    pp = gpt_pp_vs_dense(args.steps)
-    emb = host_embedding_vs_dense(max(args.steps, 16))
-    line = {"pp": pp, "embedding": emb}
-    print(json.dumps(line))
+    pp = gpt_pp_vs_dense(args.steps, quiet=args.json)
+    emb = host_embedding_vs_dense(max(args.steps, 16), quiet=args.json)
+    if args.json:
+        print(json.dumps({"pp": pp, "embedding": emb}))
 
 
 if __name__ == "__main__":
