@@ -1,0 +1,52 @@
+"""Minimal training loop: LeNet on synthetic MNIST-shaped data.
+
+Run: python examples/mnist_lenet.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")  # delete on a real TPU host
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def synthetic_mnist(n=512, seed=0):
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, 10, n)
+    imgs = rng.randn(n, 1, 28, 28).astype(np.float32) * 0.1
+    for i, y in enumerate(labels):          # class-dependent blob
+        imgs[i, 0, y * 2:y * 2 + 4, y * 2:y * 2 + 4] += 1.0
+    return imgs, labels[:, None]
+
+
+def main():
+    paddle.seed(0)
+    xs, ys = synthetic_mnist()
+    ds = paddle.io.TensorDataset([xs, ys])
+    loader = paddle.io.DataLoader(ds, batch_size=64, shuffle=True)
+
+    net = paddle.models.LeNet()
+    model = paddle.Model(net)
+    model.prepare(
+        optimizer=paddle.optimizer.Adam(learning_rate=1e-3,
+                                        parameters=net),
+        loss=nn.CrossEntropyLoss(),
+        metrics=[paddle.metric.Accuracy()])
+    model.fit(loader, epochs=3, verbose=1)
+    eval_logs = model.evaluate(loader, verbose=0)
+    print("final:", {k: float(v) for k, v in eval_logs.items()})
+
+    model.save("/tmp/lenet_example")        # params + optimizer state
+    print("saved to /tmp/lenet_example*")
+
+
+if __name__ == "__main__":
+    main()

@@ -197,6 +197,13 @@ class Model:
         n_labels = len(self._labels_spec) if self._labels_spec else 1
         return batch[:-n_labels], batch[-n_labels:]
 
+    @property
+    def compiled_shape_count(self) -> int:
+        """Distinct input (shape, dtype) signatures the train/eval steps
+        have seen — each one is a separate XLA compile (the quantity the
+        recompile guard and io.sequence bucketing bound)."""
+        return len(self._shape_signatures)
+
     def _guard_recompiles(self, inputs, labels) -> None:
         """Every distinct input shape recompiles the jitted step (XLA
         static shapes — SURVEY §7 hard parts). Track the signatures seen

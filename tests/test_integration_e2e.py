@@ -1,0 +1,81 @@
+"""Capstone integration: the framework's round-3 pieces composed in one
+scenario — GPT with pp x tp x dp hybrid parallelism, step-granular
+AutoCheckpoint, a (programmatic) preemption mid-run, and a lossless
+resume on a fresh model instance. The in-process analog of running
+examples/gpt_hybrid_parallel.py, killing it, and re-running it."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import parallel
+from paddle_tpu.distributed import elastic
+from paddle_tpu.io.checkpoint import AutoCheckpoint
+from paddle_tpu.models.gpt import (GPTConfig, GPTForCausalLMPipe,
+                                   GPTPretrainingCriterion)
+
+pytestmark = pytest.mark.slow  # smoke tier skips (tools/ci.sh --smoke)
+
+TOTAL = 12
+PREEMPT_AT = 5
+
+
+def _build(mesh):
+    pt.seed(0)
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=4,
+                    num_heads=4, max_position_embeddings=32,
+                    hidden_dropout=0.0, attention_dropout=0.0,
+                    use_flash=False)
+    net = GPTForCausalLMPipe(cfg, num_microbatches=2, mesh=mesh)
+    model = pt.Model(net)
+    model.prepare(
+        optimizer=pt.optimizer.AdamW(learning_rate=1e-3, parameters=net,
+                                     weight_decay=0.01),
+        loss=GPTPretrainingCriterion())
+    parallel.distributed_model(model, mesh=mesh)
+    return cfg, model
+
+
+def _batch(step, cfg):
+    rng = np.random.RandomState(100 + step)
+    return rng.randint(0, cfg.vocab_size, (4, 32))
+
+
+def _run(ckpt_dir, preempt_at=None):
+    """Train through acp.epochs; optionally 'preempt' (trigger + drain)
+    at a step. Returns {step: loss}."""
+    mesh = parallel.init_mesh(pp=2, tp=2, dp=2)
+    losses = {}
+    try:
+        cfg, model = _build(mesh)
+        guard = elastic.PreemptionGuard(install=False)  # programmatic
+        acp = AutoCheckpoint.for_model(str(ckpt_dir), model)
+        for step in acp.epochs(TOTAL):
+            ids = _batch(step, cfg)
+            logs = model.train_batch([ids], [ids])
+            losses[step] = float(logs["loss"])
+            acp.commit(step)
+            if step == preempt_at:
+                guard.trigger()            # the SIGTERM analog
+            if guard.check(exit=False):    # checkpoint already committed
+                return losses
+    finally:
+        parallel.set_mesh(None)
+    return losses
+
+
+def test_hybrid_parallel_preempt_resume_lossless(tmp_path):
+    base = _run(tmp_path / "baseline")
+    assert sorted(base) == list(range(TOTAL))
+
+    first = _run(tmp_path / "resumed", preempt_at=PREEMPT_AT)
+    assert sorted(first) == list(range(PREEMPT_AT + 1))
+
+    second = _run(tmp_path / "resumed")     # fresh model, resumes
+    assert sorted(second) == list(range(PREEMPT_AT + 1, TOTAL))
+
+    merged = {**first, **second}
+    for step in range(TOTAL):
+        np.testing.assert_allclose(
+            merged[step], base[step], rtol=1e-5,
+            err_msg=f"step {step} diverged across preempt/resume")
