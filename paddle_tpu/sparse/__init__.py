@@ -199,3 +199,154 @@ def add(a, b):
     if isinstance(a, SparseCooTensor):
         return a + b
     return b + a
+
+
+# ---------------------------------------------------------------------------
+# round-3 sparse-yaml surface fills (ref: phi/api/yaml/sparse_api.yaml)
+# ---------------------------------------------------------------------------
+
+def cos(sp):
+    return _unary(jnp.cos, sp)
+
+
+def acos(sp):
+    return _unary(jnp.arccos, sp)
+
+
+def acosh(sp):
+    return _unary(jnp.arccosh, sp)
+
+
+def asinh(sp):
+    return _unary(jnp.arcsinh, sp)
+
+
+def atan(sp):
+    return _unary(jnp.arctan, sp)
+
+
+def atanh(sp):
+    return _unary(jnp.arctanh, sp)
+
+
+def sinh(sp):
+    return _unary(jnp.sinh, sp)
+
+
+def tan(sp):
+    return _unary(jnp.tan, sp)
+
+
+def relu6(sp):
+    return _unary(lambda v: jnp.clip(v, 0, 6), sp)
+
+
+def leaky_relu(sp, negative_slope: float = 0.01):
+    return _unary(lambda v: jnp.where(v >= 0, v, negative_slope * v), sp)
+
+
+def subtract(a: SparseCooTensor, b):
+    """sparse - sparse/dense (ref: sparse_api.yaml subtract)."""
+    if isinstance(b, SparseCooTensor):
+        return a + _unary(jnp.negative, b)
+    return a.to_dense() - b
+
+
+def multiply(a: SparseCooTensor, b):
+    """Elementwise product; sparse pattern is preserved (zero * x = 0),
+    so a dense operand is gathered at the nonzero coordinates."""
+    if isinstance(b, SparseCooTensor):
+        # pattern intersection, O(nnz) — never densify
+        return SparseCooTensor(jsparse.bcoo_multiply_sparse(
+            a._bcoo.sum_duplicates(), b._bcoo.sum_duplicates()))
+    b = jnp.asarray(b)
+    if b.ndim == 0:
+        return _unary(lambda v: v * b, a)
+    coords = tuple(a._bcoo.indices.T)
+    return SparseCooTensor(jsparse.BCOO(
+        (a._bcoo.data * b[coords], a._bcoo.indices), shape=a.shape))
+
+
+def divide(a: SparseCooTensor, b):
+    """ref: sparse_api.yaml divide / divide_scalar."""
+    b_arr = jnp.asarray(b.to_dense() if isinstance(b, SparseCooTensor)
+                        else b)
+    if b_arr.ndim == 0:
+        return _unary(lambda v: v / b_arr, a)
+    coords = tuple(a._bcoo.indices.T)
+    return SparseCooTensor(jsparse.BCOO(
+        (a._bcoo.data / b_arr[coords], a._bcoo.indices), shape=a.shape))
+
+
+divide_scalar = divide
+
+
+def softmax(sp: SparseCooTensor, axis: int = -1) -> SparseCooTensor:
+    """Softmax over the nonzeros of each row (ref: sparse_api.yaml
+    softmax — the sparse-attention normalizer: absent entries are
+    -inf, not 0). 2-D, last axis."""
+    if axis not in (-1, sp._bcoo.ndim - 1):
+        raise NotImplementedError("sparse softmax: last axis only")
+    if sp._bcoo.ndim != 2:
+        raise NotImplementedError(
+            "sparse softmax: 2-D only (batched rows would need segment "
+            "ids built from all leading index columns)")
+    b = sp._bcoo.sum_duplicates()
+    rows = b.indices[:, 0]
+    n_rows = b.shape[0]
+    import jax
+    row_max = jax.ops.segment_max(b.data, rows, n_rows)
+    e = jnp.exp(b.data - row_max[rows])
+    denom = jax.ops.segment_sum(e, rows, n_rows)
+    return SparseCooTensor(jsparse.BCOO((e / denom[rows], b.indices),
+                                        shape=b.shape))
+
+
+def addmm(input, x: SparseCooTensor, y, beta: float = 1.0,
+          alpha: float = 1.0):
+    """beta*input + alpha*(x @ y) (ref: sparse_api.yaml addmm)."""
+    return beta * jnp.asarray(input) + alpha * (x._bcoo @ jnp.asarray(y))
+
+
+def full_like(sp: SparseCooTensor, fill_value) -> SparseCooTensor:
+    return _unary(lambda v: jnp.full_like(v, fill_value), sp)
+
+
+def values(sp: SparseCooTensor):
+    return sp.values()
+
+
+def to_dense(sp: SparseCooTensor):
+    return sp.to_dense()
+
+
+coo_to_dense = to_dense
+
+
+def to_sparse_coo(x, sparse_dim=None):
+    x = jnp.asarray(x)
+    if sparse_dim is not None and sparse_dim != x.ndim:
+        raise NotImplementedError(
+            "hybrid COO (sparse_dim < ndim: dense inner values) is not "
+            "supported; use sparse_dim=None for fully-sparse")
+    return SparseCooTensor.from_dense(x)
+
+
+dense_to_coo = to_sparse_coo
+create_sparse_coo_tensor = sparse_coo_tensor
+
+
+def to_sparse_csr(x):
+    """CSR view: (crows, cols, values) host tuple — XLA computes on the
+    BCOO form; CSR is an interchange format here (module docstring)."""
+    import numpy as np
+    xs = np.asarray(x if not isinstance(x, SparseCooTensor)
+                    else x.to_dense())
+    if xs.ndim != 2:
+        raise ValueError("to_sparse_csr expects a 2-D tensor")
+    rows, cols = np.nonzero(xs)
+    vals = xs[rows, cols]
+    crows = np.zeros(xs.shape[0] + 1, np.int64)
+    np.add.at(crows, rows + 1, 1)
+    crows = np.cumsum(crows)
+    return (jnp.asarray(crows), jnp.asarray(cols), jnp.asarray(vals))

@@ -28,6 +28,30 @@ YAML_FILES = (
     "/root/reference/paddle/phi/api/yaml/legacy_api.yaml",
 )
 
+# sparse tensor surface (sparse_api.yaml) — resolved against
+# paddle_tpu.sparse; strings_api.yaml is declined wholesale (string
+# tensors are host-side data prep on TPU; python/numpy own them — XLA
+# has no string compute and the reference's strings kernels are
+# CPU-only there too).
+SPARSE_YAML = "/root/reference/paddle/phi/api/yaml/sparse_api.yaml"
+SPARSE_SNAPSHOT = """abs acos acosh add addmm asin asinh atan atanh cast
+coalesce conv3d coo_to_dense create_sparse_coo_tensor dense_to_coo
+divide divide_scalar expm1 full_like fused_attention leaky_relu log1p
+masked_matmul matmul maxpool multiply mv pow relu relu6 scale sin sinh
+softmax sqrt square subtract tan tanh to_dense to_sparse_coo
+to_sparse_csr values""".split()
+
+SPARSE_DECLINED = {
+    "conv3d": "submanifold sparse 3-D convolution (point clouds): a "
+              "gather-scatter kernel dominated by irregular memory "
+              "access — hostile to MXU tiling; TPU point-cloud "
+              "pipelines voxelize to dense conv3d (F.conv3d)",
+    "maxpool": "same irregular-access family as sparse conv3d",
+    "fused_attention": "sparse-pattern attention is served by the "
+                       "Pallas flash/ring attention kernels (dense "
+                       "tiles with masking beat gather-scatter on TPU)",
+}
+
 # Fallback snapshot (sorted) for machines without the reference checkout.
 SNAPSHOT = """abs accuracy acos acosh adadelta adam_ adamax adamw add add_n
 addmm all allclose angle any arange argmax argmin argsort as_complex
@@ -169,11 +193,36 @@ def _namespaces():
     }
 
 
+def sparse_ops():
+    if not os.path.exists(SPARSE_YAML):
+        return sorted(set(SPARSE_SNAPSHOT))
+    names = set()
+    for line in open(SPARSE_YAML):
+        m = re.match(r"^- (?:sparse_)?api\s*:\s*(\w+)", line)
+        if m:
+            names.add(m.group(1))
+    return sorted(names)
+
+
 def classify():
     ns = _namespaces()
     search_order = ("tensor", "paddle", "functional", "linalg", "nn",
                     "vision")
     out = {"direct": [], "alias": [], "declined": [], "missing": []}
+    import paddle_tpu.sparse as sparse_mod
+    for name in sparse_ops():
+        if name in SPARSE_DECLINED:
+            out["declined"].append((f"sparse.{name}",
+                                    SPARSE_DECLINED[name]))
+        elif hasattr(sparse_mod, name):
+            out["direct"].append((f"sparse.{name}", "sparse"))
+        else:
+            out["missing"].append((f"sparse.{name}",
+                                   "missing from paddle_tpu.sparse"))
+    out["declined"].append((
+        "strings.* (strings_api.yaml: empty/empty_like/lower/upper)",
+        "string tensors are host-side data prep; python/numpy own them "
+        "on TPU (the reference's strings kernels are CPU-only as well)"))
     for name in reference_ops():
         target = ALIASES.get(name)
         if target:
