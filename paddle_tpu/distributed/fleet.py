@@ -167,6 +167,13 @@ from . import fs as utils  # noqa: E402
 #    framework.
 #  - HDFS/AFS shells: see distributed/fs.py (LocalFS implemented,
 #    HDFS/AFS declined with pointer).
+#  - distributed.metric (reference python/paddle/distributed/metric/
+#    metrics.py): a yaml-driven config shim over the PS fleet_wrapper's
+#    MetricMsg aggregation. In single-controller SPMD, metric state
+#    arrays are GLOBAL (paddle_tpu.metric.Auc accumulates sharded
+#    batches exactly); cross-process aggregation, when state is kept
+#    host-local, is parallel.all_reduce on the stat arrays. No yaml
+#    indirection to port.
 
 
 def init_worker(*a, **kw):
