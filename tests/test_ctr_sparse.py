@@ -39,7 +39,7 @@ def test_hash_ids_folds_out_of_range():
     out = emb(huge)
     # multiply-shift (Fibonacci) whitening before the modulo — a bare
     # id % N clusters structured CTR key spaces onto hot rows
-    h = (np.uint32(2000000001) * np.uint32(0x9E3779B9)) & 0xFFFFFFFF
+    h = (2000000001 * 0x9E3779B9) & 0xFFFFFFFF  # uint32 wraparound
     h ^= h >> 16
     expected_row = 1 + h % 9
     np.testing.assert_allclose(out[0],

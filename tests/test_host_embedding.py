@@ -159,6 +159,32 @@ def test_widedeep_style_training_with_large_table(tmp_path):
         bad.restore(snap)
 
 
+def test_pool_index_survives_merges_and_growth():
+    """The sorted-index + tail map (r4 vectorization) returns the same
+    rows across index merges, pool growth, and duplicate-heavy batches
+    as a fresh table touching the same ids."""
+    a = HostOffloadedEmbedding(10_000_000, 8, seed=3, padding_idx=None,
+                               optimizer="sgd", learning_rate=1.0)
+    rng = np.random.RandomState(7)
+    seen = []
+    for _ in range(12):                    # crosses the 1024 merge gate
+        ids = rng.randint(1, 10_000_000, (64, 8))
+        seen.append(ids)
+        a._pull(ids)
+    b = HostOffloadedEmbedding(10_000_000, 8, seed=3, padding_idx=None,
+                               optimizer="sgd", learning_rate=1.0)
+    probe = np.concatenate([s.reshape(-1) for s in seen])[::17]
+    np.testing.assert_allclose(a._pull(probe), b._pull(probe))
+    # duplicate-heavy push merges before the rule step (vectorized path)
+    dup_ids = np.full((32,), int(probe[0]), np.int64)
+    before = a._pull(probe[:1])[0].copy()
+    a._push(dup_ids, np.ones((32, 8), np.float32))
+    np.testing.assert_allclose(a._pull(probe[:1])[0], before - 32.0,
+                               rtol=1e-6)
+    # sgd table never allocates the accumulator pool; snapshot is clean
+    assert a._pool_acc is None and len(a._accum) == 0
+
+
 def test_geo_merge_averages_held_rows(tmp_path):
     """Geo-SGD periodic merge: rows average over the replicas that hold
     them; rows unique to one replica pass through unchanged."""
