@@ -143,8 +143,9 @@ _PS_MSG = ("the parameter-server runtime is replaced by (a) sharded "
            "SparseEmbedding tables over the mesh (nn.SparseEmbedding; "
            "SURVEY §7 step 8) for tables that fit pod HBM, and (b) "
            "host-RAM tables with streamed pull/push for beyond-HBM "
-           "vocabularies (nn.HostOffloadedEmbedding — the "
-           "MemorySparseTable/communicator redesign) — run collective "
+           "vocabularies (nn.HostOffloadedEmbedding; key-range-sharded "
+           "across hosts as nn.ShardedHostEmbedding — the "
+           "MemorySparseTable/brpc-routing redesign) — run collective "
            "mode: fleet.init(is_collective=True)")
 
 

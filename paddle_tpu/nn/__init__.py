@@ -37,6 +37,7 @@ from .layers.moe import (GShardGate, MoELayer, NaiveGate,  # noqa
 from .layers.sparse_embedding import (MultiSlotEmbedding,  # noqa
                                       SparseEmbedding)
 from .layers.host_embedding import HostOffloadedEmbedding  # noqa
+from .layers.sharded_embedding import ShardedHostEmbedding  # noqa
 from .layers.rnn import (GRU, LSTM, RNN, BiRNN, GRUCell, LSTMCell,  # noqa
                          SimpleRNN, SimpleRNNCell)
 from .layers.transformer import (MultiHeadAttention, Transformer,  # noqa

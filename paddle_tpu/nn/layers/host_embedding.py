@@ -36,9 +36,10 @@ Known trade (documented): the pull callback serializes host gather into
 the step (the reference's async mode hid this behind staleness); at CTR
 batch sizes the gather is microseconds-per-KB and amortized by device
 compute. Multi-host: each process holds the full table for its local
-batch (data-parallel PS-per-host); the key-range-sharded variant where
-aggregate capacity scales with the cluster is the round-4 work item
-tracked in VERDICT.md ask #2.
+batch (data-parallel PS-per-host); for tables beyond one host's RAM use
+:class:`~.sharded_embedding.ShardedHostEmbedding`, which key-range
+shards rows over the mesh so aggregate capacity scales with the
+cluster.
 """
 
 from __future__ import annotations
@@ -83,6 +84,25 @@ def _row_init(ids: np.ndarray, dim: int, seed: int,
     # without a float64 intermediate pass
     u = (z >> np.uint64(40)).astype(np.float32) * np.float32(2.0 ** -24)
     return u * np.float32(2.0 * scale) - np.float32(scale)
+
+
+def pooled_combine(ids, emb, padding_idx, combiner):
+    """MultiSlot pooling shared by the host-offloaded and key-sharded
+    embeddings: padding rows contribute zero; sum/mean/sqrtn over the
+    slot axis."""
+    b, k = ids.shape
+    if padding_idx is not None:
+        mask = (ids != padding_idx)[..., None]
+        emb = emb * mask.astype(emb.dtype)
+        counts = mask.sum(axis=1).astype(emb.dtype)
+    else:
+        counts = jnp.full((b, 1), float(k), emb.dtype)
+    pooled = emb.sum(axis=1)
+    if combiner == "mean":
+        pooled = pooled / jnp.maximum(counts, 1.0)
+    elif combiner == "sqrtn":
+        pooled = pooled / jnp.sqrt(jnp.maximum(counts, 1.0))
+    return pooled
 
 
 class _PoolView(Mapping):
@@ -430,20 +450,8 @@ class HostOffloadedEmbedding(Layer):
 
     def forward(self, ids):
         ids = self._fold_ids(jnp.asarray(ids))
-        b, k = ids.shape
         emb = self._lookup(ids)                      # [b, k, D]
-        if self.padding_idx is not None:
-            mask = (ids != self.padding_idx)[..., None]
-            emb = emb * mask.astype(emb.dtype)
-            counts = mask.sum(axis=1).astype(emb.dtype)
-        else:
-            counts = jnp.full((b, 1), float(k), emb.dtype)
-        pooled = emb.sum(axis=1)
-        if self.combiner == "mean":
-            pooled = pooled / jnp.maximum(counts, 1.0)
-        elif self.combiner == "sqrtn":
-            pooled = pooled / jnp.sqrt(jnp.maximum(counts, 1.0))
-        return pooled
+        return pooled_combine(ids, emb, self.padding_idx, self.combiner)
 
     # -- snapshot lifecycle (save_sparse_table analog) ----------------------
     @property

@@ -38,3 +38,41 @@ def test_two_process_allreduce_and_dp_parity(tmp_path):
     np.testing.assert_allclose(out["losses"], base, rtol=2e-4, atol=2e-5,
                                err_msg="2-process DP losses diverge from "
                                        "single-process baseline")
+
+
+def test_sharded_embedding_exceeds_single_host_budget(tmp_path):
+    """Key-range-sharded host embedding across 2 OS processes (VERDICT
+    r3 ask #2): the aggregate table exceeds any single per-host row
+    budget, WideDeep trains with loss parity vs the unsharded
+    single-process run, and a mid-run generation restart from sharded
+    snapshots resumes losslessly."""
+    from paddle_tpu import distributed
+
+    budget = 2000
+    ctx = distributed.spawn(dist_worker.sharded_embedding_train,
+                            args=(str(tmp_path), 12, 8, budget),
+                            nprocs=2, join=False)
+    ok = ctx.join(timeout=420)
+    for p in ctx.processes:
+        if p.exitcode is None:
+            p.terminate()
+    assert ok, "sharded-embedding multi-process run failed or timed out"
+
+    r0 = json.loads((tmp_path / "rank0.json").read_text())
+    r1 = json.loads((tmp_path / "rank1.json").read_text())
+    base, total_rows = dist_worker.sharded_embedding_baseline(12, 8)
+
+    # capacity law: the whole table fits NO single host budget, but the
+    # per-host shards each do — capacity scaled with the cluster.
+    # (The worker itself asserts the sharded restore round-trips every
+    # local row; the budget check raises in-step if a host overflows.)
+    assert total_rows > budget, (total_rows, budget)
+    assert r0["rows_final"] <= budget and r1["rows_final"] <= budget
+    assert r0["rows_final"] + r1["rows_final"] == total_rows
+    assert min(r0["rows_step8"], r1["rows_step8"]) > 0
+
+    # loss parity with the unsharded reference, across the restart
+    np.testing.assert_allclose(r0["losses"], base, rtol=2e-4, atol=2e-5,
+                               err_msg="sharded-embedding losses diverge "
+                                       "from unsharded baseline")
+    np.testing.assert_allclose(r0["losses"], r1["losses"], rtol=1e-6)

@@ -1,0 +1,114 @@
+"""Key-range-sharded beyond-HBM embedding (VERDICT r3 ask #2) on the
+single-process 8-device mesh: routed pull/push parity with the
+unsharded table, exactly-once updates, sharded snapshot re-keying.
+The REAL 2-OS-process run (aggregate capacity > any one host budget +
+generation restart) lives in tests/test_dist_multiprocess.py.
+
+Reference analog: paddle/fluid/distributed/ps/table/memory_sparse_table.h
+(key-sharded tables), service/brpc_ps_client.cc (id → shard routing)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu import nn, parallel
+from paddle_tpu.nn.layers.host_embedding import HostOffloadedEmbedding
+from paddle_tpu.nn.layers.sharded_embedding import (
+    ShardedHostEmbedding, _owned_device_indices)
+
+pytestmark = pytest.mark.slow  # smoke tier skips (tools/ci.sh --smoke)
+
+
+@pytest.fixture
+def dp8_mesh():
+    mesh = parallel.init_mesh(dp=8)
+    yield mesh
+    parallel.set_mesh(None)
+
+
+def test_forward_and_push_parity_with_unsharded(dp8_mesh):
+    """psum-routed gather == dense host-table lookup, and the backward
+    routes each row's grad to exactly one owner (updates match the
+    unsharded accessor step exactly)."""
+    from paddle_tpu.nn.layer import functional_call, split_state
+
+    pt.seed(0)
+    sh = ShardedHostEmbedding(100_000, 8, seed=5, optimizer="sgd",
+                              learning_rate=1.0, padding_idx=None)
+    un = HostOffloadedEmbedding(100_000, 8, seed=5, optimizer="sgd",
+                                learning_rate=1.0, padding_idx=None)
+    ids = np.random.RandomState(0).randint(1, 100_000, (16, 4))
+
+    np.testing.assert_allclose(np.asarray(sh(ids)), np.asarray(un(ids)),
+                               rtol=1e-6)
+
+    params, _ = split_state(sh)
+
+    def loss(p, i):
+        out, _ = functional_call(sh, p, {}, i)
+        return out.sum()
+
+    g = jax.grad(loss)(params, jnp.asarray(ids))
+    jax.effects_barrier()
+    np.testing.assert_allclose(np.asarray(g["push_anchor"]), 0.0)
+    # d(sum)/d(row) = 1 per occurrence; lr=1 sgd → row -= #occurrences,
+    # applied ONCE by the owning device (not once per device)
+    flat = np.unique(ids.reshape(-1))
+    before = un._pull(flat)
+    un._push(ids.reshape(-1),
+             np.ones((ids.size, 8), np.float32))
+    np.testing.assert_allclose(sh._local._pull(flat), un._pull(flat),
+                               rtol=1e-6)
+    assert not np.allclose(un._pull(flat), before)
+
+
+def test_padding_and_combiners_match_unsharded(dp8_mesh):
+    pt.seed(0)
+    for combiner in ("sum", "mean", "sqrtn"):
+        sh = ShardedHostEmbedding(1000, 4, seed=2, combiner=combiner)
+        un = HostOffloadedEmbedding(1000, 4, seed=2, combiner=combiner)
+        ids = np.array([[5, 0, 9, 0], [3, 3, 0, 0],
+                        [0, 0, 0, 0], [7, 1, 2, 4]] * 2)  # 8 rows
+        np.testing.assert_allclose(np.asarray(sh(ids)),
+                                   np.asarray(un(ids)), rtol=1e-6,
+                                   err_msg=combiner)
+
+
+def test_ownership_and_restore_rekey(dp8_mesh, tmp_path):
+    """Every device index is owned by this (single) process; restoring
+    shard files re-filters rows by the CURRENT world size."""
+    mine = _owned_device_indices(dp8_mesh.mesh, "dp")
+    np.testing.assert_array_equal(mine, np.arange(8))
+
+    sh = ShardedHostEmbedding(10_000, 4, seed=1)
+    ids = np.arange(1, 65).reshape(8, 8)
+    sh(ids)
+    assert sh.touched_rows_local == 64
+    path = sh.snapshot_shard(str(tmp_path / "t"))
+    assert path.endswith(".shard0of1.npz")
+
+    fresh = ShardedHostEmbedding(10_000, 4, seed=1)
+    fresh.restore_shards([path])
+    assert fresh.touched_rows_local == 64
+    np.testing.assert_allclose(fresh._local._pull(np.arange(1, 65)),
+                               sh._local._pull(np.arange(1, 65)))
+    bad = ShardedHostEmbedding(99, 4)
+    with pytest.raises(ValueError, match="shape mismatch"):
+        bad.restore_shards([path])
+    # fold-scheme mismatch refused (same guard as the unsharded table)
+    folded = ShardedHostEmbedding(10_000, 4, hash_ids=True)
+    with pytest.raises(ValueError, match="fold scheme"):
+        folded.restore_shards([path])
+
+
+def test_degenerate_mesh_falls_back_to_local_table():
+    """No dp axis installed → the plain host-table path (same rows)."""
+    parallel.set_mesh(None)
+    sh = ShardedHostEmbedding(1000, 4, seed=3)
+    un = HostOffloadedEmbedding(1000, 4, seed=3)
+    ids = np.array([[1, 2, 3, 0]])
+    np.testing.assert_allclose(np.asarray(sh(ids)), np.asarray(un(ids)),
+                               rtol=1e-6)
