@@ -87,3 +87,81 @@ class CostModel:
                         **kw) -> ProgramCost:
         """Name parity with the reference's measuring entry point."""
         return self.profile(fn, args, measure=True, **kw)
+
+
+@dataclass
+class MemoryProfile:
+    temp_bytes: int       # XLA temp buffers (activations, workspaces)
+    argument_bytes: int
+    output_bytes: int
+
+    @property
+    def total_bytes(self) -> int:
+        return self.temp_bytes + self.argument_bytes + self.output_bytes
+
+
+def memory_profile(fn: Callable, args: Tuple = (),
+                   static_argnums=()) -> MemoryProfile:
+    """Compiled per-device memory of a jitted program — the
+    backend-independent footprint XLA's ``memory_analysis`` reports.
+    Used by the perf-regression gate (tests/test_perf_gate.py) so
+    memory wins (fused_xent's no-logits path, flash attention's O(s)
+    temps, pipeline partitioning) stay provable without a chip."""
+    compiled = jax.jit(fn, static_argnums=static_argnums) \
+        .lower(*args).compile()
+    m = compiled.memory_analysis()
+    if isinstance(m, list):  # per-device list on some backends
+        m = m[0] if m else None
+    if m is None:
+        raise RuntimeError(
+            "memory_analysis unavailable on this backend; the perf "
+            "gate needs a backend whose PJRT client reports it "
+            "(CPU and TPU both do)")
+    return MemoryProfile(int(m.temp_size_in_bytes),
+                         int(m.argument_size_in_bytes),
+                         int(m.output_size_in_bytes))
+
+
+@dataclass
+class CollectiveStats:
+    instructions: int = 0
+    elements: int = 0
+
+
+_COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter",
+                "all-to-all", "collective-permute")
+# async all-gather-start / collective-permute-start carry their INPUT
+# buffers in the result tuple; only the last member is the output
+_START_CARRIES_INPUT = ("all-gather", "collective-permute")
+
+
+def collective_elements(compiled_or_text) -> Dict[str, "CollectiveStats"]:
+    """Per-collective instruction + element counts parsed from
+    optimized HLO — the communication-volume side of the perf gate
+    (e.g. DP grad sync must be ONE fused all-reduce of exactly the
+    parameter count: element volume catches a doubled sync, the
+    instruction count catches per-layer unfusing). ``-start/-done``
+    async pairs count once (the ``-start`` line)."""
+    import math
+    import re
+
+    text = compiled_or_text if isinstance(compiled_or_text, str) \
+        else compiled_or_text.as_text()
+    pat = re.compile(r"=\s*(.+?)\s*(" +
+                     "|".join(re.escape(c) for c in _COLLECTIVES) +
+                     r")(-start)?\(")
+    counts: Dict[str, CollectiveStats] = {}
+    for line in text.splitlines():
+        m = pat.search(line)
+        if not m:
+            continue
+        op, is_start = m.group(2), bool(m.group(3))
+        shapes = re.findall(r"[a-z0-9]+\[([\d,]*)\]", m.group(1))
+        if is_start and op in _START_CARRIES_INPUT and len(shapes) > 1:
+            shapes = shapes[-1:]
+        stats = counts.setdefault(op, CollectiveStats())
+        stats.instructions += 1
+        stats.elements += sum(
+            math.prod(int(x) for x in shp.split(",")) if shp else 1
+            for shp in shapes)
+    return counts
