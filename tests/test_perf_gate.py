@@ -200,6 +200,7 @@ def test_gpt_train_step_flops_and_memory_budget():
     ratio = float(ca["flops"]) / analytic
     assert 1.0 <= ratio <= 1.30, ratio
 
-    m = comp.memory_analysis()
-    mib = (m.temp_size_in_bytes + m.output_size_in_bytes) / 2**20
+    from paddle_tpu.cost_model import memory_profile_compiled
+    m = memory_profile_compiled(comp)
+    mib = (m.temp_bytes + m.output_bytes) / 2**20
     assert mib <= 230, mib
