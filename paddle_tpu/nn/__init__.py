@@ -45,3 +45,11 @@ from .layers.transformer import (MultiHeadAttention, Transformer,  # noqa
                                  TransformerEncoder, TransformerEncoderLayer)
 
 from . import utils  # noqa  (weight_norm/spectral_norm/vector packing)
+from .layers.fill_r4 import (  # noqa: E402,F401
+    AdaptiveMaxPool3D, BeamSearchDecoder, ChannelShuffle, CTCLoss,
+    Conv1DTranspose, Conv3DTranspose, CosineEmbeddingLoss, Dropout3D,
+    HSigmoidLoss, HingeEmbeddingLoss, InstanceNorm1D, InstanceNorm3D,
+    LogSigmoid, MarginRankingLoss, MaxUnPool1D, MaxUnPool2D,
+    MaxUnPool3D, MultiLabelSoftMarginLoss, ParameterList, RNNCellBase,
+    Silu, Softmax2D, SpectralNorm, TripletMarginLoss,
+    TripletMarginWithDistanceLoss, dynamic_decode)

@@ -288,7 +288,14 @@ def _pool(x, init, reduce_fn, kernel, stride, padding, data_format,
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0,
-               data_format="NCHW"):
+               return_mask=False, data_format="NCHW"):
+    if return_mask:
+        from .functional_fill import max_pool_with_mask
+        if data_format != "NCHW":
+            raise ValueError("return_mask supports NCHW only")
+        k = _norm_tuple(kernel_size, 2)
+        return max_pool_with_mask(x, k, _norm_tuple(stride or k, 2),
+                                  _norm_tuple(padding, 2))
     return _pool(x, -jnp.inf, lax.max, kernel_size, stride, padding,
                  data_format)
 
@@ -299,7 +306,15 @@ def avg_pool2d(x, kernel_size, stride=None, padding=0,
                  count_include_pad=count_include_pad, average=True)
 
 
-def max_pool1d(x, kernel_size, stride=None, padding=0, data_format="NCL"):
+def max_pool1d(x, kernel_size, stride=None, padding=0,
+               return_mask=False, data_format="NCL"):
+    if return_mask:
+        from .functional_fill import max_pool_with_mask
+        if data_format != "NCL":
+            raise ValueError("return_mask supports NCL only")
+        k = _norm_tuple(kernel_size, 1)
+        return max_pool_with_mask(x, k, _norm_tuple(stride or k, 1),
+                                  _norm_tuple(padding, 1))
     return _pool(x, -jnp.inf, lax.max, kernel_size, stride, padding,
                  "NLC" if data_format == "NLC" else "NCHW")
 
@@ -312,7 +327,14 @@ def avg_pool1d(x, kernel_size, stride=None, padding=0,
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0,
-               data_format="NCDHW"):
+               return_mask=False, data_format="NCDHW"):
+    if return_mask:
+        from .functional_fill import max_pool_with_mask
+        if data_format != "NCDHW":
+            raise ValueError("return_mask supports NCDHW only")
+        k = _norm_tuple(kernel_size, 3)
+        return max_pool_with_mask(x, k, _norm_tuple(stride or k, 3),
+                                  _norm_tuple(padding, 3))
     return _pool(x, -jnp.inf, lax.max, kernel_size, stride, padding,
                  "NDHWC" if data_format == "NDHWC" else "NCHW")
 
@@ -948,3 +970,7 @@ def swiglu(x, gate=None):
     if gate is None:
         x, gate = jnp.split(x, 2, axis=-1)
     return jax.nn.silu(x) * gate
+
+
+# -- round-4 surface completion (tools/api_coverage.py) ---------------------
+from .functional_fill import *  # noqa: E402,F401,F403

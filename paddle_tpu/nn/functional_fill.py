@@ -1,0 +1,585 @@
+"""nn.functional surface completion (VERDICT r3 ask #4; enumerated by
+tools/api_coverage.py against the reference's
+python/paddle/nn/functional/__init__.py __all__).
+
+Every fill is a real jnp/lax implementation (XLA fuses; no kernels to
+register). Reference files cited per function. The ``*_`` activation
+family is functional (returns, never mutates) — see
+tensor/extra.py's recorded stance on inplace ops.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..core import rng as _rng
+
+
+# ---------------------------------------------------------------------------
+# conv / shape utilities
+# ---------------------------------------------------------------------------
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCL", name=None):
+    """1-D transposed conv as a width-1 2-D transposed conv (ref:
+    nn/functional/conv.py conv1d_transpose)."""
+    from .functional import conv2d_transpose
+    x = jnp.asarray(x)
+    if data_format == "NLC":
+        x = jnp.swapaxes(x, 1, 2)
+    x4 = x[:, :, None, :]                      # NCL → NC1L
+    w4 = jnp.asarray(weight)[:, :, None, :]
+    s = stride if isinstance(stride, int) else stride[0]
+    p = padding if isinstance(padding, int) else padding[0]
+    d = dilation if isinstance(dilation, int) else dilation[0]
+    op = output_padding if isinstance(output_padding, int) \
+        else output_padding[0]
+    out = conv2d_transpose(x4, w4, bias=bias, stride=(1, s),
+                           padding=(0, p), output_padding=(0, op),
+                           groups=groups, dilation=(1, d))
+    out = out[:, :, 0, :]
+    if output_size is not None:
+        want = output_size if isinstance(output_size, int) \
+            else output_size[0]
+        out = out[..., :want]
+    if data_format == "NLC":
+        out = jnp.swapaxes(out, 1, 2)
+    return out
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    """Batched diagonal embedding (ref: nn/functional/extension.py
+    diag_embed)."""
+    x = jnp.asarray(x)
+    n = x.shape[-1] + abs(offset)
+    out = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+    idx = jnp.arange(x.shape[-1])
+    r = idx + max(-offset, 0)
+    c = idx + max(offset, 0)
+    out = out.at[..., r, c].set(x)
+    # move the two new axes into position
+    nd = out.ndim
+    d1, d2 = dim1 % nd, dim2 % nd
+    perm = [i for i in range(nd) if i not in (nd - 2, nd - 1)]
+    order = sorted([d1, d2])
+    perm.insert(order[0], nd - 2 if d1 < d2 else nd - 1)
+    perm.insert(order[1], nd - 1 if d1 < d2 else nd - 2)
+    return jnp.transpose(out, np.argsort(perm)) \
+        if perm != list(range(nd)) else out
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    x = jnp.asarray(x)
+    left, right, top, bottom = (padding if not isinstance(padding, int)
+                                else (padding,) * 4)
+    if data_format == "NHWC":
+        pads = ((0, 0), (top, bottom), (left, right), (0, 0))
+    else:
+        pads = ((0, 0), (0, 0), (top, bottom), (left, right))
+    return jnp.pad(x, pads)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    """out[n, o] = x1[n, :] @ W[o] @ x2[n, :] (ref:
+    nn/functional/common.py bilinear; layers Bilinear)."""
+    x1, x2, w = jnp.asarray(x1), jnp.asarray(x2), jnp.asarray(weight)
+    out = jnp.einsum("ni,oij,nj->no", x1, w, x2)
+    if bias is not None:
+        out = out + jnp.asarray(bias).reshape(1, -1)
+    return out
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    """Channel-wise 3-D dropout (whole [D,H,W] features drop — ref:
+    nn/functional/common.py dropout3d)."""
+    x = jnp.asarray(x)
+    if not training or p == 0.0:
+        return x
+    ch_axis = 1 if data_format == "NCDHW" else -1
+    shape = [1] * x.ndim
+    shape[0] = x.shape[0]
+    shape[ch_axis] = x.shape[ch_axis]
+    keep = jax.random.bernoulli(_rng.next_key(), 1.0 - p, tuple(shape))
+    return jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True,
+          name=None):
+    """Randomized leaky relu (ref: nn/functional/activation.py rrelu):
+    training draws slope~U[lower, upper] per element; eval uses the
+    mean slope."""
+    x = jnp.asarray(x)
+    if training:
+        a = jax.random.uniform(_rng.next_key(), x.shape, x.dtype,
+                               lower, upper)
+    else:
+        a = (lower + upper) / 2.0
+    return jnp.where(x >= 0, x, a * x)
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    from .functional import adaptive_avg_pool3d  # shape rules shared
+    x = jnp.asarray(x)
+    if isinstance(output_size, int):
+        output_size = (output_size,) * 3
+    n, c, d, h, w = x.shape
+    od, oh, ow = output_size
+    if d % od or h % oh or w % ow:
+        raise ValueError("adaptive_max_pool3d needs divisible sizes")
+    r = x.reshape(n, c, od, d // od, oh, h // oh, ow, w // ow)
+    out = r.max(axis=(3, 5, 7))
+    if return_mask:
+        raise NotImplementedError(
+            "return_mask for adaptive 3d pooling is not supported; "
+            "use max_pool3d(..., return_mask=True)")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# max-pool argmax masks + the max-unpool family (ref:
+# nn/functional/pooling.py max_poolNd(return_mask=True) / max_unpoolNd)
+# ---------------------------------------------------------------------------
+
+def max_pool_with_mask(x, kernel, stride, padding):
+    """(pooled, flat-argmax-indices) for NC* layouts, any spatial rank.
+    Indices are flat over the input's spatial dims per (N, C) plane —
+    what max_unpoolNd consumes. Built on conv_general_dilated_patches
+    (channel-slowest ordering verified) with -inf padding so padded
+    cells never win the argmax."""
+    x = jnp.asarray(x)
+    nd = len(kernel)
+    spatial = x.shape[2:]
+    pads = [(int(p), int(p)) for p in padding]
+    # finite sentinel, not -inf: the patches op multiplies by a one-hot
+    # kernel and -inf * 0 = NaN (runtime-confirmed in review)
+    lowest = float(jnp.finfo(x.dtype).min) \
+        if jnp.issubdtype(x.dtype, jnp.floating) \
+        else int(jnp.iinfo(x.dtype).min)
+    xpad = jnp.pad(x, [(0, 0), (0, 0)] + pads,
+                   constant_values=lowest)
+    patches = lax.conv_general_dilated_patches(
+        xpad, kernel, stride, padding=[(0, 0)] * nd)
+    n, c = x.shape[:2]
+    k_total = math.prod(kernel)
+    out_sp = patches.shape[2:]
+    patches = patches.reshape((n, c, k_total) + out_sp)
+    vals = patches.max(axis=2)
+    local = patches.argmax(axis=2)                 # flat over kernel
+    # local → per-dim offsets → global input coords → flat index
+    flat = jnp.zeros_like(local)
+    rem = local
+    coords = []
+    for i in range(nd - 1, -1, -1):
+        coords.append(rem % kernel[i])
+        rem = rem // kernel[i]
+    coords = coords[::-1]                          # per-dim offsets
+    for i in range(nd):
+        grid = jnp.arange(out_sp[i]) * stride[i] - padding[i]
+        shape = [1] * (2 + nd)
+        shape[2 + i] = out_sp[i]
+        gpos = coords[i] + grid.reshape(shape)
+        flat = flat * spatial[i] + gpos
+    return vals, flat
+
+
+from .functional import _norm_tuple  # noqa: E402  (shared helper)
+
+def _unpool(x, indices, spatial_out):
+    """Scatter pooled values back at their argmax positions. ``indices``
+    are flat over the spatial dims per (N, C) plane — the reference's
+    mask convention."""
+    x, indices = jnp.asarray(x), jnp.asarray(indices)
+    n, c = x.shape[:2]
+    flat_sz = math.prod(spatial_out)
+    vals = x.reshape(n, c, -1)
+    idx = indices.reshape(n, c, -1)
+    out = jnp.zeros((n, c, flat_sz), x.dtype)
+    out = jax.vmap(jax.vmap(
+        lambda o, i, v: o.at[i].set(v)))(out, idx, vals)
+    return out.reshape((n, c) + tuple(spatial_out))
+
+
+def _unpool_out_size(in_sz, kernel, stride, padding):
+    return (in_sz - 1) * stride - 2 * padding + kernel
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCL", name=None):
+    (k,) = _norm_tuple(kernel_size, 1)
+    (s,) = _norm_tuple(stride or k, 1)
+    (p,) = _norm_tuple(padding, 1)
+    l = _unpool_out_size(jnp.asarray(x).shape[-1], k, s, p) \
+        if output_size is None else tuple(output_size)[-1]
+    return _unpool(x, indices, (int(l),))
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCHW", name=None):
+    if isinstance(kernel_size, int):
+        kernel_size = (kernel_size,) * 2
+    stride = stride or kernel_size
+    if isinstance(stride, int):
+        stride = (stride,) * 2
+    if isinstance(padding, int):
+        padding = (padding,) * 2
+    x = jnp.asarray(x)
+    if output_size is None:
+        hw = tuple(_unpool_out_size(s, k, st, p) for s, k, st, p in
+                   zip(x.shape[-2:], kernel_size, stride, padding))
+    else:
+        hw = tuple(output_size)[-2:]
+    return _unpool(x, indices, hw)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCDHW", name=None):
+    if isinstance(kernel_size, int):
+        kernel_size = (kernel_size,) * 3
+    stride = stride or kernel_size
+    if isinstance(stride, int):
+        stride = (stride,) * 3
+    if isinstance(padding, int):
+        padding = (padding,) * 3
+    x = jnp.asarray(x)
+    if output_size is None:
+        dhw = tuple(_unpool_out_size(s, k, st, p) for s, k, st, p in
+                    zip(x.shape[-3:], kernel_size, stride, padding))
+    else:
+        dhw = tuple(output_size)[-3:]
+    return _unpool(x, indices, dhw)
+
+
+# ---------------------------------------------------------------------------
+# losses (ref: python/paddle/nn/functional/loss.py)
+# ---------------------------------------------------------------------------
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return loss.mean()
+    if reduction == "sum":
+        return loss.sum()
+    return loss
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0,
+                          reduction="mean", name=None):
+    x1, x2 = jnp.asarray(input1), jnp.asarray(input2)
+    label = jnp.asarray(label)
+    cos = (x1 * x2).sum(-1) / (
+        jnp.linalg.norm(x1, axis=-1) * jnp.linalg.norm(x2, axis=-1)
+        + 1e-12)
+    loss = jnp.where(label == 1, 1.0 - cos,
+                     jnp.maximum(0.0, cos - margin))
+    return _reduce(loss, reduction)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean",
+                         name=None):
+    x, y = jnp.asarray(input), jnp.asarray(label)
+    loss = jnp.where(y == 1, x, jnp.maximum(0.0, margin - x))
+    return _reduce(loss, reduction)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0,
+                        reduction="mean", name=None):
+    x, o, y = (jnp.asarray(a) for a in (input, other, label))
+    loss = jnp.maximum(0.0, -y * (x - o) + margin)
+    return _reduce(loss, reduction)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean", name=None):
+    x, y = jnp.asarray(input), jnp.asarray(label)
+    loss = -(y * jax.nn.log_sigmoid(x)
+             + (1 - y) * jax.nn.log_sigmoid(-x))
+    if weight is not None:
+        loss = loss * jnp.asarray(weight)
+    loss = loss.mean(axis=-1)
+    return _reduce(loss, reduction)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean",
+                        name=None):
+    a, pos, neg = (jnp.asarray(t) for t in (input, positive, negative))
+
+    def dist(u, v):
+        return ((jnp.abs(u - v) + epsilon) ** p).sum(-1) ** (1.0 / p)
+
+    d_pos, d_neg = dist(a, pos), dist(a, neg)
+    if swap:
+        d_neg = jnp.minimum(d_neg, dist(pos, neg))
+    return _reduce(jnp.maximum(0.0, d_pos - d_neg + margin), reduction)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None,
+                                      margin=1.0, swap=False,
+                                      reduction="mean", name=None):
+    a, pos, neg = (jnp.asarray(t) for t in (input, positive, negative))
+    d = distance_function or (
+        lambda u, v: jnp.linalg.norm(u - v, axis=-1))
+    d_pos, d_neg = d(a, pos), d(a, neg)
+    if swap:
+        d_neg = jnp.minimum(d_neg, d(pos, neg))
+    return _reduce(jnp.maximum(0.0, d_pos - d_neg + margin), reduction)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """1 - Dice coefficient over the last (class-prob) axis (ref:
+    loss.py dice_loss: input [N, ..., C] probs, label [N, ..., 1]
+    int)."""
+    x = jnp.asarray(input)
+    y = jnp.asarray(label).squeeze(-1)
+    y1 = jax.nn.one_hot(y, x.shape[-1], dtype=x.dtype)
+    red = tuple(range(1, x.ndim))
+    inter = (x * y1).sum(red)
+    union = x.sum(red) + y1.sum(red)
+    return (1.0 - (2.0 * inter + epsilon) / (union + epsilon)).mean()
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    """N-pair loss (ref loss.py npair_loss): softmax CE over
+    anchor·positiveᵀ with same-label targets + L2 on embeddings."""
+    a, p = jnp.asarray(anchor), jnp.asarray(positive)
+    y = jnp.asarray(labels).reshape(-1)
+    sim = a @ p.T                                  # [B, B]
+    tgt = (y[:, None] == y[None, :]).astype(a.dtype)
+    tgt = tgt / tgt.sum(-1, keepdims=True)
+    ce = (-tgt * jax.nn.log_softmax(sim, axis=-1)).sum(-1).mean()
+    reg = l2_reg * ((a * a).sum(-1) + (p * p).sum(-1)).mean() / 2.0
+    return ce + reg
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25,
+                       gamma=2.0, reduction="sum", name=None):
+    x, y = jnp.asarray(logit), jnp.asarray(label)
+    p = jax.nn.sigmoid(x)
+    ce = -(y * jax.nn.log_sigmoid(x) + (1 - y) * jax.nn.log_sigmoid(-x))
+    p_t = p * y + (1 - p) * (1 - y)
+    a_t = alpha * y + (1 - alpha) * (1 - y)
+    loss = a_t * ((1 - p_t) ** gamma) * ce
+    if normalizer is not None:
+        loss = loss / jnp.asarray(normalizer)
+    return _reduce(loss, reduction)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid over the DEFAULT complete binary tree (ref:
+    loss.py hsigmoid_loss; operators/hierarchical_sigmoid_op). Custom
+    path tables follow the same math with user codes."""
+    x = jnp.asarray(input)
+    y = jnp.asarray(label).reshape(-1)
+    w = jnp.asarray(weight)
+    code_len = int(math.ceil(math.log2(max(num_classes, 2))))
+    if path_table is not None:
+        table = jnp.asarray(path_table)
+        codes = jnp.asarray(path_code).astype(x.dtype)
+        mask = (table >= 0).astype(x.dtype)
+        table = jnp.maximum(table, 0)
+    else:
+        # complete-tree: internal node ids along the root→leaf path
+        ids = y + num_classes          # leaf position in the heap
+        steps = []
+        code = []
+        cur = ids
+        for _ in range(code_len):
+            code.append((cur % 2).astype(x.dtype))
+            cur = cur // 2
+            steps.append(cur)
+        table = jnp.stack(steps[::-1], axis=1) - 1   # internal idx
+        codes = jnp.stack(code[::-1], axis=1)
+        mask = (table >= 0) & (table < w.shape[0])
+        mask = mask.astype(x.dtype)
+        table = jnp.clip(table, 0, w.shape[0] - 1)
+    logits = jnp.einsum("bd,bkd->bk", x, w[table])
+    if bias is not None:
+        logits = logits + jnp.asarray(bias).reshape(-1)[table]
+    # label bit 1 → sigmoid(logit), 0 → 1-sigmoid: BCE per node
+    ce = -(codes * jax.nn.log_sigmoid(logits)
+           + (1 - codes) * jax.nn.log_sigmoid(-logits))
+    return (ce * mask).sum(-1, keepdims=True).mean()
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean",
+                         name=None):
+    """ArcFace-family margin softmax (ref loss.py margin_cross_entropy:
+    cos(m1·θ + m2) − m3 applied to the target logit). Single-shard
+    math; TP sharding composes via the mesh, not a process group."""
+    x = jnp.asarray(logits)
+    y = jnp.asarray(label).reshape(-1)
+    cos = jnp.clip(x, -1.0, 1.0)
+    theta = jnp.arccos(cos)
+    tgt = jax.nn.one_hot(y, x.shape[-1], dtype=x.dtype)
+    adj = jnp.cos(margin1 * theta + margin2) - margin3
+    out = scale * jnp.where(tgt > 0, adj, cos)
+    logp = jax.nn.log_softmax(out, axis=-1)
+    loss = -(tgt * logp).sum(-1)
+    loss = _reduce(loss, reduction)
+    if return_softmax:
+        return loss, jnp.exp(logp)
+    return loss
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Sample negative class centers + remap labels (ref: loss.py
+    class_center_sample, the PartialFC sampler). Host-side numpy
+    sampling — call OUTSIDE jit, like the reference's data-prep use."""
+    y = np.asarray(label).reshape(-1)
+    pos = np.unique(y)
+    n_extra = max(0, num_samples - len(pos))
+    rest = np.setdiff1d(np.arange(num_classes), pos)
+    host_seed = int(np.asarray(jax.random.randint(
+        _rng.next_key(), (), 0, 2**31 - 1)))
+    rng = np.random.RandomState(host_seed)
+    neg = rng.choice(rest, size=min(n_extra, len(rest)), replace=False)
+    sampled = np.sort(np.concatenate([pos, neg]))
+    remap = {c: i for i, c in enumerate(sampled.tolist())}
+    new_y = np.asarray([remap[int(v)] for v in y], y.dtype)
+    return jnp.asarray(new_y), jnp.asarray(sampled)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """Connectionist temporal classification via the log-domain
+    forward algorithm, scanned over time (ref: loss.py ctc_loss →
+    warpctc_op; here lax.scan replaces warp-ctc). ``log_probs``
+    [T, N, C] are logits — softmax is applied internally, matching the
+    reference."""
+    lp = jax.nn.log_softmax(jnp.asarray(log_probs, jnp.float32), -1)
+    labels = jnp.asarray(labels)
+    t_max, n, _ = lp.shape
+    s_max = labels.shape[1]
+    # extended label sequence: blank l1 blank l2 ... blank lS blank
+    ext_len = 2 * s_max + 1
+    ext = jnp.full((n, ext_len), blank, labels.dtype)
+    ext = ext.at[:, 1::2].set(labels)
+    in_len = jnp.asarray(input_lengths).reshape(-1)
+    lab_len = jnp.asarray(label_lengths).reshape(-1)
+    ext_valid = 2 * lab_len + 1
+
+    neg_inf = -1e30
+    # α init: positions 0 (blank) and 1 (first label)
+    alpha0 = jnp.full((n, ext_len), neg_inf)
+    alpha0 = alpha0.at[:, 0].set(lp[0, jnp.arange(n), ext[:, 0]])
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(s_max > 0, lp[0, jnp.arange(n), ext[:, 1]], neg_inf))
+
+    same_as_prev2 = jnp.concatenate(
+        [jnp.ones((n, 2), bool),
+         ext[:, 2:] == ext[:, :-2]], axis=1)
+
+    def step(alpha, t):
+        a_prev = alpha
+        a_shift1 = jnp.concatenate(
+            [jnp.full((n, 1), neg_inf), alpha[:, :-1]], axis=1)
+        a_shift2 = jnp.concatenate(
+            [jnp.full((n, 2), neg_inf), alpha[:, :-2]], axis=1)
+        a_shift2 = jnp.where(same_as_prev2, neg_inf, a_shift2)
+        merged = jnp.logaddexp(jnp.logaddexp(a_prev, a_shift1),
+                               a_shift2)
+        emit = jnp.take_along_axis(lp[t], ext, axis=1)
+        new = merged + emit
+        # freeze past each sample's input length
+        new = jnp.where((t < in_len)[:, None], new, alpha)
+        return new, None
+
+    alpha, _ = lax.scan(step, alpha0, jnp.arange(1, t_max))
+    idx = jnp.arange(n)
+    last = alpha[idx, jnp.maximum(ext_valid - 1, 0)]
+    last2 = jnp.where(ext_valid >= 2,
+                      alpha[idx, jnp.maximum(ext_valid - 2, 0)],
+                      neg_inf)
+    loss = -jnp.logaddexp(last, last2)
+    if norm_by_times:
+        loss = loss / jnp.maximum(in_len, 1).astype(loss.dtype)
+    if reduction == "mean":
+        # reference divides each sample by its label length, then means
+        return (loss / jnp.maximum(lab_len, 1)).mean()
+    if reduction == "sum":
+        return loss.sum()
+    return loss
+
+
+def gather_tree(ids, parents):
+    """Beam-search backtrace (ref: nn/functional/extension.py
+    gather_tree; operators/gather_tree_op): walk parent pointers from
+    the last step, emitting the realigned token ids."""
+    ids, parents = jnp.asarray(ids), jnp.asarray(parents)
+    t_max = ids.shape[0]
+
+    def step(beam_idx, t):
+        tok = jnp.take_along_axis(ids[t], beam_idx, axis=-1)
+        par = jnp.take_along_axis(parents[t], beam_idx, axis=-1)
+        return par, tok
+
+    init = jnp.broadcast_to(jnp.arange(ids.shape[-1]), ids.shape[1:])
+    _, toks = lax.scan(step, init, jnp.arange(t_max - 1, -1, -1))
+    return jnp.flip(toks, axis=0)
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """Block-sparse attention evaluated as masked dense attention (ref:
+    nn/functional/sparse_attention.py — CUDA-only there). On TPU dense
+    tiles with masking beat gather/scatter; the flash/ring kernels in
+    ops/ are the production path, this keeps API+semantics parity."""
+    q, k, v = (jnp.asarray(t) for t in (query, key, value))
+    offs = jnp.asarray(sparse_csr_offset)
+    cols = jnp.asarray(sparse_csr_columns)
+    b, h, s, d = q.shape
+    scores = q @ jnp.swapaxes(k, -1, -2) / math.sqrt(d)
+    # vectorized CSR expansion: nonzero j belongs to row r iff
+    # offs[r] <= j < offs[r+1]
+    nnz = cols.shape[-1]
+    j = jnp.arange(nnz)
+    starts = offs[..., None, :-1]                  # [b, h, 1, s]
+    ends = offs[..., None, 1:]
+    hits = ((j[:, None] >= starts) & (j[:, None] < ends))
+    rows = jnp.argmax(hits, axis=-1)               # [b, h, nnz]
+    mask = jnp.zeros((b, h, s, s), bool)
+    bi = jnp.arange(b)[:, None, None]
+    hi = jnp.arange(h)[None, :, None]
+    mask = mask.at[bi, hi, rows, cols].set(True)
+    scores = jnp.where(mask, scores, -1e30)
+    if key_padding_mask is not None:
+        kp = jnp.asarray(key_padding_mask)[:, None, None, :]
+        scores = jnp.where(kp > 0, scores, -1e30)
+    if attn_mask is not None:
+        scores = scores + jnp.asarray(attn_mask)
+    p = jax.nn.softmax(scores, axis=-1)
+    p = jnp.where(mask, p, 0.0)
+    return p @ v
+
+
+# -- functional inplace-name aliases (see tensor/extra.py stance) ----------
+
+def relu_(x, name=None):
+    return jax.nn.relu(jnp.asarray(x))
+
+
+def elu_(x, alpha=1.0, name=None):
+    return jax.nn.elu(jnp.asarray(x), alpha)
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    x = jnp.asarray(x)
+    if dtype is not None:
+        x = x.astype(dtype)
+    return jax.nn.softmax(x, axis=axis)
+
+
+def tanh_(x, name=None):
+    return jnp.tanh(jnp.asarray(x))

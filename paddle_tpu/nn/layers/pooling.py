@@ -15,7 +15,7 @@ class MaxPool2D(Layer):
 
     def forward(self, x):
         return F.max_pool2d(x, self.kernel_size, self.stride, self.padding,
-                            self.data_format)
+                            data_format=self.data_format)
 
 
 class AvgPool2D(Layer):
@@ -80,7 +80,7 @@ class MaxPool3D(Layer):
 
     def forward(self, x):
         return F.max_pool3d(x, self.kernel_size, self.stride,
-                            self.padding, self.data_format)
+                            self.padding, data_format=self.data_format)
 
 
 class AvgPool3D(Layer):

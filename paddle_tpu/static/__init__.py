@@ -21,6 +21,20 @@ from typing import Sequence
 
 from .. import jit as _jit
 from ..jit import InputSpec  # noqa: F401
+from . import program as _program
+from .program import (  # noqa: F401
+    BuildStrategy, CompiledProgram, ExecutionStrategy,
+    ExponentialMovingAverage, ParallelExecutor, Print, Program, Scope,
+    Variable, WeightNormParamAttr, accuracy, append_backward, auc,
+    cpu_places, create_global_var, create_parameter, ctr_metric_bundle,
+    cuda_places, data, default_main_program, default_startup_program,
+    deserialize_persistables, deserialize_program, device_guard,
+    exponential_decay, global_scope, gradients, load, load_from_file,
+    load_program_state, mlu_places, name_scope, normalize_program,
+    npu_places, program_guard, py_func, save, save_to_file,
+    scope_guard, serialize_persistables, serialize_program,
+    set_program_state, xpu_places)
+from . import nn  # noqa: F401
 
 
 def save_inference_model(path_prefix: str, feed_vars, fetch_vars=None,
@@ -43,15 +57,24 @@ def load_inference_model(path_prefix: str, executor=None, **_ignored):
 
 
 class Executor:
-    """Serving-run facade (ref: fluid/executor.py Executor.run — the
-    inference direction only; training goes through Model/jit)."""
+    """ref: fluid/executor.py:621 Executor.run. Dispatches on the
+    program kind: a static ``Program`` (closure-DAG evaluation, the
+    training direction) or a TranslatedLayer from
+    load_inference_model (the serving direction)."""
 
     def __init__(self, place=None):
         self.place = place
+        self._static = _program.StaticExecutor(place)
 
-    def run(self, program, feed=None, fetch_list=None):
-        """``program`` is a TranslatedLayer from load_inference_model;
-        ``feed`` a dict or list of input arrays (ordered)."""
+    def run(self, program=None, feed=None, fetch_list=None,
+            return_numpy=True):
+        if program is None or isinstance(program, _program.Program) or \
+                isinstance(program, _program.CompiledProgram):
+            if isinstance(program, _program.CompiledProgram):
+                program = program.program
+            return self._static.run(program, feed=feed,
+                                    fetch_list=fetch_list or (),
+                                    return_numpy=return_numpy)
         if feed is None:
             raise ValueError("feed required")
         inputs = list(feed.values()) if isinstance(feed, dict) else \

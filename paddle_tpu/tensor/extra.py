@@ -1,0 +1,283 @@
+"""Tensor-surface completion fills (VERDICT r3 ask #4 — public-API
+parity beyond the op yamls; enumerated by tools/api_coverage.py against
+the reference's tensor_method_func list,
+reference: python/paddle/tensor/__init__.py:281, and the top-level
+``paddle.*`` __all__, python/paddle/__init__.py).
+
+Two deliberate semantic stances, recorded once here:
+
+- **Inplace ``*_`` family**: the reference's trailing-underscore ops
+  mutate their input and return it (python/paddle/tensor/math.py
+  ``add_`` etc. via inplace kernels). jax.Arrays are immutable — every
+  ``x_()`` here computes the same value and RETURNS it without
+  mutating. Code written against the reference's dominant idiom
+  (``y = x.add_(1)`` / chained calls) behaves identically; code
+  relying on aliasing side effects (mutating a view updates the base)
+  must be ported to functional style — XLA donation gives the same
+  memory reuse under jit without aliasing semantics.
+- **Random ``uniform_`` / ``exponential_``**: draw fresh samples of the
+  input's shape from the global generator (core.rng) instead of
+  overwriting in place.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import rng as _rng
+
+# ---------------------------------------------------------------------------
+# elementwise / reduction fills
+# ---------------------------------------------------------------------------
+
+
+def deg2rad(x, name=None):
+    return jnp.deg2rad(jnp.asarray(x))
+
+
+def rad2deg(x, name=None):
+    return jnp.rad2deg(jnp.asarray(x))
+
+
+def frac(x, name=None):
+    """Fractional part, sign-preserving: x - trunc(x) (ref
+    tensor/math.py frac)."""
+    x = jnp.asarray(x)
+    return x - jnp.trunc(x)
+
+
+def gcd(x, y, name=None):
+    return jnp.gcd(jnp.asarray(x), jnp.asarray(y))
+
+
+def lcm(x, y, name=None):
+    return jnp.lcm(jnp.asarray(x), jnp.asarray(y))
+
+
+def heaviside(x, y, name=None):
+    return jnp.heaviside(jnp.asarray(x), jnp.asarray(y))
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    return jnp.nansum(jnp.asarray(x), axis=axis, dtype=dtype,
+                      keepdims=keepdim)
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return jnp.nanmean(jnp.asarray(x), axis=axis, keepdims=keepdim)
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return jnp.nanmedian(jnp.asarray(x), axis=axis, keepdims=keepdim)
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    return jnp.nanquantile(jnp.asarray(x), q, axis=axis,
+                           keepdims=keepdim)
+
+
+def neg(x, name=None):
+    return -jnp.asarray(x)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    """b * tanh(a * x) (ref operators stanh_op)."""
+    return scale_b * jnp.tanh(scale_a * jnp.asarray(x))
+
+
+def floor_mod(x, y, name=None):
+    from . import mod
+    return mod(x, y)
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Clamp the p-norm of every sub-tensor along ``axis`` to
+    ``max_norm`` (ref tensor/math.py renorm)."""
+    x = jnp.asarray(x)
+    reduce_axes = tuple(i for i in range(x.ndim) if i != axis % x.ndim)
+    norms = jnp.sum(jnp.abs(x) ** p, axis=reduce_axes,
+                    keepdims=True) ** (1.0 / p)
+    factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+    return x * factor
+
+
+# ---------------------------------------------------------------------------
+# shape / indexing fills
+# ---------------------------------------------------------------------------
+
+
+def rank(x, name=None):
+    return jnp.asarray(jnp.ndim(x))
+
+
+def tensordot(x, y, axes=2, name=None):
+    if isinstance(axes, (list, tuple)) and len(axes) == 2 and \
+            all(isinstance(a, (list, tuple)) for a in axes):
+        axes = tuple(tuple(a) for a in axes)
+    return jnp.tensordot(jnp.asarray(x), jnp.asarray(y), axes=axes)
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(jnp.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def diagflat(x, offset=0, name=None):
+    return jnp.diagflat(jnp.asarray(x), k=offset)
+
+
+def reverse(x, axis, name=None):
+    if isinstance(axis, int):
+        axis = [axis]
+    return jnp.flip(jnp.asarray(x), axis=tuple(axis))
+
+
+def scatter_nd(index, updates, shape, name=None):
+    """Zeros of ``shape`` with ``updates`` scatter-ADDED at ``index``
+    (duplicate indices accumulate — ref operators/scatter_nd_add)."""
+    updates = jnp.asarray(updates)
+    out = jnp.zeros(tuple(shape), updates.dtype)
+    index = jnp.asarray(index)
+    return out.at[tuple(jnp.moveaxis(index, -1, 0))].add(updates)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    """Static crop: slice ``shape`` starting at ``offsets`` (ref
+    tensor/creation crop; -1 in shape keeps the remainder)."""
+    x = jnp.asarray(x)
+    shape = list(x.shape) if shape is None else list(shape)
+    offsets = [0] * x.ndim if offsets is None else list(offsets)
+    shape = [x.shape[i] - offsets[i] if s == -1 else s
+             for i, s in enumerate(shape)]
+    return jax.lax.dynamic_slice(x, offsets, shape)
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    from ..core.dtype import get_default_dtype
+    return jnp.logspace(start, stop, int(num), base=base,
+                        dtype=dtype or get_default_dtype())
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    x = jnp.asarray(x)
+    if high is None:
+        low, high = 0, low
+    want = jnp.dtype(dtype) if dtype is not None else x.dtype
+    draw = want if jnp.issubdtype(want, jnp.integer) else jnp.int32
+    out = jax.random.randint(_rng.next_key(), x.shape, low, high,
+                             dtype=draw)
+    return out.astype(want)
+
+
+def standard_normal(shape, dtype=None, name=None):
+    from ..core.dtype import get_default_dtype
+    return jax.random.normal(_rng.next_key(), tuple(shape),
+                             dtype=dtype or get_default_dtype())
+
+
+# ---------------------------------------------------------------------------
+# predicates / conversion
+# ---------------------------------------------------------------------------
+
+
+def is_tensor(x):
+    return isinstance(x, (jax.Array, np.ndarray))
+
+
+def is_complex(x):
+    return jnp.iscomplexobj(x)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.integer)
+
+
+def is_empty(x, name=None):
+    return jnp.asarray(jnp.size(x) == 0)
+
+
+def tolist(x):
+    return np.asarray(x).tolist()
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Printing config (ref framework set_printoptions) — forwarded to
+    numpy, which renders jax.Array reprs too."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+# ---------------------------------------------------------------------------
+# the inplace (*_) family — functional on TPU, see module docstring
+# ---------------------------------------------------------------------------
+
+
+def _functional_inplace(fn_name, base):
+    def wrapper(x, *args, **kwargs):
+        return base(x, *args, **kwargs)
+    wrapper.__name__ = fn_name
+    wrapper.__qualname__ = fn_name
+    wrapper.__doc__ = (f"Functional form of the reference's inplace "
+                       f"``{fn_name}`` — returns the result instead of "
+                       f"mutating (jax.Arrays are immutable; see "
+                       f"tensor/extra.py)." )
+    return wrapper
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    """Fresh uniform sample of x's shape (functional; see module
+    docstring)."""
+    x = jnp.asarray(x)
+    return jax.random.uniform(_rng.next_key(), x.shape, x.dtype,
+                              min, max)
+
+
+def exponential_(x, lam=1.0, name=None):
+    """Fresh Exp(lam) sample of x's shape (functional)."""
+    x = jnp.asarray(x)
+    return jax.random.exponential(_rng.next_key(), x.shape,
+                                  x.dtype) / lam
+
+
+# the alias installation must run AFTER tensor/__init__ defines the
+# base ops; __init__ imports this module last and calls _finalize().
+_INPLACE_BASES = ["add", "ceil", "clip", "exp", "floor", "reshape",
+                  "squeeze", "unsqueeze", "tanh", "sqrt", "round",
+                  "rsqrt", "scale", "scatter", "subtract", "lerp",
+                  "erfinv", "reciprocal", "flatten", "put_along_axis"]
+
+_LINALG_REEXPORTS = ["cholesky", "cholesky_solve", "cond", "corrcoef",
+                     "cov", "eig", "eigvals", "eigvalsh", "lstsq",
+                     "lu", "lu_unpack", "matrix_power", "multi_dot",
+                     "qr", "solve", "triangular_solve"]
+
+
+def _finalize(tensor_ns: dict) -> dict:
+    """Called by tensor/__init__ after all base defs exist. Returns the
+    extra names to splice into the tensor namespace."""
+    from .. import linalg as L
+    out = {}
+    for b in _INPLACE_BASES:
+        base = tensor_ns.get(b) or globals().get(b)
+        if base is not None:
+            out[b + "_"] = _functional_inplace(b + "_", base)
+    for name in _LINALG_REEXPORTS:
+        if name not in tensor_ns:
+            out[name] = getattr(L, name)
+    return out

@@ -1,0 +1,310 @@
+"""vision.ops surface completion (VERDICT r3 ask #4; ref:
+python/paddle/vision/ops.py __all__). Layer wrappers over the existing
+functional detection ops, plus the YOLOv3 loss, RPN proposal
+generation, FPN routing, and PIL-backed image IO.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn.layer import Layer
+from .ops import deform_conv2d, nms, psroi_pool, roi_align, roi_pool
+
+
+class RoIAlign(Layer):
+    """ref: vision/ops.py RoIAlign (layer form of roi_align)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self.output_size,
+                         self.spatial_scale)
+
+
+class RoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size,
+                        self.spatial_scale)
+
+
+class PSRoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self.output_size,
+                          self.spatial_scale)
+
+
+class DeformConv2D(Layer):
+    """ref: vision/ops.py DeformConv2D (layer form of deform_conv2d)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        from ..nn import initializer as I
+        k = (kernel_size,) * 2 if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        scale = 1.0 / math.sqrt(in_channels * k[0] * k[1])
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, *k],
+            initializer=I.Uniform(-scale, scale))
+        self.bias = None if bias_attr is False else \
+            self.create_parameter([out_channels],
+                                  initializer=I.Uniform(-scale, scale))
+        self.stride, self.padding = stride, padding
+        self.dilation = dilation
+        self.deformable_groups, self.groups = deformable_groups, groups
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias,
+                             self.stride, self.padding, self.dilation,
+                             self.deformable_groups, self.groups, mask)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level,
+                             refer_level, refer_scale,
+                             pixel_offset=False, rois_num=None,
+                             name=None):
+    """Route each RoI to its FPN level by sqrt(area) (ref:
+    operators/detection/distribute_fpn_proposals_op; FPN eq. 1).
+    Returns (rois_per_level, restore_index, rois_num_per_level)."""
+    rois = np.asarray(fpn_rois)
+    off = 1.0 if pixel_offset else 0.0
+    w = rois[:, 2] - rois[:, 0] + off
+    h = rois[:, 3] - rois[:, 1] + off
+    scale = np.sqrt(np.maximum(w * h, 1e-12))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(int)
+    outs, idxs, nums = [], [], []
+    for level in range(min_level, max_level + 1):
+        sel = np.nonzero(lvl == level)[0]
+        outs.append(jnp.asarray(rois[sel]))
+        idxs.append(sel)
+        nums.append(len(sel))
+    order = np.concatenate(idxs) if idxs else np.empty(0, int)
+    restore = np.argsort(order)
+    return outs, jnp.asarray(restore), jnp.asarray(nums)
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors,
+                       variances, pre_nms_top_n=6000,
+                       post_nms_top_n=1000, nms_thresh=0.5,
+                       min_size=0.1, eta=1.0, pixel_offset=False,
+                       return_rois_num=False, name=None):
+    """RPN proposal generation (ref:
+    operators/detection/generate_proposals_v2_op): decode anchor
+    deltas, clip to the image, filter small boxes, NMS. Host-side
+    numpy like the reference's CPU kernel — proposal generation is a
+    data-prep stage, not a training hot loop."""
+    scores = np.asarray(scores)
+    deltas = np.asarray(bbox_deltas)
+    anchors = np.asarray(anchors).reshape(-1, 4)
+    variances = np.asarray(variances).reshape(-1, 4)
+    n = scores.shape[0]
+    all_rois, all_probs, nums = [], [], []
+    off = 1.0 if pixel_offset else 0.0
+    for i in range(n):
+        s = scores[i].transpose(1, 2, 0).reshape(-1)
+        d = deltas[i].transpose(1, 2, 0).reshape(-1, 4)
+        order = np.argsort(-s)[:pre_nms_top_n]
+        s, d, a, v = s[order], d[order], anchors[order], variances[order]
+        aw = a[:, 2] - a[:, 0] + off
+        ah = a[:, 3] - a[:, 1] + off
+        acx = a[:, 0] + aw * 0.5
+        acy = a[:, 1] + ah * 0.5
+        cx = v[:, 0] * d[:, 0] * aw + acx
+        cy = v[:, 1] * d[:, 1] * ah + acy
+        w = aw * np.exp(np.minimum(v[:, 2] * d[:, 2], 10.0))
+        h = ah * np.exp(np.minimum(v[:, 3] * d[:, 3], 10.0))
+        boxes = np.stack([cx - w * 0.5, cy - h * 0.5,
+                          cx + w * 0.5 - off, cy + h * 0.5 - off], 1)
+        ih, iw = np.asarray(img_size)[i][:2]
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, iw - off)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, ih - off)
+        keep = ((boxes[:, 2] - boxes[:, 0] >= min_size)
+                & (boxes[:, 3] - boxes[:, 1] >= min_size))
+        boxes, s = boxes[keep], s[keep]
+        kept = np.asarray(nms(jnp.asarray(boxes), nms_thresh,
+                              scores=jnp.asarray(s),
+                              top_k=post_nms_top_n))
+        all_rois.append(boxes[kept])
+        all_probs.append(s[kept])
+        nums.append(len(kept))
+    rois = jnp.asarray(np.concatenate(all_rois)) if all_rois else \
+        jnp.zeros((0, 4))
+    probs = jnp.asarray(np.concatenate(all_probs)) if all_probs else \
+        jnp.zeros((0,))
+    if return_rois_num:
+        return rois, probs, jnp.asarray(nums)
+    return rois, probs
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 detection loss for one scale (ref:
+    operators/detection/yolov3_loss_op.h): coordinate BCE/L1 on
+    responsible anchors, objectness BCE with an ignore band, class
+    BCE. Decoding mirrors vision/ops.py yolo_box."""
+    x = jnp.asarray(x)
+    gt_box = jnp.asarray(gt_box, jnp.float32)      # [N, B, 4] cx,cy,w,h (0-1)
+    gt_label = jnp.asarray(gt_label)               # [N, B]
+    n, _, h, w = x.shape
+    na = len(anchor_mask)
+    an_all = np.asarray(anchors, np.float32).reshape(-1, 2)
+    an = an_all[np.asarray(anchor_mask)]
+    in_h, in_w = h * downsample_ratio, w * downsample_ratio
+    pred = x.reshape(n, na, 5 + class_num, h, w)
+    tx, ty = pred[:, :, 0], pred[:, :, 1]
+    tw, th = pred[:, :, 2], pred[:, :, 3]
+    tobj = pred[:, :, 4]
+    tcls = pred[:, :, 5:]
+
+    gx = gt_box[..., 0]                            # [N, B]
+    gy = gt_box[..., 1]
+    gw = gt_box[..., 2]
+    gh = gt_box[..., 3]
+    valid = (gw > 0) & (gh > 0)
+    gi = jnp.clip((gx * w).astype(int), 0, w - 1)
+    gj = jnp.clip((gy * h).astype(int), 0, h - 1)
+
+    # responsible anchor: best wh-IoU among ALL anchors of this layer
+    gwp = gw * in_w
+    ghp = gh * in_h
+    inter = (jnp.minimum(gwp[..., None], an_all[:, 0])
+             * jnp.minimum(ghp[..., None], an_all[:, 1]))
+    union = gwp[..., None] * ghp[..., None] \
+        + an_all[:, 0] * an_all[:, 1] - inter
+    best = jnp.argmax(inter / jnp.maximum(union, 1e-9), -1)  # [N, B]
+    mask_pos = jnp.asarray([int(m) for m in anchor_mask])
+    resp = (best[..., None] == mask_pos)           # [N, B, na]
+    responsible = resp & valid[..., None]
+
+    # build targets on the grid via scatter
+    zeros = jnp.zeros((n, na, h, w))
+    b_idx = jnp.arange(n)[:, None, None]
+    a_idx = jnp.arange(na)[None, None, :]
+    bb = jnp.broadcast_to(b_idx, responsible.shape)
+    aa = jnp.broadcast_to(a_idx, responsible.shape)
+    jj = jnp.broadcast_to(gj[..., None], responsible.shape)
+    ii = jnp.broadcast_to(gi[..., None], responsible.shape)
+    wgt = responsible.astype(jnp.float32)
+    obj_mask = zeros.at[bb, aa, jj, ii].max(wgt)
+    score = (jnp.asarray(gt_score) if gt_score is not None
+             else jnp.ones_like(gx))
+
+    def scatter(vals):
+        v = jnp.broadcast_to(vals[..., None], responsible.shape) * wgt
+        return zeros.at[bb, aa, jj, ii].add(v)
+
+    t_x = scatter(gx * w - gi)
+    t_y = scatter(gy * h - gj)
+    anw = jnp.asarray(an[:, 0]).reshape(1, na, 1, 1)
+    anh = jnp.asarray(an[:, 1]).reshape(1, na, 1, 1)
+    t_w = scatter(jnp.log(jnp.maximum(gwp, 1e-9))) \
+        - obj_mask * jnp.log(anw)
+    t_h = scatter(jnp.log(jnp.maximum(ghp, 1e-9))) \
+        - obj_mask * jnp.log(anh)
+    t_score = scatter(score)
+    box_scale = 2.0 - scatter(gw * gh)             # small-box up-weight
+
+    def bce(logit, target):
+        return -(target * jax.nn.log_sigmoid(logit)
+                 + (1 - target) * jax.nn.log_sigmoid(-logit))
+
+    loss_xy = obj_mask * box_scale * (bce(tx, t_x) + bce(ty, t_y))
+    loss_wh = obj_mask * box_scale * 0.5 * (jnp.abs(tw - t_w)
+                                            + jnp.abs(th - t_h))
+
+    # objectness: positives → score; negatives with best-IoU above
+    # ignore_thresh are excluded (the ignore band)
+    px = (jax.nn.sigmoid(tx) + jnp.arange(w).reshape(1, 1, 1, w)) / w
+    py = (jax.nn.sigmoid(ty) + jnp.arange(h).reshape(1, 1, h, 1)) / h
+    pw = jnp.exp(jnp.clip(tw, -10, 10)) * anw / in_w
+    ph = jnp.exp(jnp.clip(th, -10, 10)) * anh / in_h
+
+    pl, pr = px - pw / 2, px + pw / 2
+    pt, pb = py - ph / 2, py + ph / 2
+    gl, gr = gx - gw / 2, gx + gw / 2
+    gt_, gb = gy - gh / 2, gy + gh / 2
+
+    def pairwise_iou():
+        ix = (jnp.minimum(pr[..., None], gr[:, None, None, None, :])
+              - jnp.maximum(pl[..., None], gl[:, None, None, None, :]))
+        iy = (jnp.minimum(pb[..., None], gb[:, None, None, None, :])
+              - jnp.maximum(pt[..., None], gt_[:, None, None, None, :]))
+        inter = jnp.clip(ix, 0) * jnp.clip(iy, 0)
+        uni = (pw * ph)[..., None] \
+            + (gw * gh)[:, None, None, None, :] - inter
+        iou = inter / jnp.maximum(uni, 1e-9)
+        return jnp.where(valid[:, None, None, None, :], iou, 0.0).max(-1)
+
+    best_iou = pairwise_iou()
+    noobj = (1.0 - obj_mask) * (best_iou < ignore_thresh)
+    loss_obj = obj_mask * t_score * bce(tobj, jnp.ones_like(tobj)) \
+        + noobj * bce(tobj, jnp.zeros_like(tobj))
+
+    smooth = 1.0 / class_num if use_label_smooth else 0.0
+    onehot = jax.nn.one_hot(gt_label, class_num)   # [N, B, C]
+    onehot = onehot * (1.0 - smooth) + smooth / 2.0
+    cls_target = jnp.zeros((n, na, class_num, h, w))
+    cc = jnp.broadcast_to(b_idx, responsible.shape + (class_num,))
+    cls_target = cls_target.at[
+        jnp.broadcast_to(bb[..., None], bb.shape + (class_num,)),
+        jnp.broadcast_to(aa[..., None], aa.shape + (class_num,)),
+        jnp.broadcast_to(jnp.arange(class_num), bb.shape + (class_num,)),
+        jnp.broadcast_to(jj[..., None], jj.shape + (class_num,)),
+        jnp.broadcast_to(ii[..., None], ii.shape + (class_num,)),
+    ].add(jnp.broadcast_to(onehot[:, :, None], responsible.shape
+                           + (class_num,)) * wgt[..., None])
+    loss_cls = obj_mask[:, :, None] * bce(tcls, cls_target)
+
+    per_img = (loss_xy.sum((1, 2, 3)) + loss_wh.sum((1, 2, 3))
+               + loss_obj.sum((1, 2, 3)) + loss_cls.sum((1, 2, 3, 4)))
+    return per_img
+
+
+def read_file(filename, name=None):
+    """Raw file bytes as a uint8 tensor (ref: vision/ops.py read_file
+    → CUDA nvjpeg pipeline; host IO here)."""
+    with open(filename, "rb") as f:
+        data = f.read()
+    return jnp.asarray(np.frombuffer(data, np.uint8))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """JPEG decode via PIL (ref: vision/ops.py decode_jpeg → nvjpeg;
+    on TPU image decode is host-side data prep). Returns CHW uint8."""
+    import io
+
+    from PIL import Image
+
+    img = Image.open(io.BytesIO(np.asarray(x).tobytes()))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode in ("rgb", "RGB"):
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return jnp.asarray(arr)
