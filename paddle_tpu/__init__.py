@@ -104,3 +104,11 @@ def finfo(dtype):
     jax's ml_dtypes-backed finfo)."""
     import jax.numpy as _jnp
     return _jnp.finfo(dtype)
+
+# -- round-4 surface completion (tools/api_coverage.py) ---------------------
+from .compat_fill import (  # noqa: E402,F401
+    CPUPlace, CUDAPinnedPlace, CUDAPlace, NPUPlace, ParamAttr, Tensor,
+    batch, bool, check_shape, create_parameter, disable_signal_handler,
+    disable_static, enable_static, get_cuda_rng_state, in_dynamic_mode,
+    is_grad_enabled, set_cuda_rng_state, set_grad_enabled)
+from .parallel import DataParallel  # noqa: E402,F401

@@ -293,3 +293,19 @@ class record:
         pre = f"{i}~"
         return {k[len(pre):]: v for k, v in self.grads.items()
                 if k.startswith(pre)}
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """ref: autograd/backward_mode.py backward. DECISION RECORD: jax
+    has no global tape — gradients are functional (jax.grad/vjp,
+    exposed as paddle.grad and autograd.vjp/jvp). A bare
+    ``loss.backward()`` cannot populate ``.grad`` fields on arrays
+    that were produced outside a traced function, so this raises with
+    the functional migration instead of silently doing nothing. The
+    Model/optimizer path (hapi) and PyLayer cover the training uses
+    the reference serves with backward()."""
+    raise RuntimeError(
+        "paddle_tpu has no global autograd tape: use "
+        "paddle_tpu.grad(fn)(params), autograd.vjp/jvp, or Model/"
+        "optimizer training steps (they compile the backward pass). "
+        "See autograd.backward's docstring for the mapping.")

@@ -1,0 +1,182 @@
+"""Top-level ``paddle.*`` surface completion (VERDICT r3 ask #4; the
+remaining names of python/paddle/__init__.py's __all__ after the
+tensor/nn/static fills). Mostly identity/compat records whose real
+machinery lives elsewhere in this package — each cites where.
+"""
+
+from __future__ import annotations
+
+import builtins
+import contextlib
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .core import rng as _rng
+from .device import (CPUPlace, CUDAPinnedPlace, CUDAPlace,  # noqa: F401
+                     NPUPlace)
+
+# the tensor type itself (ref: paddle.Tensor — the pybind VarBase/
+# eager Tensor class). jax.Array is the tensor here; isinstance checks
+# and annotations against paddle.Tensor keep working.
+Tensor = jax.Array
+
+# dtype alias (ref: paddle.bool)
+from .core.dtype import bool_ as bool  # noqa: E402,F401
+
+
+class ParamAttr:
+    """Parameter config carrier (ref: fluid/param_attr.py ParamAttr —
+    name/initializer/lr/regularizer/trainable). Consumed by
+    create_parameter and accepted (name + initializer + trainable
+    honored; per-param lr scaling is the optimizer's _param_groups
+    job) anywhere a weight_attr/bias_attr is taken."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=True,
+                 need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """Eager free-standing parameter (ref: paddle.create_parameter →
+    LayerHelper): returns an initialized array; layers own their
+    parameters via Layer.create_parameter."""
+    from .nn import initializer as I
+    init = default_initializer
+    if init is None and isinstance(attr, ParamAttr):
+        init = attr.initializer
+    if init is None:
+        init = I.get_global_initializer() or (
+            I.Constant(0.0) if is_bias else I.XavierUniform())
+    return init(list(shape), jnp.dtype(dtype))
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Reader-decorator batching (ref: python/paddle/batch.py)."""
+
+    def batched():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batched
+
+
+def check_shape(shape, op_name="", expected_shape_type=(list, tuple),
+                expected_element_type=(int,),
+                expected_tensor_dtype=("int32", "int64")):
+    """Shape-argument validator (ref: tensor/random.py check_shape via
+    fluid/data_feeder.py:153). Arrays are accepted as dynamic shapes
+    when integer-typed."""
+    if isinstance(shape, (jax.Array, np.ndarray)):
+        if str(np.asarray(shape).dtype) not in expected_tensor_dtype:
+            raise TypeError(
+                f"{op_name}: Tensor shape must be "
+                f"{expected_tensor_dtype}, got {np.asarray(shape).dtype}")
+        return
+    if not isinstance(shape, expected_shape_type):
+        raise TypeError(f"{op_name}: shape must be "
+                        f"{expected_shape_type}, got {type(shape)}")
+    for item in shape:
+        if not isinstance(item, expected_element_type) \
+                and not isinstance(item, (jax.Array, np.integer)):
+            raise TypeError(
+                f"{op_name}: shape element must be int, got "
+                f"{type(item)}")
+
+
+def disable_signal_handler():
+    """ref: paddle.disable_signal_handler (the C++ layer's SIGSEGV
+    dumpers). The crash handlers here belong to the Python runtime and
+    absl; nothing to uninstall — kept for script compat."""
+
+
+# -- static/dynamic mode toggles (ref: paddle.enable_static — the
+# dual-world switch). One world here: the static API (paddle.static)
+# works regardless; the flag is tracked so in_dynamic_mode() answers
+# faithfully for scripts that branch on it.
+
+_static_mode = False
+
+
+def enable_static():
+    global _static_mode
+    _static_mode = True
+
+
+def disable_static():
+    global _static_mode
+    _static_mode = False
+
+
+def in_dynamic_mode() -> bool:
+    return not _static_mode
+
+
+# -- grad-enabled flag (ref: paddle.set_grad_enabled/is_grad_enabled;
+# fluid/dygraph/base.py). Gradients are functional (jax.grad), so the
+# flag gates the Model/PyLayer paths' willingness to build backwards —
+# and no_grad() uses it.
+
+_grad_enabled = True
+
+
+class set_grad_enabled:
+    """Applies at construction (usable as a statement, the reference's
+    torch-style semantics) AND restores on context exit."""
+
+    def __init__(self, mode: bool):
+        global _grad_enabled
+        self._old = _grad_enabled
+        _grad_enabled = builtins.bool(mode)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        global _grad_enabled
+        _grad_enabled = self._old
+        return False
+
+
+def _set_grad_flag(mode) -> None:
+    """Internal: no_grad() (framework.py) flips this so
+    is_grad_enabled() answers faithfully inside the context."""
+    global _grad_enabled
+    _grad_enabled = builtins.bool(mode)
+
+
+def is_grad_enabled() -> bool:
+    return _grad_enabled
+
+
+def get_cuda_rng_state():
+    """ref: paddle.get_cuda_rng_state — generator state snapshot. The
+    accelerator RNG here is the counter-based global KeyStream
+    (core/rng.py); its state is the key + named sub-streams."""
+    stream = _rng.current_stream()
+    return {"key": np.asarray(jax.random.key_data(stream._key)),
+            "streams": {k: np.asarray(jax.random.key_data(v))
+                        for k, v in stream._streams.items()}}
+
+
+def set_cuda_rng_state(state):
+    stream = _rng.current_stream()
+    stream._key = jax.random.wrap_key_data(jnp.asarray(state["key"]))
+    stream._streams = {
+        k: jax.random.wrap_key_data(jnp.asarray(v))
+        for k, v in state.get("streams", {}).items()}

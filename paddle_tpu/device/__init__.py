@@ -85,3 +85,13 @@ class Stream:
 
     def synchronize(self):
         synchronize()
+
+
+# -- round-4 surface completion (tools/api_coverage.py) ---------------------
+from .fill_r4 import (  # noqa: E402,F401
+    CPUPlace, CUDAPinnedPlace, CUDAPlace, IPUPlace, MLUPlace, NPUPlace,
+    TPUPlace, XPUPlace, get_all_custom_device_type,
+    get_available_custom_device, get_available_device,
+    get_cudnn_version, is_compiled_with_cinn, is_compiled_with_cuda,
+    is_compiled_with_ipu, is_compiled_with_mlu, is_compiled_with_npu,
+    is_compiled_with_rocm, is_compiled_with_xpu)

@@ -1,0 +1,136 @@
+"""Device-surface completion (VERDICT r3 ask #4; ref:
+python/paddle/device/__init__.py __all__ + the Place classes bound in
+pybind.cc). On a TPU build every vendor-probe answers honestly:
+``is_compiled_with_*`` is False for CUDA/ROCm/XPU/NPU/MLU/IPU/CINN
+(this build compiles against PJRT:TPU only — the reference's analogous
+flags are compile-time cmake answers, platform/flags), Place objects
+are lightweight identity records (the reference's Place is a tagged
+device index, platform/place.h), and custom-device queries surface
+PJRT's non-TPU platforms.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+class _Place:
+    """Tagged device identity (ref: platform/place.h Place)."""
+
+    kind = "unknown"
+
+    def __init__(self, device_id: int = 0):
+        self.device_id = int(device_id)
+
+    def get_device_id(self) -> int:
+        return self.device_id
+
+    def __eq__(self, other):
+        return (type(self) is type(other)
+                and self.device_id == other.device_id)
+
+    def __hash__(self):
+        return hash((type(self).__name__, self.device_id))
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.device_id})"
+
+
+class CPUPlace(_Place):
+    kind = "cpu"
+
+    def __init__(self):
+        super().__init__(0)
+
+    def __repr__(self):
+        return "CPUPlace"
+
+
+class TPUPlace(_Place):
+    kind = "tpu"
+
+
+class CUDAPlace(_Place):
+    kind = "gpu"
+
+
+class CUDAPinnedPlace(_Place):
+    kind = "gpu_pinned"
+
+    def __init__(self):
+        super().__init__(0)
+
+
+class NPUPlace(_Place):
+    kind = "npu"
+
+
+class XPUPlace(_Place):
+    kind = "xpu"
+
+
+class MLUPlace(_Place):
+    kind = "mlu"
+
+
+class IPUPlace(_Place):
+    kind = "ipu"
+
+    def __init__(self):
+        super().__init__(0)
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_npu() -> bool:
+    return False
+
+
+def is_compiled_with_mlu() -> bool:
+    return False
+
+
+def is_compiled_with_ipu() -> bool:
+    return False
+
+
+def is_compiled_with_cinn() -> bool:
+    # the graph compiler is XLA, always on — but the CINN flag asks
+    # about the reference's specific external compiler: not present
+    return False
+
+
+def get_cudnn_version():
+    """ref: device/__init__.py get_cudnn_version — None when not a
+    CUDA build (matches the reference's no-CUDA answer)."""
+    return None
+
+
+def get_available_device():
+    """ref: device/__init__.py get_available_device."""
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_all_custom_device_type():
+    """PJRT platforms beyond the builtin cpu/gpu/tpu set — the
+    custom-device registry analog (ref: phi/backends/device_manager.h
+    DeviceManager::GetAllCustomDeviceTypes)."""
+    builtin = {"cpu", "gpu", "tpu", "cuda", "rocm"}
+    return sorted({d.platform for d in jax.devices()
+                   if d.platform.lower() not in builtin})
+
+
+def get_available_custom_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()
+            if d.platform.lower() not in {"cpu", "gpu", "tpu", "cuda",
+                                          "rocm"}]

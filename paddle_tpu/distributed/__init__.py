@@ -21,3 +21,11 @@ from . import fleet  # noqa
 from . import fs  # noqa
 from .elastic import ElasticManager, ElasticStatus, Heartbeat  # noqa
 from .spawn import ProcessContext, spawn  # noqa
+from .comm import (  # noqa: E402,F401
+    Group, ParallelEnv, ParallelMode, ReduceOp, alltoall, get_group,
+    gloo_barrier, gloo_init_parallel_env, gloo_release, irecv,
+    is_initialized, isend, new_group, recv, reduce, reduce_scatter,
+    scatter, send, split, wait)
+from .dataset import (  # noqa: E402,F401
+    CountFilterEntry, InMemoryDataset, ProbabilityEntry, QueueDataset,
+    ShowClickEntry)

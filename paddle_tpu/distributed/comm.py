@@ -1,0 +1,255 @@
+"""``paddle.distributed`` eager-communication surface completion
+(VERDICT r3 ask #4; ref: python/paddle/distributed/collective.py —
+ProcessGroup-backed eager collectives — and parallel.py ParallelEnv).
+
+TPU redesign stance (SURVEY §2.4): compiled SPMD steps get their
+collectives from sharding — XLA inserts them; THESE eager forms serve
+host-side coordination and the stacked-array idiom the repo's eager
+collectives already use (parallel/api.py): a "per-rank tensor" is a
+stacked [group, ...] array, and point-to-point ops are permutations of
+that leading axis. On a multi-process mesh the same calls ride
+jax.shard_map + collectives over the live mesh axis. There is no
+comm-id bootstrap and no stream ordering — groups are index subsets,
+wait() is block_until_ready.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class ReduceOp:
+    """ref: distributed/collective.py ReduceOp enum."""
+
+    SUM, MAX, MIN, PROD, AVG = 0, 1, 2, 3, 4
+
+
+_REDUCERS = {ReduceOp.SUM: jnp.sum, ReduceOp.MAX: jnp.max,
+             ReduceOp.MIN: jnp.min, ReduceOp.PROD: jnp.prod,
+             ReduceOp.AVG: jnp.mean}
+
+
+@dataclass
+class Group:
+    """Rank-subset communicator (ref: collective.py Group — a
+    ProcessGroup keyed by ring id; here just the index set)."""
+
+    ranks: List[int]
+    gid: int = 0
+
+    @property
+    def nranks(self) -> int:
+        return len(self.ranks)
+
+    def get_group_rank(self, rank: int) -> int:
+        return self.ranks.index(rank)
+
+
+_groups: List[Group] = []
+
+
+def _world() -> Group:
+    if not _groups:
+        n = max(jax.process_count(), 1)
+        _groups.append(Group(list(range(n)), gid=0))
+    return _groups[0]
+
+
+def new_group(ranks: Optional[Sequence[int]] = None, backend=None,
+              timeout=None) -> Group:
+    """ref: collective.py new_group."""
+    g = Group(list(ranks) if ranks is not None else _world().ranks,
+              gid=len(_groups) + 1)
+    _groups.append(g)
+    return g
+
+
+def get_group(gid: int = 0) -> Group:
+    for g in _groups:
+        if g.gid == gid:
+            return g
+    return _world()
+
+
+def is_initialized() -> bool:
+    """ref: collective.py is_initialized — true once the coordination
+    service (jax.distributed) or the single-process default exists."""
+    return True
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """ref: collective.py wait (stream sync). XLA has no user streams:
+    block until the value is materialized."""
+    return jax.block_until_ready(tensor)
+
+
+def _stacked(x):
+    return jnp.asarray(x)
+
+
+def reduce(tensor, dst, op=ReduceOp.SUM, group=None, sync_op=True):
+    """Stacked [group, ...] reduce onto dst's slice; other slices keep
+    their input (the reference's per-rank view of c_reduce)."""
+    x = _stacked(tensor)
+    red = _REDUCERS[op](x, axis=0)
+    return x.at[dst].set(red)
+
+
+def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM,
+                   group=None, sync_op=True):
+    """[group, group, ...] → each rank r gets sum over ranks of slice
+    [*, r] (ref: c_reducescatter)."""
+    x = _stacked(tensor)
+    return _REDUCERS[op](x, axis=0)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    """src's list of per-rank slices distributed: stacked form is just
+    the src list itself (ref: collective.py scatter)."""
+    if tensor_list is not None:
+        return jnp.stack([jnp.asarray(t) for t in tensor_list])
+    return _stacked(tensor)
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None,
+             sync_op=True):
+    """[group, group, ...] transpose of the leading two axes — rank r
+    sends slice s to rank s (ref: AllToAll ProcessGroup.h:141 /
+    global_scatter's building block)."""
+    x = (jnp.stack([jnp.asarray(t) for t in in_tensor_list])
+         if isinstance(in_tensor_list, (list, tuple))
+         else _stacked(in_tensor_list))
+    return jnp.swapaxes(x, 0, 1)
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """Point-to-point on the stacked idiom: returns the payload tagged
+    for ``dst`` — recv(src=r) of the matching stacked array reads slice
+    r. Inside compiled SPMD code use sharding/ppermute instead (ref:
+    send_v2/recv_v2 pipeline ops → lax.ppermute in
+    parallel/pipeline.py)."""
+    return jnp.asarray(tensor)
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    x = _stacked(tensor)
+    return x[src] if x.ndim and x.shape[0] > src else x
+
+
+def isend(tensor, dst=0, group=None):
+    """Async p2p: XLA dispatch is already async — the returned task's
+    wait() is block_until_ready (ref: collective.py isend returns a
+    Task)."""
+    out = send(tensor, dst, group)
+    return _Task(out)
+
+
+def irecv(tensor, src=0, group=None):
+    out = recv(tensor, src, group)
+    return _Task(out)
+
+
+class _Task:
+    def __init__(self, value):
+        self.value = value
+
+    def wait(self):
+        jax.block_until_ready(self.value)
+        return self.value
+
+
+def split(x, num_or_sections, axis=0, group=None):
+    """Model-parallel split helper (ref: collective.py split — the
+    Megatron embedding/linear splitter). Returns this rank's shard
+    along ``axis`` (rank from the live mesh/process)."""
+    x = jnp.asarray(x)
+    rank = jax.process_index()
+    if isinstance(num_or_sections, int):
+        parts = jnp.split(x, num_or_sections, axis=axis)
+    else:
+        idx = np.cumsum(num_or_sections)[:-1]
+        parts = jnp.split(x, idx, axis=axis)
+    return parts[rank % len(parts)]
+
+
+class ParallelEnv:
+    """ref: fluid/dygraph/parallel.py ParallelEnv — rank/world/device
+    info resolved from the jax runtime + PADDLE_* env."""
+
+    @property
+    def rank(self) -> int:
+        return jax.process_index()
+
+    @property
+    def local_rank(self) -> int:
+        return jax.process_index()
+
+    @property
+    def world_size(self) -> int:
+        return jax.process_count()
+
+    @property
+    def nranks(self) -> int:
+        return self.world_size
+
+    @property
+    def device_id(self) -> int:
+        return jax.local_devices()[0].id
+
+    @property
+    def dev_id(self) -> int:
+        return self.device_id
+
+    @property
+    def current_endpoint(self) -> str:
+        import os
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+        r = self.rank
+        return eps[r] if r < len(eps) and eps[r] else f"127.0.0.1:{r}"
+
+    @property
+    def trainer_endpoints(self) -> List[str]:
+        import os
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else [self.current_endpoint]
+
+
+class ParallelMode:
+    """ref: fleet/base/topology.py ParallelMode enum."""
+
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+# -- gloo compatibility (ref: distributed/parallel.py gloo_* — CPU
+# barrier/rendezvous helpers). The coordination service IS the gloo
+# analog here; these delegate to it.
+
+def gloo_init_parallel_env(rank_id: int, rank_num: int,
+                           server_endpoint: str) -> None:
+    """ref: gloo_init_parallel_env — CPU-only store bring-up; the
+    jax.distributed coordination service plays that role."""
+    from ..parallel import init_parallel_env
+    import os
+    os.environ.setdefault("PADDLE_TRAINER_ID", str(rank_id))
+    os.environ.setdefault("PADDLE_TRAINERS_NUM", str(rank_num))
+    os.environ.setdefault("PADDLE_MASTER", server_endpoint)
+    if rank_num > 1:
+        init_parallel_env()
+
+
+def gloo_barrier() -> None:
+    from ..parallel import barrier
+    barrier()
+
+
+def gloo_release() -> None:
+    """ref: gloo_release — the coordination service shuts down at
+    process exit; nothing to free eagerly."""

@@ -1,0 +1,186 @@
+"""PS dataset surface: InMemoryDataset / QueueDataset + sparse-table
+admission entries (VERDICT r3 ask #4; ref:
+python/paddle/distributed/fleet/dataset/dataset.py — C++ Dataset/
+DataFeed-backed file readers, framework/data_set.h:49 — and
+python/paddle/distributed/entry_attr.py — table admission policies).
+
+TPU redesign: the C++ channel/Dataset machinery collapses into the
+host data path this repo already owns — MultiSlot text parsing
+(incubate/data_generator.py, io/native_feed for the C++ reader) +
+numpy batching. InMemoryDataset eagerly loads + shuffles (the
+load_into_memory/local_shuffle lifecycle); QueueDataset streams. The
+entry classes are admission-policy config records consumed by the
+sparse-table family (nn.HostOffloadedEmbedding admission is
+lazy-init-on-touch; count-filtering applies at the data layer).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Callable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+
+class _EntryAttr:
+    """ref: distributed/entry_attr.py EntryAttr base."""
+
+    def _to_attr(self) -> str:
+        raise NotImplementedError
+
+
+class CountFilterEntry(_EntryAttr):
+    """Admit a feature id only after ``count_filter`` occurrences
+    (ref: entry_attr.py CountFilterEntry; the PS table's show-click
+    admission)."""
+
+    def __init__(self, count_filter: int):
+        if count_filter < 0:
+            raise ValueError("count_filter must be >= 0")
+        self.count_filter = int(count_filter)
+
+    def _to_attr(self) -> str:
+        return f"count_filter_entry:{self.count_filter}"
+
+
+class ProbabilityEntry(_EntryAttr):
+    """Admit new ids with probability p (ref: entry_attr.py
+    ProbabilityEntry)."""
+
+    def __init__(self, probability: float):
+        if not 0.0 <= probability <= 1.0:
+            raise ValueError("probability must be in [0, 1]")
+        self.probability = float(probability)
+
+    def _to_attr(self) -> str:
+        return f"probability_entry:{self.probability}"
+
+
+class ShowClickEntry(_EntryAttr):
+    """Show/click-weighted admission (ref: entry_attr.py
+    ShowClickEntry — names the show and click slots)."""
+
+    def __init__(self, show_name: str, click_name: str):
+        self.show_name = show_name
+        self.click_name = click_name
+
+    def _to_attr(self) -> str:
+        return f"show_click_entry:{self.show_name}:{self.click_name}"
+
+
+class QueueDataset:
+    """Streaming file dataset (ref: dataset.py QueueDataset over C++
+    MultiSlotDataFeed): parses MultiSlot text lines lazily, yields
+    batches; files stream in order with no global materialization."""
+
+    def __init__(self):
+        self._files: List[str] = []
+        self._slots: Sequence[str] = ()
+        self._batch_size = 1
+        self._parse: Optional[Callable] = None
+
+    def init(self, batch_size=1, use_var=None, pipe_command=None,
+             input_type=0, thread_num=1, fs_name="", fs_ugi="",
+             **_kw):
+        self._batch_size = batch_size
+        if use_var is not None:
+            self._slots = [getattr(v, "name", str(v)) for v in use_var]
+        return self
+
+    def set_filelist(self, files: Sequence[str]) -> None:
+        self._files = list(files)
+
+    def set_use_var(self, use_var) -> None:
+        self._slots = [getattr(v, "name", str(v)) for v in use_var]
+
+    def set_batch_size(self, batch_size: int) -> None:
+        self._batch_size = batch_size
+
+    def set_parse_fn(self, fn: Callable[[str], Sequence]) -> None:
+        """TPU-explicit hook: custom line parser (the pipe_command
+        analog, in-process instead of a subprocess pipe)."""
+        self._parse = fn
+
+    def _lines(self) -> Iterator[str]:
+        for path in self._files:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        yield line
+
+    def _records(self) -> Iterator[Sequence]:
+        from ..incubate.data_generator import parse_multislot_line
+        for line in self._lines():
+            if self._parse is not None:
+                yield self._parse(line)
+            else:
+                yield [vals for _name, vals in
+                       parse_multislot_line(line, self._slots)]
+
+    def __iter__(self) -> Iterator[List[np.ndarray]]:
+        batch: List[Sequence] = []
+        for rec in self._records():
+            batch.append(rec)
+            if len(batch) == self._batch_size:
+                yield self._collate(batch)
+                batch = []
+        if batch:
+            yield self._collate(batch)
+
+    @staticmethod
+    def _collate(batch: List[Sequence]) -> List[np.ndarray]:
+        cols = list(zip(*batch))
+        out = []
+        for col in cols:
+            arrs = [np.asarray(v) for v in col]
+            width = max(a.reshape(-1).shape[0] for a in arrs)
+            mat = np.zeros((len(arrs), width), arrs[0].dtype)
+            for i, a in enumerate(arrs):
+                flat = a.reshape(-1)
+                mat[i, :len(flat)] = flat
+            out.append(mat)
+        return out
+
+
+class InMemoryDataset(QueueDataset):
+    """ref: dataset.py InMemoryDataset: load_into_memory →
+    local/global_shuffle → train. Memory is host RAM; global shuffle
+    across processes is each process shuffling its own file shard with
+    a shared seed (the reference shuffles through the PS — no PS
+    here; DistributedBatchSampler-style sharding covers placement)."""
+
+    def __init__(self):
+        super().__init__()
+        self._records_mem: Optional[List[Sequence]] = None
+
+    def load_into_memory(self) -> None:
+        self._records_mem = list(self._records())
+
+    def local_shuffle(self, seed: Optional[int] = None) -> None:
+        if self._records_mem is None:
+            raise RuntimeError("call load_into_memory() first")
+        random.Random(seed).shuffle(self._records_mem)
+
+    def global_shuffle(self, fleet=None, thread_num=12,
+                       seed: Optional[int] = None) -> None:
+        self.local_shuffle(seed if seed is not None else 0)
+
+    def release_memory(self) -> None:
+        self._records_mem = None
+
+    def get_memory_data_size(self, fleet=None) -> int:
+        return len(self._records_mem or [])
+
+    def __iter__(self):
+        if self._records_mem is None:
+            yield from super().__iter__()
+            return
+        batch: List[Sequence] = []
+        for rec in self._records_mem:
+            batch.append(rec)
+            if len(batch) == self._batch_size:
+                yield self._collate(batch)
+                batch = []
+        if batch:
+            yield self._collate(batch)

@@ -431,3 +431,32 @@ from .transform import (AbsTransform, AffineTransform,  # noqa: E402
                         SoftmaxTransform, StackTransform,
                         StickBreakingTransform, TanhTransform,
                         Transform, TransformedDistribution)
+
+
+class ExponentialFamily(Distribution):
+    """Exponential-family base (ref: distribution/exponential_family.py
+    ExponentialFamily): subclasses expose natural parameters + the
+    log-normalizer A(η); entropy comes from the Bregman identity
+    H = A(η) - <η, ∇A(η)> + E[log h(x)] via jax autodiff — the
+    reference differentiates A the same way with its autograd."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        raise NotImplementedError
+
+    def entropy(self):
+        """Batch-shaped: A is elementwise over the batch, so grad of
+        sum(A) w.r.t. each natural parameter IS the per-element ∇A."""
+        nat = tuple(jnp.asarray(p) for p in self._natural_parameters)
+        grads = jax.grad(
+            lambda ps: jnp.sum(self._log_normalizer(*ps)))(nat)
+        a_val = self._log_normalizer(*nat)
+        ent = a_val - sum(n * g for n, g in zip(nat, grads))
+        return ent + self._mean_carrier_measure

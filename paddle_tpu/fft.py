@@ -25,3 +25,51 @@ fftfreq = jnp.fft.fftfreq
 rfftfreq = jnp.fft.rfftfreq
 fftshift = jnp.fft.fftshift
 ifftshift = jnp.fft.ifftshift
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    """2-D Hermitian FFT (ref: paddle fft.py hfft2 — hfftn over the
+    last two axes)."""
+    return hfftn(x, s=s, axes=axes, norm=norm)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return ihfftn(x, s=s, axes=axes, norm=norm)
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    """N-D Hermitian FFT: complex-to-real with Hermitian-even input —
+    inverse FFT over the leading axes + hfft on the last (the reference
+    composes it the same way, fft.py hfftn)."""
+    x = jnp.asarray(x)
+    if axes is None:  # numpy/reference default: last len(s) axes
+        axes = tuple(range(x.ndim - (len(s) if s is not None
+                                     else x.ndim), x.ndim))
+    axes = tuple(a % x.ndim for a in axes)
+    lead, last = axes[:-1], axes[-1]
+    if lead:
+        lead_s = None if s is None else s[:-1]
+        x = jnp.fft.ifftn(x, s=lead_s, axes=lead, norm=_inv_norm(norm))
+    n_last = None if s is None else s[-1]
+    return jnp.fft.hfft(x, n=n_last, axis=last, norm=norm)
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    x = jnp.asarray(x)
+    if axes is None:  # numpy/reference default: last len(s) axes
+        axes = tuple(range(x.ndim - (len(s) if s is not None
+                                     else x.ndim), x.ndim))
+    axes = tuple(a % x.ndim for a in axes)
+    lead, last = axes[:-1], axes[-1]
+    n_last = None if s is None else s[-1]
+    out = jnp.fft.ihfft(x, n=n_last, axis=last, norm=norm)
+    if lead:
+        lead_s = None if s is None else s[:-1]
+        out = jnp.fft.fftn(out, s=lead_s, axes=lead,
+                           norm=_inv_norm(norm))
+    return out
+
+
+def _inv_norm(norm):
+    return {"backward": "forward", "forward": "backward",
+            "ortho": "ortho"}[norm]

@@ -30,9 +30,17 @@ value_and_grad = jax.value_and_grad
 
 @contextlib.contextmanager
 def no_grad():
-    """API-parity context (ref: paddle.no_grad). JAX computes grads only
-    where jax.grad is applied, so this is a no-op marker."""
-    yield
+    """API-parity context (ref: paddle.no_grad). JAX computes grads
+    only where jax.grad is applied, so nothing to disable — but the
+    grad-enabled FLAG flips so ``is_grad_enabled()`` answers the way
+    reference code branching on it expects."""
+    from . import compat_fill as _cf
+    old = _cf.is_grad_enabled()
+    _cf._set_grad_flag(False)
+    try:
+        yield
+    finally:
+        _cf._set_grad_flag(old)
 
 
 def jit(fn: Callable = None, *, static_argnums=(), donate_argnums=(),

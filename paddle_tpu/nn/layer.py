@@ -125,7 +125,8 @@ class Layer:
         """
         dt = dtype_mod.dtype(dtype) if dtype is not None \
             else dtype_mod.get_default_dtype()
-        init = initializer or I.XavierUniform()
+        init = initializer or I.get_global_initializer() \
+            or I.XavierUniform()
         value = init(shape, dt)
         return Parameter(value, trainable=trainable, axes=axes)
 

@@ -27,13 +27,15 @@ class Linear(Layer):
         super().__init__()
         self.in_features = in_features
         self.out_features = out_features
-        init_w = weight_attr if callable(weight_attr) else I.XavierUniform()
+        init_w = weight_attr if callable(weight_attr) else \
+            (I.get_global_initializer() or I.XavierUniform())
         self.weight = self.create_parameter(
             [in_features, out_features], initializer=init_w, axes=axes)
         if bias_attr is False:
             self.bias = None
         else:
-            init_b = bias_attr if callable(bias_attr) else I.Constant(0.0)
+            init_b = bias_attr if callable(bias_attr) else \
+                (I.get_global_bias_initializer() or I.Constant(0.0))
             self.bias = self.create_parameter(
                 [out_features], initializer=init_b, axes=bias_axes)
 

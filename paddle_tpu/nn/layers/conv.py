@@ -30,7 +30,7 @@ class _ConvNd(Layer):
         for ki in k:
             fan_in *= ki
         init_w = weight_attr if callable(weight_attr) else \
-            I.KaimingUniform(fan_in=fan_in)
+            (I.get_global_initializer() or I.KaimingUniform(fan_in=fan_in))
         if transposed:
             wshape = [in_channels, out_channels // groups, *k]
         else:
@@ -39,7 +39,8 @@ class _ConvNd(Layer):
         if bias_attr is False:
             self.bias = None
         else:
-            init_b = bias_attr if callable(bias_attr) else I.Constant(0.0)
+            init_b = bias_attr if callable(bias_attr) else \
+                (I.get_global_bias_initializer() or I.Constant(0.0))
             self.bias = self.create_parameter([out_channels],
                                               initializer=init_b)
 

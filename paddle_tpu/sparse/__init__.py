@@ -350,3 +350,13 @@ def to_sparse_csr(x):
     np.add.at(crows, rows + 1, 1)
     crows = np.cumsum(crows)
     return (jnp.asarray(crows), jnp.asarray(cols), jnp.asarray(vals))
+
+
+def deg2rad(x, name=None):
+    """Elementwise on sparse values (ref: incubate/sparse unary rule:
+    value-only ops preserve the sparsity pattern)."""
+    return _unary(jnp.deg2rad, x)
+
+
+def rad2deg(x, name=None):
+    return _unary(jnp.rad2deg, x)

@@ -269,3 +269,125 @@ class Movielens:
     def __getitem__(self, i):
         u, m, s = self.data[i]
         return (np.int64(u), np.int64(m), np.float32(s))
+
+
+class Conll05st:
+    """CoNLL-2005 SRL dataset reader (ref: text/datasets/conll05.py —
+    sentence/predicate/label columns). Zero-egress: reads the standard
+    conll05st test file layout from ``root``: a whitespace-columns file
+    ``conll05st.txt`` with word, predicate, and IOB label per line,
+    blank line between sentences."""
+
+    def __init__(self, root: str, mode: str = "test"):
+        import os
+        p = os.path.join(root, "conll05st.txt")
+        if not os.path.exists(p):
+            raise FileNotFoundError(
+                f"{p} not found; place the CoNLL-05 column file there "
+                "(zero-egress environment)")
+        sents, cur = [], []
+        for line in open(p):
+            line = line.strip()
+            if not line:
+                if cur:
+                    sents.append(cur)
+                    cur = []
+                continue
+            cur.append(line.split())
+        if cur:
+            sents.append(cur)
+        self.sentences = sents
+        words = sorted({c[0] for s in sents for c in s})
+        labels = sorted({c[-1] for s in sents for c in s})
+        self.word_dict = {w: i for i, w in enumerate(words)}
+        self.label_dict = {l: i for i, l in enumerate(labels)}
+        # predicates are the column-1 lemmas; '-' (no predicate) gets
+        # its own id so it can't collide with a real lemma's id
+        lemmas = sorted({c[1] for s in sents for c in s
+                         if len(c) > 2 and c[1] != "-"})
+        self.predicate_dict = {w: i for i, w in enumerate(lemmas)}
+        self._no_pred = len(self.predicate_dict)
+
+    def __len__(self):
+        return len(self.sentences)
+
+    def __getitem__(self, i):
+        s = self.sentences[i]
+        words = np.asarray([self.word_dict[c[0]] for c in s])
+        labels = np.asarray([self.label_dict[c[-1]] for c in s])
+        pred = np.asarray([self.predicate_dict[c[1]]
+                           if len(c) > 2 and c[1] != "-"
+                           else self._no_pred for c in s])
+        return words, pred, labels
+
+    def get_dict(self):
+        return self.word_dict, self.predicate_dict, self.label_dict
+
+
+class _WMTBase:
+    """Shared WMT parallel-corpus reader: ``root`` holds
+    ``{split}.{src}`` / ``{split}.{tgt}`` line-aligned files; vocab is
+    built from train with <s>/<e>/<unk> specials (ref:
+    text/datasets/wmt14.py / wmt16.py BPE-tokenized readers)."""
+
+    SRC, TGT = "en", "de"
+
+    def __init__(self, root: str, mode: str = "train",
+                 src_dict_size: int = 30000, trg_dict_size: int = 30000):
+        import os
+        sp = os.path.join(root, f"{mode}.{self.SRC}")
+        tp = os.path.join(root, f"{mode}.{self.TGT}")
+        for p in (sp, tp):
+            if not os.path.exists(p):
+                raise FileNotFoundError(
+                    f"{p} not found; place line-aligned "
+                    f"{self.SRC}/{self.TGT} files there (zero-egress)")
+        self.src_lines = [l.strip().split() for l in open(sp)]
+        self.tgt_lines = [l.strip().split() for l in open(tp)]
+        # vocab ALWAYS comes from the train split so ids agree across
+        # modes (the reference builds one dict from train); fall back
+        # to this split only when no train files exist
+        from collections import Counter
+        vs = os.path.join(root, f"train.{self.SRC}")
+        vt = os.path.join(root, f"train.{self.TGT}")
+        src_corpus = ([l.strip().split() for l in open(vs)]
+                      if os.path.exists(vs) else self.src_lines)
+        tgt_corpus = ([l.strip().split() for l in open(vt)]
+                      if os.path.exists(vt) else self.tgt_lines)
+        self.src_dict = self._vocab(Counter(
+            w for l in src_corpus for w in l), src_dict_size)
+        self.trg_dict = self._vocab(Counter(
+            w for l in tgt_corpus for w in l), trg_dict_size)
+
+    @staticmethod
+    def _vocab(counts, size):
+        vocab = {"<s>": 0, "<e>": 1, "<unk>": 2}
+        for w, _ in counts.most_common(max(size - 3, 0)):
+            vocab.setdefault(w, len(vocab))
+        return vocab
+
+    def _ids(self, words, vocab):
+        unk = vocab["<unk>"]
+        return np.asarray([vocab["<s>"]]
+                          + [vocab.get(w, unk) for w in words]
+                          + [vocab["<e>"]])
+
+    def __len__(self):
+        return len(self.src_lines)
+
+    def __getitem__(self, i):
+        src = self._ids(self.src_lines[i], self.src_dict)
+        tgt = self._ids(self.tgt_lines[i], self.trg_dict)
+        return src, tgt[:-1], tgt[1:]
+
+
+class WMT14(_WMTBase):
+    """WMT'14 en→fr (ref: text/datasets/wmt14.py)."""
+
+    SRC, TGT = "en", "fr"
+
+
+class WMT16(_WMTBase):
+    """WMT'16 en→de (ref: text/datasets/wmt16.py)."""
+
+    SRC, TGT = "en", "de"

@@ -1,0 +1,55 @@
+"""Public-API parity gate (VERDICT r3 ask #4): the reference's
+``paddle.*`` python surface — top-level __all__, 28 submodule __all__
+lists, and the Tensor-method table — must stay fully adjudicated
+(direct / alias / declined-with-record). A new reference export or a
+regression dropping one of ours fails here.
+
+Ref: python/paddle/__init__.py (269 names),
+python/paddle/tensor/__init__.py:281 tensor_method_func,
+python/paddle/static/nn/__init__.py, operators/sequence_ops/, ...
+(enumerated by tools/api_coverage.py)."""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                "tools"))
+
+import api_coverage  # noqa: E402
+
+pytestmark = pytest.mark.slow  # imports the whole package tree
+
+
+@pytest.fixture(scope="module")
+def report():
+    if not os.path.isdir(api_coverage.REF):
+        pytest.skip("reference checkout not present")
+    return api_coverage.collect()
+
+
+def test_no_missing_names(report):
+    assert report["missing_keys"] == [], report["missing_keys"]
+
+
+def test_fully_adjudicated(report):
+    t = report["totals"]
+    assert t["covered_pct"] >= 99.5, t
+    assert t["total"] > 1100, t  # the enumeration itself still works
+
+
+def test_declines_carry_reasons():
+    for key, reason in api_coverage.DECLINED.items():
+        assert len(reason) > 30, f"{key}: decision record too thin"
+
+
+def test_surface_counts_sane(report):
+    # spot-pin the big surfaces so a silent enumeration regression
+    # (e.g. an __all__ regex miss) cannot fake a green gate
+    s = report["surfaces"]
+    assert s["paddle"]["direct"] >= 260
+    assert s["paddle.Tensor"]["direct"] >= 210
+    assert s["paddle.nn"]["direct"] >= 120
+    assert s["paddle.nn.functional"]["direct"] >= 100
+    assert s["paddle.static.nn"]["direct"] >= 41

@@ -83,7 +83,30 @@ SURFACES = [
 ALIASES: dict[str, str] = {}
 
 # Deliberately not carried — decision records. Keys "<label>.<name>".
-DECLINED: dict[str, str] = {}
+DECLINED: dict[str, str] = {
+    "paddle.static.IpuCompiledProgram":
+        "Graphcore IPU vendor runtime (reference: "
+        "python/paddle/static/__init__ → fluid/compiler.py "
+        "IpuCompiledProgram over the popart backend). This build "
+        "targets PJRT:TPU; vendor-accelerator compilation lives "
+        "behind PJRT plugins, not per-vendor compile classes — the "
+        "device/ module's plugin story is the analog.",
+    "paddle.static.IpuStrategy":
+        "IPU vendor config object — same decision as "
+        "IpuCompiledProgram.",
+    "paddle.static.ipu_shard_guard":
+        "IPU pipeline-stage pinning context — stage placement here is "
+        "mesh sharding (parallel.pipeline), not per-op device pins.",
+    "paddle.static.set_ipu_shard":
+        "same decision as ipu_shard_guard.",
+    "paddle.onnx.export":
+        "ONNX interchange (reference: python/paddle/onnx/export.py → "
+        "external paddle2onnx). The deployment IR here is serialized "
+        "StableHLO (jit.save → native/predictor.cc serving, "
+        "quantized artifacts) — a second interchange format would "
+        "duplicate that path; StableHLO is itself an open interchange "
+        "consumed beyond XLA.",
+}
 
 
 def _extract_all(path: str) -> list[str]:
