@@ -74,9 +74,30 @@ def main(argv=None) -> int:
     parser.add_argument("--heartbeat_timeout", type=float, default=None,
                         help="restart the job if no rank heartbeats for "
                              "this many seconds (elastic stall watch)")
+    parser.add_argument("--nnodes", type=int, default=1,
+                        help="number of nodes; >1 runs this process as "
+                             "the node agent for --node_rank (ref: "
+                             "launch/controllers/collective.py Pod)")
+    parser.add_argument("--node_rank", type=int, default=0)
+    parser.add_argument("--rdzv_dir", type=str, default=None,
+                        help="shared rendezvous directory (required "
+                             "when --nnodes > 1; NFS/GCS-fuse on pods)")
+    parser.add_argument("--node_timeout", type=float, default=10.0,
+                        help="seconds without a peer agent heartbeat "
+                             "before declaring the node lost")
     parser.add_argument("training_script")
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
+    if args.nnodes > 1:
+        if not args.rdzv_dir:
+            parser.error("--nnodes > 1 requires --rdzv_dir")
+        from .multinode import NodeAgent
+        return NodeAgent(
+            args.node_rank, args.nnodes, args.nproc_per_node,
+            args.training_script, args.script_args,
+            rdzv_dir=args.rdzv_dir, max_restarts=args.max_restarts,
+            node_timeout=args.node_timeout,
+            log_dir=args.log_dir).run()
     return launch(args.nproc_per_node, args.training_script,
                   args.script_args, master=args.master,
                   log_dir=args.log_dir, max_restarts=args.max_restarts,

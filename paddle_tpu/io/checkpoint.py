@@ -45,8 +45,12 @@ class CheckpointManager:
                  async_save: bool = True):
         ocp = _ocp()
         self.directory = os.path.abspath(directory)
+        # cleanup_tmp_directories: a hard kill (preempted VM) mid-save
+        # leaves an uncommitted tmp step dir; without cleanup the next
+        # incarnation's save of that same step can collide with it
         options = ocp.CheckpointManagerOptions(
-            max_to_keep=max_to_keep, enable_async_checkpointing=async_save)
+            max_to_keep=max_to_keep, enable_async_checkpointing=async_save,
+            cleanup_tmp_directories=True)
         self._mgr = ocp.CheckpointManager(self.directory, options=options)
 
     def save(self, step: int, tree: Any, force: bool = False) -> bool:
@@ -168,8 +172,33 @@ class AutoCheckpoint:
         acp._hapi_model = model
         return acp
 
-    def epochs(self, total: int):
+    def epochs(self, total: int, agree_step=None):
+        """Resume-aware epoch/step range.
+
+        ``agree_step`` (optional) maps this process's latest committed
+        step (-1 if none) to the step EVERY process will resume from —
+        in a multi-process job a hard kill can land between ranks'
+        commits, leaving per-rank checkpoint dirs one step apart; ranks
+        resuming from different steps desync every subsequent
+        collective. Pass e.g. a process-allgather min (see
+        tests/multinode_worker.py) so all ranks restore the same step.
+        Divergence is bounded by commit cadence; keep ``max_to_keep``
+        ≥ 2 so the agreed (possibly one-older) step is still on disk.
+        (ref: auto_checkpoint.py keys snapshots by job id and trainer;
+        its etcd CheckpointSaver serializes ranks instead.)"""
         start = self.mgr.latest_step()
+        if agree_step is not None:
+            local = -1 if start is None else start
+            agreed = int(agree_step(local))
+            if agreed > local:
+                # includes the no-local-checkpoint rank (local=-1,
+                # agreed>=0): restore(agreed) would fail with a missing
+                # step; diagnose the broken agree_fn instead
+                raise RuntimeError(
+                    f"agreed resume step {agreed} is ahead of local "
+                    f"checkpoints (latest {start}) — agree_step must "
+                    f"be a global MIN")
+            start = None if agreed < 0 else agreed
         first = 0 if start is None else start + 1
         if first > 0:
             tree = self.mgr.restore(start)
