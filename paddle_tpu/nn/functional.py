@@ -83,8 +83,18 @@ def hardshrink(x, threshold: float = 0.5):
     return jnp.where(jnp.abs(x) > threshold, x, 0.0)
 
 
-def prelu(x, weight):
-    return jnp.where(x >= 0, x, weight * x)
+def prelu(x, weight, data_format: str = "NCHW"):
+    """ref: nn/functional/activation.py prelu — a weight of length C
+    applies along the CHANNEL axis (1 for NC*, last for N*C), not by
+    trailing-axis broadcasting (plain ``weight * x`` would silently
+    scale the wrong axis for NCHW inputs)."""
+    w = jnp.asarray(weight)
+    if w.size > 1 and x.ndim > 1:
+        axis = 1 if data_format.startswith("NC") else x.ndim - 1
+        shape = [1] * x.ndim
+        shape[axis] = w.size
+        w = w.reshape(shape)
+    return jnp.where(x >= 0, x, w * x)
 
 
 def softmax(x, axis: int = -1):

@@ -1,0 +1,734 @@
+"""OpSpec numeric sweep, part 2 (VERDICT r3 item 6): the conv / pool /
+pad / vision-functional / norm / indexing / linalg families — the r3
+coverage fills and alias targets that were previously "resolved" but
+not NumPy-reference-checked, now in the same declarative table as
+tests/test_optest.py, multi-shape for the headline ops.
+
+References are written from the op DEFINITIONS (reference unittests:
+test_conv2d_op.py, test_pool2d_op.py, test_pad3d_op.py,
+test_grid_sampler_op.py, test_pixel_shuffle.py, test_norm_all.py ...),
+as loops/np formulas — independent of the implementation under test."""
+
+import numpy as np
+import pytest
+import scipy.special as sps
+
+import paddle_tpu as pt
+import paddle_tpu.tensor as T
+from paddle_tpu import linalg
+from paddle_tpu.nn import functional as F
+from paddle_tpu.vision import ops as vops
+from paddle_tpu.testing import OpSpec, arr, run_spec
+
+pytestmark = pytest.mark.slow  # smoke tier skips (tools/ci.sh --smoke)
+
+
+# ---------------------------------------------------------------------------
+# NumPy references (dimension-generic loops; shapes are tiny)
+# ---------------------------------------------------------------------------
+
+def _tup(v, nd):
+    return (v,) * nd if np.isscalar(v) else tuple(v)
+
+
+def _np_conv(x, w, stride=1, pad=0, groups=1):
+    """x (N,Cin,*S), w (Cout,Cin/g,*K) → (N,Cout,*O)."""
+    nd = x.ndim - 2
+    stride, pad = _tup(stride, nd), _tup(pad, nd)
+    xp = np.pad(x, [(0, 0), (0, 0)] + [(p, p) for p in pad])
+    K = w.shape[2:]
+    O = [(xp.shape[2 + i] - K[i]) // stride[i] + 1 for i in range(nd)]
+    N, Cout = x.shape[0], w.shape[0]
+    cing = x.shape[1] // groups
+    coutg = Cout // groups
+    out = np.zeros((N, Cout, *O), np.float64)
+    for n in range(N):
+        for co in range(Cout):
+            g = co // coutg
+            for pos in np.ndindex(*O):
+                sl = tuple(slice(pos[i] * stride[i],
+                                 pos[i] * stride[i] + K[i])
+                           for i in range(nd))
+                patch = xp[(n, slice(g * cing, (g + 1) * cing)) + sl]
+                out[(n, co) + pos] = (patch * w[co]).sum()
+    return out.astype(np.float32)
+
+
+def _np_conv_transpose(x, w, stride=1, pad=0, output_padding=0):
+    """x (N,Cin,*S), w (Cin,Cout,*K) → scatter-add transpose conv."""
+    nd = x.ndim - 2
+    stride, pad = _tup(stride, nd), _tup(pad, nd)
+    op = _tup(output_padding, nd)
+    K = w.shape[2:]
+    O = [(x.shape[2 + i] - 1) * stride[i] - 2 * pad[i] + K[i] + op[i]
+         for i in range(nd)]
+    N, Cin, Cout = x.shape[0], x.shape[1], w.shape[1]
+    out = np.zeros((N, Cout, *O), np.float64)
+    for n in range(N):
+        for ci in range(Cin):
+            for pos in np.ndindex(*x.shape[2:]):
+                for kpos in np.ndindex(*K):
+                    o = tuple(pos[i] * stride[i] + kpos[i] - pad[i]
+                              for i in range(nd))
+                    if all(0 <= o[i] < O[i] for i in range(nd)):
+                        out[(n, slice(None)) + o] += \
+                            x[(n, ci) + pos] * w[(ci, slice(None)) + kpos]
+    return out.astype(np.float32)
+
+
+def _np_pool(x, k, stride=None, pad=0, mode="avg",
+             count_include_pad=True):
+    nd = x.ndim - 2
+    k = _tup(k, nd)
+    stride = _tup(stride if stride is not None else k, nd)
+    pad = _tup(pad, nd)
+    if mode == "avg":
+        fill = 0.0
+    else:
+        fill = -np.inf
+    xp = np.pad(x, [(0, 0), (0, 0)] + [(p, p) for p in pad],
+                constant_values=fill)
+    O = [(xp.shape[2 + i] - k[i]) // stride[i] + 1 for i in range(nd)]
+    out = np.zeros((*x.shape[:2], *O), np.float64)
+    for n in range(x.shape[0]):
+        for c in range(x.shape[1]):
+            for pos in np.ndindex(*O):
+                sl = tuple(slice(pos[i] * stride[i],
+                                 pos[i] * stride[i] + k[i])
+                           for i in range(nd))
+                win = xp[(n, c) + sl]
+                if mode == "max":
+                    out[(n, c) + pos] = win.max()
+                elif count_include_pad:
+                    out[(n, c) + pos] = win.mean()
+                else:
+                    finite = win[np.isfinite(win)]
+                    # zeros-padded avg windows excluding pad counts
+                    lo = tuple(pos[i] * stride[i] for i in range(nd))
+                    cnt = 1
+                    for i in range(nd):
+                        a = max(lo[i], pad[i])
+                        b = min(lo[i] + k[i], pad[i] + x.shape[2 + i])
+                        cnt *= max(0, b - a)
+                    out[(n, c) + pos] = win.sum() / cnt
+                    del finite
+    return out.astype(np.float32)
+
+
+def _np_adaptive_pool(x, out_size, mode="avg"):
+    nd = x.ndim - 2
+    out_size = _tup(out_size, nd)
+    out = np.zeros((*x.shape[:2], *out_size), np.float64)
+    for n in range(x.shape[0]):
+        for c in range(x.shape[1]):
+            for pos in np.ndindex(*out_size):
+                sl = []
+                for i in range(nd):
+                    L = x.shape[2 + i]
+                    a = (pos[i] * L) // out_size[i]
+                    b = -(-((pos[i] + 1) * L) // out_size[i])
+                    sl.append(slice(a, b))
+                win = x[(n, c) + tuple(sl)]
+                out[(n, c) + pos] = win.max() if mode == "max" \
+                    else win.mean()
+    return out.astype(np.float32)
+
+
+def _np_maxout(x, groups, axis=1):
+    # paddle semantics: C → C/groups, out[...,c,...] = max over the
+    # `groups` consecutive channels of block c
+    sh = list(x.shape)
+    co = sh[axis] // groups
+    resh = sh[:axis] + [co, groups] + sh[axis + 1:]
+    return x.reshape(resh).max(axis=axis + 1)
+
+
+def _np_grid_sample(x, grid, mode="bilinear", align_corners=True):
+    """zeros padding; grid (N,Ho,Wo,2) with (gx, gy) in [-1,1]."""
+    N, C, H, W = x.shape
+    _, Ho, Wo, _ = grid.shape
+    out = np.zeros((N, C, Ho, Wo), np.float64)
+
+    def unnorm(g, L):
+        if align_corners:
+            return (g + 1) / 2 * (L - 1)
+        return ((g + 1) * L - 1) / 2
+
+    def at(n, c, iy, ix):
+        if 0 <= iy < H and 0 <= ix < W:
+            return x[n, c, iy, ix]
+        return 0.0
+
+    for n in range(N):
+        for ho in range(Ho):
+            for wo in range(Wo):
+                gx, gy = grid[n, ho, wo]
+                fx, fy = unnorm(gx, W), unnorm(gy, H)
+                if mode == "nearest":
+                    ix, iy = int(np.round(fx)), int(np.round(fy))
+                    for c in range(C):
+                        out[n, c, ho, wo] = at(n, c, iy, ix)
+                    continue
+                x0, y0 = int(np.floor(fx)), int(np.floor(fy))
+                tx, ty = fx - x0, fy - y0
+                for c in range(C):
+                    out[n, c, ho, wo] = (
+                        at(n, c, y0, x0) * (1 - tx) * (1 - ty) +
+                        at(n, c, y0, x0 + 1) * tx * (1 - ty) +
+                        at(n, c, y0 + 1, x0) * (1 - tx) * ty +
+                        at(n, c, y0 + 1, x0 + 1) * tx * ty)
+    return out.astype(np.float32)
+
+
+def _np_affine_grid(theta, out_shape, align_corners=True):
+    N, _, H, W = out_shape
+    if align_corners:
+        xs = np.linspace(-1, 1, W)
+        ys = np.linspace(-1, 1, H)
+    else:
+        xs = (np.arange(W) * 2 + 1) / W - 1
+        ys = (np.arange(H) * 2 + 1) / H - 1
+    base = np.stack(
+        [np.tile(xs, (H, 1)),
+         np.tile(ys[:, None], (1, W)),
+         np.ones((H, W))], -1)          # (H,W,3)
+    out = np.einsum("hwk,nik->nhwi", base, theta)
+    return out.astype(np.float32)
+
+
+def _np_pixel_shuffle(x, r):
+    N, C, H, W = x.shape
+    c = C // (r * r)
+    y = x.reshape(N, c, r, r, H, W)
+    return y.transpose(0, 1, 4, 2, 5, 3).reshape(N, c, H * r, W * r)
+
+
+def _np_pixel_unshuffle(x, r):
+    N, C, H, W = x.shape
+    h, w = H // r, W // r
+    y = x.reshape(N, C, h, r, w, r)
+    return y.transpose(0, 1, 3, 5, 2, 4).reshape(N, C * r * r, h, w)
+
+
+def _np_channel_shuffle(x, g):
+    N, C, H, W = x.shape
+    return x.reshape(N, g, C // g, H, W).transpose(0, 2, 1, 3, 4) \
+        .reshape(N, C, H, W)
+
+
+def _np_interp_nearest(x, size):
+    N, C, H, W = x.shape
+    Ho, Wo = size
+    iy = (np.arange(Ho) * H // Ho)
+    ix = (np.arange(Wo) * W // Wo)
+    return x[:, :, iy][:, :, :, ix]
+
+
+def _np_interp_bilinear_ac(x, size):
+    """align_corners=True separable linear interpolation."""
+    N, C, H, W = x.shape
+    Ho, Wo = size
+    fy = np.linspace(0, H - 1, Ho)
+    fx = np.linspace(0, W - 1, Wo)
+    y0 = np.floor(fy).astype(int)
+    y1 = np.minimum(y0 + 1, H - 1)
+    ty = fy - y0
+    x0 = np.floor(fx).astype(int)
+    x1 = np.minimum(x0 + 1, W - 1)
+    tx = fx - x0
+    a = x[:, :, y0] * (1 - ty)[None, None, :, None] + \
+        x[:, :, y1] * ty[None, None, :, None]
+    return (a[:, :, :, x0] * (1 - tx) + a[:, :, :, x1] * tx) \
+        .astype(np.float32)
+
+
+def _np_temporal_shift(x, seg_num, shift_ratio=0.25):
+    NT, C, H, W = x.shape
+    N = NT // seg_num
+    v = x.reshape(N, seg_num, C, H, W)
+    fold = int(C * shift_ratio)
+    out = np.zeros_like(v)
+    # paddle kernel: first fold shifts from t-1 (zero at t=0), second
+    # fold from t+1 (zero at t=T-1), rest pass through
+    out[:, 1:, :fold] = v[:, :-1, :fold]
+    out[:, :-1, fold:2 * fold] = v[:, 1:, fold:2 * fold]
+    out[:, :, 2 * fold:] = v[:, :, 2 * fold:]
+    return out.reshape(NT, C, H, W)
+
+
+def _np_lrn(x, size=5, alpha=1e-4, beta=0.75, k=1.0):
+    N, C, H, W = x.shape
+    sq = x ** 2
+    out = np.zeros_like(x)
+    half = size // 2
+    for c in range(C):
+        lo, hi = max(0, c - half), min(C, c + half + size % 2)
+        s = sq[:, lo:hi].sum(1)
+        out[:, c] = x[:, c] / (k + alpha / size * s) ** beta
+    return out
+
+
+def _np_group_norm(x, groups, eps=1e-5):
+    N, C = x.shape[:2]
+    v = x.reshape(N, groups, -1)
+    m = v.mean(-1, keepdims=True)
+    var = v.var(-1, keepdims=True)
+    return ((v - m) / np.sqrt(var + eps)).reshape(x.shape)
+
+
+def _np_unfold(x, k, stride=1, pad=0):
+    """im2col: (N,C,H,W) → (N, C*kh*kw, L) column order matching the
+    reference's im2col (C-major, then kh, kw)."""
+    N, C, H, W = x.shape
+    kh, kw = _tup(k, 2)
+    sh, sw = _tup(stride, 2)
+    ph, pw = _tup(pad, 2)
+    xp = np.pad(x, [(0, 0), (0, 0), (ph, ph), (pw, pw)])
+    Ho = (H + 2 * ph - kh) // sh + 1
+    Wo = (W + 2 * pw - kw) // sw + 1
+    cols = np.zeros((N, C * kh * kw, Ho * Wo), x.dtype)
+    for n in range(N):
+        idx = 0
+        for c in range(C):
+            for i in range(kh):
+                for j in range(kw):
+                    patch = xp[n, c, i:i + Ho * sh:sh, j:j + Wo * sw:sw]
+                    cols[n, idx] = patch.reshape(-1)
+                    idx += 1
+    return cols
+
+
+def _np_renorm(x, p, axis, max_norm):
+    out = x.copy()
+    x_m = np.moveaxis(x, axis, 0)
+    o_m = np.moveaxis(out, axis, 0)
+    for i in range(x_m.shape[0]):
+        n = (np.abs(x_m[i]) ** p).sum() ** (1.0 / p)
+        if n > max_norm:
+            o_m[i] = x_m[i] * (max_norm / n)
+    return out
+
+
+def _np_ctc_loss(log_probs, labels, blank=0):
+    """Forward-algorithm CTC negative log likelihood for ONE sequence.
+    log_probs (t=T, C) log-softmaxed; labels (L,)."""
+    Tn, _ = log_probs.shape
+    ext = [blank]
+    for l in labels:
+        ext += [int(l), blank]
+    S = len(ext)
+    alpha = np.full((Tn, S), -np.inf)
+    alpha[0, 0] = log_probs[0, blank]
+    if S > 1:
+        alpha[0, 1] = log_probs[0, ext[1]]
+    for t in range(1, Tn):
+        for s in range(S):
+            cands = [alpha[t - 1, s]]
+            if s >= 1:
+                cands.append(alpha[t - 1, s - 1])
+            if s >= 2 and ext[s] != blank and ext[s] != ext[s - 2]:
+                cands.append(alpha[t - 1, s - 2])
+            alpha[t, s] = sps.logsumexp(cands) + log_probs[t, ext[s]]
+    return -sps.logsumexp([alpha[-1, -1], alpha[-1, -2]])
+
+
+# ---------------------------------------------------------------------------
+# inputs
+# ---------------------------------------------------------------------------
+
+_X1 = arr((2, 3, 8), seed=40)                 # N,C,L
+_W1 = arr((4, 3, 3), seed=41, low=-0.5, high=0.5)
+_X2 = arr((2, 3, 6, 7), seed=42)              # N,C,H,W
+_W2 = arr((4, 3, 3, 3), seed=43, low=-0.5, high=0.5)
+_X3 = arr((1, 2, 4, 5, 4), seed=44)           # N,C,D,H,W
+_W3 = arr((3, 2, 2, 3, 2), seed=45, low=-0.5, high=0.5)
+_WT1 = arr((3, 4, 3), seed=46, low=-0.5, high=0.5)   # Cin,Cout,K
+_WT2 = arr((3, 4, 3, 3), seed=47, low=-0.5, high=0.5)
+_WT3 = arr((2, 3, 2, 2, 2), seed=48, low=-0.5, high=0.5)
+_G1 = arr((2, 5, 6, 2), seed=49, low=-0.95, high=0.95)   # grid
+_G2 = arr((1, 3, 3, 2), seed=50, low=-0.95, high=0.95)
+_TH = arr((2, 2, 3), seed=51)                # affine theta
+_SQ = np.eye(4, dtype=np.float32) * 2 + 0.3 * arr((4, 4), seed=52)
+_SPD = (_SQ @ _SQ.T + np.eye(4, dtype=np.float32)).astype(np.float32)
+_M64 = arr((4, 6), seed=53)
+
+SPECS = [
+    # -- conv family (test_conv{1,2,3}d_op.py) --------------------------
+    OpSpec("conv1d", F.conv1d, _np_conv, (_X1, _W1), grad_wrt=(0, 1)),
+    OpSpec("conv1d.s2p1", F.conv1d,
+           lambda x, w: _np_conv(x, w, stride=2, pad=1), (_X1, _W1),
+           kwargs=dict(stride=2, padding=1), grad_wrt=(0, 1)),
+    OpSpec("conv2d", F.conv2d, _np_conv, (_X2, _W2), grad_wrt=(0, 1)),
+    OpSpec("conv2d.s2p1", F.conv2d,
+           lambda x, w: _np_conv(x, w, stride=2, pad=1), (_X2, _W2),
+           kwargs=dict(stride=2, padding=1), grad_wrt=(0, 1)),
+    OpSpec("conv2d.groups", F.conv2d,
+           lambda x, w: _np_conv(x, w, groups=2),
+           (arr((2, 4, 5, 5), seed=54),
+            arr((6, 2, 3, 3), seed=55, low=-0.5, high=0.5)),
+           kwargs=dict(groups=2), grad_wrt=(0, 1)),
+    OpSpec("conv3d", F.conv3d, _np_conv, (_X3, _W3), grad_wrt=(0, 1)),
+    OpSpec("conv3d.s2p1", F.conv3d,
+           lambda x, w: _np_conv(x, w, stride=2, pad=1), (_X3, _W3),
+           kwargs=dict(stride=2, padding=1), grad_wrt=(0, 1)),
+
+    # -- transpose convs (test_conv{2,3}d_transpose_op.py) --------------
+    OpSpec("conv1d_transpose", F.conv1d_transpose, _np_conv_transpose,
+           (_X1, _WT1), grad_wrt=(0, 1)),
+    OpSpec("conv2d_transpose", F.conv2d_transpose, _np_conv_transpose,
+           (_X2, _WT2), grad_wrt=(0, 1)),
+    OpSpec("conv2d_transpose.s2", F.conv2d_transpose,
+           lambda x, w: _np_conv_transpose(x, w, stride=2, pad=1),
+           (_X2, _WT2), kwargs=dict(stride=2, padding=1),
+           grad_wrt=(0, 1)),
+    OpSpec("conv3d_transpose", F.conv3d_transpose, _np_conv_transpose,
+           (_X3, _WT3), grad_wrt=(0, 1)),
+    OpSpec("conv3d_transpose.s2", F.conv3d_transpose,
+           lambda x, w: _np_conv_transpose(x, w, stride=2),
+           (arr((1, 2, 3, 3, 3), seed=56), _WT3),
+           kwargs=dict(stride=2), grad_wrt=(0, 1)),
+
+    # -- pooling (test_pool{1,2,3}d_op.py, adaptive, maxout) ------------
+    OpSpec("avg_pool1d", lambda x: F.avg_pool1d(x, 2),
+           lambda x: _np_pool(x, 2), (_X1,)),
+    OpSpec("avg_pool2d", lambda x: F.avg_pool2d(x, 2),
+           lambda x: _np_pool(x, 2), (_X2,)),
+    OpSpec("avg_pool2d.s1p1", lambda x: F.avg_pool2d(
+        x, 3, stride=1, padding=1),
+        lambda x: _np_pool(x, 3, 1, 1), (_X2,)),
+    OpSpec("avg_pool2d.nopad", lambda x: F.avg_pool2d(
+        x, 3, stride=1, padding=1, count_include_pad=False),
+        lambda x: _np_pool(x, 3, 1, 1, count_include_pad=False),
+        (_X2,)),
+    OpSpec("avg_pool3d", lambda x: F.avg_pool3d(x, 2),
+           lambda x: _np_pool(x, 2), (_X3,)),
+    OpSpec("max_pool1d", lambda x: F.max_pool1d(x, 2),
+           lambda x: _np_pool(x, 2, mode="max"), (_X1,)),
+    OpSpec("max_pool2d", lambda x: F.max_pool2d(x, 2),
+           lambda x: _np_pool(x, 2, mode="max"), (_X2,)),
+    OpSpec("max_pool2d.s1", lambda x: F.max_pool2d(x, 3, stride=1),
+           lambda x: _np_pool(x, 3, 1, mode="max"), (_X2,)),
+    OpSpec("max_pool3d", lambda x: F.max_pool3d(x, 2),
+           lambda x: _np_pool(x, 2, mode="max"), (_X3,)),
+    # adaptive pools: output_size must divide the input length (the
+    # recorded static-shape TPU constraint, nn/functional.py)
+    OpSpec("adaptive_avg_pool1d", lambda x: F.adaptive_avg_pool1d(x, 4),
+           lambda x: _np_adaptive_pool(x, 4), (_X1,)),
+    OpSpec("adaptive_avg_pool2d", lambda x: F.adaptive_avg_pool2d(
+        x, (3, 7)), lambda x: _np_adaptive_pool(x, (3, 7)), (_X2,)),
+    OpSpec("adaptive_avg_pool3d", lambda x: F.adaptive_avg_pool3d(
+        x, (2, 5, 2)), lambda x: _np_adaptive_pool(x, (2, 5, 2)),
+        (_X3,)),
+    OpSpec("adaptive_max_pool2d", lambda x: F.adaptive_max_pool2d(
+        x, (3, 7)), lambda x: _np_adaptive_pool(x, (3, 7), "max"),
+        (_X2,)),
+    OpSpec("maxout", lambda x: F.maxout(x, 2),
+           lambda x: _np_maxout(x, 2), (arr((2, 6, 3, 3), seed=57),)),
+
+    # -- pad family (test_pad3d_op.py; constant/reflect/replicate/
+    #    circular over 3D/4D/5D inputs) ---------------------------------
+    OpSpec("pad.1d_const", lambda x: F.pad(x, [1, 2], value=0.5,
+                                           data_format="NCL"),
+           lambda x: np.pad(x, [(0, 0), (0, 0), (1, 2)],
+                            constant_values=0.5), (_X1,)),
+    OpSpec("pad.2d_reflect", lambda x: F.pad(x, [1, 2, 2, 1],
+                                             mode="reflect"),
+           lambda x: np.pad(x, [(0, 0), (0, 0), (2, 1), (1, 2)],
+                            mode="reflect"), (_X2,)),
+    OpSpec("pad.2d_replicate", lambda x: F.pad(x, [1, 2, 2, 1],
+                                               mode="replicate"),
+           lambda x: np.pad(x, [(0, 0), (0, 0), (2, 1), (1, 2)],
+                            mode="edge"), (_X2,)),
+    OpSpec("pad.2d_circular", lambda x: F.pad(x, [1, 2, 2, 1],
+                                              mode="circular"),
+           lambda x: np.pad(x, [(0, 0), (0, 0), (2, 1), (1, 2)],
+                            mode="wrap"), (_X2,)),
+    OpSpec("pad.3d_const", lambda x: F.pad(x, [1, 1, 2, 0, 0, 2],
+                                           value=1.0,
+                                           data_format="NCDHW"),
+           lambda x: np.pad(x, [(0, 0), (0, 0), (0, 2), (2, 0), (1, 1)],
+                            constant_values=1.0), (_X3,)),
+    OpSpec("pad.3d_reflect", lambda x: F.pad(x, [1, 1, 2, 1, 1, 2],
+                                             mode="reflect",
+                                             data_format="NCDHW"),
+           lambda x: np.pad(x, [(0, 0), (0, 0), (1, 2), (2, 1), (1, 1)],
+                            mode="reflect"), (_X3,)),
+    OpSpec("pad.3d_replicate", lambda x: F.pad(x, [1, 1, 2, 1, 1, 2],
+                                               mode="replicate",
+                                               data_format="NCDHW"),
+           lambda x: np.pad(x, [(0, 0), (0, 0), (1, 2), (2, 1), (1, 1)],
+                            mode="edge"), (_X3,)),
+    OpSpec("pad.3d_circular", lambda x: F.pad(x, [1, 1, 2, 1, 1, 2],
+                                              mode="circular",
+                                              data_format="NCDHW"),
+           lambda x: np.pad(x, [(0, 0), (0, 0), (1, 2), (2, 1), (1, 1)],
+                            mode="wrap"), (_X3,)),
+
+    # -- vision functional (test_grid_sampler_op.py, pixel_shuffle,
+    #    temporal_shift, interpolate) -----------------------------------
+    OpSpec("grid_sample", F.grid_sample, _np_grid_sample,
+           (arr((2, 3, 4, 5), seed=58), _G1), grad_wrt=(0, 1),
+           grad_rtol=0.1),
+    OpSpec("grid_sample.shape2", F.grid_sample, _np_grid_sample,
+           (arr((1, 2, 6, 6), seed=59), _G2), grad_wrt=(0, 1),
+           grad_rtol=0.1),
+    OpSpec("grid_sample.nearest",
+           lambda x, g: F.grid_sample(x, g, mode="nearest"),
+           lambda x, g: _np_grid_sample(x, g, mode="nearest"),
+           (arr((1, 2, 6, 6), seed=60), _G2), grad=False),
+    OpSpec("affine_grid", lambda t: F.affine_grid(t, (2, 3, 4, 5)),
+           lambda t: _np_affine_grid(t, (2, 3, 4, 5)), (_TH,)),
+    OpSpec("affine_grid.nac",
+           lambda t: F.affine_grid(t, (2, 3, 3, 6),
+                                   align_corners=False),
+           lambda t: _np_affine_grid(t, (2, 3, 3, 6),
+                                     align_corners=False), (_TH,)),
+    OpSpec("pixel_shuffle", lambda x: F.pixel_shuffle(x, 2),
+           lambda x: _np_pixel_shuffle(x, 2),
+           (arr((2, 8, 3, 4), seed=61),)),
+    OpSpec("pixel_unshuffle", lambda x: F.pixel_unshuffle(x, 2),
+           lambda x: _np_pixel_unshuffle(x, 2),
+           (arr((2, 2, 6, 4), seed=62),)),
+    OpSpec("channel_shuffle", lambda x: F.channel_shuffle(x, 3),
+           lambda x: _np_channel_shuffle(x, 3),
+           (arr((2, 6, 3, 3), seed=63),)),
+    OpSpec("interpolate.nearest",
+           lambda x: F.interpolate(x, size=(12, 14)),
+           lambda x: _np_interp_nearest(x, (12, 14)), (_X2,)),
+    OpSpec("interpolate.bilinear",
+           lambda x: F.interpolate(x, size=(12, 14), mode="bilinear",
+                                   align_corners=True),
+           lambda x: _np_interp_bilinear_ac(x, (12, 14)), (_X2,)),
+    OpSpec("temporal_shift", lambda x: vops.temporal_shift(x, 2),
+           lambda x: _np_temporal_shift(x, 2),
+           (arr((4, 4, 3, 3), seed=64),)),
+    OpSpec("sequence_mask",
+           lambda: F.sequence_mask(np.array([1, 3, 2]), maxlen=4),
+           lambda: np.arange(4)[None, :] < np.array([1, 3, 2])[:, None],
+           (), grad=False),
+    OpSpec("embedding",
+           lambda w: F.embedding(np.array([[0, 2], [1, 1]]), w),
+           lambda w: w[np.array([[0, 2], [1, 1]])],
+           (arr((5, 4), seed=65),)),
+    OpSpec("unfold", lambda x: F.unfold(x, 2),
+           lambda x: _np_unfold(x, 2), (_X2,)),
+    OpSpec("unfold.s2p1", lambda x: F.unfold(x, 3, strides=2,
+                                             paddings=1),
+           lambda x: _np_unfold(x, 3, 2, 1), (_X2,)),
+
+    # -- norm layers (test_batch_norm_op.py, group_norm, lrn) -----------
+    OpSpec("batch_norm.eval",
+           lambda x, m, v: F.batch_norm(x, m, v)[0],
+           lambda x, m, v: (x - m[None, :, None, None]) /
+           np.sqrt(v[None, :, None, None] + 1e-5),
+           (_X2, arr((3,), seed=66), arr((3,), seed=67, **dict(
+               low=0.5, high=1.5)))),
+    OpSpec("instance_norm", F.instance_norm,
+           lambda x: (x - x.mean((2, 3), keepdims=True)) /
+           np.sqrt(x.var((2, 3), keepdims=True) + 1e-5), (_X2,)),
+    OpSpec("group_norm", lambda x: F.group_norm(x, 3),
+           lambda x: _np_group_norm(x, 3),
+           (arr((2, 6, 3, 4), seed=68),)),
+    OpSpec("local_response_norm", F.local_response_norm, _np_lrn,
+           (arr((2, 8, 3, 3), seed=69),)),
+
+    # -- indexing / selection -------------------------------------------
+    OpSpec("topk", lambda x: T.topk(x, 3, axis=1),
+           lambda x: (np.sort(x, 1)[:, ::-1][:, :3],
+                      np.argsort(-x, 1, kind="stable")[:, :3]),
+           (arr((3, 6), seed=70),), grad=False),
+    OpSpec("scatter",
+           lambda x: T.scatter(x, np.array([2, 0]),
+                               np.zeros((2, 4), np.float32)),
+           lambda x: np.stack([np.zeros(4, np.float32), x[1],
+                               np.zeros(4, np.float32)]),
+           (arr((3, 4), seed=71),)),
+    OpSpec("gather_nd",
+           lambda x: T.gather_nd(x, np.array([[0, 1], [2, 3]])),
+           lambda x: x[[0, 2], [1, 3]], (arr((3, 4), seed=72),)),
+    OpSpec("repeat_interleave",
+           lambda x: T.repeat_interleave(x, 2, axis=1),
+           lambda x: np.repeat(x, 2, axis=1), (arr((2, 3), seed=73),)),
+    OpSpec("unbind", lambda x: T.unbind(x, axis=1),
+           lambda x: [x[:, i] for i in range(x.shape[1])],
+           (arr((2, 3), seed=74),)),
+    OpSpec("put_along_axis",
+           lambda x: T.put_along_axis(x, np.array([[0, 2]]),
+                                      np.array([[9.0, 8.0]],
+                                               np.float32), 1),
+           lambda x: np.stack([[9.0, x[0, 1], 8.0]]).astype(np.float32),
+           (arr((1, 3), seed=75),)),
+    OpSpec("index_sample",
+           lambda x: T.index_sample(x, np.array([[2, 0], [1, 3]])),
+           lambda x: np.take_along_axis(
+               x, np.array([[2, 0], [1, 3]]), 1),
+           (arr((2, 4), seed=76),)),
+    OpSpec("isclose", T.isclose, np.isclose,
+           (np.array([1.0, 2.0, np.nan], np.float32),
+            np.array([1.0, 2.1, np.nan], np.float32)), grad=False),
+    OpSpec("equal_all", T.equal_all,
+           lambda x, y: np.asarray(True), (_M64, _M64 * 1.0),
+           grad=False),
+    OpSpec("nanmean", T.nanmean, np.nanmean,
+           (np.array([[1.0, np.nan], [2.0, 4.0]], np.float32),),
+           grad=False),
+    OpSpec("nansum", T.nansum, np.nansum,
+           (np.array([[1.0, np.nan], [2.0, 4.0]], np.float32),),
+           grad=False),
+    OpSpec("nanmedian", T.nanmedian, np.nanmedian,
+           (np.array([1.0, np.nan, 3.0, 2.0], np.float32),),
+           grad=False),
+    OpSpec("heaviside", T.heaviside, np.heaviside,
+           (np.array([-1.0, 0.0, 2.0], np.float32),
+            np.array([0.5, 0.5, 0.5], np.float32)), grad=False),
+    OpSpec("frac", T.frac, lambda x: x - np.trunc(x),
+           (arr((3, 4), seed=77, low=-3, high=3),), grad=False),
+    OpSpec("renorm", lambda x: T.renorm(x, 2.0, 0, 1.0),
+           lambda x: _np_renorm(x, 2.0, 0, 1.0),
+           (arr((3, 4), seed=78, low=-2, high=2),)),
+
+    # -- linalg (test_linalg_*, test_cholesky_op.py ...) ----------------
+    OpSpec("cholesky", lambda: pt.linalg.cholesky(_SPD),
+           lambda: np.linalg.cholesky(_SPD), (), grad=False),
+    OpSpec("det", pt.linalg.det, np.linalg.det, (_SQ,)),
+    OpSpec("slogdet", pt.linalg.slogdet,
+           lambda x: tuple(np.linalg.slogdet(x)), (_SQ,), grad=False),
+    OpSpec("matrix_power", lambda x: pt.linalg.matrix_power(x, 3),
+           lambda x: np.linalg.matrix_power(x, 3), (_SQ,)),
+    OpSpec("pinv", pt.linalg.pinv, np.linalg.pinv, (_M64,),
+           rtol=1e-4, atol=1e-4),
+    OpSpec("solve", pt.linalg.solve, np.linalg.solve,
+           (_SPD, arr((4,), seed=79))),
+    OpSpec("triangular_solve",
+           lambda a, b: pt.linalg.triangular_solve(a, b),
+           lambda a, b: np.linalg.solve(np.triu(a), b),
+           (_SPD + 3 * np.eye(4, dtype=np.float32),
+            arr((4, 1), seed=80))),
+    OpSpec("matrix_rank", pt.linalg.matrix_rank,
+           lambda x: np.asarray(np.linalg.matrix_rank(x)), (_SPD,),
+           grad=False),
+    OpSpec("cov", pt.linalg.cov, np.cov, (_M64,)),
+    OpSpec("corrcoef", pt.linalg.corrcoef, np.corrcoef, (_M64,),
+           rtol=1e-4, atol=1e-4),
+    # decomposition grads: JAX implements no VJP for wide-matrix QR;
+    # reconstruction identity is the forward check
+    OpSpec("qr.reconstruct",
+           lambda x: (lambda q, r: q @ r)(*pt.linalg.qr(x)),
+           lambda x: x, (_M64,), rtol=1e-4, atol=1e-4, grad=False),
+    OpSpec("svd.reconstruct",
+           lambda x: (lambda u, s, vh: (u * s) @ vh)(
+               *pt.linalg.svd(x, full_matrices=False)),
+           lambda x: x, (_M64,), rtol=1e-4, atol=1e-4, grad=False),
+    OpSpec("eigh.reconstruct",
+           lambda x: (lambda w, v: (v * w) @ v.T)(*pt.linalg.eigh(x)),
+           lambda x: x, (_SPD,), rtol=1e-4, atol=1e-4, grad=False),
+    OpSpec("multi_dot",
+           lambda a, b: pt.linalg.multi_dot([a, b]),
+           np.matmul, (arr((3, 5), seed=81), arr((5, 4), seed=82)),
+           grad_wrt=(0, 1)),
+
+    # -- activation/selection stragglers from the resolved-only list ----
+    OpSpec("celu", F.celu,
+           lambda x: np.maximum(0, x) + np.minimum(
+               0, np.expm1(np.minimum(x, 0))), (_M64,)),
+    OpSpec("prelu",
+           lambda x, w: F.prelu(x, w),
+           lambda x, w: np.where(x >= 0, x, w.reshape(1, -1, 1, 1) * x),
+           (arr((2, 3, 4, 4), seed=88),
+            arr((3,), seed=89, low=0.1, high=0.5)), grad_wrt=(0, 1)),
+    OpSpec("thresholded_relu", F.thresholded_relu,
+           lambda x: np.where(x > 1.0, x, 0.0),
+           (arr((3, 4), seed=90, low=-2, high=2),)),
+    OpSpec("dropout.eval",
+           lambda x: F.dropout(x, 0.5, training=False),
+           lambda x: x, (_M64,)),
+    OpSpec("allclose", T.allclose, np.allclose,
+           (np.array([1.0, 2.0], np.float32),
+            np.array([1.0, 2.0 + 5e-9], np.float32)), grad=False),
+    OpSpec("scatter_nd_add",
+           lambda x: T.scatter_nd_add(
+               x, np.array([[1], [1], [0]]),
+               np.ones((3, 4), np.float32)),
+           lambda x: x + np.array([[1.0], [2.0], [0.0]]) *
+           np.ones((1, 4), np.float32),
+           (arr((3, 4), seed=91),)),
+    OpSpec("cholesky_solve",
+           lambda b: pt.linalg.cholesky_solve(
+               b, np.linalg.cholesky(_SPD)),
+           lambda b: np.linalg.solve(_SPD, b),
+           (arr((4, 2), seed=92),), rtol=1e-4, atol=1e-4),
+
+    # -- losses ----------------------------------------------------------
+    OpSpec("margin_ranking_loss",
+           lambda a, b: F.margin_ranking_loss(
+               a, b, np.ones((4,), np.float32), margin=0.1),
+           lambda a, b: np.maximum(0, -(a - b) + 0.1).mean(),
+           (arr((4,), seed=83), arr((4,), seed=84)), grad_wrt=(0, 1)),
+    OpSpec("dice_loss",
+           lambda p: F.dice_loss(p, np.array([[0], [1], [1]])),
+           lambda p: 1 - (2 * p[np.arange(3), [0, 1, 1]].sum()) /
+           (p.sum() + 3),
+           (np.asarray(sps.softmax(arr((3, 2), seed=85), -1)),),
+           rtol=1e-4, atol=1e-4),
+    OpSpec("softmax_with_cross_entropy",
+           lambda lg: F.softmax_with_cross_entropy(
+               lg, np.array([0, 2, 1]), reduction="none"),
+           lambda lg: -(lg - sps.logsumexp(lg, -1, keepdims=True))[
+               np.arange(3), [0, 2, 1]],
+           (arr((3, 4), seed=86),)),
+]
+
+
+def _np_log_softmax(x):
+    return x - sps.logsumexp(x, axis=-1, keepdims=True)
+
+
+_CTC_LOGITS = arr((5, 1, 4), seed=87)   # T,N,C
+
+SPECS.append(OpSpec(
+    "ctc_loss",
+    lambda lg: F.ctc_loss(lg, np.array([[1, 2]]), np.array([5]),
+                          np.array([2]), reduction="none"),
+    lambda lg: np.asarray(
+        [_np_ctc_loss(_np_log_softmax(lg[:, 0, :]), [1, 2])],
+        np.float32),
+    (_CTC_LOGITS,), rtol=1e-4, atol=1e-4))
+
+
+_IDS = []
+for s in SPECS:
+    n = s.name
+    while n in _IDS:
+        n += "'"
+    _IDS.append(n)
+
+
+@pytest.mark.parametrize("spec", SPECS, ids=_IDS)
+def test_op_extended(spec):
+    run_spec(spec)
+
+
+# bf16 forward sweep over the float-smooth subset (same dimension as
+# tests/test_optest.py's)
+_BF16_SKIP = {
+    "pinv", "qr.reconstruct", "svd.reconstruct", "eigh.reconstruct",
+    "cholesky", "det", "slogdet", "matrix_power", "solve",
+    "triangular_solve", "cov", "corrcoef", "renorm",  # decompositions /
+    # ill-conditioned at bf16 resolution
+    "ctc_loss", "dice_loss", "cholesky_solve",
+}
+_BF16_SPECS = [s for s in SPECS
+               if s.grad and s.ref is not None and s.jit
+               and s.name not in _BF16_SKIP]
+_BF16_IDS = []
+for s in _BF16_SPECS:
+    n = s.name + "-bf16"
+    while n in _BF16_IDS:
+        n += "'"
+    _BF16_IDS.append(n)
+
+
+@pytest.mark.parametrize("spec", _BF16_SPECS, ids=_BF16_IDS)
+def test_op_extended_bf16(spec):
+    from paddle_tpu.testing import check_forward_bf16
+    check_forward_bf16(spec)
