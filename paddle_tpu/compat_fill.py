@@ -55,8 +55,10 @@ def create_parameter(shape, dtype="float32", name=None, attr=None,
     if init is None and isinstance(attr, ParamAttr):
         init = attr.initializer
     if init is None:
-        init = I.get_global_initializer() or (
-            I.Constant(0.0) if is_bias else I.XavierUniform())
+        if is_bias:
+            init = I.get_global_bias_initializer() or I.Constant(0.0)
+        else:
+            init = I.get_global_initializer() or I.XavierUniform()
     return init(list(shape), jnp.dtype(dtype))
 
 

@@ -51,13 +51,17 @@ class Group:
 
 
 _groups: List[Group] = []
+_world_group: Optional[Group] = None
 
 
 def _world() -> Group:
-    if not _groups:
+    # a dedicated slot, NOT _groups[0]: a user calling new_group()
+    # before any world access would otherwise become the world group
+    global _world_group
+    if _world_group is None:
         n = max(jax.process_count(), 1)
-        _groups.append(Group(list(range(n)), gid=0))
-    return _groups[0]
+        _world_group = Group(list(range(n)), gid=0)
+    return _world_group
 
 
 def new_group(ranks: Optional[Sequence[int]] = None, backend=None,
@@ -70,6 +74,8 @@ def new_group(ranks: Optional[Sequence[int]] = None, backend=None,
 
 
 def get_group(gid: int = 0) -> Group:
+    if gid == 0:
+        return _world()
     for g in _groups:
         if g.gid == gid:
             return g

@@ -118,9 +118,14 @@ class QueueDataset:
                 yield [vals for _name, vals in
                        parse_multislot_line(line, self._slots)]
 
+    def _iter_records(self) -> Iterator[Sequence]:
+        """Record source for iteration — subclasses swap this (e.g. the
+        in-memory copy) without re-implementing batching."""
+        return self._records()
+
     def __iter__(self) -> Iterator[List[np.ndarray]]:
         batch: List[Sequence] = []
-        for rec in self._records():
+        for rec in self._iter_records():
             batch.append(rec)
             if len(batch) == self._batch_size:
                 yield self._collate(batch)
@@ -172,15 +177,7 @@ class InMemoryDataset(QueueDataset):
     def get_memory_data_size(self, fleet=None) -> int:
         return len(self._records_mem or [])
 
-    def __iter__(self):
+    def _iter_records(self):
         if self._records_mem is None:
-            yield from super().__iter__()
-            return
-        batch: List[Sequence] = []
-        for rec in self._records_mem:
-            batch.append(rec)
-            if len(batch) == self._batch_size:
-                yield self._collate(batch)
-                batch = []
-        if batch:
-            yield self._collate(batch)
+            return super()._iter_records()
+        return iter(self._records_mem)

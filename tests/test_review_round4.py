@@ -1,0 +1,75 @@
+"""Regression pins for the round-4 code-review findings: fft Hermitian
+composition direction, world-group identity, global bias initializer in
+create_parameter, and the distributed resume-step agreement guard."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def test_hfftn_ihfftn_match_torch_all_norms():
+    """hfftn composes FORWARD fftn over leading axes (ihfftn the
+    inverse); the frequency-reversed composition round-trips against
+    itself, so pin against torch's reference implementation."""
+    import torch
+    from paddle_tpu import fft
+    rng = np.random.RandomState(0)
+    x = (rng.randn(4, 6) + 1j * rng.randn(4, 6)).astype(np.complex64)
+    xr = rng.randn(4, 6).astype(np.float32)
+    for norm in ("backward", "forward", "ortho"):
+        np.testing.assert_allclose(
+            np.asarray(fft.hfftn(x, norm=norm)),
+            torch.fft.hfftn(torch.from_numpy(x), norm=norm).numpy(),
+            rtol=1e-4, atol=1e-4, err_msg=f"hfftn {norm}")
+        np.testing.assert_allclose(
+            np.asarray(fft.ihfftn(xr, norm=norm)),
+            torch.fft.ihfftn(torch.from_numpy(xr), norm=norm).numpy(),
+            rtol=1e-4, atol=1e-4, err_msg=f"ihfftn {norm}")
+        np.testing.assert_allclose(
+            np.asarray(fft.hfft2(x, norm=norm)),
+            torch.fft.hfft2(torch.from_numpy(x), norm=norm).numpy(),
+            rtol=1e-4, atol=1e-4, err_msg=f"hfft2 {norm}")
+
+
+def test_new_group_before_world_access():
+    """new_group() as the FIRST distributed call must not hijack the
+    world group."""
+    from paddle_tpu.distributed import comm
+    saved_groups, saved_world = comm._groups, comm._world_group
+    comm._groups, comm._world_group = [], None
+    try:
+        sub = comm.new_group([0])
+        world = comm.get_group(0)
+        assert world.gid == 0
+        assert world.nranks >= 1
+        assert sub.gid != 0
+        assert comm.get_group(sub.gid) is sub
+    finally:
+        comm._groups, comm._world_group = saved_groups, saved_world
+
+
+def test_create_parameter_global_bias_initializer():
+    from paddle_tpu import nn
+    from paddle_tpu.nn import initializer as I
+    nn.initializer.set_global_initializer(I.Constant(2.0),
+                                          I.Constant(0.5))
+    try:
+        w = pt.create_parameter([4], is_bias=False)
+        b = pt.create_parameter([4], is_bias=True)
+        np.testing.assert_allclose(np.asarray(w), 2.0)
+        np.testing.assert_allclose(np.asarray(b), 0.5)
+    finally:
+        nn.initializer.set_global_initializer(None)
+
+
+def test_agree_step_guard_fires_without_local_checkpoints(tmp_path):
+    """A rank with NO local checkpoints receiving agreed >= 0 must get
+    the diagnostic error (broken agree_fn), not an orbax missing-step
+    failure."""
+    from paddle_tpu import nn
+    from paddle_tpu.io.checkpoint import AutoCheckpoint
+    net = nn.Linear(2, 2)
+    acp = AutoCheckpoint(str(tmp_path / "ckpt"), net)
+    with pytest.raises(RuntimeError, match="global MIN"):
+        list(acp.epochs(3, agree_step=lambda local: 1))

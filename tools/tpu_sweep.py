@@ -1,0 +1,61 @@
+"""Extended TPU benchmark sweep (VERDICT r3 item 1a): run the headline
+configs the moment the chip is reachable and append one JSON line per
+config to PERF_SWEEP.jsonl — GPT-2-small batch 8/16/32 with and without
+the fused vocab path, a GPT-2-medium and (OOM-guarded) GPT-2-large
+point, ResNet-50 and BERT batch scaling. Each entry is the same
+compiled hapi train step bench.py times (framework end-to-end).
+
+Run: python tools/tpu_sweep.py [out.jsonl]   (single TPU client!)
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+
+
+def main(out_path="PERF_SWEEP.jsonl"):
+    import jax
+    dev = jax.devices()[0]
+    print(f"device: {dev.device_kind}", file=sys.stderr)
+
+    runs = []
+    for b in (8, 16, 32):
+        runs.append(("gpt2s_fused", lambda b=b: bench.bench_gpt(batch=b)))
+    for b in (8, 16, 32):
+        runs.append(("gpt2s_dense",
+                     lambda b=b: bench.bench_gpt(batch=b, fused=False)))
+    runs.append(("gpt2_medium", lambda: bench.bench_gpt(
+        batch=8, model_name="gpt2-medium")))
+    runs.append(("gpt2_medium", lambda: bench.bench_gpt(
+        batch=16, model_name="gpt2-medium")))
+    runs.append(("gpt2_large", lambda: bench.bench_gpt(
+        batch=4, model_name="gpt2-large")))
+    runs.append(("gpt2_large", lambda: bench.bench_gpt(
+        batch=8, model_name="gpt2-large")))
+    runs.append(("resnet50", lambda: bench.bench_resnet(batch=128)))
+    runs.append(("resnet50", lambda: bench.bench_resnet(batch=256)))
+    runs.append(("bert", lambda: bench.bench_bert(batch=64)))
+    runs.append(("bert", lambda: bench.bench_bert(batch=128)))
+
+    with open(out_path, "a") as f:
+        for tag, fn in runs:
+            t0 = time.time()
+            try:
+                rec = fn()
+                rec["tag"] = tag
+            except Exception as e:  # OOM on the big points is expected
+                rec = {"tag": tag, "error": str(e)[:200]}
+            rec["device"] = dev.device_kind
+            rec["wall_s"] = round(time.time() - t0, 1)
+            f.write(json.dumps(rec) + "\n")
+            f.flush()
+            print(json.dumps(rec), file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main(*sys.argv[1:])
