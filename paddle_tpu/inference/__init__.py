@@ -96,6 +96,28 @@ def _load_lib():
         lib.ptpred_out_nbytes.restype = ctypes.c_int64
         lib.ptpred_out_nbytes.argtypes = [ctypes.c_void_p, ctypes.c_int]
         lib.ptpred_destroy.argtypes = [ctypes.c_void_p]
+        # per-request result API (thread-safe concurrent serving)
+        lib.ptpred_run2.restype = ctypes.c_void_p
+        lib.ptpred_run2.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_size_t]
+        lib.ptres_num_outputs.restype = ctypes.c_int
+        lib.ptres_num_outputs.argtypes = [ctypes.c_void_p]
+        lib.ptres_ndim.restype = ctypes.c_int
+        lib.ptres_ndim.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.ptres_dim.restype = ctypes.c_int64
+        lib.ptres_dim.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                  ctypes.c_int]
+        lib.ptres_dtype.restype = ctypes.c_uint32
+        lib.ptres_dtype.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.ptres_data.restype = ctypes.c_void_p
+        lib.ptres_data.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.ptres_nbytes.restype = ctypes.c_int64
+        lib.ptres_nbytes.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.ptres_destroy.argtypes = [ctypes.c_void_p]
         _lib = lib
     return _lib
 
@@ -235,7 +257,16 @@ class Predictor:
     # -- array-style API ----------------------------------------------------
     def run(self, inputs: Optional[Sequence[np.ndarray]] = None
             ) -> List[np.ndarray]:
+        """Execute one request. Thread-safe when `inputs` is passed
+        explicitly: each call owns its result handle (ptpred_run2) and
+        ctypes releases the GIL for the duration of the native call, so
+        N server threads share one predictor (the reference requires a
+        predictor clone per thread — analysis_predictor.h:95; PJRT's
+        re-entrant execute removes that restriction here). The
+        handle-style API (get_input_handle / get_output_handle) stores
+        per-predictor state and stays single-threaded."""
         lib = self._lib
+        explicit_inputs = inputs
         if inputs is None:
             inputs = [self._inputs[n].copy_to_cpu()
                       for n in self._in_names]
@@ -271,29 +302,41 @@ class Predictor:
             dims_flat.extend(a.shape)
         dims = (ctypes.c_int64 * len(dims_flat))(*dims_flat)
         err = ctypes.create_string_buffer(4096)
-        rc = lib.ptpred_run(self._h, ptrs, dtypes, ndims, dims, n,
-                            err, len(err))
-        if rc != 0:
+        res = lib.ptpred_run2(self._h, ptrs, dtypes, ndims, dims, n,
+                              err, len(err))
+        if not res:
             raise RuntimeError(f"predictor run failed: "
                                f"{err.value.decode()}")
-        outs = []
-        for i in range(lib.ptpred_num_outputs(self._h)):
-            nd = lib.ptpred_out_ndim(self._h, i)
-            shape = tuple(lib.ptpred_out_dim(self._h, i, d)
-                          for d in range(nd))
-            code = lib.ptpred_out_dtype(self._h, i)
-            nbytes = lib.ptpred_out_nbytes(self._h, i)
-            buf = ctypes.string_at(lib.ptpred_out_data(self._h, i),
-                                   nbytes)
-            dtype = _DTYPE_BY_CODE[code]
-            if dtype == "bfloat16":
-                import ml_dtypes
-                arr = np.frombuffer(buf, ml_dtypes.bfloat16)
-            else:
-                arr = np.frombuffer(buf, np.dtype(dtype))
-            outs.append(arr.reshape(shape).copy())
-        for n_, a in zip(self._out_names, outs):
-            self._outputs[n_].copy_from_cpu(a)
+        try:
+            outs = []
+            for i in range(lib.ptres_num_outputs(res)):
+                nd = lib.ptres_ndim(res, i)
+                shape = tuple(lib.ptres_dim(res, i, d)
+                              for d in range(nd))
+                code = lib.ptres_dtype(res, i)
+                nbytes = lib.ptres_nbytes(res, i)
+                dtype = _DTYPE_BY_CODE[code]
+                if dtype == "bfloat16":
+                    import ml_dtypes
+                    np_dtype = np.dtype(ml_dtypes.bfloat16)
+                else:
+                    np_dtype = np.dtype(dtype)
+                if nbytes == 0:  # empty output: data() may be NULL
+                    outs.append(np.empty(shape, np_dtype))
+                    continue
+                # zero-copy view of the result buffer (owned by `res`,
+                # alive until ptres_destroy below), one copy out
+                ptr = ctypes.cast(lib.ptres_data(res, i),
+                                  ctypes.POINTER(ctypes.c_uint8))
+                raw = np.ctypeslib.as_array(ptr, shape=(nbytes,))
+                outs.append(raw.view(np_dtype).reshape(shape).copy())
+        finally:
+            lib.ptres_destroy(res)
+        if explicit_inputs is None:
+            # handle-style callers read these back; explicit-input
+            # (thread-safe) calls skip the shared store entirely
+            for n_, a in zip(self._out_names, outs):
+                self._outputs[n_].copy_from_cpu(a)
         return outs
 
     # -- handle-style API (reference parity) --------------------------------
@@ -319,3 +362,168 @@ class Predictor:
 def create_predictor(config: Config) -> Predictor:
     """ref: paddle.inference.create_predictor."""
     return Predictor(config)
+
+
+class DynamicBatcher:
+    """Micro-batching front-end over a predictor.
+
+    The reference scales serving by running one AnalysisPredictor clone
+    per server thread (reference:
+    paddle/fluid/inference/api/analysis_predictor.h:95 + capi_exp
+    thread pools) — each clone holds its own scopes. On TPU the
+    executable is compiled at a fixed batch B and the MXU wants full
+    tiles, so the throughput move is the opposite: ONE predictor, many
+    request threads, and a coalescer that packs up to B queued rows
+    into a single device call.
+
+    ``submit(inputs)`` (each input's leading dim = this request's row
+    count) returns a Future. A worker thread drains the queue: after
+    the first request arrives it waits at most ``max_delay_ms`` for
+    more, packs rows up to ``max_batch``, pads the tail by repeating
+    the final row (XLA shapes are static), runs once, and slices each
+    request's rows back out of the outputs. Requests that would
+    overflow the pack are held for the next cycle, preserving order.
+    """
+
+    def __init__(self, predictor, max_batch: Optional[int] = None,
+                 max_delay_ms: float = 2.0):
+        if max_batch is None:
+            exp = getattr(predictor, "_meta", {}).get("exported_inputs")
+            if exp and isinstance(exp[0]["shape"][0], int):
+                max_batch = exp[0]["shape"][0]
+            else:
+                raise ValueError(
+                    "max_batch not given and the artifact's leading "
+                    "input dim is not a static int")
+        self._pred = predictor
+        self.max_batch = int(max_batch)
+        self.max_delay = max_delay_ms / 1000.0
+        import queue
+        import threading
+        self._q: "queue.Queue" = queue.Queue()
+        self._held = None  # overflow request deferred to the next pack
+        self._closed = False
+        # makes the closed-check + put atomic against close(): no
+        # submit can enqueue after the STOP sentinel, so _drain is
+        # guaranteed to see every accepted request
+        self._mu = threading.Lock()
+        self._worker = threading.Thread(target=self._loop, daemon=True)
+        self._worker.start()
+        # served/coalesced stats for tests and monitoring
+        self.n_requests = 0
+        self.n_device_calls = 0
+
+    def submit(self, inputs: Sequence[np.ndarray]):
+        from concurrent.futures import Future
+        arrs = [np.ascontiguousarray(a) for a in inputs]
+        rows = arrs[0].shape[0]
+        if rows > self.max_batch:
+            raise ValueError(
+                f"request rows {rows} > max_batch {self.max_batch}")
+        if any(a.shape[0] != rows for a in arrs):
+            raise ValueError("all inputs must share the leading dim")
+        fut: Future = Future()
+        with self._mu:
+            if self._closed:
+                raise RuntimeError("batcher closed")
+            self._q.put((arrs, rows, fut))
+        return fut
+
+    def run(self, inputs: Sequence[np.ndarray]) -> List[np.ndarray]:
+        """Blocking convenience wrapper around submit()."""
+        return self.submit(inputs).result()
+
+    # -- worker -------------------------------------------------------------
+    def _take(self, timeout):
+        if self._held is not None:
+            item, self._held = self._held, None
+            return item
+        import queue
+        try:
+            return self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def _loop(self):
+        import time
+        while True:
+            first = self._take(timeout=0.1)
+            if first is None:
+                if self._closed:
+                    return self._drain()
+                continue
+            if first == "STOP":
+                return self._drain()
+            pack = [first]
+            used = first[1]
+            deadline = time.monotonic() + self.max_delay
+            while used < self.max_batch:
+                rest = deadline - time.monotonic()
+                nxt = self._take(timeout=max(rest, 0.0))
+                if nxt is None:
+                    break
+                if nxt == "STOP":
+                    self._flush(pack, used)
+                    return self._drain()
+                if used + nxt[1] > self.max_batch:
+                    self._held = nxt  # keep order; goes in the next pack
+                    break
+                pack.append(nxt)
+                used += nxt[1]
+            self._flush(pack, used)
+
+    def _drain(self):
+        """Fail anything still queued at shutdown — a submit() racing
+        close() must get an exception, never a forever-pending future."""
+        import queue
+        leftovers = [self._held] if self._held is not None else []
+        self._held = None
+        while True:
+            try:
+                leftovers.append(self._q.get_nowait())
+            except queue.Empty:
+                break
+        for item in leftovers:
+            if item != "STOP":
+                item[2].set_exception(RuntimeError("batcher closed"))
+
+    def _flush(self, pack, used):
+        try:
+            # batch-build inside the guard: a shape-mismatched request
+            # must fail its pack's futures, not kill the worker thread
+            n_in = len(pack[0][0])
+            batched = []
+            for j in range(n_in):
+                parts = [req[0][j] for req in pack]
+                cat = np.concatenate(parts, axis=0)
+                if used < self.max_batch:  # pad: repeat the last row
+                    padrow = cat[-1:]
+                    cat = np.concatenate(
+                        [cat] + [padrow] * (self.max_batch - used),
+                        axis=0)
+                batched.append(cat)
+            outs = self._pred.run(batched)
+        except BaseException as e:
+            for _, _, fut in pack:
+                fut.set_exception(e)
+            return
+        self.n_requests += len(pack)
+        self.n_device_calls += 1
+        ofs = 0
+        for arrs, rows, fut in pack:
+            # copy: a view would pin the whole max_batch output alive
+            # for as long as the caller holds its rows
+            fut.set_result([o[ofs:ofs + rows].copy() for o in outs])
+            ofs += rows
+
+    def close(self):
+        with self._mu:
+            self._closed = True
+            self._q.put("STOP")
+        self._worker.join(timeout=10)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -23,6 +23,20 @@
 //   ptpred_out_ndim/out_dim/out_dtype/out_data(handle, i)
 //   ptpred_destroy(handle)
 //
+// Concurrency (ref: the reference serves AnalysisPredictor behind
+// multi-threaded servers — analysis_predictor.h:95 requires one
+// predictor clone per thread; here one predictor serves all threads):
+// PJRT_LoadedExecutable_Execute is re-entrant and the predictor's
+// state (client, executable, resident param buffers) is read-only
+// after create, so concurrent requests need only per-request output
+// storage. The ptpred_run2 / ptres_* family returns an owned result
+// handle per call and is fully thread-safe; the legacy ptpred_run /
+// ptpred_out_* family stores results on the predictor and serializes
+// that store behind a mutex (reads remain caller-synchronized).
+//   ptpred_run2(handle, ins..., err, errlen) -> result handle | NULL
+//   ptres_num_outputs/ndim/dim/dtype/data/nbytes(result, ...)
+//   ptres_destroy(result)
+//
 // `options` parameterizes PJRT_Client_Create as "key=i:42;key=s:text".
 
 #include <dlfcn.h>
@@ -32,6 +46,7 @@
 #include <cstring>
 #include <fstream>
 #include <memory>
+#include <mutex>
 #include <sstream>
 #include <string>
 #include <vector>
@@ -177,6 +192,7 @@ struct Predictor {
   size_t num_state_args = 0;
   std::vector<PJRT_Buffer*> state_bufs;   // resident params+buffers
   std::vector<HostArray> outputs;         // last run's host results
+  std::mutex out_mu;                      // guards `outputs` stores
   size_t num_outputs = 0;
 
   ~Predictor() {
@@ -328,6 +344,84 @@ bool LoadPbin(const std::string& path, std::vector<HostArray>* out,
   return true;
 }
 
+// One request's host-side results; owned by the caller of ptpred_run2.
+struct RunResult {
+  std::vector<HostArray> outputs;
+};
+
+// Upload inputs, execute, download outputs into `result`. Touches only
+// read-only predictor state plus per-call locals — safe to call from
+// any number of threads at once.
+int RunImpl(Predictor* pred, const void** in_ptrs,
+            const uint32_t* in_dtypes, const uint32_t* in_ndims,
+            const int64_t* in_dims_flat, int n_inputs,
+            std::vector<HostArray>* result, ErrOut& err) {
+  const PJRT_Api* api = pred->api;
+
+  auto destroy_buf = [api](PJRT_Buffer* b) {
+    PJRT_Buffer_Destroy_Args d;
+    std::memset(&d, 0, sizeof(d));
+    d.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+    d.buffer = b;
+    api->PJRT_Buffer_Destroy(&d);
+  };
+
+  std::vector<PJRT_Buffer*> input_bufs;
+  size_t dim_ofs = 0;
+  for (int i = 0; i < n_inputs; ++i) {
+    PJRT_Buffer* b = pred->HostToDevice(
+        in_ptrs[i], DtypeCodeToPjrt(in_dtypes[i]), in_dims_flat + dim_ofs,
+        in_ndims[i], err);
+    if (!b) {  // a failed request must not leak the earlier uploads
+      for (auto* ib : input_bufs) destroy_buf(ib);
+      return 1;
+    }
+    dim_ofs += in_ndims[i];
+    input_bufs.push_back(b);
+  }
+
+  std::vector<PJRT_Buffer*> args(pred->state_bufs);
+  args.insert(args.end(), input_bufs.begin(), input_bufs.end());
+  PJRT_Buffer* const* arg_list = args.data();
+
+  std::vector<PJRT_Buffer*> outs(pred->num_outputs, nullptr);
+  PJRT_Buffer** out_list = outs.data();
+
+  PJRT_ExecuteOptions eo;
+  std::memset(&eo, 0, sizeof(eo));
+  eo.struct_size = PJRT_ExecuteOptions_STRUCT_SIZE;
+
+  PJRT_LoadedExecutable_Execute_Args ea;
+  std::memset(&ea, 0, sizeof(ea));
+  ea.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
+  ea.executable = pred->exec;
+  ea.options = &eo;
+  ea.argument_lists = &arg_list;
+  ea.num_devices = 1;
+  ea.num_args = args.size();
+  ea.output_lists = &out_list;
+  ea.execute_device = nullptr;  // single-device: compiled assignment
+  PJRT_Error* e = api->PJRT_LoadedExecutable_Execute(&ea);
+  for (auto* b : input_bufs) destroy_buf(b);
+  if (e) {
+    err.set(PjrtErrMessage(api, e));
+    return 1;
+  }
+
+  result->clear();
+  result->resize(pred->num_outputs);
+  bool failed = false;
+  for (size_t i = 0; i < pred->num_outputs; ++i) {
+    // keep destroying the remaining outputs even after a failure —
+    // a stream of failing requests must not exhaust device memory
+    if (!failed && !pred->DeviceToHost(outs[i], &(*result)[i], err)) {
+      failed = true;
+    }
+    destroy_buf(outs[i]);
+  }
+  return failed ? 1 : 0;
+}
+
 }  // namespace
 
 extern "C" {
@@ -446,66 +540,54 @@ int ptpred_run(void* h, const void** in_ptrs, const uint32_t* in_dtypes,
                int n_inputs, char* errbuf, size_t errlen) {
   ErrOut err{errbuf, errlen};
   auto* pred = static_cast<Predictor*>(h);
-  const PJRT_Api* api = pred->api;
-
-  std::vector<PJRT_Buffer*> input_bufs;
-  size_t dim_ofs = 0;
-  for (int i = 0; i < n_inputs; ++i) {
-    PJRT_Buffer* b = pred->HostToDevice(
-        in_ptrs[i], DtypeCodeToPjrt(in_dtypes[i]), in_dims_flat + dim_ofs,
-        in_ndims[i], err);
-    if (!b) return 1;
-    dim_ofs += in_ndims[i];
-    input_bufs.push_back(b);
-  }
-
-  std::vector<PJRT_Buffer*> args(pred->state_bufs);
-  args.insert(args.end(), input_bufs.begin(), input_bufs.end());
-  PJRT_Buffer* const* arg_list = args.data();
-
-  std::vector<PJRT_Buffer*> outs(pred->num_outputs, nullptr);
-  PJRT_Buffer** out_list = outs.data();
-
-  PJRT_ExecuteOptions eo;
-  std::memset(&eo, 0, sizeof(eo));
-  eo.struct_size = PJRT_ExecuteOptions_STRUCT_SIZE;
-
-  PJRT_LoadedExecutable_Execute_Args ea;
-  std::memset(&ea, 0, sizeof(ea));
-  ea.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
-  ea.executable = pred->exec;
-  ea.options = &eo;
-  ea.argument_lists = &arg_list;
-  ea.num_devices = 1;
-  ea.num_args = args.size();
-  ea.output_lists = &out_list;
-  ea.execute_device = nullptr;  // single-device: compiled assignment
-  PJRT_Error* e = api->PJRT_LoadedExecutable_Execute(&ea);
-  for (auto* b : input_bufs) {
-    PJRT_Buffer_Destroy_Args d;
-    std::memset(&d, 0, sizeof(d));
-    d.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
-    d.buffer = b;
-    api->PJRT_Buffer_Destroy(&d);
-  }
-  if (e) {
-    err.set(PjrtErrMessage(api, e));
-    return 1;
-  }
-
-  pred->outputs.clear();
-  pred->outputs.resize(pred->num_outputs);
-  for (size_t i = 0; i < pred->num_outputs; ++i) {
-    bool ok = pred->DeviceToHost(outs[i], &pred->outputs[i], err);
-    PJRT_Buffer_Destroy_Args d;
-    std::memset(&d, 0, sizeof(d));
-    d.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
-    d.buffer = outs[i];
-    api->PJRT_Buffer_Destroy(&d);
-    if (!ok) return 1;
-  }
+  std::vector<HostArray> result;
+  int rc = RunImpl(pred, in_ptrs, in_dtypes, in_ndims, in_dims_flat,
+                   n_inputs, &result, err);
+  if (rc != 0) return rc;
+  std::lock_guard<std::mutex> lock(pred->out_mu);
+  pred->outputs = std::move(result);
   return 0;
 }
+
+void* ptpred_run2(void* h, const void** in_ptrs,
+                  const uint32_t* in_dtypes, const uint32_t* in_ndims,
+                  const int64_t* in_dims_flat, int n_inputs,
+                  char* errbuf, size_t errlen) {
+  ErrOut err{errbuf, errlen};
+  auto* pred = static_cast<Predictor*>(h);
+  auto res = std::make_unique<RunResult>();
+  int rc = RunImpl(pred, in_ptrs, in_dtypes, in_ndims, in_dims_flat,
+                   n_inputs, &res->outputs, err);
+  if (rc != 0) return nullptr;
+  return res.release();
+}
+
+int ptres_num_outputs(void* r) {
+  return static_cast<int>(static_cast<RunResult*>(r)->outputs.size());
+}
+
+int ptres_ndim(void* r, int i) {
+  auto& o = static_cast<RunResult*>(r)->outputs.at(i);
+  return static_cast<int>(o.dims.size());
+}
+
+int64_t ptres_dim(void* r, int i, int d) {
+  return static_cast<RunResult*>(r)->outputs.at(i).dims.at(d);
+}
+
+uint32_t ptres_dtype(void* r, int i) {
+  return static_cast<RunResult*>(r)->outputs.at(i).dtype_code;
+}
+
+const void* ptres_data(void* r, int i) {
+  return static_cast<RunResult*>(r)->outputs.at(i).data.data();
+}
+
+int64_t ptres_nbytes(void* r, int i) {
+  return static_cast<RunResult*>(r)->outputs.at(i).data.size();
+}
+
+void ptres_destroy(void* r) { delete static_cast<RunResult*>(r); }
 
 int ptpred_out_ndim(void* h, int i) {
   auto& o = static_cast<Predictor*>(h)->outputs.at(i);

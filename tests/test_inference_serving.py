@@ -1,0 +1,236 @@
+"""Serving concurrency tests (VERDICT r3 missing #6 / ask #8).
+
+The reference serves AnalysisPredictor behind multi-threaded servers
+with one predictor clone per thread (ref:
+paddle/fluid/inference/api/analysis_predictor.h:95 + capi_exp thread
+pools). Here ONE predictor serves all threads (PJRT execute is
+re-entrant; per-request result handles remove the shared-output race),
+and a DynamicBatcher coalesces queued rows into full-batch device
+calls — the TPU-appropriate inversion of clone-per-thread.
+
+Batcher mechanics run against a stub predictor (no hardware); the true
+concurrent-run test follows test_inference_native's skip-on-busy
+pattern against the real plugin.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.inference import DynamicBatcher
+
+
+class StubPredictor:
+    """Deterministic stand-in: y = x * 2 rowwise, records call shapes."""
+
+    def __init__(self, delay=0.0):
+        self.calls = []
+        self.delay = delay
+        self.lock = threading.Lock()
+
+    def run(self, inputs):
+        with self.lock:
+            self.calls.append([a.shape for a in inputs])
+        if self.delay:
+            time.sleep(self.delay)
+        return [inputs[0] * 2.0]
+
+
+def test_batcher_coalesces_to_one_device_call():
+    pred = StubPredictor()
+    with DynamicBatcher(pred, max_batch=8, max_delay_ms=50) as b:
+        futs = [b.submit([np.full((1, 4), float(i), np.float32)])
+                for i in range(8)]
+        outs = [f.result(timeout=10) for f in futs]
+    for i, o in enumerate(outs):
+        np.testing.assert_allclose(o[0], np.full((1, 4), 2.0 * i))
+        assert o[0].shape == (1, 4)
+    # 8 single-row requests, batch capacity 8 -> ideally 1 call; the
+    # worker may cut an early pack before all requests enqueue, but
+    # coalescing must beat request-per-call
+    assert pred.calls and all(s[0] == (8, 4) for s in pred.calls)
+    assert b.n_device_calls < 8
+    assert b.n_requests == 8
+
+
+def test_batcher_pads_partial_batch():
+    pred = StubPredictor()
+    with DynamicBatcher(pred, max_batch=8, max_delay_ms=5) as b:
+        out = b.run([np.ones((3, 2), np.float32)])
+    assert out[0].shape == (3, 2)  # padding sliced back off
+    assert pred.calls[0][0] == (8, 2)  # device saw the full batch
+
+
+def test_batcher_multirow_and_overflow_holdover():
+    """5+5 rows into batch 8: second request must be deferred to a
+    second pack, order preserved, both correct."""
+    pred = StubPredictor(delay=0.01)
+    with DynamicBatcher(pred, max_batch=8, max_delay_ms=30) as b:
+        f1 = b.submit([np.full((5, 2), 1.0, np.float32)])
+        f2 = b.submit([np.full((5, 2), 3.0, np.float32)])
+        o1 = f1.result(timeout=10)[0]
+        o2 = f2.result(timeout=10)[0]
+    np.testing.assert_allclose(o1, np.full((5, 2), 2.0))
+    np.testing.assert_allclose(o2, np.full((5, 2), 6.0))
+    assert b.n_device_calls == 2
+
+
+def test_batcher_rejects_oversized_and_ragged():
+    pred = StubPredictor()
+    with DynamicBatcher(pred, max_batch=4, max_delay_ms=1) as b:
+        with pytest.raises(ValueError):
+            b.submit([np.ones((5, 2), np.float32)])
+        with pytest.raises(ValueError):
+            b.submit([np.ones((2, 2), np.float32),
+                      np.ones((3, 2), np.float32)])
+
+
+def test_batcher_propagates_run_errors():
+    class Boom:
+        def run(self, inputs):
+            raise RuntimeError("device gone")
+
+    with DynamicBatcher(Boom(), max_batch=4, max_delay_ms=1) as b:
+        fut = b.submit([np.ones((1, 2), np.float32)])
+        with pytest.raises(RuntimeError, match="device gone"):
+            fut.result(timeout=10)
+
+
+def test_batcher_survives_mismatched_trailing_shapes():
+    """A pack whose rows can't concatenate must fail ITS futures and
+    leave the worker alive for later requests."""
+    pred = StubPredictor(delay=0.01)
+    with DynamicBatcher(pred, max_batch=8, max_delay_ms=30) as b:
+        f1 = b.submit([np.ones((1, 4), np.float32)])
+        f2 = b.submit([np.ones((1, 6), np.float32)])  # ragged trailing
+        excs = 0
+        for f in (f1, f2):
+            try:
+                f.result(timeout=10)
+            except ValueError:
+                excs += 1
+        assert excs >= 1  # at least the pack that mixed shapes failed
+        out = b.run([np.ones((1, 4), np.float32)])  # worker still alive
+        np.testing.assert_allclose(out[0], np.full((1, 4), 2.0))
+
+
+def test_batcher_close_contract():
+    """close() completes accepted work, then rejects new submits —
+    FIFO ordering (submit's check+put and close's set+STOP share one
+    lock) means every accepted request is ahead of STOP and served."""
+    pred = StubPredictor(delay=0.01)
+    b = DynamicBatcher(pred, max_batch=4, max_delay_ms=1)
+    futs = [b.submit([np.full((1, 2), float(i), np.float32)])
+            for i in range(6)]
+    b.close()
+    assert not b._worker.is_alive()
+    for i, f in enumerate(futs):  # all accepted requests completed
+        np.testing.assert_allclose(f.result(timeout=5)[0],
+                                   np.full((1, 2), 2.0 * i))
+    with pytest.raises(RuntimeError, match="batcher closed"):
+        b.submit([np.ones((1, 2), np.float32)])
+
+
+def test_batcher_drain_fails_leftovers():
+    """_drain (the belt-and-braces shutdown sweep) must fail queued
+    and held items rather than leave futures forever-pending."""
+    from concurrent.futures import Future
+    pred = StubPredictor()
+    b = DynamicBatcher(pred, max_batch=4, max_delay_ms=1)
+    b.close()
+    f1, f2 = Future(), Future()
+    b._q.put(([np.ones((1, 2), np.float32)], 1, f1))
+    b._held = ([np.ones((1, 2), np.float32)], 1, f2)
+    b._drain()
+    for f in (f1, f2):
+        with pytest.raises(RuntimeError, match="batcher closed"):
+            f.result(timeout=5)
+    assert b._held is None
+
+
+def test_batcher_threaded_clients_all_served():
+    pred = StubPredictor(delay=0.002)
+    results = {}
+    with DynamicBatcher(pred, max_batch=4, max_delay_ms=10) as b:
+        def client(i):
+            out = b.run([np.full((1, 3), float(i), np.float32)])
+            results[i] = out[0]
+
+        ts = [threading.Thread(target=client, args=(i,))
+              for i in range(16)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+    assert len(results) == 16
+    for i, o in results.items():
+        np.testing.assert_allclose(o, np.full((1, 3), 2.0 * i))
+    assert b.n_device_calls < 16  # coalescing actually happened
+
+
+# ---- real-plugin concurrency (skip-on-busy, like test_inference_native)
+
+
+def _plugin_available() -> bool:
+    try:
+        from paddle_tpu import inference
+        inference.default_plugin()
+        return True
+    except Exception:
+        return False
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not _plugin_available(),
+                    reason="no PJRT plugin .so on this machine")
+def test_concurrent_predictor_run_matches_serial(tmp_path):
+    import paddle_tpu as pt
+    from paddle_tpu import jit
+
+    class MLP(pt.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.l1 = pt.nn.Linear(16, 64)
+            self.l2 = pt.nn.Linear(64, 8)
+
+        def forward(self, x):
+            return self.l2(pt.nn.functional.relu(self.l1(x)))
+
+    pt.seed(0)
+    net = MLP()
+    net.eval()
+    rng = np.random.RandomState(0)
+    xs = [rng.randn(4, 16).astype(np.float32) for _ in range(12)]
+    refs = [np.asarray(net(x)) for x in xs]
+    path = str(tmp_path / "artifact")
+    jit.save(net, path, input_spec=[jit.InputSpec([4, 16], "float32")])
+
+    from paddle_tpu import inference
+    os.environ.setdefault("PT_PJRT_CREATE_TIMEOUT", "90")
+    try:
+        pred = inference.create_predictor(inference.Config(path))
+    except TimeoutError as e:
+        pytest.skip(f"device unavailable for native predictor: {e}")
+
+    outs = [None] * len(xs)
+    errs = []
+
+    def worker(tid):
+        try:
+            for i in range(tid, len(xs), 4):
+                outs[i] = pred.run([xs[i]])[0]
+        except BaseException as e:  # surfaced below
+            errs.append(e)
+
+    ts = [threading.Thread(target=worker, args=(t,)) for t in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=120)
+    assert not errs, errs
+    for o, r in zip(outs, refs):
+        assert o is not None
+        np.testing.assert_allclose(o, r, atol=5e-2, rtol=2e-2)
