@@ -323,14 +323,21 @@ class ElasticManager:
             if status is ElasticStatus.COMPLETED:
                 return 0
             self.generation += 1
-            if code == RESTART_EXIT_CODE:
+            # -SIGTERM: the platform's preemption signal killed the rank
+            # before PreemptionGuard installed (interpreter start, jax
+            # import) — no checkpoint from THIS incarnation, but the
+            # last committed one restores losslessly, and the kill was
+            # the scheduler's doing, not the trainer's: budget-free
+            if code == RESTART_EXIT_CODE or code == -signal.SIGTERM:
                 preemptions += 1
                 if preemptions > max_preemptions:
                     # NOT 67: exiting 67 here would tell any outer
                     # supervisor "restart me for free", defeating the
                     # runaway backstop the moment it fires
                     return 1
-                print(f"[elastic] preempted rank checkpointed; restart "
+                kind = ("checkpointed" if code == RESTART_EXIT_CODE
+                        else "killed pre-guard")
+                print(f"[elastic] preempted rank {kind}; restart "
                       f"{preemptions} (budget-free)", file=sys.stderr)
             else:
                 self.restarts += 1

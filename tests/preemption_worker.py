@@ -32,6 +32,9 @@ def main(workdir: str, total_steps: int):
                   loss=nn.CrossEntropyLoss())
 
     guard = elastic.PreemptionGuard()
+    # sentinel for the race-the-compile test: from here on a SIGTERM is
+    # flag-only; the first train_batch (trace+compile) happens after
+    open(os.path.join(workdir, "guard_installed"), "w").write("1")
     acp = AutoCheckpoint.for_model(os.path.join(workdir, "ckpt"), model)
     loss_path = os.path.join(workdir, "losses.txt")
     for step in acp.epochs(total_steps):   # step-granular range

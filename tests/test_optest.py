@@ -493,6 +493,24 @@ def test_op(spec):
     run_spec(spec)
 
 
+# smoke-tier representative slice: one op per structural family in
+# THIS file's table (MXU matmul, elementwise, reduction, norm, shape,
+# gather, scan — convs live in test_optest_extended's own smoke pick),
+# so `ci.sh --smoke` still numerically checks the op layer
+_SMOKE_NAMES = ("matmul", "add", "softmax", "mean", "layer_norm",
+                "reshape", "gather", "cumsum")
+_SMOKE_SPECS = [s for s in SPECS if s.name in _SMOKE_NAMES]
+assert len(_SMOKE_SPECS) >= len(_SMOKE_NAMES), \
+    "smoke slice silently lost an op"
+
+
+@pytest.mark.smoke
+@pytest.mark.parametrize("spec", _SMOKE_SPECS,
+                         ids=[s.name for s in _SMOKE_SPECS])
+def test_op_smoke(spec):
+    run_spec(spec)
+
+
 # bf16 sweep over the differentiable numeric ops: same table, inputs
 # quantized through bfloat16, loose tolerances (the reference's
 # per-dtype OpTest dimension)

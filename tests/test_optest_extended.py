@@ -703,6 +703,20 @@ for s in SPECS:
     _IDS.append(n)
 
 
+# smoke-tier representative slice for the conv/pool/vision families
+# this file owns (see test_optest.py's slice for the core families)
+_SMOKE_NAMES = ("conv2d", "max_pool2d", "grid_sample")
+_SMOKE_SPECS = [s for s in SPECS if s.name in _SMOKE_NAMES]
+assert len(_SMOKE_SPECS) >= 3, "smoke slice silently lost an op"
+
+
+@pytest.mark.smoke
+@pytest.mark.parametrize("spec", _SMOKE_SPECS,
+                         ids=[s.name for s in _SMOKE_SPECS])
+def test_op_extended_smoke(spec):
+    run_spec(spec)
+
+
 @pytest.mark.parametrize("spec", SPECS, ids=_IDS)
 def test_op_extended(spec):
     run_spec(spec)

@@ -37,6 +37,7 @@ def _x(b=8, d=16, seed=0):
                        jnp.float32)
 
 
+@pytest.mark.smoke  # smoke-tier representative (file is all-slow)
 def test_pipeline_layer_groups_stages():
     pipe = PipelineLayer([LayerDesc(Block, 16) for _ in range(8)],
                          num_stages=4)
@@ -46,6 +47,7 @@ def test_pipeline_layer_groups_stages():
                       num_stages=4)
 
 
+@pytest.mark.smoke  # smoke-tier representative (file is all-slow)
 @pytest.mark.parametrize("pp,m", [(2, 4), (4, 8), (4, 6)])
 def test_pipeline_forward_matches_dense(pp, m):
     pt.seed(0)

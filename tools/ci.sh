@@ -6,12 +6,13 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-# --smoke: fast tier only (skips @pytest.mark.slow — compile-bound model
-# zoo sweeps, multi-process tests); full suite remains the merge gate.
+# --smoke: fast tier only — skips @pytest.mark.slow except tests ALSO
+# marked @pytest.mark.smoke (representative picks inside all-slow files,
+# so pipeline/optest keep smoke coverage); full suite remains the merge gate.
 PYTEST_ARGS=()
 TIER=""
 if [[ "${1:-}" == "--smoke" ]]; then
-  PYTEST_ARGS=(-m "not slow")
+  PYTEST_ARGS=(-m "not slow or smoke")
   TIER=" [smoke]"
 fi
 
