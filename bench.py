@@ -109,7 +109,8 @@ def _mfu(model_flops_per_sec) -> float | None:
 
 def bench_gpt(batch: int = 8, seq: int = 1024, warmup: int = 3,
               iters: int = 20, cpu_smoke: bool = False,
-              model_name: str = "gpt2-small", fused: bool = True):
+              model_name: str = "gpt2-small", fused: bool = True,
+              scan_layers: bool = False, remat: bool = False):
     import paddle_tpu as paddle
     from paddle_tpu.models.gpt import (GPTForCausalLM,
                                        GPTFusedPretrainingCriterion,
@@ -130,7 +131,8 @@ def bench_gpt(batch: int = 8, seq: int = 1024, warmup: int = 3,
     else:
         cfg = gpt_config(model_name, max_position_embeddings=seq,
                          hidden_dropout=0.0, attention_dropout=0.0,
-                         fused_loss=fused)
+                         fused_loss=fused, scan_layers=scan_layers,
+                         remat=remat)
     net = GPTForCausalLM(cfg)
     model = paddle.Model(net)
     model.prepare(
@@ -151,7 +153,8 @@ def bench_gpt(batch: int = 8, seq: int = 1024, warmup: int = 3,
     return {"metric": "gpt2s_train_tokens_per_sec",
             "value": round(tps, 1), "unit": "tokens/sec",
             "batch": batch, "seq": seq, "params": n_params,
-            "model": model_name, "fused": fused,
+            "model": model_name, "fused": cfg.fused_loss,
+            "scan": cfg.scan_layers, "remat": cfg.remat,
             "mfu": _mfu(tps * flops_per_token)}
 
 

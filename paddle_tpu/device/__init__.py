@@ -5,7 +5,36 @@ is_compiled_with_*, cuda streams/events under device/cuda/). On TPU the
 runtime owns streams — XLA schedules compute/transfer overlap itself —
 so Stream/Event become synchronization-scope facades over
 block_until_ready, kept for API familiarity rather than scheduling
-control (SURVEY.md §2.4: no comm streams, no c_sync_* ordering ops)."""
+control (SURVEY.md §2.4: no comm streams, no c_sync_* ordering ops).
+
+DECISION RECORD — the reference's L2 platform-runtime surface and
+where each piece lands here (SURVEY.md §1 L2):
+
+- ``Place`` / ``DeviceContextPool`` (platform/place.h,
+  device_context.h:277): a Place is ``jax.Device``; the context pool
+  is the PJRT client, one per backend, owned by jax. No pool facade —
+  every jax.Array carries its device, so context lookup by place has
+  nothing left to do.
+- Streams/events (``CUDADeviceContext`` streams, ``c_sync_*`` ops,
+  stream-safe allocator): XLA:TPU executes one program at a time with
+  compiler-scheduled async copies; PJRT exposes completion futures,
+  not streams. The Stream/Event classes below are scope facades; the
+  ordering the reference gets from stream analysis the compiler gets
+  from data dependence. Rejected: surfacing PJRT execute futures as
+  user streams — nothing the XLA scheduler doesn't already do.
+- Dynamic loader (platform/dynload/dynamic_loader.cc): vendor-lib
+  dlopen lives exactly once, in the serving predictor's plugin loader
+  (native/predictor.cc dlopen + ``inference.default_plugin()``
+  discovery order: PT_PJRT_PLUGIN env, tunneled plugin, libtpu).
+- Device-plugin interface (phi/backends/device_manager.h:116
+  ``DeviceManager`` / custom_device.cc:38 ``CustomDevice``): the PJRT
+  C API *is* the plugin ABI — any vendor .so exporting GetPjrtApi is
+  a backend, loadable in-process by jax (jax_plugins entry point) or
+  by the native predictor (set_pjrt_plugin). We deliberately add no
+  second registration layer on top.
+- ``InitDevices`` / global flags / enforce: jax initializes lazily;
+  flags live in paddle_tpu.flags (typed, env-overridable); error
+  contracts are Python exceptions (utils/enforce analog)."""
 
 from __future__ import annotations
 

@@ -106,6 +106,22 @@ class Model:
         if self._opt_state is None and self._optimizer is not None:
             self._opt_state = self._optimizer.init_state(self._params)
 
+    def sync_weights(self):
+        """Rebind the latest device state onto the network's attributes.
+
+        The compiled train step donates its inputs, so after
+        ``train_batch`` the arrays previously bound to the network are
+        deleted; touching the network directly (``net(x)``,
+        ``net.generate(...)``, ``net.state_dict()``) then raises
+        "Array has been deleted". ``fit``/``save``/checkpointing sync
+        automatically; manual ``train_batch`` loops call this before
+        using the network object. Cost is reference rebinding only —
+        the arrays stay on device. (ref: the reference's dygraph Model
+        shares parameter objects with the network, so this hazard
+        doesn't exist there; donation is the TPU-side trade for
+        in-place optimizer updates.)"""
+        self._sync_state_out()
+
     def _sync_state_out(self):
         """Write device state back into the network (on save/exit)."""
         if self._params is not None:

@@ -52,6 +52,16 @@ class GPTConfig:
     tie_word_embeddings: bool = True
     use_flash: bool = True
     remat: bool = False  # rematerialize each block (jax.checkpoint)
+    # lax.scan over the (identical-structure) decoder blocks instead of
+    # a Python loop: the block lowers ONCE (compile time ~O(1) in depth
+    # — the lever that makes 24-48-layer configs compile fast), and
+    # with remat=True the recompute is structural (scan carries are the
+    # only saved activations; XLA cannot CSE recomputation across scan
+    # iterations, so the memory win survives every backend's pipeline).
+    # Per-layer params are stacked to [L, ...] leaves at trace time —
+    # one extra params-sized HBM copy per step, paid for depth>=12 by
+    # the compile/memory wins. Decode caches fall back to the loop.
+    scan_layers: bool = False
     # fused vocab path: forward returns (hidden, tied weight) and
     # GPTFusedPretrainingCriterion streams the loss over vocab chunks —
     # the [b, s, vocab] logits never exist in the train graph (PERF.md)
@@ -300,22 +310,76 @@ class GPTModel(Layer):
         x = with_logical_constraint(x, ("batch", "seq", None))
         rope_pos = position_ids if self.cfg.use_rope else None
         new_caches = [] if caches is not None else None
-        for i, layer in enumerate(self.layers):
-            if caches is not None:
-                x, c = layer(x, attn_mask=attn_mask, cache=caches[i],
-                             position_ids=rope_pos)
-                new_caches.append(c)
-            elif self.cfg.remat:
-                # trade FLOPs for HBM: recompute the block in backward
-                x = jax.checkpoint(
-                    lambda x, l=layer: l(x, attn_mask=attn_mask,
-                                         position_ids=rope_pos))(x)
-            else:
-                x = layer(x, attn_mask=attn_mask, position_ids=rope_pos)
-            x = with_logical_constraint(x, ("batch", "seq", None))
+        if self.cfg.scan_layers and caches is None:
+            x = self._scan_trunk(x, attn_mask, rope_pos)
+        else:
+            for i, layer in enumerate(self.layers):
+                if caches is not None:
+                    x, c = layer(x, attn_mask=attn_mask, cache=caches[i],
+                                 position_ids=rope_pos)
+                    new_caches.append(c)
+                elif self.cfg.remat:
+                    # trade FLOPs for HBM: recompute the block in backward
+                    x = jax.checkpoint(
+                        lambda x, l=layer: l(x, attn_mask=attn_mask,
+                                             position_ids=rope_pos))(x)
+                else:
+                    x = layer(x, attn_mask=attn_mask,
+                              position_ids=rope_pos)
+                x = with_logical_constraint(x, ("batch", "seq", None))
         x = self.ln_f(x)
         if caches is not None:
             return x, new_caches
+        return x
+
+    def _scan_trunk(self, x, attn_mask, rope_pos):
+        """lax.scan over the decoder stack (cfg.scan_layers).
+
+        All blocks share one structure, so block 0 serves as the
+        functional template: each layer's params (the live — possibly
+        traced — values the outer functional_call swapped in) are
+        stacked to [L, ...] leaves and the scan body applies the
+        template to its slice. Dropout keys fold the layer index into
+        the ambient stream so iterations draw distinct randomness even
+        though the body traces once. With cfg.remat the body is
+        checkpointed: saved state is exactly the scan carries (the
+        per-block boundary activations) — remat the compiler cannot
+        undo, on any backend. ref: the reference's depth loop is
+        run-to-completion eager (incubate/nn/functional teaches fused
+        blocks instead); scan-over-depth is the XLA-native form."""
+        from ..core import rng as rng_mod
+        from ..nn.layer import functional_call, split_state
+        from ..parallel.sharding import with_logical_constraint
+
+        per_layer = []
+        for layer in self.layers:
+            p, b = split_state(layer)
+            if b:  # stateful blocks can't share one traced template
+                raise NotImplementedError(
+                    "scan_layers requires buffer-free decoder blocks; "
+                    f"found buffers {list(b)}")
+            per_layer.append(p)
+        keys = list(per_layer[0])
+        assert all(list(p) == keys for p in per_layer[1:]), \
+            "scan_layers requires structurally identical blocks"
+        stacked = {k: jnp.stack([p[k] for p in per_layer])
+                   for k in keys}
+        base_key = rng_mod.current_stream().next_key("scan_trunk")
+        template = self.layers[0]
+
+        def body(carry, sl):
+            params_i, idx = sl
+            with rng_mod.key_guard(jax.random.fold_in(base_key, idx)):
+                out, _ = functional_call(
+                    template, params_i, {}, carry, attn_mask=attn_mask,
+                    position_ids=rope_pos)
+            return with_logical_constraint(
+                out, ("batch", "seq", None)), None
+
+        if self.cfg.remat:
+            body = jax.checkpoint(body)
+        idxs = jnp.arange(len(per_layer))
+        x, _ = jax.lax.scan(body, x, (stacked, idxs))
         return x
 
 
@@ -431,6 +495,12 @@ class GPTForCausalLMPipe(Layer):
         from ..parallel import get_mesh
         from ..parallel.pipeline import PipelineLayer, PipelineParallel
         self.cfg = cfg
+        if cfg.scan_layers:
+            import warnings
+            warnings.warn(
+                "GPTForCausalLMPipe ignores cfg.scan_layers: the "
+                "pipeline's tick scan + checkpointed tick body already "
+                "provide the structural depth loop and remat")
         mesh = mesh or get_mesh(required=False)
         pp = mesh.axis_size("pp") if mesh is not None else 1
         num_stages = pp * virtual_pp_degree

@@ -1,0 +1,136 @@
+"""scan_layers: lax.scan over the decoder stack (GPTConfig.scan_layers).
+
+The TPU-native depth loop — the block lowers once (compile O(1) in
+depth) and, with remat, the scan carries are the ONLY saved
+activations: recompute happens inside the backward scan body, where no
+backend pass can CSE it against the forward (XLA:CPU strips
+jax.checkpoint's optimization barriers from the unrolled trunk and
+merges the recompute away — discovered measuring the r4 1.3B
+feasibility study; the scan form is what makes remat memory provable
+on every backend). ref: the reference's trunk is an eager Python loop
+(incubate fused blocks are its depth lever instead).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as pt
+from paddle_tpu.models.gpt import (GPTConfig, GPTForCausalLM,
+                                   GPTFusedPretrainingCriterion,
+                                   GPTPretrainingCriterion, gpt_config)
+
+pytestmark = pytest.mark.slow  # compile-bound; smoke runs the pick below
+
+_TINY = dict(vocab_size=128, hidden_size=32, num_layers=4, num_heads=2,
+             max_position_embeddings=16, hidden_dropout=0.0,
+             attention_dropout=0.0, use_flash=False)
+
+
+def _ids(b=2, s=16):
+    return np.random.RandomState(0).randint(0, 128, (b, s))
+
+
+@pytest.mark.smoke
+def test_scan_forward_matches_loop():
+    pt.seed(0)
+    loop = GPTForCausalLM(GPTConfig(**_TINY))
+    pt.seed(0)
+    scan = GPTForCausalLM(GPTConfig(**_TINY, scan_layers=True))
+    ids = _ids()
+    np.testing.assert_allclose(np.asarray(loop(ids)),
+                               np.asarray(scan(ids)),
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("remat", [False, True])
+def test_scan_training_matches_loop(remat):
+    ids = _ids()
+    losses = {}
+    for scan in (False, True):
+        pt.seed(0)
+        net = GPTForCausalLM(GPTConfig(**_TINY, scan_layers=scan,
+                                       remat=remat))
+        m = pt.Model(net)
+        m.prepare(optimizer=pt.optimizer.AdamW(learning_rate=1e-3,
+                                               parameters=net),
+                  loss=GPTPretrainingCriterion())
+        losses[scan] = [float(m.train_batch([ids], [ids])["loss"])
+                        for _ in range(3)]
+    np.testing.assert_allclose(losses[False], losses[True],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_scan_with_dropout_trains_and_varies():
+    """Dropout inside the scan body folds the layer index into the key:
+    training must be finite and actually stochastic across steps."""
+    cfg = dict(_TINY)
+    cfg["hidden_dropout"] = 0.3
+    pt.seed(0)
+    net = GPTForCausalLM(GPTConfig(**cfg, scan_layers=True))
+    m = pt.Model(net)
+    m.prepare(optimizer=pt.optimizer.AdamW(learning_rate=0.0,
+                                           parameters=net),
+              loss=GPTPretrainingCriterion())
+    ids = _ids()
+    # lr=0: same params every step, so loss variation isolates dropout
+    ls = [float(m.train_batch([ids], [ids])["loss"]) for _ in range(4)]
+    assert all(np.isfinite(ls))
+    assert len({round(v, 8) for v in ls}) > 1, ls
+
+
+def test_scan_decode_cache_falls_back_to_loop():
+    """caches present -> the loop path serves (scan has no cache lane):
+    greedy generation from a scan model matches the loop model's."""
+    ids = _ids(1, 8)
+    outs = []
+    for scan in (False, True):
+        pt.seed(0)
+        net = GPTForCausalLM(GPTConfig(**_TINY, scan_layers=scan))
+        net.eval()
+        outs.append(np.asarray(net.generate(ids, max_new_tokens=5)))
+    np.testing.assert_array_equal(outs[0], outs[1])
+
+
+def test_scan_remat_memory_is_structural():
+    """The load-bearing property: on the 8-device fsdp mesh the
+    scan+remat train step's compiled temps undercut the unrolled
+    remat trunk by >=3x (the unrolled form's checkpoint barriers are
+    stripped by the CPU pipeline; the scan form survives it)."""
+    from paddle_tpu import parallel
+    from paddle_tpu.core import rng as rng_mod
+
+    def temps(scan):
+        # deep enough that per-layer activations dominate the fixed
+        # embedding/loss/optimizer buffers (at 4 layers the ratio
+        # dilutes to ~2.7x; the effect scales with depth)
+        cfg = gpt_config("gpt2-small", hidden_size=256, num_heads=4,
+                         hidden_dropout=0.0, attention_dropout=0.0,
+                         use_flash=False, remat=True, fused_loss=True,
+                         num_layers=12, scan_layers=scan)
+        mesh = parallel.init_mesh(fsdp=8)
+        try:
+            pt.seed(0)
+            net = GPTForCausalLM(cfg)
+            m = pt.Model(net)
+            m.prepare(optimizer=pt.optimizer.AdamW(
+                learning_rate=1e-4, parameters=net),
+                loss=GPTFusedPretrainingCriterion())
+            parallel.distributed_model(m, mesh=mesh)
+            m._sync_state_in()
+            m._train_step_fn = m._build_train_step()
+            ids = np.zeros((32, 512), np.int32)
+            inputs = m._shard_batch((ids,))
+            labels = m._shard_batch((ids,))
+            key = rng_mod.split_for_step(0)
+            mem = m._train_step_fn.lower(
+                m._params, m._frozen, m._opt_state, m._buffers, 0,
+                key, inputs, labels).compile().memory_analysis()
+            return float(mem.temp_size_in_bytes)
+        finally:
+            parallel.set_mesh(None)
+
+    unrolled = temps(False)
+    scanned = temps(True)
+    assert scanned * 3 <= unrolled, (scanned, unrolled)

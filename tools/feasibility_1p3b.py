@@ -1,0 +1,180 @@
+"""BASELINE config-4 feasibility study, compile-only (VERDICT r3 ask #7).
+
+AOT-compiles the REAL GPT-3-1.3B training step (seq 2048, remat'd
+trunk, fused vocab loss, AdamW) on virtual CPU meshes for candidate
+dp/fsdp/tp/pp layouts and tables XLA's compiled per-device memory
+analysis against the v5e HBM budget (16 GiB x 0.85 headroom). This is
+the measured counterpart of parallel/planner.py's analytic search —
+the same closed loop verify_plan runs per-model, here swept across the
+layout space at the baseline's flagship scale (ref: BASELINE config 4
+"GPT-3 1.3B Fleet hybrid TP+PP+DP";
+/root/reference/python/paddle/distributed/auto_parallel/planner_v2.py
+searches dist-attrs analytically and never compiles candidates).
+
+Each layout runs in a fresh subprocess so the virtual device count can
+differ (jax_num_cpu_devices is a pre-first-use config). Compiling 1.3B
+on one CPU core takes minutes per layout — run in background:
+
+    python tools/feasibility_1p3b.py [--out FEASIBILITY_1P3B.json]
+    python tools/feasibility_1p3b.py --child '{"devices":8,...}'
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+_GiB = float(1 << 30)
+V5E_BUDGET = 16 * _GiB * 0.85
+
+# (devices, axes, global_batch, microbatches-for-pp)
+# global batch keeps 8 sequences per data-parallel shard, the
+# batch-sweep's best-throughput point at seq 2048 scale
+LAYOUTS = [
+    # v5e-8
+    (8, {"fsdp": 8}, 64, 0),
+    (8, {"fsdp": 4, "tp": 2}, 64, 0),
+    (8, {"dp": 2, "fsdp": 4}, 64, 0),
+    (8, {"tp": 8}, 8, 0),            # pure-TP: one data shard
+    (8, {"dp": 8}, 64, 0),           # expected OOM: full state per chip
+    (8, {"pp": 2, "tp": 2, "dp": 2}, 16, 2),   # config-4 hybrid shape
+    # v5e-16
+    (16, {"fsdp": 8, "tp": 2}, 128, 0),
+    (16, {"pp": 2, "fsdp": 4, "dp": 2}, 32, 2),
+    # v5e-64
+    (64, {"dp": 4, "fsdp": 8, "tp": 2}, 512, 0),
+]
+
+
+def run_child(spec: dict) -> dict:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", int(spec["devices"]))
+
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu import parallel
+    from paddle_tpu.core import rng as rng_mod
+    from paddle_tpu.models.gpt import (GPTForCausalLM, GPTForCausalLMPipe,
+                                       GPTFusedPretrainingCriterion,
+                                       gpt_config)
+
+    axes = dict(spec["axes"])
+    gb = int(spec["global_batch"])
+    micro = int(spec.get("microbatches", 0))
+    seq = 2048
+
+    # scan_layers: structural remat — REQUIRED for honest CPU-compiled
+    # memory numbers (the CPU pipeline strips jax.checkpoint's
+    # optimization barriers and CSEs the recompute away, so the
+    # unrolled-remat trunk measures as if remat were off: the r4 first
+    # pass read 188 GiB/device for fsdp=8 that way); scan carries are
+    # real buffers no pass can elide, on any backend
+    # pp rows: the pipe trunk scans over schedule ticks and
+    # checkpoints the tick body — already structural remat; its own
+    # depth loop ignores scan_layers (the Pipe model warns on it)
+    cfg = gpt_config("gpt3-1.3b", hidden_dropout=0.0,
+                     attention_dropout=0.0, use_flash=False,
+                     remat=True, fused_loss=True,
+                     scan_layers=not micro)
+    mesh = parallel.init_mesh(**axes)
+    try:
+        pt.seed(0)
+        t0 = time.time()
+        if micro:
+            net = GPTForCausalLMPipe(cfg, num_microbatches=micro,
+                                     mesh=mesh)
+        else:
+            net = GPTForCausalLM(cfg)
+        model = pt.Model(net)
+        model.prepare(optimizer=pt.optimizer.AdamW(
+            learning_rate=1e-4, parameters=net, weight_decay=0.01),
+            loss=GPTFusedPretrainingCriterion())
+        parallel.distributed_model(model, mesh=mesh)
+        model._sync_state_in()
+        build_s = time.time() - t0
+
+        model._train_step_fn = model._build_train_step()
+        ids = np.zeros((gb, seq), np.int32)
+        inputs = model._shard_batch((ids,))
+        labels = model._shard_batch((ids,))
+        key = rng_mod.split_for_step(0)
+        t0 = time.time()
+        lowered = model._train_step_fn.lower(
+            model._params, model._frozen, model._opt_state,
+            model._buffers, 0, key, inputs, labels)
+        mem = lowered.compile().memory_analysis()
+        compile_s = time.time() - t0
+
+        # planner prediction for the same layout (pp is outside the
+        # planner's search space by design — planner.py module doc)
+        predicted = None
+        if not micro:
+            from paddle_tpu.parallel import planner
+            plan = planner.evaluate(net, axes, global_batch=gb,
+                                    seq_len=seq)
+            predicted = plan.hbm_bytes
+
+        total = float(mem.temp_size_in_bytes + mem.argument_size_in_bytes)
+        return {
+            "devices": spec["devices"], "axes": axes,
+            "global_batch": gb, "seq_len": seq,
+            "microbatches": micro or None,
+            "argument_bytes": float(mem.argument_size_in_bytes),
+            "temp_bytes": float(mem.temp_size_in_bytes),
+            "output_bytes": float(mem.output_size_in_bytes),
+            "total_bytes": total,
+            "total_gib": total / _GiB,
+            "fits_v5e": total <= V5E_BUDGET,
+            "planner_predicted_bytes": predicted,
+            "planner_ratio": (total / predicted) if predicted else None,
+            "build_s": round(build_s, 1),
+            "compile_s": round(compile_s, 1),
+        }
+    finally:
+        parallel.set_mesh(None)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="FEASIBILITY_1P3B.json")
+    ap.add_argument("--child", default=None)
+    ap.add_argument("--timeout", type=int, default=3600)
+    args = ap.parse_args()
+
+    if args.child:
+        print(json.dumps(run_child(json.loads(args.child))))
+        return
+
+    rows = []
+    for devices, axes, gb, micro in LAYOUTS:
+        spec = {"devices": devices, "axes": axes, "global_batch": gb,
+                "microbatches": micro}
+        print(f"[feasibility] {spec}", file=sys.stderr, flush=True)
+        from _subproc import run_spec
+        rec = run_spec(__file__, "--child", spec, timeout=args.timeout)
+        if "error" in rec:
+            rec = {**spec, "error": rec["error"]}
+        rows.append(rec)
+        with open(args.out, "w") as f:  # checkpoint after every layout
+            json.dump({"budget_gib": V5E_BUDGET / _GiB, "rows": rows},
+                      f, indent=1)
+        last = rows[-1]
+        if "error" in last:
+            print(f"  ERROR: {last['error'][:200]}", file=sys.stderr)
+        else:
+            print(f"  {last['total_gib']:.2f} GiB/device "
+                  f"(fits={last['fits_v5e']}, compile "
+                  f"{last['compile_s']}s)", file=sys.stderr, flush=True)
+    print(json.dumps({"rows": len(rows), "out": args.out}))
+
+
+if __name__ == "__main__":
+    main()

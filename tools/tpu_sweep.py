@@ -5,7 +5,16 @@ the fused vocab path, a GPT-2-medium and (OOM-guarded) GPT-2-large
 point, ResNet-50 and BERT batch scaling. Each entry is the same
 compiled hapi train step bench.py times (framework end-to-end).
 
-Run: python tools/tpu_sweep.py [out.jsonl]   (single TPU client!)
+Each config runs in a FRESH subprocess: one long-lived client
+accumulates device buffers across configs (a prior model's donated
+state is not reliably freed before the next model uploads), which
+turned the r4 first pass's ResNet/BERT points into instant
+RESOURCE_EXHAUSTED. Fresh-process isolation costs ~9s of tunnel init
+per config and makes every point independent; it also retries
+transient remote-compile 500s once.
+
+Run: python tools/tpu_sweep.py [out.jsonl]        (the whole sweep)
+     python tools/tpu_sweep.py --one '{"kind":"gpt","batch":8,...}'
 """
 
 import json
@@ -15,42 +24,64 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import bench  # noqa: E402
+RUNS = [
+    {"tag": "gpt2s_fused", "kind": "gpt", "batch": 8},
+    {"tag": "gpt2s_fused", "kind": "gpt", "batch": 16},
+    {"tag": "gpt2s_fused", "kind": "gpt", "batch": 32},
+    {"tag": "gpt2s_dense", "kind": "gpt", "batch": 8, "fused": False},
+    {"tag": "gpt2s_dense", "kind": "gpt", "batch": 16, "fused": False},
+    {"tag": "gpt2s_dense", "kind": "gpt", "batch": 32, "fused": False},
+    {"tag": "gpt2_medium", "kind": "gpt", "batch": 8,
+     "model_name": "gpt2-medium"},
+    {"tag": "gpt2_medium", "kind": "gpt", "batch": 16,
+     "model_name": "gpt2-medium"},
+    {"tag": "gpt2_large", "kind": "gpt", "batch": 4,
+     "model_name": "gpt2-large"},
+    {"tag": "gpt2_large", "kind": "gpt", "batch": 8,
+     "model_name": "gpt2-large"},
+    {"tag": "resnet50", "kind": "resnet", "batch": 128},
+    {"tag": "resnet50", "kind": "resnet", "batch": 256},
+    {"tag": "bert", "kind": "bert", "batch": 64},
+    {"tag": "bert", "kind": "bert", "batch": 128},
+]
 
 
-def main(out_path="PERF_SWEEP.jsonl"):
+def run_one(spec: dict) -> dict:
     import jax
+    import bench
     dev = jax.devices()[0]
-    print(f"device: {dev.device_kind}", file=sys.stderr)
+    kind = spec["kind"]
+    kw = {k: v for k, v in spec.items() if k not in ("tag", "kind")}
+    if kind == "gpt":
+        rec = bench.bench_gpt(**kw)
+    elif kind == "resnet":
+        rec = bench.bench_resnet(**kw)
+    elif kind == "bert":
+        rec = bench.bench_bert(**kw)
+    else:
+        raise ValueError(kind)
+    rec["tag"] = spec["tag"]
+    rec["device"] = dev.device_kind
+    return rec
 
-    runs = []
-    for b in (8, 16, 32):
-        runs.append(("gpt2s_fused", lambda b=b: bench.bench_gpt(batch=b)))
-    for b in (8, 16, 32):
-        runs.append(("gpt2s_dense",
-                     lambda b=b: bench.bench_gpt(batch=b, fused=False)))
-    runs.append(("gpt2_medium", lambda: bench.bench_gpt(
-        batch=8, model_name="gpt2-medium")))
-    runs.append(("gpt2_medium", lambda: bench.bench_gpt(
-        batch=16, model_name="gpt2-medium")))
-    runs.append(("gpt2_large", lambda: bench.bench_gpt(
-        batch=4, model_name="gpt2-large")))
-    runs.append(("gpt2_large", lambda: bench.bench_gpt(
-        batch=8, model_name="gpt2-large")))
-    runs.append(("resnet50", lambda: bench.bench_resnet(batch=128)))
-    runs.append(("resnet50", lambda: bench.bench_resnet(batch=256)))
-    runs.append(("bert", lambda: bench.bench_bert(batch=64)))
-    runs.append(("bert", lambda: bench.bench_bert(batch=128)))
 
+def _transient(err: str) -> bool:
+    # retry only the tunnel's compile-helper 500s; a real OOM or crash
+    # must not hammer the chip (match the specific status token, not a
+    # bare "500" that could appear in byte counts or line numbers)
+    return "remote_compile" in err and "HTTP 500" in err
+
+
+def main(out_path="PERF_SWEEP.jsonl", only=None):
+    from _subproc import run_spec
     with open(out_path, "a") as f:
-        for tag, fn in runs:
+        for spec in RUNS:
+            if only and spec["tag"] not in only:
+                continue
             t0 = time.time()
-            try:
-                rec = fn()
-                rec["tag"] = tag
-            except Exception as e:  # OOM on the big points is expected
-                rec = {"tag": tag, "error": str(e)[:200]}
-            rec["device"] = dev.device_kind
+            rec = run_spec(__file__, "--one", spec, timeout=1800,
+                           retries=1, retry_if=_transient)
+            rec.setdefault("tag", spec["tag"])
             rec["wall_s"] = round(time.time() - t0, 1)
             f.write(json.dumps(rec) + "\n")
             f.flush()
@@ -58,4 +89,9 @@ def main(out_path="PERF_SWEEP.jsonl"):
 
 
 if __name__ == "__main__":
-    main(*sys.argv[1:])
+    if len(sys.argv) > 2 and sys.argv[1] == "--one":
+        print(json.dumps(run_one(json.loads(sys.argv[2]))))
+    elif len(sys.argv) > 2:
+        main(sys.argv[1], only=set(sys.argv[2].split(",")))
+    else:
+        main(*sys.argv[1:])
