@@ -70,6 +70,12 @@ def run_child(spec: dict) -> dict:
     gb = int(spec["global_batch"])
     micro = int(spec.get("microbatches", 0))
     seq = 2048
+    # refinement knobs (base sweep: f32 activations, dense attention —
+    # the conservatively-compilable proxy; the real TPU config runs
+    # bf16 AMP + flash, which the refined variants measure):
+    use_flash = bool(spec.get("use_flash", False))
+    amp = spec.get("amp")  # e.g. "O1"
+    remat = bool(spec.get("remat", True))
 
     # scan_layers: structural remat — REQUIRED for honest CPU-compiled
     # memory numbers (the CPU pipeline strips jax.checkpoint's
@@ -81,8 +87,8 @@ def run_child(spec: dict) -> dict:
     # checkpoints the tick body — already structural remat; its own
     # depth loop ignores scan_layers (the Pipe model warns on it)
     cfg = gpt_config("gpt3-1.3b", hidden_dropout=0.0,
-                     attention_dropout=0.0, use_flash=False,
-                     remat=True, fused_loss=True,
+                     attention_dropout=0.0, use_flash=use_flash,
+                     remat=remat, fused_loss=True,
                      scan_layers=not micro)
     mesh = parallel.init_mesh(**axes)
     try:
@@ -96,7 +102,8 @@ def run_child(spec: dict) -> dict:
         model = pt.Model(net)
         model.prepare(optimizer=pt.optimizer.AdamW(
             learning_rate=1e-4, parameters=net, weight_decay=0.01),
-            loss=GPTFusedPretrainingCriterion())
+            loss=GPTFusedPretrainingCriterion(),
+            **({"amp_configs": amp} if amp else {}))
         parallel.distributed_model(model, mesh=mesh)
         model._sync_state_in()
         build_s = time.time() - t0
@@ -127,6 +134,7 @@ def run_child(spec: dict) -> dict:
             "devices": spec["devices"], "axes": axes,
             "global_batch": gb, "seq_len": seq,
             "microbatches": micro or None,
+            "use_flash": use_flash, "amp": amp, "remat": remat,
             "argument_bytes": float(mem.argument_size_in_bytes),
             "temp_bytes": float(mem.temp_size_in_bytes),
             "output_bytes": float(mem.output_size_in_bytes),
