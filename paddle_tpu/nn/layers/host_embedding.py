@@ -62,6 +62,7 @@ cluster.
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Iterator, Mapping, Optional
 
@@ -74,6 +75,38 @@ from ..layer import Layer
 _SM1 = np.uint64(0xBF58476D1CE4E5B9)
 _SM2 = np.uint64(0x94D049BB133111EB)
 _GAMMA = np.uint64(0x9E3779B97F4A7C15)
+
+_SPILL_SEQ = 0  # per-process instance counter for spill file names
+
+
+def _reap_dead_spill_files(spill_dir: str) -> None:
+    """Unlink spill files left by DEAD processes (a crashed run's
+    100s-of-GB pool would otherwise leak and accumulate across
+    restarts). Only files matching our naming scheme with a
+    non-living pid are touched — live processes sharing the dir keep
+    their pools."""
+    import re
+    pat = re.compile(r"\.p(\d+)\.i\d+\.gen\d+\.f32$")
+    try:
+        names = os.listdir(spill_dir)
+    except OSError:
+        return
+    for n in names:
+        m = pat.search(n)
+        if not m:
+            continue
+        pid = int(m.group(1))
+        if pid == os.getpid():
+            continue
+        try:
+            os.kill(pid, 0)  # raises if no such process
+        except ProcessLookupError:
+            try:
+                os.unlink(os.path.join(spill_dir, n))
+            except OSError:
+                pass
+        except OSError:
+            pass  # pid exists but not ours (EPERM): leave it
 
 
 def _splitmix64(x: np.ndarray) -> np.ndarray:
@@ -185,7 +218,8 @@ class HostOffloadedEmbedding(Layer):
                  hash_ids: bool = False, optimizer: str = "adagrad",
                  learning_rate: float = 0.05, init_scale: float = 1e-3,
                  initial_accumulator: float = 0.1, seed: int = 0,
-                 async_push: bool = False, max_pending_push: int = 2):
+                 async_push: bool = False, max_pending_push: int = 2,
+                 spill_dir: Optional[str] = None):
         """``async_push=True`` turns the push into the reference's
         async-communicator mode (communicator.h:234 queued push_sparse):
         the backward's io_callback ENQUEUES the (ids, grads) block and
@@ -208,6 +242,24 @@ class HostOffloadedEmbedding(Layer):
         self.init_scale = init_scale
         self.initial_accumulator = initial_accumulator
         self.seed = seed
+        # Disk-spill tier (ref: the reference's SSD sparse table,
+        # distributed/ps/table/ssd_sparse_table.h — rocksdb cold rows
+        # under a memory cache): with ``spill_dir`` the value/
+        # accumulator pools are np.memmap files, so table capacity is
+        # bounded by DISK, and the OS page cache is the hot tier (true
+        # LRU, sized by actual memory pressure — no hand-rolled
+        # promotion policy to mis-tune). RAM mode is unchanged when
+        # spill_dir is None.
+        self.spill_dir = spill_dir
+        self._spill_gen = 0
+        # per-instance file prefix: two tables (or two processes)
+        # sharing a spill_dir must not truncate each other's pools
+        global _SPILL_SEQ
+        _SPILL_SEQ += 1
+        self._spill_tag = f"p{os.getpid()}.i{_SPILL_SEQ}"
+        if spill_dir is not None:
+            os.makedirs(spill_dir, exist_ok=True)
+            _reap_dead_spill_files(spill_dir)
         # array-pool host storage: only touched rows exist (lazy init);
         # a sorted id→slot index maps sparse ids to pool rows
         self._reset_pool(capacity=64)
@@ -228,9 +280,36 @@ class HostOffloadedEmbedding(Layer):
             [1], initializer=I.Constant(0.0))
 
     # -- pool plumbing ------------------------------------------------------
+    def _alloc_rows(self, name: str, shape, zero: bool = False):
+        """Row-pool allocation: RAM ndarray, or a memmap file under
+        spill_dir (generation-numbered — memmaps can't resize, so each
+        growth writes a fresh file and unlinks the old)."""
+        if getattr(self, "spill_dir", None) is None:
+            return (np.zeros if zero else np.empty)(shape, np.float32)
+        path = os.path.join(
+            self.spill_dir,
+            f"{name}.{self._spill_tag}.gen{self._spill_gen}.f32")
+        m = np.memmap(path, np.float32, mode="w+", shape=shape)
+        if zero:
+            m[:] = 0.0
+        return m
+
+    def _drop_spill_file(self, arr) -> None:
+        # unlink while the old mapping may still be referenced: POSIX
+        # keeps the mapping valid until the last reference drops (the
+        # pool swap right after this call releases ours)
+        if isinstance(arr, np.memmap):
+            try:
+                os.unlink(arr.filename)
+            except OSError:
+                pass
+
     def _reset_pool(self, capacity: int = 64) -> None:
         d = self.embedding_dim
         self._n = 0
+        self._spill_gen = getattr(self, "_spill_gen", 0) + 1
+        for name in ("_pool_vals", "_pool_acc"):
+            self._drop_spill_file(getattr(self, name, None))
         # id→slot map: a SORTED (ids, slots) index for vectorized
         # searchsorted batch lookup + a small dict tail of rows created
         # since the last merge (merged geometrically — amortized O(1))
@@ -238,7 +317,7 @@ class HostOffloadedEmbedding(Layer):
         self._sidx_slots = np.empty((0,), np.int64)
         self._tail: dict[int, int] = {}
         self._pool_ids = np.empty((capacity,), np.int64)
-        self._pool_vals = np.empty((capacity, d), np.float32)
+        self._pool_vals = self._alloc_rows("pool_vals", (capacity, d))
         self._pool_acc: Optional[np.ndarray] = None  # lazy: first push
         self._acc_set = np.zeros((capacity,), bool)
         # accumulators whose id has no value row yet (the legacy dict
@@ -254,20 +333,27 @@ class HostOffloadedEmbedding(Layer):
         if need <= cap:
             return
         new = max(need, cap * 2)
+        self._spill_gen += 1
         for name in ("_pool_ids", "_pool_vals", "_pool_acc", "_acc_set"):
             old = getattr(self, name)
             if old is None:
                 continue
-            buf = np.zeros((new,) + old.shape[1:], old.dtype) \
-                if old.dtype == bool else np.empty(
-                    (new,) + old.shape[1:], old.dtype)
+            if name in ("_pool_vals", "_pool_acc"):
+                buf = self._alloc_rows(name.lstrip("_"),
+                                       (new,) + old.shape[1:])
+            elif old.dtype == bool:
+                buf = np.zeros((new,) + old.shape[1:], old.dtype)
+            else:
+                buf = np.empty((new,) + old.shape[1:], old.dtype)
             buf[:self._n] = old[:self._n]
             setattr(self, name, buf)
+            if name in ("_pool_vals", "_pool_acc"):
+                self._drop_spill_file(old)
 
     def _ensure_acc_pool(self) -> np.ndarray:
         if self._pool_acc is None:
-            self._pool_acc = np.empty(
-                (len(self._pool_ids), self.embedding_dim), np.float32)
+            self._pool_acc = self._alloc_rows(
+                "pool_acc", (len(self._pool_ids), self.embedding_dim))
         return self._pool_acc
 
     def _index_lookup(self, uniq: np.ndarray) -> np.ndarray:

@@ -89,7 +89,8 @@ class ShardedHostEmbedding(Layer):
                  initial_accumulator: float = 0.1, seed: int = 0,
                  axis: str = "dp",
                  host_budget_rows: Optional[int] = None,
-                 async_push: bool = False, max_pending_push: int = 2):
+                 async_push: bool = False, max_pending_push: int = 2,
+                 spill_dir: Optional[str] = None):
         super().__init__()
         self.axis = axis
         self.host_budget_rows = host_budget_rows
@@ -110,7 +111,8 @@ class ShardedHostEmbedding(Layer):
             optimizer=optimizer, learning_rate=learning_rate,
             init_scale=init_scale,
             initial_accumulator=initial_accumulator, seed=seed,
-            async_push=async_push, max_pending_push=max_pending_push)
+            async_push=async_push, max_pending_push=max_pending_push,
+            spill_dir=spill_dir)
         # own push-anchor so the custom_vjp backward is not pruned
         # (same trick as HostOffloadedEmbedding.__init__)
         from .. import initializer as I

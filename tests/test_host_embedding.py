@@ -233,3 +233,95 @@ def test_geo_merge_averages_held_rows(tmp_path):
     np.testing.assert_allclose(a._accum[1], [0.5, 0.5])  # max-merge
     with pytest.raises(ValueError, match="mismatch"):
         HostOffloadedEmbedding(99, 2).geo_merge(snap)
+
+
+def test_spill_dir_parity_and_files(tmp_path):
+    """Disk-spill tier (ref: ssd_sparse_table.h): with spill_dir the
+    value/accumulator pools are memmap files — identical numerics to
+    the RAM pool (init is deterministic in (seed, id)), capacity bound
+    by disk, files regenerated across pool growth."""
+    import os
+    from paddle_tpu.nn import HostOffloadedEmbedding
+
+    ids = np.asarray([[3, 9, 500_001, 0], [77, 77, 12, 0]])
+    grads = np.random.RandomState(0).randn(2, 4, 8).astype(np.float32)
+
+    def run(spill):
+        emb = HostOffloadedEmbedding(
+            1_000_000, 8, optimizer="adagrad", learning_rate=0.1,
+            seed=7, spill_dir=str(tmp_path / "spill") if spill else None)
+        outs = []
+        for _ in range(3):
+            out = np.asarray(emb._pull(ids.reshape(-1)))
+            emb._apply_push(ids.reshape(-1),
+                            grads.reshape(-1, 8))
+            outs.append(out)
+        return emb, np.stack(outs)
+
+    emb_ram, ram = run(False)
+    emb_spill, spill = run(True)
+    np.testing.assert_allclose(ram, spill, atol=0, rtol=0)
+    assert isinstance(emb_spill._pool_vals, np.memmap)
+    assert isinstance(emb_spill._pool_acc, np.memmap)
+    assert not isinstance(emb_ram._pool_vals, np.memmap)
+    files = os.listdir(tmp_path / "spill")
+    assert any("pool_vals" in f for f in files), files
+    # growth rewrote generations; stale files unlinked (one live file
+    # per pool array)
+    assert sum("pool_vals" in f for f in files) == 1, files
+    assert sum("pool_acc" in f for f in files) == 1, files
+
+
+def test_spill_dir_shared_by_two_tables(tmp_path):
+    """Two tables over one spill_dir must not truncate or unlink each
+    other's pools (per-instance file tags)."""
+    from paddle_tpu.nn import HostOffloadedEmbedding
+
+    d = str(tmp_path / "shared")
+    a = HostOffloadedEmbedding(10_000, 4, seed=1, spill_dir=d)
+    b = HostOffloadedEmbedding(10_000, 4, seed=2, spill_dir=d)
+    ids = np.arange(1, 300)  # forces pool growth in both
+    va1 = np.asarray(a._pull(ids))
+    vb1 = np.asarray(b._pull(ids))
+    vb2 = np.asarray(b._pull(ids))   # b again after a allocated
+    va2 = np.asarray(a._pull(ids))
+    np.testing.assert_array_equal(va1, va2)
+    np.testing.assert_array_equal(vb1, vb2)
+    assert not np.allclose(va1, vb1)  # different seeds, distinct pools
+
+
+def test_spill_reaps_dead_process_files(tmp_path):
+    """Files left by a crashed (dead-pid) run are reaped on init;
+    live-pid files survive."""
+    import os
+    from paddle_tpu.nn import HostOffloadedEmbedding
+
+    d = tmp_path / "reap"
+    d.mkdir()
+    dead = d / "pool_vals.p999999.i1.gen3.f32"   # no such pid
+    live = d / f"pool_vals.p{os.getpid()}.i0.gen1.f32"
+    other = d / "unrelated.bin"
+    for f in (dead, live, other):
+        f.write_bytes(b"x" * 16)
+    HostOffloadedEmbedding(100, 4, spill_dir=str(d))
+    assert not dead.exists()
+    assert live.exists() and other.exists()
+
+
+def test_spill_snapshot_restore_roundtrip(tmp_path):
+    from paddle_tpu.nn import HostOffloadedEmbedding
+
+    emb = HostOffloadedEmbedding(10_000, 4, optimizer="sgd",
+                                 learning_rate=0.1, seed=3,
+                                 spill_dir=str(tmp_path / "s"))
+    ids = np.asarray([5, 17, 999, 5])
+    emb._apply_push(ids, np.ones((4, 4), np.float32))
+    before = np.asarray(emb._pull(ids))
+    emb.snapshot(str(tmp_path / "snap.npz"))
+
+    emb2 = HostOffloadedEmbedding(10_000, 4, optimizer="sgd",
+                                  learning_rate=0.1, seed=3,
+                                  spill_dir=str(tmp_path / "s2"))
+    emb2.restore(str(tmp_path / "snap.npz"))
+    np.testing.assert_allclose(np.asarray(emb2._pull(ids)), before)
+    assert isinstance(emb2._pool_vals, np.memmap)
