@@ -329,22 +329,90 @@ class PipelineParallel(Layer):
                                  "identical; SPMD pipelining requires it")
         order = _round_robin_order(pp, virtual_pp_degree)
         self._keys = keys
+        self._per = pipe.layers_per_stage
+        # Homogeneous chunks (every in-chunk layer structurally equal —
+        # the transformer case) additionally stack the LAYER dim:
+        # [S, per, ...] leaves, so the stage applies its layers with an
+        # inner lax.scan whose checkpointed body gives STRUCTURAL
+        # remat — the chunk-level jax.checkpoint alone is an
+        # optimization barrier some backend pipelines (XLA:CPU) strip
+        # and CSE away, which made pp memory measure as no-remat
+        # (r4 feasibility study). Scan carries are real buffers
+        # everywhere, and the block lowers once per stage.
+        self._layer_suffixes = self._detect_homogeneous(chunks[0], keys,
+                                                        metas)
+        chunk_params = [dict(c.named_parameters()) for c in chunks]
+        if self._layer_suffixes:
+            for suffix in self._layer_suffixes:
+                stacked = jnp.stack([
+                    jnp.stack([chunk_params[i][f"{j}.{suffix}"]
+                               for j in range(self._per)])
+                    for i in order])
+                meta = metas[f"0.{suffix}"]
+                axes = meta.axes
+                if axes is None:
+                    axes = (None,) * (stacked.ndim - 2)
+                self.add_parameter(
+                    suffix.replace(".", "__"),
+                    Parameter(stacked, trainable=meta.trainable,
+                              axes=("pp_stage", None, *axes)))
+        else:
+            for key in keys:
+                stacked = jnp.stack(
+                    [chunk_params[i][key] for i in order])
+                axes = metas[key].axes
+                if axes is None:
+                    axes = (None,) * (stacked.ndim - 1)
+                self.add_parameter(
+                    key.replace(".", "__"),
+                    Parameter(stacked, trainable=metas[key].trainable,
+                              axes=("pp_stage", *axes)))
+
+    def _detect_homogeneous(self, chunk, keys, metas):
+        """Suffix list when every layer in the chunk is structurally
+        identical (same param suffixes, shapes, AND meta — trainable
+        flag + logical axes — per layer index); None otherwise
+        (heterogeneous chunks keep the flat per-key layout, which
+        preserves per-layer meta like partially-frozen stages)."""
+        import re
+        per = self._per
+        if per <= 1:
+            return None
+        by_idx: dict = {}
         for key in keys:
-            stacked = jnp.stack(
-                [dict(chunks[i].named_parameters())[key] for i in order])
-            axes = metas[key].axes
-            if axes is None:
-                axes = (None,) * (stacked.ndim - 1)
-            self.add_parameter(
-                key.replace(".", "__"),
-                Parameter(stacked, trainable=metas[key].trainable,
-                          axes=("pp_stage", *axes)))
+            m = re.match(r"^(\d+)\.(.+)$", key)
+            if not m:
+                return None
+            by_idx.setdefault(int(m.group(1)), set()).add(m.group(2))
+        if sorted(by_idx) != list(range(per)):
+            return None
+        suffixes = by_idx[0]
+        if any(s != suffixes for s in by_idx.values()):
+            return None
+        params = dict(chunk.named_parameters())
+        for sfx in suffixes:
+            shapes = {tuple(params[f"{j}.{sfx}"].shape)
+                      for j in range(per)}
+            if len(shapes) != 1:
+                return None
+            meta0 = metas[f"0.{sfx}"]
+            for j in range(1, per):
+                mj = metas[f"{j}.{sfx}"]
+                if (mj.trainable != meta0.trainable
+                        or mj.axes != meta0.axes):
+                    return None  # e.g. a frozen layer inside the stage
+        return sorted(suffixes)
 
     def _stacked(self):
+        if self._layer_suffixes:
+            return {s: self._parameters[s.replace(".", "__")]
+                    for s in self._layer_suffixes}
         return {k: self._parameters[k.replace(".", "__")]
                 for k in self._keys}
 
     def _chunk_params(self, stacked, pos: int):
+        if self._layer_suffixes:
+            return {s: stacked[s][pos] for s in self._layer_suffixes}
         return {k: stacked[k][pos] for k in self._keys}
 
     def forward(self, x):
@@ -356,9 +424,16 @@ class PipelineParallel(Layer):
             pp = self.num_stages // v
             for k in range(self.num_stages):
                 pos = (k % pp) * v + (k // pp)
-                x, _ = functional_call(
-                    self._proto, self._chunk_params(stacked, pos), {}, x,
-                    training=self.training)
+                p = self._chunk_params(stacked, pos)
+                if self._layer_suffixes:
+                    for j in range(self._per):
+                        x, _ = functional_call(
+                            self._proto[0],
+                            {s: p[s][j] for s in self._layer_suffixes},
+                            {}, x, training=self.training)
+                else:
+                    x, _ = functional_call(self._proto, p, {}, x,
+                                           training=self.training)
             return x
         pp = mesh.axis_size("pp")
         if pp * v != self.num_stages:
@@ -368,10 +443,36 @@ class PipelineParallel(Layer):
 
         # _proto is not a registered sublayer, so train()/eval() on this
         # wrapper never reach it — propagate the mode explicitly per call
-        def stage_fn(params_local, mb):
-            out, _ = functional_call(self._proto, params_local, {}, mb,
-                                     training=self.training)
-            return out
+        if self._layer_suffixes:
+            template = self._proto[0]
+            per = self._per
+            suffixes = self._layer_suffixes
+            from ..core import rng as _rng
+
+            def stage_fn(params_local, mb):
+                # params_local: {suffix: [per, ...]} — inner scan over
+                # the chunk's layers; checkpointed body = structural
+                # remat (residuals are the per-layer boundaries only)
+                base = _rng.next_key("stage_layers")
+
+                def body(carry, sl):
+                    p, idx = sl
+                    with _rng.key_guard(jax.random.fold_in(base, idx)):
+                        out, _ = functional_call(
+                            template, p, {}, carry,
+                            training=self.training)
+                    return out, None
+
+                wrapped = jax.checkpoint(body) if self._remat else body
+                out, _ = lax.scan(wrapped, mb,
+                                  ({s: params_local[s] for s in suffixes},
+                                   jnp.arange(per)))
+                return out
+        else:
+            def stage_fn(params_local, mb):
+                out, _ = functional_call(self._proto, params_local, {},
+                                         mb, training=self.training)
+                return out
 
         return pipeline_spmd(stage_fn, stacked, x,
                              self.num_microbatches, mesh,

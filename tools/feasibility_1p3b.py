@@ -99,6 +99,15 @@ def run_child(spec: dict) -> dict:
                                      mesh=mesh)
         else:
             net = GPTForCausalLM(cfg)
+        if amp == "O2":
+            # O2 = bf16 parameter storage (amp.decorate): activations
+            # inherit bf16 through the trunk, so the stored boundary
+            # buffers halve — a dtype effect the CPU compile measures
+            # honestly (unlike O1 compute-casting, which leaves
+            # storage f32, or interpret-mode flash, which is not
+            # representative)
+            from paddle_tpu import amp as amp_mod
+            net = amp_mod.decorate(net, level="O2")
         model = pt.Model(net)
         model.prepare(optimizer=pt.optimizer.AdamW(
             learning_rate=1e-4, parameters=net, weight_decay=0.01),
