@@ -17,6 +17,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 
 from .. import nn
@@ -41,6 +42,10 @@ class BertConfig:
     layer_norm_epsilon: float = 1e-12
     pad_token_id: int = 0
     use_flash: bool = True
+    remat: bool = False        # rematerialize each encoder block
+    scan_layers: bool = False  # lax.scan over the encoder stack (see
+    #                            GPTConfig.scan_layers: single-lowering
+    #                            depth loop + structural remat)
     # fused MLM vocab path (see ops/fused_xent.py): the pretraining
     # forward returns the transformed hidden states + tied weight +
     # decoder bias instead of [b, s, vocab] logits
@@ -157,8 +162,19 @@ class BertModel(Layer):
     def forward(self, input_ids, token_type_ids=None, position_ids=None,
                 attn_mask=None):
         x = self.embeddings(input_ids, token_type_ids, position_ids)
-        for layer in self.encoder:
-            x = layer(x, attn_mask=attn_mask)
+        if self.cfg.scan_layers:
+            from ..nn.utils import scan_layer_stack
+            x = scan_layer_stack(list(self.encoder), x,
+                                 remat=self.cfg.remat,
+                                 rng_tag="bert_trunk",
+                                 attn_mask=attn_mask)
+        else:
+            for layer in self.encoder:
+                if self.cfg.remat:
+                    x = jax.checkpoint(
+                        lambda x, l=layer: l(x, attn_mask=attn_mask))(x)
+                else:
+                    x = layer(x, attn_mask=attn_mask)
         pooled = self.pooler(x) if self.pooler is not None else None
         return x, pooled
 

@@ -333,54 +333,21 @@ class GPTModel(Layer):
         return x
 
     def _scan_trunk(self, x, attn_mask, rope_pos):
-        """lax.scan over the decoder stack (cfg.scan_layers).
-
-        All blocks share one structure, so block 0 serves as the
-        functional template: each layer's params (the live — possibly
-        traced — values the outer functional_call swapped in) are
-        stacked to [L, ...] leaves and the scan body applies the
-        template to its slice. Dropout keys fold the layer index into
-        the ambient stream so iterations draw distinct randomness even
-        though the body traces once. With cfg.remat the body is
-        checkpointed: saved state is exactly the scan carries (the
-        per-block boundary activations) — remat the compiler cannot
-        undo, on any backend. ref: the reference's depth loop is
+        """lax.scan over the decoder stack (cfg.scan_layers) — see
+        nn.utils.scan_layer_stack for the mechanics (single-lowering
+        depth loop, stacked [L, ...] params, per-layer dropout keys,
+        structural remat). ref: the reference's depth loop is
         run-to-completion eager (incubate/nn/functional teaches fused
         blocks instead); scan-over-depth is the XLA-native form."""
-        from ..core import rng as rng_mod
-        from ..nn.layer import functional_call, split_state
+        from ..nn.utils import scan_layer_stack
         from ..parallel.sharding import with_logical_constraint
 
-        per_layer = []
-        for layer in self.layers:
-            p, b = split_state(layer)
-            if b:  # stateful blocks can't share one traced template
-                raise NotImplementedError(
-                    "scan_layers requires buffer-free decoder blocks; "
-                    f"found buffers {list(b)}")
-            per_layer.append(p)
-        keys = list(per_layer[0])
-        assert all(list(p) == keys for p in per_layer[1:]), \
-            "scan_layers requires structurally identical blocks"
-        stacked = {k: jnp.stack([p[k] for p in per_layer])
-                   for k in keys}
-        base_key = rng_mod.current_stream().next_key("scan_trunk")
-        template = self.layers[0]
-
-        def body(carry, sl):
-            params_i, idx = sl
-            with rng_mod.key_guard(jax.random.fold_in(base_key, idx)):
-                out, _ = functional_call(
-                    template, params_i, {}, carry, attn_mask=attn_mask,
-                    position_ids=rope_pos)
-            return with_logical_constraint(
-                out, ("batch", "seq", None)), None
-
-        if self.cfg.remat:
-            body = jax.checkpoint(body)
-        idxs = jnp.arange(len(per_layer))
-        x, _ = jax.lax.scan(body, x, (stacked, idxs))
-        return x
+        return scan_layer_stack(
+            self.layers, x, remat=self.cfg.remat,
+            constraint=lambda o: with_logical_constraint(
+                o, ("batch", "seq", None)),
+            rng_tag="scan_trunk", attn_mask=attn_mask,
+            position_ids=rope_pos)
 
 
 def _lm_logits(cfg: GPTConfig, embeddings: GPTEmbeddings, hidden,

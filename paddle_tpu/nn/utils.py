@@ -162,3 +162,73 @@ def vector_to_parameters(vec, parameters):
         out.append(vec[off:off + n].reshape(jnp.shape(p)))
         off += n
     return out
+
+
+def scan_layer_stack(layers, x, *, remat: bool = False,
+                     constraint=None, rng_tag: str = "scan_stack",
+                     **call_kwargs):
+    """Apply structurally identical ``layers`` to ``x`` via ``lax.scan``.
+
+    The TPU-native depth loop shared by the GPT and BERT trunks (and
+    the pipeline's in-stage layers): the block lowers ONCE (compile
+    O(1) in depth), per-layer params are stacked to [L, ...] leaves at
+    trace time, dropout keys fold the layer index into the ambient
+    stream, and with ``remat`` the checkpointed scan body makes
+    rematerialization STRUCTURAL — recompute happens inside the
+    backward scan where no backend pass (notably XLA:CPU's
+    barrier-stripping + CSE) can elide it; the saved state is exactly
+    the per-layer boundary activations.
+
+    ``constraint``: optional fn applied to each boundary (e.g.
+    ``with_logical_constraint(x, ("batch", "seq", None))``).
+    ``call_kwargs`` are broadcast to every layer call (masks, position
+    ids). Requires buffer-free blocks with identical param structure.
+    """
+    from .layer import split_state
+
+    layers = list(layers)
+    per_layer = []
+    for layer in layers:
+        p, b = split_state(layer)
+        if b:
+            raise NotImplementedError(
+                "scan_layer_stack requires buffer-free blocks; found "
+                f"buffers {list(b)}")
+        per_layer.append(p)
+    keys = list(per_layer[0])
+    if any(list(p) != keys for p in per_layer[1:]):
+        raise ValueError(
+            "scan_layer_stack requires structurally identical blocks")
+    stacked = {k: jnp.stack([p[k] for p in per_layer]) for k in keys}
+    return scan_stacked_apply(layers[0], stacked, x, remat=remat,
+                              constraint=constraint, rng_tag=rng_tag,
+                              **call_kwargs)
+
+
+def scan_stacked_apply(template, stacked, x, *, remat: bool = False,
+                       constraint=None, rng_tag: str = "scan_stack",
+                       training=None, **call_kwargs):
+    """Core of the scan depth loop, for callers that already hold
+    [L, ...]-stacked params (the pipeline's in-stage layers): applies
+    ``template`` to each leading-dim slice via lax.scan, folding the
+    layer index into the ambient RNG stream; with ``remat`` the
+    checkpointed body gives structural rematerialization."""
+    from ..core import rng as _rng
+    from .layer import functional_call
+
+    n = jax.tree_util.tree_leaves(stacked)[0].shape[0]
+    base_key = _rng.current_stream().next_key(rng_tag)
+
+    def body(carry, sl):
+        params_i, idx = sl
+        with _rng.key_guard(jax.random.fold_in(base_key, idx)):
+            out, _ = functional_call(template, params_i, {}, carry,
+                                     training=training, **call_kwargs)
+        if constraint is not None:
+            out = constraint(out)
+        return out, None
+
+    if remat:
+        body = jax.checkpoint(body)
+    out, _ = jax.lax.scan(body, x, (stacked, jnp.arange(n)))
+    return out

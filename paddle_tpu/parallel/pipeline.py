@@ -445,36 +445,31 @@ class PipelineParallel(Layer):
         # wrapper never reach it — propagate the mode explicitly per call
         if self._layer_suffixes:
             template = self._proto[0]
-            per = self._per
             suffixes = self._layer_suffixes
-            from ..core import rng as _rng
+            from ..nn.utils import scan_stacked_apply
 
             def stage_fn(params_local, mb):
                 # params_local: {suffix: [per, ...]} — inner scan over
                 # the chunk's layers; checkpointed body = structural
                 # remat (residuals are the per-layer boundaries only)
-                base = _rng.next_key("stage_layers")
+                return scan_stacked_apply(
+                    template, {s: params_local[s] for s in suffixes},
+                    mb, remat=self._remat, rng_tag="stage_layers",
+                    training=self.training)
 
-                def body(carry, sl):
-                    p, idx = sl
-                    with _rng.key_guard(jax.random.fold_in(base, idx)):
-                        out, _ = functional_call(
-                            template, p, {}, carry,
-                            training=self.training)
-                    return out, None
-
-                wrapped = jax.checkpoint(body) if self._remat else body
-                out, _ = lax.scan(wrapped, mb,
-                                  ({s: params_local[s] for s in suffixes},
-                                   jnp.arange(per)))
-                return out
+            # the inner scan already remats per layer — an outer
+            # chunk-level checkpoint on top would re-run every layer's
+            # forward a third time in backward for nothing
+            chunk_remat = False
         else:
             def stage_fn(params_local, mb):
                 out, _ = functional_call(self._proto, params_local, {},
                                          mb, training=self.training)
                 return out
 
+            chunk_remat = self._remat
+
         return pipeline_spmd(stage_fn, stacked, x,
                              self.num_microbatches, mesh,
                              virtual=v, mb_spec=self._mb_spec,
-                             remat=self._remat)
+                             remat=chunk_remat)

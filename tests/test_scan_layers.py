@@ -134,3 +134,31 @@ def test_scan_remat_memory_is_structural():
     unrolled = temps(False)
     scanned = temps(True)
     assert scanned * 3 <= unrolled, (scanned, unrolled)
+
+
+def test_bert_scan_matches_loop():
+    """BERT/ERNIE trunks share the scan depth loop (nn.utils.
+    scan_layer_stack): forward + training parity with the eager loop."""
+    from paddle_tpu.models.bert import (BertConfig, BertForPretraining,
+                                        BertPretrainingCriterion)
+
+    kw = dict(vocab_size=128, hidden_size=32, num_layers=4, num_heads=2,
+              max_position_embeddings=32, hidden_dropout=0.0,
+              attention_dropout=0.0, use_flash=False)
+    ids = np.random.RandomState(0).randint(3, 128, (2, 16))
+    labels = np.random.RandomState(1).randint(0, 128, (2, 16))
+    nsp = np.asarray([0, 1])
+    losses = {}
+    for scan in (False, True):
+        pt.seed(0)
+        net = BertForPretraining(BertConfig(**kw, scan_layers=scan,
+                                            remat=scan))
+        m = pt.Model(net)
+        m.prepare(optimizer=pt.optimizer.AdamW(learning_rate=1e-3,
+                                               parameters=net),
+                  loss=BertPretrainingCriterion())
+        losses[scan] = [
+            float(m.train_batch([ids], [labels, nsp])["loss"])
+            for _ in range(3)]
+    np.testing.assert_allclose(losses[False], losses[True],
+                               rtol=1e-5, atol=1e-6)
