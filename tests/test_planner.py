@@ -188,3 +188,35 @@ def test_verify_plan_corrects_bad_estimate():
         assert np.isfinite(float(logs["loss"]))
     finally:
         parallel.set_mesh(None)
+
+
+def test_planner_agrees_with_compiled_feasibility_study():
+    """Reconcile the analytic planner against the committed compiled
+    1.3B study (FEASIBILITY_1P3B.json, VERDICT r3 ask #7): for every
+    non-pp row the planner must (a) never OVER-estimate the compiled
+    f32 proxy, (b) stay within the 4x calibration band verify_plan
+    corrects from one compile, and (c) agree on the clear-cut
+    infeasibility verdicts (dp=8) and feasibility (tp=8)."""
+    import json
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "FEASIBILITY_1P3B.json")
+    if not os.path.exists(path):
+        pytest.skip("feasibility study artifact not present")
+    rows = [r for r in json.load(open(path))["rows"]
+            if "error" not in r and r.get("planner_ratio")]
+    assert len(rows) >= 5, "study artifact lost its planner rows"
+    for r in rows:
+        assert 1.0 <= r["planner_ratio"] <= 4.0, (r["axes"],
+                                                  r["planner_ratio"])
+    budget = 16 * (1 << 30) * 0.85
+    by_axes = {tuple(sorted(r["axes"].items())): r for r in rows}
+    dp8 = by_axes.get((("dp", 8),))
+    if dp8 is not None:  # planner and compiler agree: hopeless
+        assert not dp8["fits_v5e"]
+        assert dp8["planner_predicted_bytes"] > budget
+    tp8 = by_axes.get((("tp", 8),))
+    if tp8 is not None:  # and: comfortable
+        assert tp8["fits_v5e"]
+        assert tp8["planner_predicted_bytes"] <= budget
