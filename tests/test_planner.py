@@ -205,7 +205,11 @@ def test_planner_agrees_with_compiled_feasibility_study():
     if not os.path.exists(path):
         pytest.skip("feasibility study artifact not present")
     rows = [r for r in json.load(open(path))["rows"]
-            if "error" not in r and r.get("planner_ratio")]
+            if "error" not in r and r.get("planner_ratio")
+            and not r.get("use_flash") and not r.get("amp")]
+    # ^ band calibrated for the f32 dense-attention proxy rows; the
+    #   flash/amp probe variants are deliberately non-representative
+    #   (see the artifact's "note")
     assert len(rows) >= 5, "study artifact lost its planner rows"
     for r in rows:
         assert 1.0 <= r["planner_ratio"] <= 4.0, (r["axes"],
