@@ -497,18 +497,27 @@ def take_along_axis(x, indices, axis):
 
 
 def put_along_axis(x, indices, values, axis):
-    return jnp.put_along_axis(jnp.asarray(x), indices, values, axis=axis,
+    x = jnp.asarray(x)
+    # the reference op requires value/input dtype agreement and casts
+    # (put_along_axis_op.cc); mixed f32-into-bf16 scatters are a
+    # FutureWarning-then-error in jax
+    values = jnp.asarray(values).astype(x.dtype)
+    return jnp.put_along_axis(x, indices, values, axis=axis,
                               inplace=False)
 
 
 def scatter(x, index, updates, overwrite=True):
+    x = jnp.asarray(x)
+    updates = jnp.asarray(updates).astype(x.dtype)
     if overwrite:
-        return jnp.asarray(x).at[index].set(updates)
-    return jnp.asarray(x).at[index].add(updates)
+        return x.at[index].set(updates)
+    return x.at[index].add(updates)
 
 
 def scatter_nd_add(x, index, updates):
-    return jnp.asarray(x).at[tuple(jnp.moveaxis(index, -1, 0))].add(updates)
+    x = jnp.asarray(x)
+    updates = jnp.asarray(updates).astype(x.dtype)
+    return x.at[tuple(jnp.moveaxis(index, -1, 0))].add(updates)
 
 
 def index_select(x, index, axis=0):
