@@ -234,3 +234,58 @@ def test_concurrent_predictor_run_matches_serial(tmp_path):
     for o, r in zip(outs, refs):
         assert o is not None
         np.testing.assert_allclose(o, r, atol=5e-2, rtol=2e-2)
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not _plugin_available(),
+                    reason="no PJRT plugin .so on this machine")
+def test_standalone_cpp_server_binary(tmp_path):
+    """predictor_main.cc → ptserve: a pure-C++ process (zero Python)
+    loads the artifact, serves concurrent requests through the
+    thread-safe API, and its output-0 checksum matches the Python
+    forward (the reference's demo_ci C++ consumer proof)."""
+    import json
+    import subprocess
+    import sys
+
+    import paddle_tpu as pt
+    from paddle_tpu import inference, jit
+
+    native = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "paddle_tpu", "native")
+    inference._load_lib()  # ensure libptpredictor.so is current
+    exe = os.path.join(native, "ptserve")
+    subprocess.run(
+        ["g++", "-O2", "-std=c++17", "predictor_main.cc", "-o", exe,
+         "-L.", "-lptpredictor", "-Wl,-rpath,$ORIGIN"],
+        cwd=native, check=True, capture_output=True)
+
+    pt.seed(0)
+    net = pt.nn.Sequential(pt.nn.Linear(16, 32), pt.nn.Tanh(),
+                           pt.nn.Linear(32, 4))
+    net.eval()
+    x = np.random.RandomState(0).randn(8, 16).astype(np.float32)
+    ref_sum = float(np.asarray(net(x)).astype(np.float64).sum())
+    art = str(tmp_path / "artifact")
+    jit.save(net, art, input_spec=[jit.InputSpec([8, 16], "float32")])
+    np.save(tmp_path / "x.npy", x)
+
+    try:
+        proc = subprocess.run(
+            [exe, inference.default_plugin(),
+             inference.default_plugin_options(), art,
+             str(tmp_path / "x.npy"), "--threads", "3", "--iters", "4"],
+            capture_output=True, text=True, timeout=300,
+            env={**os.environ, "PT_PJRT_CREATE_TIMEOUT": "120"})
+    except subprocess.TimeoutExpired:
+        pytest.skip("device unavailable (serve binary timed out)")
+    if proc.returncode == 3 or (proc.returncode != 0 and (
+            "tunnel" in proc.stderr or "wedged" in proc.stderr
+            or "Unavailable" in proc.stderr
+            or "UNAVAILABLE" in proc.stderr)):
+        pytest.skip(f"device unavailable: {proc.stderr[-200:]}")
+    assert proc.returncode == 0, proc.stderr[-800:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["requests"] == 12
+    np.testing.assert_allclose(out["out0_sum"], ref_sum,
+                               rtol=2e-2, atol=1e-2)
