@@ -17,6 +17,7 @@
 
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <fstream>
 #include <string>
@@ -32,6 +33,7 @@ void* ptpred_run2(void*, const void**, const uint32_t*, const uint32_t*,
 int ptres_num_outputs(void*);
 int ptres_ndim(void*, int);
 int64_t ptres_dim(void*, int, int);
+uint32_t ptres_dtype(void*, int);
 const void* ptres_data(void*, int);
 int64_t ptres_nbytes(void*, int);
 void ptres_destroy(void*);
@@ -77,7 +79,17 @@ bool ParseNpy(const std::string& path, NpyArray* out) {
   else return false;
   if (find_val("fortran_order").find("True") != std::string::npos)
     return false;
-  std::string shape = find_val("shape");
+  // shape is a parenthesized tuple — find_val's comma-split would
+  // truncate multi-dim shapes, so extract (...) directly
+  std::string shape;
+  {
+    auto sp = header.find("'shape'");
+    if (sp == std::string::npos) return false;
+    auto lp = header.find('(', sp);
+    auto rp = header.find(')', lp);
+    if (lp == std::string::npos || rp == std::string::npos) return false;
+    shape = header.substr(lp + 1, rp - lp - 1);
+  }
   int64_t count = 1;
   const char* p = shape.c_str();
   while (*p) {
@@ -105,12 +117,15 @@ int main(int argc, char** argv) {
     return 2;
   }
   int threads = 1, iters = 8;
+  bool parse_only = false;
   std::vector<NpyArray> inputs;
   for (int i = 4; i < argc; ++i) {
     if (std::strcmp(argv[i], "--threads") == 0 && i + 1 < argc) {
       threads = std::atoi(argv[++i]);
     } else if (std::strcmp(argv[i], "--iters") == 0 && i + 1 < argc) {
       iters = std::atoi(argv[++i]);
+    } else if (std::strcmp(argv[i], "--parse-only") == 0) {
+      parse_only = true;  // hardware-free NPY reader check
     } else {
       NpyArray a;
       if (!ParseNpy(argv[i], &a)) {
@@ -119,6 +134,17 @@ int main(int argc, char** argv) {
       }
       inputs.push_back(std::move(a));
     }
+  }
+
+  if (parse_only) {
+    for (auto& a : inputs) {
+      std::printf("{\"dtype_code\": %u, \"dims\": [", a.dtype_code);
+      for (size_t d = 0; d < a.dims.size(); ++d)
+        std::printf("%s%lld", d ? ", " : "",
+                    static_cast<long long>(a.dims[d]));
+      std::printf("], \"nbytes\": %zu}\n", a.data.size());
+    }
+    return 0;
   }
 
   // hang-proofing: PJRT_Client_Create on a tunneled device can block
@@ -176,10 +202,25 @@ int main(int argc, char** argv) {
       }
       if (record && it == 0) {
         // checksum of output 0 so runs are comparable to Python
-        int64_t n = ptres_nbytes(res, 0) / 4;
-        const float* d = static_cast<const float*>(ptres_data(res, 0));
+        uint32_t code = ptres_dtype(res, 0);
+        int64_t nb = ptres_nbytes(res, 0);
+        const void* d = ptres_data(res, 0);
         double s = 0.0;
-        for (int64_t k = 0; k < n; ++k) s += d[k];
+        if (code == 0) {        // f32
+          for (int64_t k = 0; k < nb / 4; ++k)
+            s += static_cast<const float*>(d)[k];
+        } else if (code == 1) {  // f64
+          for (int64_t k = 0; k < nb / 8; ++k)
+            s += static_cast<const double*>(d)[k];
+        } else if (code == 2) {  // i32
+          for (int64_t k = 0; k < nb / 4; ++k)
+            s += static_cast<const int32_t*>(d)[k];
+        } else if (code == 3) {  // i64
+          for (int64_t k = 0; k < nb / 8; ++k)
+            s += static_cast<const int64_t*>(d)[k];
+        } else {
+          std::fprintf(stderr, "out0 dtype code %u not summed\n", code);
+        }
         first_sum = s;
       }
       ptres_destroy(res);

@@ -289,3 +289,40 @@ def test_standalone_cpp_server_binary(tmp_path):
     assert out["requests"] == 12
     np.testing.assert_allclose(out["out0_sum"], ref_sum,
                                rtol=2e-2, atol=1e-2)
+
+
+def test_serve_binary_npy_parser():
+    """Hardware-free: ptserve --parse-only must read multi-dim npy
+    headers exactly (a comma-split once truncated (8,16) to (8,))."""
+    import json
+    import subprocess
+    import tempfile
+
+    native = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "paddle_tpu", "native")
+    exe = os.path.join(native, "ptserve")
+    subprocess.run(
+        ["g++", "-O2", "-std=c++17", "predictor_main.cc", "-o", exe,
+         "-L.", "-lptpredictor", "-Wl,-rpath,$ORIGIN"],
+        cwd=native, check=True, capture_output=True)
+    with tempfile.TemporaryDirectory() as td:
+        cases = {
+            "a": np.ones((8, 16), np.float32),
+            "b": np.arange(6, dtype=np.int64),
+            "c": np.zeros((2, 3, 4), np.float64),
+            "d": np.zeros((5,), np.int32),
+        }
+        paths = []
+        for name, arr in cases.items():
+            p = os.path.join(td, f"{name}.npy")
+            np.save(p, arr)
+            paths.append((p, arr))
+        proc = subprocess.run(
+            [exe, "x", "", "y"] + [p for p, _ in paths]
+            + ["--parse-only"], capture_output=True, text=True,
+            timeout=60)
+        assert proc.returncode == 0, proc.stderr
+        lines = [json.loads(l) for l in proc.stdout.strip().splitlines()]
+        for (p, arr), rec in zip(paths, lines):
+            assert rec["dims"] == list(arr.shape), (p, rec)
+            assert rec["nbytes"] == arr.nbytes, (p, rec)
