@@ -67,14 +67,55 @@ def _merge(o1, lse1, o2, lse2):
     return o, lse
 
 
+def _block_attention_streamed(q, k, v, sm_scale, q_base, k_base,
+                              causal, chunk):
+    """_block_attention with the K/V chunk streamed: an online-softmax
+    lax.scan over ``chunk``-column tiles, so the per-device logits
+    working set is [sq, chunk] instead of [sq, sk] — flash attention
+    in XLA-native form (the pallas kernel serves the dedicated op;
+    this form needs no kernel and composes with shard_map/ppermute).
+    ``q_base``/``k_base`` are the blocks' global position offsets
+    (traced scalars under shard_map) for the causal mask; the
+    checkpointed scan body makes the O(chunk) claim structural.
+    Returns (out f32, lse f32) like _block_attention."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    n = sk // chunk
+    k_r = jnp.moveaxis(k.reshape(b, n, chunk, h, d), 1, 0)
+    v_r = jnp.moveaxis(v.reshape(b, n, chunk, h, d), 1, 0)
+
+    def body(carry, xs):
+        o_acc, lse_acc = carry
+        k_i, v_i, i = xs
+        # q_base + r >= k_base + i*chunk + c, as a _causal_mask offset
+        mask = _causal_mask(sq, chunk, q_base - k_base - i * chunk) \
+            if causal else None
+        o_j, lse_j = _block_attention(q, k_i, v_i, sm_scale, mask)
+        return _merge(o_acc, lse_acc, o_j, lse_j), None
+
+    body = jax.checkpoint(body)
+    o0 = jnp.zeros(q.shape, jnp.float32)
+    lse0 = jnp.full((b, h, sq), NEG_INF, jnp.float32)
+    (o, lse), _ = lax.scan(body, (o0, lse0),
+                           (k_r, v_r, jnp.arange(n)))
+    return o, lse
+
+
 def ring_attention(q, k, v, *, causal: bool = False,
                    sm_scale: Optional[float] = None,
-                   axis: str = "sp", mesh=None):
+                   axis: str = "sp", mesh=None,
+                   chunk_size: Optional[int] = None):
     """Exact attention with Q/K/V sequence-sharded over mesh axis ``axis``.
 
     q, k, v: [b, s_global, h, d] GLOBAL arrays (sharded or to-be-sharded
     over the sp axis). Returns [b, s_global, h, d] with the same
     sequence sharding. Equals full attention numerically.
+
+    ``chunk_size``: stream each ring block's K/V through the
+    online-softmax scan in tiles of this many columns — per-device
+    logits drop from [s/sp, s/sp] to [s/sp, chunk_size], making the
+    per-device attention memory O(s·chunk/sp) (the flash-in-block
+    lever for true long context; requires chunk_size | s/sp).
     """
     from ..parallel.mesh import get_mesh
     mesh = mesh or get_mesh()
@@ -85,10 +126,22 @@ def ring_attention(q, k, v, *, causal: bool = False,
     s_local = s // sp
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
 
+    if chunk_size is not None:
+        if chunk_size <= 0:
+            raise ValueError(f"chunk_size must be positive, got "
+                             f"{chunk_size}")
+        if s_local % chunk_size:
+            raise ValueError(
+                f"chunk_size {chunk_size} must divide s/sp = {s_local}")
+
     if sp == 1:
-        out, _ = _block_attention(
-            q, k, v, scale,
-            _causal_mask(s, s, 0) if causal else None)
+        if chunk_size is not None and chunk_size < s:
+            out, _ = _block_attention_streamed(
+                q, k, v, scale, 0, 0, causal, chunk_size)
+        else:
+            out, _ = _block_attention(
+                q, k, v, scale,
+                _causal_mask(s, s, 0) if causal else None)
         return out.astype(q.dtype)
 
     spec = P(None, axis, None, None)
@@ -103,15 +156,22 @@ def ring_attention(q, k, v, *, causal: bool = False,
         def step(carry, j):
             k_cur, v_cur, o_acc, lse_acc = carry
             src = (rank - j) % sp  # which rank's chunk we now hold
-            if causal:
-                # global positions: q row r -> rank*s_local + r,
-                # k col c -> src*s_local + c; attend iff q_pos >= k_pos
-                q_pos = rank * s_local + rows[:, None]
-                k_pos = src * s_local + cols[None, :]
-                mask = jnp.where(q_pos >= k_pos, 0.0, NEG_INF)
+            if chunk_size is not None and chunk_size < s_local:
+                o_j, lse_j = _block_attention_streamed(
+                    q_l, k_cur, v_cur, scale, rank * s_local,
+                    src * s_local, causal, chunk_size)
             else:
-                mask = None
-            o_j, lse_j = _block_attention(q_l, k_cur, v_cur, scale, mask)
+                if causal:
+                    # global positions: q row r -> rank*s_local + r,
+                    # k col c -> src*s_local + c; attend iff
+                    # q_pos >= k_pos
+                    q_pos = rank * s_local + rows[:, None]
+                    k_pos = src * s_local + cols[None, :]
+                    mask = jnp.where(q_pos >= k_pos, 0.0, NEG_INF)
+                else:
+                    mask = None
+                o_j, lse_j = _block_attention(q_l, k_cur, v_cur, scale,
+                                              mask)
             o_acc, lse_acc = _merge(o_acc, lse_acc, o_j, lse_j)
             k_nxt = lax.ppermute(k_cur, axis, ring)
             v_nxt = lax.ppermute(v_cur, axis, ring)
