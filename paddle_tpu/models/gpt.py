@@ -52,6 +52,14 @@ class GPTConfig:
     tie_word_embeddings: bool = True
     use_flash: bool = True
     remat: bool = False  # rematerialize each block (jax.checkpoint)
+    # context parallelism: attention runs as ring attention over the
+    # mesh's sp axis (ops/ring_attention — K/V chunks rotate the ICI
+    # ring; exact numerics). Composes with dp/fsdp/tp (partial-manual
+    # over sp only); NOT with the pp trunk (nested manual axes) or the
+    # decode cache. ring_chunk_size additionally streams each block's
+    # K/V in tiles (flash-in-block) for true long-context footprints.
+    sequence_parallel: bool = False
+    ring_chunk_size: Optional[int] = None
     # lax.scan over the (identical-structure) decoder blocks instead of
     # a Python loop: the block lowers ONCE (compile time ~O(1) in depth
     # — the lever that makes 24-48-layer configs compile fast), and
@@ -148,6 +156,16 @@ class GPTAttention(Layer):
             0.0, cfg.initializer_range / math.sqrt(2 * cfg.num_layers)),
             axes=("heads", "embed"), bias_axes=(None,))
 
+    def _sp_mesh(self):
+        """The installed mesh when it has a real sp axis, else None
+        (sequence_parallel degrades to plain attention off-mesh, so
+        the same config runs single-device tests unchanged)."""
+        from ..parallel.mesh import get_mesh
+        mesh = get_mesh(required=False)
+        if mesh is not None and mesh.axis_size("sp") > 1:
+            return mesh
+        return None
+
     def forward(self, x, attn_mask=None, cache=None,
                 position_ids=None):
         b, s, h = x.shape
@@ -193,6 +211,29 @@ class GPTAttention(Layer):
                 q, k, v, attn_mask=causal_mask,
                 dropout_p=self.cfg.attention_dropout,
                 training=self.training, use_flash=False)
+        elif self.cfg.sequence_parallel and \
+                (sp_mesh := self._sp_mesh()) is not None:
+            from ..ops.ring_attention import ring_attention
+            if attn_mask is not None:
+                # a silent dense fallback would all-gather the full
+                # sequence and defeat the O(s/sp) point — fail loudly
+                # like the dropout case below
+                raise NotImplementedError(
+                    "sequence_parallel attention does not take an "
+                    "attn_mask (ring blocks are causal-only); drop the "
+                    "mask or disable sequence_parallel")
+            if self.cfg.attention_dropout and self.training:
+                raise NotImplementedError(
+                    "sequence_parallel attention has no dropout lane; "
+                    "set attention_dropout=0.0")
+            if self.num_kv_heads != self.num_heads:
+                # ring blocks want matching head counts; expand GQA
+                # groups (correctness path — the K/V tiles are small)
+                rep = self.num_heads // self.num_kv_heads
+                k = jnp.repeat(k, rep, axis=2)
+                v = jnp.repeat(v, rep, axis=2)
+            out = ring_attention(q, k, v, causal=True, mesh=sp_mesh,
+                                 chunk_size=self.cfg.ring_chunk_size)
         else:
             # always causal (decoder-only); an extra additive mask (e.g.
             # padding) composes with it rather than replacing it
@@ -468,6 +509,12 @@ class GPTForCausalLMPipe(Layer):
                 "GPTForCausalLMPipe ignores cfg.scan_layers: the "
                 "pipeline's tick scan + checkpointed tick body already "
                 "provide the structural depth loop and remat")
+        if cfg.sequence_parallel:
+            raise ValueError(
+                "sequence_parallel cannot compose with the pipelined "
+                "trunk: ring attention's shard_map would nest inside "
+                "the pipeline's manual pp region. Use sp with the "
+                "dense GPTForCausalLM, or pp without sp")
         mesh = mesh or get_mesh(required=False)
         pp = mesh.axis_size("pp") if mesh is not None else 1
         num_stages = pp * virtual_pp_degree

@@ -182,9 +182,14 @@ def ring_attention(q, k, v, *, causal: bool = False,
         carry, _ = _scan_helper(step, (k_l, v_l, o0, lse0), sp)
         return carry[2].astype(q_l.dtype)
 
+    # partial-manual: only the sp axis is manual (the ring's ppermute
+    # needs it); batch/head dims stay in GSPMD auto mode so dp/fsdp/tp
+    # shardings of the enclosing step pass through untouched — the same
+    # trick the pipeline uses for tp-inside-pp (parallel/pipeline.py)
     mapped = jax.shard_map(per_shard, mesh=mesh.mesh,
                            in_specs=(spec, spec, spec),
-                           out_specs=spec, check_vma=False)
+                           out_specs=spec, check_vma=False,
+                           axis_names={axis})
     return mapped(q, k, v)
 
 

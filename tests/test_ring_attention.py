@@ -169,3 +169,42 @@ def test_chunked_ring_memory_linear_in_seq():
     t1 = temps(4096, 256)
     t2 = temps(8192, 256)
     assert t2 / t1 <= 2.6, (t1, t2)
+
+
+def test_gpt_sequence_parallel_training_matches_dense():
+    """GPTConfig.sequence_parallel: the flagship trains with ring
+    attention over sp (composed with dp), loss-parity with the dense
+    single-mesh model — context parallelism as a model config, not
+    just a standalone op."""
+    import paddle_tpu as pt
+    from paddle_tpu import parallel
+    from paddle_tpu.models.gpt import (GPTConfig, GPTForCausalLM,
+                                       GPTPretrainingCriterion)
+
+    kw = dict(vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+              max_position_embeddings=32, hidden_dropout=0.0,
+              attention_dropout=0.0, use_flash=False)
+    ids = np.random.RandomState(0).randint(0, 64, (4, 32))
+
+    def losses(sp):
+        pt.seed(0)
+        cfg = GPTConfig(**kw, sequence_parallel=bool(sp),
+                        ring_chunk_size=4 if sp else None)
+        net = GPTForCausalLM(cfg)
+        m = pt.Model(net)
+        m.prepare(optimizer=pt.optimizer.AdamW(learning_rate=1e-3,
+                                               parameters=net),
+                  loss=GPTPretrainingCriterion())
+        if sp:
+            mesh = parallel.init_mesh(sp=sp, dp=8 // sp)
+            parallel.distributed_model(m, mesh=mesh)
+        try:
+            return [float(m.train_batch([ids], [ids])["loss"])
+                    for _ in range(3)]
+        finally:
+            if sp:
+                parallel.set_mesh(None)
+
+    dense = losses(0)
+    ring = losses(4)
+    np.testing.assert_allclose(ring, dense, rtol=5e-4, atol=5e-5)
