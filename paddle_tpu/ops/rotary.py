@@ -18,19 +18,32 @@ import functools
 from typing import Optional, Tuple
 
 import jax.numpy as jnp
+import numpy as np
 
 
 @functools.lru_cache(maxsize=16)
 def rope_tables(head_dim: int, max_len: int, base: float = 10000.0,
-                dtype=jnp.float32) -> Tuple[jnp.ndarray, jnp.ndarray]:
+                dtype=jnp.float32) -> Tuple[np.ndarray, np.ndarray]:
     """cos/sin tables [max_len, head_dim] (half-split convention).
-    Cached: eager decode loops call this per token per layer."""
-    inv = 1.0 / (base ** (jnp.arange(0, head_dim, 2,
-                                     dtype=jnp.float32) / head_dim))
-    t = jnp.arange(max_len, dtype=jnp.float32)
-    freqs = jnp.outer(t, inv)                       # [L, D/2]
-    emb = jnp.concatenate([freqs, freqs], axis=-1)  # [L, D]
-    return jnp.cos(emb).astype(dtype), jnp.sin(emb).astype(dtype)
+    Cached: eager decode loops call this per token per layer.
+
+    Computed in NUMPY on purpose: jnp primitives bind to whatever
+    trace is active, so a first call from inside a jit/scan trace
+    would cache TRACERS and poison every later trace with an
+    UnexpectedTracerError (order-dependent — an eager warm-up call
+    masked it). numpy arrays are concrete constants under any trace."""
+    inv = 1.0 / (base ** (np.arange(0, head_dim, 2,
+                                    dtype=np.float32) / head_dim))
+    t = np.arange(max_len, dtype=np.float32)
+    freqs = np.outer(t, inv)                        # [L, D/2]
+    emb = np.concatenate([freqs, freqs], axis=-1)   # [L, D]
+    np_dtype = np.dtype(dtype) if dtype != jnp.bfloat16 else None
+    cos, sin = np.cos(emb), np.sin(emb)
+    if np_dtype is not None:
+        return cos.astype(np_dtype), sin.astype(np_dtype)
+    import ml_dtypes
+    return (cos.astype(ml_dtypes.bfloat16),
+            sin.astype(ml_dtypes.bfloat16))
 
 
 def _rotate_half(x):
@@ -43,6 +56,9 @@ def apply_rotary_pos_emb(q, k, cos, sin, position_ids=None):
     ``position_ids`` ([B, S], default arange — pass the absolute
     positions when decoding with a KV cache)."""
     s = q.shape[1]
+    # tables may arrive as numpy constants (rope_tables caches numpy —
+    # trace-safe); gathering by a traced position_ids needs jnp
+    cos, sin = jnp.asarray(cos), jnp.asarray(sin)
     if position_ids is None:
         cos_g = cos[None, :s, None, :]
         sin_g = sin[None, :s, None, :]

@@ -208,3 +208,44 @@ def test_gpt_sequence_parallel_training_matches_dense():
     dense = losses(0)
     ring = losses(4)
     np.testing.assert_allclose(ring, dense, rtol=5e-4, atol=5e-5)
+
+
+def test_llama_style_scan_plus_sequence_parallel():
+    """Feature interaction: LLaMA-style trunk (RoPE + RMSNorm + SwiGLU
+    + GQA) with scan_layers AND sequence_parallel together — GQA head
+    expansion inside ring blocks, rotary positions under the scanned
+    trunk, loss parity with the same model dense."""
+    import paddle_tpu as pt
+    from paddle_tpu import parallel
+    from paddle_tpu.models.gpt import (GPTForCausalLM,
+                                       GPTPretrainingCriterion,
+                                       llama_config)
+
+    ids = np.random.RandomState(0).randint(0, 64, (4, 32))
+
+    def losses(sp):
+        pt.seed(0)
+        cfg = llama_config(hidden_size=32, num_layers=2, num_heads=4,
+                           num_kv_heads=2, vocab_size=64,
+                           max_position_embeddings=32, use_flash=False,
+                           scan_layers=True, remat=True,
+                           sequence_parallel=bool(sp),
+                           ring_chunk_size=8 if sp else None)
+        net = GPTForCausalLM(cfg)
+        m = pt.Model(net)
+        m.prepare(optimizer=pt.optimizer.AdamW(learning_rate=1e-3,
+                                               parameters=net),
+                  loss=GPTPretrainingCriterion())
+        if sp:
+            mesh = parallel.init_mesh(sp=sp, dp=8 // sp)
+            parallel.distributed_model(m, mesh=mesh)
+        try:
+            return [float(m.train_batch([ids], [ids])["loss"])
+                    for _ in range(3)]
+        finally:
+            if sp:
+                parallel.set_mesh(None)
+
+    dense = losses(0)
+    ring = losses(4)
+    np.testing.assert_allclose(ring, dense, rtol=5e-4, atol=5e-5)
