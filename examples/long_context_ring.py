@@ -27,7 +27,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 import jax
 
-if jax.default_backend() == "cpu" or not jax.devices()[0].platform == "tpu":
+# pass --tpu to run on an attached TPU slice; the default pins the CPU
+# demo WITHOUT probing the backend (initializing a wedged/busy TPU
+# tunnel hangs before the demo even starts)
+ON_TPU = "--tpu" in sys.argv
+if not ON_TPU:
     jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
@@ -41,8 +45,8 @@ from paddle_tpu.models.gpt import (GPTForCausalLM,
 def main():
     # 16k tokens on a real slice; the CPU demo default stays small
     # enough to compile+run in minutes on a laptop core
-    seq = int(sys.argv[1]) if len(sys.argv) > 1 else (
-        16384 if jax.default_backend() == "tpu" else 4096)
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    seq = int(args[0]) if args else (16384 if ON_TPU else 4096)
     sp, dp = 4, 2
 
     cfg = llama_config(hidden_size=128, num_layers=2, num_heads=4,
