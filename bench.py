@@ -199,7 +199,8 @@ def bench_resnet(batch: int = 128, warmup: int = 3, iters: int = 30,
 # ---------------------------------------------------------------------------
 
 def bench_bert(batch: int = 64, seq: int = 128, warmup: int = 3,
-               iters: int = 30, cpu_smoke: bool = False):
+               iters: int = 30, cpu_smoke: bool = False,
+               scan_layers: bool = False, remat: bool = False):
     import paddle_tpu as paddle
     from paddle_tpu.models.bert import (BertForPretraining,
                                         BertFusedPretrainingCriterion,
@@ -213,7 +214,8 @@ def bench_bert(batch: int = 64, seq: int = 128, warmup: int = 3,
         batch, iters = 2, 3
     else:
         cfg = bert_config("bert-base", hidden_dropout=0.0,
-                          attention_dropout=0.0, fused_loss=True)
+                          attention_dropout=0.0, fused_loss=True,
+                          scan_layers=scan_layers, remat=remat)
     net = BertForPretraining(cfg)
     model = paddle.Model(net)
     model.prepare(
@@ -234,6 +236,7 @@ def bench_bert(batch: int = 64, seq: int = 128, warmup: int = 3,
     return {"metric": "bertbase_train_samples_per_sec",
             "value": round(sps, 1), "unit": "samples/sec",
             "batch": batch, "seq": seq, "params": n_params,
+            "scan": cfg.scan_layers, "remat": cfg.remat,
             "mfu": _mfu(sps * seq * flops_per_token)}
 
 
