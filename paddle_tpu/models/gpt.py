@@ -100,6 +100,8 @@ PRESETS = {
                       max_position_embeddings=2048),
     "gpt3-6.7b": dict(hidden_size=4096, num_layers=32, num_heads=32,
                       max_position_embeddings=2048),
+    "gpt3-13b": dict(hidden_size=5120, num_layers=40, num_heads=40,
+                     max_position_embeddings=2048),
 }
 
 

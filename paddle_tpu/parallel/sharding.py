@@ -85,6 +85,20 @@ def named_sharding(axes, shape, mesh: Optional[DeviceMesh] = None,
     return NamedSharding(mesh.mesh, rules.mesh_axes(axes, tuple(shape), mesh))
 
 
+def shard_spec(name: Optional[str], shape, meta,
+               mesh: Optional[DeviceMesh] = None,
+               rules: Optional[LogicalRules] = None) -> NamedSharding:
+    """THE sharding a parameter gets at runtime — the single source of
+    truth shared by ``shard_params`` (materialized placement) and the
+    compile-only planning paths (ShapeDtypeStruct rows must carry
+    exactly what the runtime would do, or the study lies)."""
+    mesh = mesh or get_mesh()
+    rules = rules or LogicalRules()
+    axes = getattr(meta.get(name), "axes", None)         if (meta and name is not None) else None
+    return NamedSharding(mesh.mesh,
+                         rules.mesh_axes(axes, tuple(shape), mesh))
+
+
 def shard_params(params: Dict[str, jax.Array],
                  meta: Dict[str, Any],
                  mesh: Optional[DeviceMesh] = None,
@@ -95,13 +109,9 @@ def shard_params(params: Dict[str, jax.Array],
     non-distributed attrs, completion.py fallback)."""
     mesh = mesh or get_mesh()
     rules = rules or LogicalRules()
-    out = {}
-    for name, v in params.items():
-        axes = getattr(meta.get(name), "axes", None) if meta else None
-        s = NamedSharding(mesh.mesh,
-                          rules.mesh_axes(axes, tuple(v.shape), mesh))
-        out[name] = jax.device_put(v, s)
-    return out
+    return {name: jax.device_put(
+                v, shard_spec(name, v.shape, meta, mesh, rules))
+            for name, v in params.items()}
 
 
 def shard_batch(batch, mesh: Optional[DeviceMesh] = None):

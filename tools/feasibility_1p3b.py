@@ -52,6 +52,59 @@ LAYOUTS = [
 ]
 
 
+def _abstract_state(model, net, mesh):
+    """Shape-only state trees with the REAL shardings attached — the
+    study's big-model rows must not materialize 10s of GB of f32 state
+    on the build host (the 6.7B/16-device row hit 99% of host RAM and
+    had to be killed; the reference plans on the static Program, which
+    never materializes weights either). jax.jit.lower accepts
+    ShapeDtypeStructs, so compilation + memory analysis are identical
+    to the materialized path."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+    from jax.tree_util import DictKey, tree_map_with_path
+
+    from paddle_tpu.nn.layer import split_state
+    from paddle_tpu.parallel.sharding import shard_spec
+
+    meta = net.param_meta()
+
+    def shard_of(name, shape):
+        return shard_spec(name, shape, meta, mesh)
+
+    params_all, buffers = split_state(net)
+    trainable = {k: v for k, v in params_all.items()
+                 if meta[k].trainable}
+    frozen = {k: v for k, v in params_all.items()
+              if not meta[k].trainable}
+
+    def sds_tree(tree):
+        return {k: jax.ShapeDtypeStruct(tuple(v.shape), v.dtype,
+                                        sharding=shard_of(k, v.shape))
+                for k, v in tree.items()}
+
+    p_sds = sds_tree(trainable)
+    f_sds = sds_tree(frozen)
+    b_sds = sds_tree(buffers)
+    opt_shape = jax.eval_shape(model._optimizer.init_state, p_sds)
+
+    def reshard(path, leaf):
+        # moments are keyed by the param name they mirror; eval_shape
+        # drops shardings, so re-attach from the matching param
+        name = None
+        for k in reversed(path):
+            if isinstance(k, DictKey) and k.key in p_sds:
+                name = k.key
+                break
+        sh = shard_of(name, leaf.shape) if name else \
+            NamedSharding(mesh.mesh, PartitionSpec())
+        return jax.ShapeDtypeStruct(tuple(leaf.shape), leaf.dtype,
+                                    sharding=sh)
+
+    o_sds = tree_map_with_path(reshard, opt_shape)
+    return p_sds, f_sds, o_sds, b_sds
+
+
 def run_child(spec: dict) -> dict:
     import jax
     jax.config.update("jax_platforms", "cpu")
@@ -86,19 +139,33 @@ def run_child(spec: dict) -> dict:
     # pp rows: the pipe trunk scans over schedule ticks and
     # checkpoints the tick body — already structural remat; its own
     # depth loop ignores scan_layers (the Pipe model warns on it)
-    cfg = gpt_config("gpt3-1.3b", hidden_dropout=0.0,
+    cfg = gpt_config(spec.get("model", "gpt3-1.3b"), hidden_dropout=0.0,
                      attention_dropout=0.0, use_flash=use_flash,
                      remat=remat, fused_loss=True,
                      scan_layers=not micro)
+    abstract = bool(spec.get("abstract"))
+    if abstract and amp == "O2":
+        raise ValueError(
+            "abstract mode cannot compose with amp O2: amp.decorate "
+            "casts the net's concrete params, and the abstract net has "
+            "shape-only (eval_shape) params — measure O2 rows "
+            "materialized")
     mesh = parallel.init_mesh(**axes)
     try:
         pt.seed(0)
         t0 = time.time()
-        if micro:
-            net = GPTForCausalLMPipe(cfg, num_microbatches=micro,
-                                     mesh=mesh)
+
+        def build_net():
+            if micro:
+                return GPTForCausalLMPipe(cfg, num_microbatches=micro,
+                                          mesh=mesh)
+            return GPTForCausalLM(cfg)
+
+        if abstract:
+            from paddle_tpu.parallel.planner import abstract_model
+            net = abstract_model(build_net)
         else:
-            net = GPTForCausalLM(cfg)
+            net = build_net()
         if amp == "O2":
             # O2 = bf16 parameter storage (amp.decorate): activations
             # inherit bf16 through the trunk, so the stored boundary
@@ -114,7 +181,12 @@ def run_child(spec: dict) -> dict:
             loss=GPTFusedPretrainingCriterion(),
             **({"amp_configs": amp} if amp else {}))
         parallel.distributed_model(model, mesh=mesh)
-        model._sync_state_in()
+        if abstract:
+            state = _abstract_state(model, net, mesh)
+        else:
+            model._sync_state_in()
+            state = (model._params, model._frozen, model._opt_state,
+                     model._buffers)
         build_s = time.time() - t0
 
         model._train_step_fn = model._build_train_step()
@@ -124,8 +196,7 @@ def run_child(spec: dict) -> dict:
         key = rng_mod.split_for_step(0)
         t0 = time.time()
         lowered = model._train_step_fn.lower(
-            model._params, model._frozen, model._opt_state,
-            model._buffers, 0, key, inputs, labels)
+            *state, 0, key, inputs, labels)
         mem = lowered.compile().memory_analysis()
         compile_s = time.time() - t0
 
@@ -144,6 +215,8 @@ def run_child(spec: dict) -> dict:
             "global_batch": gb, "seq_len": seq,
             "microbatches": micro or None,
             "use_flash": use_flash, "amp": amp, "remat": remat,
+            "abstract": abstract or None,
+            "model_name": spec.get("model", "gpt3-1.3b"),
             "argument_bytes": float(mem.argument_size_in_bytes),
             "temp_bytes": float(mem.temp_size_in_bytes),
             "output_bytes": float(mem.output_size_in_bytes),
