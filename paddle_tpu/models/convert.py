@@ -1,0 +1,183 @@
+"""Checkpoint interop: load HuggingFace / torch weights into the zoo.
+
+The reference's ecosystem ships model converters (PaddleNLP
+``convert.py`` per model family, mapping HF torch checkpoints onto
+paddle Layers); this is the same capability for the TPU zoo — a user
+switching frameworks brings their trained weights along.
+
+Mappings are pure name/layout tables: HF GPT-2's Conv1D stores
+weights [in, out], exactly our ``nn.Linear`` convention, so tensors
+copy through without transposes; BERT's ``nn.Linear`` stores
+[out, in] and transposes on the way in.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+
+def _to_numpy(t) -> np.ndarray:
+    if hasattr(t, "detach"):  # torch tensor
+        return t.detach().cpu().numpy()
+    return np.asarray(t)
+
+
+def _state_dict(model_or_sd) -> Dict[str, np.ndarray]:
+    sd = model_or_sd.state_dict() if hasattr(model_or_sd, "state_dict") \
+        else model_or_sd
+    return {k: _to_numpy(v) for k, v in sd.items()}
+
+
+def gpt2_from_huggingface(model_or_state_dict, config=None):
+    """Build a :class:`~paddle_tpu.models.gpt.GPTForCausalLM` carrying
+    the weights of a HF ``GPT2LMHeadModel`` (or its state_dict).
+
+    ``config`` overrides the inferred GPTConfig fields (e.g. to enable
+    ``scan_layers``/``fused_loss`` on the converted model). Returns the
+    converted model; logits match HF within float tolerance
+    (tests/test_convert.py).
+    """
+    from .gpt import GPTConfig, GPTForCausalLM
+
+    hf_cfg = getattr(model_or_state_dict, "config", None)
+    sd = _state_dict(model_or_state_dict)
+    sd = {k[len("transformer."):] if k.startswith("transformer.") else k:
+          v for k, v in sd.items()}
+
+    n_layer = 1 + max(int(k.split(".")[1]) for k in sd
+                      if k.startswith("h."))
+    wte = sd["wte.weight"]
+    wpe = sd["wpe.weight"]
+    n_head = None
+    if isinstance(config, dict):
+        n_head = config.get("num_heads")
+    if n_head is None and hf_cfg is not None:
+        # the source model knows its own head count — never guess when
+        # it's available (a 48-dim-head checkpoint converts silently
+        # wrong under any divisor heuristic)
+        n_head = getattr(hf_cfg, "n_head", None) or \
+            getattr(hf_cfg, "num_attention_heads", None)
+    if n_head is None:
+        # bare state_dict fallback: head_dim 64 GPT-2 family invariant
+        n_head = max(1, wte.shape[1] // 64)
+
+    kw = dict(vocab_size=wte.shape[0], hidden_size=wte.shape[1],
+              num_layers=n_layer, num_heads=n_head,
+              max_position_embeddings=wpe.shape[0],
+              activation="gelu_tanh",  # HF "gelu_new"
+              tie_word_embeddings=True)
+    if config is not None and not isinstance(config, dict):
+        raise TypeError(
+            "config must be a dict of GPTConfig field overrides (a "
+            "full config object would silently drop inferred fields "
+            "like activation='gelu_tanh')")
+    kw.update(config or {})
+    cfg = GPTConfig(**kw)
+
+    import paddle_tpu as pt
+    pt.seed(0)
+    net = GPTForCausalLM(cfg)
+
+    state = {"gpt.embeddings.word_embeddings.weight": wte,
+             "gpt.embeddings.position_embeddings.weight": wpe,
+             "gpt.ln_f.weight": sd["ln_f.weight"],
+             "gpt.ln_f.bias": sd["ln_f.bias"]}
+    for i in range(n_layer):
+        src, dst = f"h.{i}", f"gpt.layers.{i}"
+        state.update({
+            # HF Conv1D is [in, out] — our Linear convention; no T
+            f"{dst}.ln_1.weight": sd[f"{src}.ln_1.weight"],
+            f"{dst}.ln_1.bias": sd[f"{src}.ln_1.bias"],
+            f"{dst}.attn.qkv_proj.weight": sd[f"{src}.attn.c_attn.weight"],
+            f"{dst}.attn.qkv_proj.bias": sd[f"{src}.attn.c_attn.bias"],
+            f"{dst}.attn.out_proj.weight": sd[f"{src}.attn.c_proj.weight"],
+            f"{dst}.attn.out_proj.bias": sd[f"{src}.attn.c_proj.bias"],
+            f"{dst}.ln_2.weight": sd[f"{src}.ln_2.weight"],
+            f"{dst}.ln_2.bias": sd[f"{src}.ln_2.bias"],
+            f"{dst}.mlp.fc_in.weight": sd[f"{src}.mlp.c_fc.weight"],
+            f"{dst}.mlp.fc_in.bias": sd[f"{src}.mlp.c_fc.bias"],
+            f"{dst}.mlp.fc_out.weight": sd[f"{src}.mlp.c_proj.weight"],
+            f"{dst}.mlp.fc_out.bias": sd[f"{src}.mlp.c_proj.bias"],
+        })
+    net.set_state_dict(state)
+    return net
+
+
+def bert_from_huggingface(model_or_state_dict, config=None,
+                          with_pooler: bool = True):
+    """Build a :class:`~paddle_tpu.models.bert.BertModel` carrying the
+    weights of a HF ``BertModel`` (or its state_dict). HF torch Linear
+    stores [out, in]: weights transpose on the way in."""
+    from .bert import BertConfig, BertModel
+
+    hf_cfg = getattr(model_or_state_dict, "config", None)
+    sd = _state_dict(model_or_state_dict)
+    sd = {k[len("bert."):] if k.startswith("bert.") else k: v
+          for k, v in sd.items()}
+
+    n_layer = 1 + max(int(k.split(".")[2]) for k in sd
+                      if k.startswith("encoder.layer."))
+    tok = sd["embeddings.word_embeddings.weight"]
+    pos = sd["embeddings.position_embeddings.weight"]
+    typ = sd["embeddings.token_type_embeddings.weight"]
+    inter0 = sd["encoder.layer.0.intermediate.dense.weight"]
+    n_head = None
+    if isinstance(config, dict):
+        n_head = config.get("num_heads")
+    if n_head is None and hf_cfg is not None:
+        n_head = getattr(hf_cfg, "num_attention_heads", None)
+    if n_head is None:
+        n_head = max(1, tok.shape[1] // 64)
+
+    kw = dict(vocab_size=tok.shape[0], hidden_size=tok.shape[1],
+              num_layers=n_layer, num_heads=n_head,
+              intermediate_size=inter0.shape[0],
+              max_position_embeddings=pos.shape[0],
+              type_vocab_size=typ.shape[0])
+    if config is not None and not isinstance(config, dict):
+        raise TypeError(
+            "config must be a dict of BertConfig field overrides")
+    kw.update(config or {})
+    cfg = BertConfig(**kw)
+
+    import paddle_tpu as pt
+    pt.seed(0)
+    net = BertModel(cfg, with_pooler=with_pooler)
+
+    def lin(dst, src):
+        return {f"{dst}.weight": sd[f"{src}.weight"].T,
+                f"{dst}.bias": sd[f"{src}.bias"]}
+
+    state = {
+        "embeddings.word_embeddings.weight": tok,
+        "embeddings.position_embeddings.weight": pos,
+        "embeddings.token_type_embeddings.weight": typ,
+        "embeddings.layer_norm.weight":
+            sd["embeddings.LayerNorm.weight"],
+        "embeddings.layer_norm.bias": sd["embeddings.LayerNorm.bias"],
+    }
+    for i in range(n_layer):
+        src = f"encoder.layer.{i}"
+        dst = f"encoder.{i}"
+        state.update(lin(f"{dst}.attn.q_proj",
+                         f"{src}.attention.self.query"))
+        state.update(lin(f"{dst}.attn.k_proj",
+                         f"{src}.attention.self.key"))
+        state.update(lin(f"{dst}.attn.v_proj",
+                         f"{src}.attention.self.value"))
+        state.update(lin(f"{dst}.attn.out_proj",
+                         f"{src}.attention.output.dense"))
+        state[f"{dst}.ln_1.weight"] = \
+            sd[f"{src}.attention.output.LayerNorm.weight"]
+        state[f"{dst}.ln_1.bias"] = \
+            sd[f"{src}.attention.output.LayerNorm.bias"]
+        state.update(lin(f"{dst}.fc_in", f"{src}.intermediate.dense"))
+        state.update(lin(f"{dst}.fc_out", f"{src}.output.dense"))
+        state[f"{dst}.ln_2.weight"] = sd[f"{src}.output.LayerNorm.weight"]
+        state[f"{dst}.ln_2.bias"] = sd[f"{src}.output.LayerNorm.bias"]
+    if with_pooler and "pooler.dense.weight" in sd:
+        state.update(lin("pooler.dense", "pooler.dense"))
+    net.set_state_dict(state)
+    return net

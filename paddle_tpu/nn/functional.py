@@ -46,6 +46,12 @@ def gelu(x, approximate: bool = False):
     return jax.nn.gelu(x, approximate=approximate)
 
 
+def gelu_tanh(x):
+    """The tanh approximation (HF gpt2's "gelu_new") as a named
+    activation so model configs can select it by string."""
+    return jax.nn.gelu(x, approximate=True)
+
+
 def leaky_relu(x, negative_slope: float = 0.01):
     return jax.nn.leaky_relu(x, negative_slope)
 

@@ -51,6 +51,28 @@ LAYOUTS = [
     (64, {"dp": 4, "fsdp": 8, "tp": 2}, 512, 0),
 ]
 
+# The scale ladder (PERF.md "the scale ladder, measured"): abstract
+# rows — ShapeDtypeStruct state, so any model size compiles without
+# materializing weights. Rerun with --ladder.
+LADDER = [
+    {"devices": 8, "axes": {"pp": 4, "tp": 2}, "global_batch": 16,
+     "microbatches": 4, "model": "gpt3-1.3b", "abstract": True},
+    {"devices": 64, "axes": {"pp": 4, "tp": 2, "dp": 8},
+     "global_batch": 64, "microbatches": 4, "model": "gpt3-1.3b",
+     "abstract": True},
+    {"devices": 8, "axes": {"pp": 4, "tp": 2}, "global_batch": 8,
+     "microbatches": 4, "model": "gpt3-6.7b", "abstract": True},
+    {"devices": 16, "axes": {"pp": 8, "tp": 2}, "global_batch": 8,
+     "microbatches": 8, "model": "gpt3-6.7b", "abstract": True},
+    {"devices": 16, "axes": {"fsdp": 8, "tp": 2}, "global_batch": 32,
+     "microbatches": 0, "model": "gpt3-6.7b", "abstract": True},
+    {"devices": 64, "axes": {"pp": 8, "tp": 2, "dp": 4},
+     "global_batch": 32, "microbatches": 8, "model": "gpt3-6.7b",
+     "abstract": True},
+    {"devices": 64, "axes": {"pp": 8, "tp": 8}, "global_batch": 8,
+     "microbatches": 8, "model": "gpt3-13b", "abstract": True},
+]
+
 
 def _abstract_state(model, net, mesh):
     """Shape-only state trees with the REAL shardings attached — the
@@ -237,16 +259,27 @@ def main():
     ap.add_argument("--out", default="FEASIBILITY_1P3B.json")
     ap.add_argument("--child", default=None)
     ap.add_argument("--timeout", type=int, default=3600)
+    ap.add_argument("--ladder", action="store_true",
+                    help="run the abstract scale-ladder specs instead "
+                         "of the 1.3B base layouts")
     args = ap.parse_args()
 
     if args.child:
         print(json.dumps(run_child(json.loads(args.child))))
         return
 
+    # append to an existing artifact — a rerun must not clobber rows
+    # another sweep (base vs ladder vs hand refinements) produced
     rows = []
-    for devices, axes, gb, micro in LAYOUTS:
-        spec = {"devices": devices, "axes": axes, "global_batch": gb,
-                "microbatches": micro}
+    if os.path.exists(args.out):
+        try:
+            rows = json.load(open(args.out)).get("rows", [])
+        except ValueError:
+            pass
+    specs = LADDER if args.ladder else [
+        {"devices": d, "axes": a, "global_batch": g, "microbatches": m}
+        for d, a, g, m in LAYOUTS]
+    for spec in specs:
         print(f"[feasibility] {spec}", file=sys.stderr, flush=True)
         from _subproc import run_spec
         rec = run_spec(__file__, "--child", spec, timeout=args.timeout)
