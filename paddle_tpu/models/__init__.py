@@ -30,4 +30,5 @@ from .vision_extra import (AlexNet, DenseNet, GoogLeNet,  # noqa
                            squeezenet1_0, squeezenet1_1)
 from .widedeep import DeepFM, WideDeep, synthetic_criteo  # noqa
 from .convert import (bert_from_huggingface,  # noqa
-                      gpt2_from_huggingface)
+                      gpt2_from_huggingface,
+                      llama_from_huggingface)

@@ -181,3 +181,102 @@ def bert_from_huggingface(model_or_state_dict, config=None,
         state.update(lin("pooler.dense", "pooler.dense"))
     net.set_state_dict(state)
     return net
+
+
+def llama_from_huggingface(model_or_state_dict, config=None):
+    """Build a LLaMA-style :class:`~paddle_tpu.models.gpt.GPTForCausalLM`
+    (RoPE + RMSNorm + SwiGLU + GQA, ``llama_config``) carrying the
+    weights of a HF ``LlamaForCausalLM`` (or its state_dict).
+
+    HF torch Linears store [out, in] (transposed in); the fused
+    projections concatenate on the out dim — qkv as [q | k | v], the
+    SwiGLU input as [gate | up] (our ``F.swiglu`` silus the first
+    half). HF's rotary is the same half-split convention as
+    ``ops/rotary.py``, so weights copy through unpermuted.
+    """
+    from .gpt import GPTForCausalLM, llama_config
+
+    hf_cfg = getattr(model_or_state_dict, "config", None)
+    sd = _state_dict(model_or_state_dict)
+    sd = {k[len("model."):] if k.startswith("model.") else k: v
+          for k, v in sd.items()}
+
+    n_layer = 1 + max(int(k.split(".")[1]) for k in sd
+                      if k.startswith("layers."))
+    tok = sd["embed_tokens.weight"]
+    hidden = tok.shape[1]
+    kq = sd["layers.0.self_attn.q_proj.weight"]     # [H, H]
+    kk = sd["layers.0.self_attn.k_proj.weight"]     # [kv*hd, H]
+    gate0 = sd["layers.0.mlp.gate_proj.weight"]     # [ffn, H]
+
+    n_head = getattr(hf_cfg, "num_attention_heads", None) \
+        if hf_cfg is not None else None
+    n_kv = getattr(hf_cfg, "num_key_value_heads", None) \
+        if hf_cfg is not None else None
+    if isinstance(config, dict):
+        n_head = config.get("num_heads", n_head)
+        n_kv = config.get("num_kv_heads", n_kv)
+    if n_head is None:
+        raise ValueError(
+            "pass the HF model (not a bare state_dict) or "
+            "config={'num_heads': ..., 'num_kv_heads': ...} — the "
+            "head grouping is not inferable from weight shapes alone")
+    if n_kv is None:
+        n_kv = max(1, n_head * kk.shape[0] // kq.shape[0])
+
+    rope_theta = getattr(hf_cfg, "rope_theta", 10000.0) \
+        if hf_cfg is not None else 10000.0
+    max_pos = getattr(hf_cfg, "max_position_embeddings", 2048) \
+        if hf_cfg is not None else 2048
+    kw = dict(hidden_size=hidden, num_layers=n_layer,
+              num_heads=n_head, num_kv_heads=n_kv,
+              vocab_size=tok.shape[0],
+              max_position_embeddings=max_pos,
+              ffn_hidden_size=gate0.shape[0], rope_base=rope_theta,
+              layer_norm_epsilon=getattr(hf_cfg, "rms_norm_eps", 1e-6)
+              if hf_cfg is not None else 1e-6)
+    if config is not None and not isinstance(config, dict):
+        raise TypeError(
+            "config must be a dict of llama_config overrides")
+    kw.update(config or {})
+    cfg = llama_config(**kw)
+
+    import paddle_tpu as pt
+    pt.seed(0)
+    net = GPTForCausalLM(cfg)
+
+    state = {"gpt.embeddings.word_embeddings.weight": tok,
+             "gpt.ln_f.weight": sd["norm.weight"],
+             "lm_head.weight": sd["lm_head.weight"].T}
+    for i in range(n_layer):
+        src, dst = f"layers.{i}", f"gpt.layers.{i}"
+        qkv = np.concatenate(
+            [sd[f"{src}.self_attn.q_proj.weight"].T,
+             sd[f"{src}.self_attn.k_proj.weight"].T,
+             sd[f"{src}.self_attn.v_proj.weight"].T], axis=1)
+        fc_in = np.concatenate(
+            [sd[f"{src}.mlp.gate_proj.weight"].T,
+             sd[f"{src}.mlp.up_proj.weight"].T], axis=1)
+        state.update({
+            f"{dst}.ln_1.weight": sd[f"{src}.input_layernorm.weight"],
+            f"{dst}.attn.qkv_proj.weight": qkv,
+            f"{dst}.attn.out_proj.weight":
+                sd[f"{src}.self_attn.o_proj.weight"].T,
+            f"{dst}.ln_2.weight":
+                sd[f"{src}.post_attention_layernorm.weight"],
+            f"{dst}.mlp.fc_in.weight": fc_in,
+            f"{dst}.mlp.fc_out.weight":
+                sd[f"{src}.mlp.down_proj.weight"].T,
+            # HF llama projections are bias-free; our Linears carry
+            # biases — zero them so the math matches
+            f"{dst}.attn.qkv_proj.bias":
+                np.zeros(qkv.shape[1], qkv.dtype),
+            f"{dst}.attn.out_proj.bias":
+                np.zeros(hidden, qkv.dtype),
+            f"{dst}.mlp.fc_in.bias":
+                np.zeros(fc_in.shape[1], fc_in.dtype),
+            f"{dst}.mlp.fc_out.bias":
+                np.zeros(hidden, fc_in.dtype),
+        })
+    net.set_state_dict(state)
+    return net

@@ -99,3 +99,33 @@ def test_bert_roundtrip_hidden_states_match():
     seq_out, _pooled = net(ids)
     np.testing.assert_allclose(np.asarray(seq_out), ref,
                                atol=3e-4, rtol=3e-4)
+
+
+def test_llama_roundtrip_logits_match():
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    from paddle_tpu.models.convert import llama_from_huggingface
+
+    hf_cfg = LlamaConfig(vocab_size=160, hidden_size=64,
+                         intermediate_size=96, num_hidden_layers=2,
+                         num_attention_heads=4, num_key_value_heads=2,
+                         max_position_embeddings=32, rope_theta=10000.0,
+                         attention_dropout=0.0, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = LlamaForCausalLM(hf_cfg)
+    hf.eval()
+
+    ids = np.random.RandomState(0).randint(0, 160, (2, 16))
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids)).logits.numpy()
+
+    net = llama_from_huggingface(hf, config={"use_flash": False})
+    net.eval()
+    out = np.asarray(net(ids))
+    np.testing.assert_allclose(out, ref, atol=3e-4, rtol=3e-4)
+
+    ours = np.asarray(net.generate(ids[:1, :8], max_new_tokens=4))
+    with torch.no_grad():
+        theirs = hf.generate(torch.tensor(ids[:1, :8]),
+                             max_new_tokens=4, do_sample=False).numpy()
+    np.testing.assert_array_equal(ours, theirs)
