@@ -171,11 +171,13 @@ def test_chunked_ring_memory_linear_in_seq():
     assert t2 / t1 <= 2.6, (t1, t2)
 
 
-def test_gpt_sequence_parallel_training_matches_dense():
+@pytest.mark.parametrize("data_axis", ["dp", "fsdp"])
+def test_gpt_sequence_parallel_training_matches_dense(data_axis):
     """GPTConfig.sequence_parallel: the flagship trains with ring
-    attention over sp (composed with dp), loss-parity with the dense
-    single-mesh model — context parallelism as a model config, not
-    just a standalone op."""
+    attention over sp composed with dp AND with fsdp (ZeRO-3 param
+    gathers crossing the partial-manual sp region), loss-parity with
+    the dense single-mesh model — context parallelism as a model
+    config, not just a standalone op."""
     import paddle_tpu as pt
     from paddle_tpu import parallel
     from paddle_tpu.models.gpt import (GPTConfig, GPTForCausalLM,
@@ -196,7 +198,7 @@ def test_gpt_sequence_parallel_training_matches_dense():
                                                parameters=net),
                   loss=GPTPretrainingCriterion())
         if sp:
-            mesh = parallel.init_mesh(sp=sp, dp=8 // sp)
+            mesh = parallel.init_mesh(**{"sp": sp, data_axis: 8 // sp})
             parallel.distributed_model(m, mesh=mesh)
         try:
             return [float(m.train_batch([ids], [ids])["loss"])
