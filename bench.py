@@ -110,7 +110,8 @@ def _mfu(model_flops_per_sec) -> float | None:
 def bench_gpt(batch: int = 8, seq: int = 1024, warmup: int = 3,
               iters: int = 20, cpu_smoke: bool = False,
               model_name: str = "gpt2-small", fused: bool = True,
-              scan_layers: bool = False, remat: bool = False):
+              scan_layers: bool = False, remat: bool = False,
+              optimizer: str = "adamw"):
     import paddle_tpu as paddle
     from paddle_tpu.models.gpt import (GPTForCausalLM,
                                        GPTFusedPretrainingCriterion,
@@ -135,9 +136,19 @@ def bench_gpt(batch: int = 8, seq: int = 1024, warmup: int = 3,
                          remat=remat)
     net = GPTForCausalLM(cfg)
     model = paddle.Model(net)
+    if optimizer == "adafactor":
+        # the single-chip big-model configuration: factored second
+        # moments keep optimizer state ~0 bytes/param vs AdamW's 8,
+        # which is what lets GPT-2-XL (1.56B) train on one 16 GB chip
+        opt = paddle.optimizer.Adafactor(learning_rate=1e-4,
+                                         parameters=net)
+    elif optimizer == "adamw":
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4, parameters=net,
+                                     weight_decay=0.01)
+    else:  # a typo must not stamp a wrong optimizer into the record
+        raise ValueError(f"unknown optimizer {optimizer!r}")
     model.prepare(
-        optimizer=paddle.optimizer.AdamW(learning_rate=1e-4, parameters=net,
-                                         weight_decay=0.01),
+        optimizer=opt,
         loss=(GPTFusedPretrainingCriterion() if cfg.fused_loss
               else GPTPretrainingCriterion()),
         amp_configs="O1")
@@ -155,7 +166,52 @@ def bench_gpt(batch: int = 8, seq: int = 1024, warmup: int = 3,
             "batch": batch, "seq": seq, "params": n_params,
             "model": model_name, "fused": cfg.fused_loss,
             "scan": cfg.scan_layers, "remat": cfg.remat,
+            "optimizer": optimizer,
             "mfu": _mfu(tps * flops_per_token)}
+
+
+# ---------------------------------------------------------------------------
+# config 5: Wide&Deep CTR (sparse embedding + PS-analog host table)
+# ---------------------------------------------------------------------------
+
+def bench_widedeep(batch: int = 16384, warmup: int = 3, iters: int = 30,
+                   cpu_smoke: bool = False, table: str = "hbm"):
+    """Criteo-shape CTR training: 13 dense + 26 categorical slots into a
+    shared table, wide+deep towers, BCE loss. ``table="hbm"`` keeps a
+    1M-row table on device (pure-SPMD CTR); ``table="host"`` trains
+    against a 100M-id HOST-RAM table pulled/pushed per step — the
+    parameter-server workload the reference ran on CPU clusters
+    (BASELINE config 5). Metric: samples/sec (CTR is lookup/bandwidth
+    bound; MFU is not meaningful)."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.models.widedeep import WideDeep, WideDeepHostTable
+
+    paddle.seed(0)
+    if cpu_smoke:
+        batch, iters = 256, 3
+    if table == "host":
+        net = WideDeepHostTable(vocab_size=100 * 1000 * 1000,
+                                embedding_dim=16)
+    else:
+        net = WideDeep(vocab_size=1000 * 1000, embedding_dim=16)
+    model = paddle.Model(net)
+    model.prepare(
+        optimizer=paddle.optimizer.Adam(learning_rate=1e-3,
+                                        parameters=net),
+        loss=nn.BCEWithLogitsLoss())
+    rng = np.random.RandomState(0)
+    dense = rng.randn(batch, 13).astype(np.float32)
+    # raw 2^31-range ids, hash-folded by the table (the Criteo regime:
+    # ids far exceed any dense table range)
+    sparse = rng.randint(0, 1 << 31, (batch, 26)).astype(np.int64)
+    labels = (rng.rand(batch) < 0.3).astype(np.float32)
+    dt = _timed_steps(model, ([dense, sparse], [labels]), warmup, iters)
+    sps = batch * iters / dt
+    return {"metric": f"widedeep_{table}_train_samples_per_sec",
+            "value": round(sps, 1), "unit": "samples/sec",
+            "batch": batch, "table": table,
+            "lookups_per_sec": round(sps * 26, 1), "mfu": None}
 
 
 # ---------------------------------------------------------------------------

@@ -473,8 +473,12 @@ class DynamicBatcher:
             self._flush(pack, used)
 
     def _drain(self):
-        """Fail anything still queued at shutdown — a submit() racing
-        close() must get an exception, never a forever-pending future."""
+        """Serve everything accepted before close() — a graceful close
+        must not drop work whose submit() already succeeded (submit's
+        closed-check is atomic with the STOP put, so all queued items
+        were accepted). Packs and flushes exactly like the live loop;
+        a predictor error still fails only its own pack's futures, and
+        no future is ever left forever-pending."""
         import queue
         leftovers = [self._held] if self._held is not None else []
         self._held = None
@@ -483,9 +487,17 @@ class DynamicBatcher:
                 leftovers.append(self._q.get_nowait())
             except queue.Empty:
                 break
+        pack, used = [], 0
         for item in leftovers:
-            if item != "STOP":
-                item[2].set_exception(RuntimeError("batcher closed"))
+            if item == "STOP":
+                continue
+            if used + item[1] > self.max_batch and pack:
+                self._flush(pack, used)
+                pack, used = [], 0
+            pack.append(item)
+            used += item[1]
+        if pack:
+            self._flush(pack, used)
 
     def _flush(self, pack, used):
         try:

@@ -60,8 +60,17 @@ def gpt2_from_huggingface(model_or_state_dict, config=None):
         n_head = getattr(hf_cfg, "n_head", None) or \
             getattr(hf_cfg, "num_attention_heads", None)
     if n_head is None:
-        # bare state_dict fallback: head_dim 64 GPT-2 family invariant
+        # bare state_dict fallback: head_dim 64 GPT-2 family invariant.
+        # A non-64 head_dim checkpoint would build a silently-wrong
+        # model, so say what was guessed and how to override it.
         n_head = max(1, wte.shape[1] // 64)
+        import warnings
+        warnings.warn(
+            f"gpt2_from_huggingface: bare state_dict with no hf config "
+            f"and no config={{'num_heads': ...}} override — guessed "
+            f"num_heads={n_head} from the GPT-2 head_dim-64 invariant; "
+            f"pass num_heads explicitly if this checkpoint differs",
+            stacklevel=2)
 
     kw = dict(vocab_size=wte.shape[0], hidden_size=wte.shape[1],
               num_layers=n_layer, num_heads=n_head,
@@ -130,6 +139,13 @@ def bert_from_huggingface(model_or_state_dict, config=None,
         n_head = getattr(hf_cfg, "num_attention_heads", None)
     if n_head is None:
         n_head = max(1, tok.shape[1] // 64)
+        import warnings
+        warnings.warn(
+            f"bert_from_huggingface: bare state_dict with no hf config "
+            f"and no config={{'num_heads': ...}} override — guessed "
+            f"num_heads={n_head} from the head_dim-64 invariant; pass "
+            f"num_heads explicitly if this checkpoint differs",
+            stacklevel=2)
 
     kw = dict(vocab_size=tok.shape[0], hidden_size=tok.shape[1],
               num_layers=n_layer, num_heads=n_head,
@@ -228,13 +244,23 @@ def llama_from_huggingface(model_or_state_dict, config=None):
         if hf_cfg is not None else 10000.0
     max_pos = getattr(hf_cfg, "max_position_embeddings", 2048) \
         if hf_cfg is not None else 2048
+    # tie_word_embeddings=True checkpoints (Llama-3.2 family;
+    # safetensors drops the shared lm_head tensor) have no
+    # lm_head.weight — tie the built model instead of KeyError-ing.
+    # The hf config's flag wins when present: a tied model passed as a
+    # live HF module DOES expose the shared tensor in state_dict(), so
+    # key presence alone would silently untie it.
+    tied = "lm_head.weight" not in sd
+    if hf_cfg is not None:
+        tied = bool(getattr(hf_cfg, "tie_word_embeddings", tied))
     kw = dict(hidden_size=hidden, num_layers=n_layer,
               num_heads=n_head, num_kv_heads=n_kv,
               vocab_size=tok.shape[0],
               max_position_embeddings=max_pos,
               ffn_hidden_size=gate0.shape[0], rope_base=rope_theta,
               layer_norm_epsilon=getattr(hf_cfg, "rms_norm_eps", 1e-6)
-              if hf_cfg is not None else 1e-6)
+              if hf_cfg is not None else 1e-6,
+              tie_word_embeddings=tied)
     if config is not None and not isinstance(config, dict):
         raise TypeError(
             "config must be a dict of llama_config overrides")
@@ -246,8 +272,9 @@ def llama_from_huggingface(model_or_state_dict, config=None):
     net = GPTForCausalLM(cfg)
 
     state = {"gpt.embeddings.word_embeddings.weight": tok,
-             "gpt.ln_f.weight": sd["norm.weight"],
-             "lm_head.weight": sd["lm_head.weight"].T}
+             "gpt.ln_f.weight": sd["norm.weight"]}
+    if not cfg.tie_word_embeddings:
+        state["lm_head.weight"] = sd["lm_head.weight"].T
     for i in range(n_layer):
         src, dst = f"layers.{i}", f"gpt.layers.{i}"
         qkv = np.concatenate(

@@ -19,6 +19,17 @@ from ..nn.layer import Layer
 from ..nn.layers.sparse_embedding import MultiSlotEmbedding
 
 
+def _deep_tower(in_dim: int, hidden: Sequence[int]) -> "nn.Sequential":
+    """[in_dim] -> hidden MLP (ReLU) -> scalar logit, shared by every
+    CTR model here."""
+    dims = [in_dim, *hidden]
+    mlp = []
+    for i in range(len(dims) - 1):
+        mlp += [nn.Linear(dims[i], dims[i + 1]), nn.ReLU()]
+    mlp.append(nn.Linear(dims[-1], 1))
+    return nn.Sequential(*mlp)
+
+
 class WideDeep(Layer):
     """ref model family: wide (linear over sparse) + deep (embeddings +
     MLP), joint logit (Cheng et al. 2016; the canonical PS workload)."""
@@ -35,12 +46,8 @@ class WideDeep(Layer):
         # deep: shared table + MLP over [dense | slot embeddings]
         self.embedding = MultiSlotEmbedding(vocab_size, embedding_dim,
                                             hash_ids=True)
-        dims = [num_dense + num_slots * embedding_dim, *hidden]
-        mlp = []
-        for i in range(len(dims) - 1):
-            mlp += [nn.Linear(dims[i], dims[i + 1]), nn.ReLU()]
-        mlp.append(nn.Linear(dims[-1], 1))
-        self.deep = nn.Sequential(*mlp)
+        self.deep = _deep_tower(num_dense + num_slots * embedding_dim,
+                                hidden)
 
     def forward(self, dense, sparse_ids):
         wide_logit = self.wide(sparse_ids).sum(-1, keepdims=True) + \
@@ -66,12 +73,8 @@ class DeepFM(Layer):
                                             hash_ids=True)
         self.num_slots = num_slots
         self.embedding_dim = embedding_dim
-        dims = [num_dense + num_slots * embedding_dim, *hidden]
-        mlp = []
-        for i in range(len(dims) - 1):
-            mlp += [nn.Linear(dims[i], dims[i + 1]), nn.ReLU()]
-        mlp.append(nn.Linear(dims[-1], 1))
-        self.deep = nn.Sequential(*mlp)
+        self.deep = _deep_tower(num_dense + num_slots * embedding_dim,
+                                hidden)
 
     def forward(self, dense, sparse_ids):
         b = dense.shape[0]
@@ -85,6 +88,51 @@ class DeepFM(Layer):
         second = 0.5 * (sum_sq - sq_sum).sum(-1, keepdims=True)
         deep = self.deep(jnp.concatenate([dense, flat], axis=-1))
         return (first + second + deep)[:, 0]
+
+
+class WideDeepHostTable(Layer):
+    """WideDeep with both tables in HOST RAM — the parameter-server
+    workload proper (BASELINE config 5; ref: train_from_dataset over
+    distributed_lookup_table, fluid/distributed/ps/table/
+    memory_sparse_table.h). Table capacity is bounded by host memory,
+    not HBM: rows are pulled into the jitted step per batch and row
+    gradients pushed back with a per-row accessor rule, so the device
+    footprint is O(batch) regardless of vocabulary size.
+
+    Per-slot layout is preserved (the deep tower sees
+    [dense | slot_0 emb | ... | slot_25 emb]) by looking up ids as
+    [b*slots, 1] single-id bags — sum pooling over a bag of one is the
+    identity, and the host gather vectorizes over the flattened batch
+    the same way."""
+
+    def __init__(self, num_dense: int = 13, num_slots: int = 26,
+                 vocab_size: int = 100 * 1000 * 1000,
+                 embedding_dim: int = 16,
+                 hidden: Sequence[int] = (256, 128, 64),
+                 optimizer: str = "adagrad", learning_rate: float = 0.05,
+                 async_push: bool = False):
+        super().__init__()
+        from ..nn.layers.host_embedding import HostOffloadedEmbedding
+        self.num_dense = num_dense
+        self.num_slots = num_slots
+        self.embedding_dim = embedding_dim
+        kw = dict(hash_ids=True, optimizer=optimizer,
+                  learning_rate=learning_rate, async_push=async_push)
+        self.wide = HostOffloadedEmbedding(vocab_size, 1, **kw)
+        self.wide_dense = nn.Linear(num_dense, 1)
+        self.embedding = HostOffloadedEmbedding(vocab_size, embedding_dim,
+                                                **kw)
+        self.deep = _deep_tower(num_dense + num_slots * embedding_dim,
+                                hidden)
+
+    def forward(self, dense, sparse_ids):
+        b, k = sparse_ids.shape
+        flat = sparse_ids.reshape(b * k, 1)
+        wide_logit = self.wide(flat).reshape(b, k).sum(-1, keepdims=True) \
+            + self.wide_dense(dense)
+        emb = self.embedding(flat).reshape(b, k * self.embedding_dim)
+        deep_logit = self.deep(jnp.concatenate([dense, emb], axis=-1))
+        return (wide_logit + deep_logit)[:, 0]
 
 
 def synthetic_criteo(n: int = 1024, num_dense: int = 13,

@@ -1,5 +1,6 @@
 """paddle_tpu.optimizer (ref: python/paddle/optimizer/__init__.py)."""
 
 from . import lr  # noqa: F401
-from .optimizer import (SGD, Adadelta, Adagrad, Adam, Adamax, AdamW,  # noqa
-                        Lamb, LarsMomentum, Momentum, Optimizer, RMSProp)
+from .optimizer import (SGD, Adadelta, Adafactor, Adagrad, Adam,  # noqa
+                        Adamax, AdamW, Lamb, LarsMomentum, Momentum,
+                        Optimizer, RMSProp)

@@ -293,6 +293,13 @@ class PipelineParallel(Layer):
     memory partition is a sharding, not per-rank code. ``forward(x)``
     pipelines the trunk with ``num_microbatches`` microbatches; on a mesh
     without a pp axis it falls back to dense execution.
+
+    CHECKPOINT LAYOUT NOTE: for homogeneous chunks (every in-chunk
+    layer structurally equal — transformers) parameters are suffix-keyed
+    ``[S, per, ...]`` stacks (e.g. ``attn__qkv__weight``); earlier
+    revisions stored flat per-layer keys (``0__attn__qkv__weight`` of
+    shape ``[S, ...]``). ``load_flat_state_dict`` maps the old layout
+    onto the stacked one.
     """
 
     def __init__(self, pipe: PipelineLayer, num_microbatches: int = 1,
@@ -402,6 +409,22 @@ class PipelineParallel(Layer):
                         or mj.axes != meta0.axes):
                     return None  # e.g. a frozen layer inside the stage
         return sorted(suffixes)
+
+    def load_flat_state_dict(self, sd):
+        """Load a pre-stacking checkpoint (flat ``{j}__{suffix}`` keys,
+        each ``[S, ...]``) into the homogeneous stacked layout
+        (``{suffix}`` keys, ``[S, per, ...]``) by re-stacking the layer
+        dim. Already-stacked dicts pass through unchanged."""
+        if self._layer_suffixes:
+            out = dict(sd)
+            for sfx in self._layer_suffixes:
+                name = sfx.replace(".", "__")
+                flat = [f"{j}__{name}" for j in range(self._per)]
+                if name not in out and all(k in out for k in flat):
+                    out[name] = jnp.stack(
+                        [jnp.asarray(out.pop(k)) for k in flat], axis=1)
+            sd = out
+        return self.set_state_dict(sd)
 
     def _stacked(self):
         if self._layer_suffixes:

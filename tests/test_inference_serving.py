@@ -134,21 +134,40 @@ def test_batcher_close_contract():
         b.submit([np.ones((1, 2), np.float32)])
 
 
-def test_batcher_drain_fails_leftovers():
-    """_drain (the belt-and-braces shutdown sweep) must fail queued
-    and held items rather than leave futures forever-pending."""
+def test_batcher_drain_serves_accepted_work():
+    """A graceful close must FLUSH work whose submit() already
+    succeeded (r4 advisor finding), not fail it: queued and held items
+    are packed like the live loop and every future resolves."""
     from concurrent.futures import Future
     pred = StubPredictor()
     b = DynamicBatcher(pred, max_batch=4, max_delay_ms=1)
     b.close()
     f1, f2 = Future(), Future()
     b._q.put(([np.ones((1, 2), np.float32)], 1, f1))
-    b._held = ([np.ones((1, 2), np.float32)], 1, f2)
+    b._held = ([np.full((1, 2), 3.0, np.float32)], 1, f2)
     b._drain()
-    for f in (f1, f2):
-        with pytest.raises(RuntimeError, match="batcher closed"):
-            f.result(timeout=5)
+    np.testing.assert_allclose(f1.result(timeout=5)[0],
+                               np.full((1, 2), 2.0))
+    np.testing.assert_allclose(f2.result(timeout=5)[0],
+                               np.full((1, 2), 6.0))
     assert b._held is None
+    # both fit one pack: the drain coalesces like the live loop
+    assert pred.calls and pred.calls[-1][0][0] == 4  # padded to max
+
+
+def test_batcher_close_resolves_inflight_submits():
+    """End-to-end: submits accepted just before close() all resolve
+    with results after close() returns."""
+    pred = StubPredictor()
+    b = DynamicBatcher(pred, max_batch=8, max_delay_ms=50)
+    futs = [b.submit([np.full((1, 2), float(i), np.float32)])
+            for i in range(5)]
+    b.close()
+    for i, f in enumerate(futs):
+        np.testing.assert_allclose(f.result(timeout=5)[0],
+                                   np.full((1, 2), 2.0 * i))
+    with pytest.raises(RuntimeError, match="batcher closed"):
+        b.submit([np.zeros((1, 2), np.float32)])
 
 
 def test_batcher_threaded_clients_all_served():

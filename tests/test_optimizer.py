@@ -177,3 +177,44 @@ def test_lr_schedule_in_jit():
     vals = [float(step(jnp.asarray(i))) for i in range(5)]
     assert len(set(vals)) == 5  # different lr values...
     assert sum(traces) == 1     # ...single compile
+
+
+def test_adafactor_converges_and_state_is_sublinear():
+    """Adafactor (no reference analog — the single-chip big-model
+    optimizer): converges on the quadratic, and its second-moment state
+    for a [R, C] weight is R+C floats, not R*C (the property that fits
+    1.5B params on one 16 GB chip)."""
+    from paddle_tpu.optimizer import Adafactor
+    np.random.seed(0)
+    # scale_parameter: alpha = rms(p)·lr, so steps shrink geometrically
+    # near the optimum (unit-RMS updates alone would oscillate at lr)
+    params = run_steps(Adafactor, n=200, learning_rate=0.1)
+    assert float(quad_loss(params)) < 0.05
+
+    p = {"w": jnp.zeros((128, 64)), "b": jnp.zeros((64,))}
+    opt = Adafactor()
+    s = opt.init_state(p)
+    assert s["vr"]["w"].shape == (128,)
+    assert s["vc"]["w"].shape == (64,)
+    assert s["v"]["w"].size == 0          # factored: no full moment
+    assert s["v"]["b"].shape == (64,)     # 1-D: full moment
+    assert "m" not in s                   # beta1=None: no first moment
+    state_floats = sum(x.size for x in jax.tree_util.tree_leaves(s))
+    assert state_floats < 0.05 * (128 * 64)
+
+
+def test_adafactor_relative_step_and_momentum():
+    """Default (no lr): T5 relative step min(1e-2, 1/sqrt(t)) with
+    parameter scaling; beta1 adds a first moment that changes the
+    trajectory but still converges."""
+    from paddle_tpu.optimizer import Adafactor
+    np.random.seed(1)
+    params = {"w": jnp.asarray(np.random.randn(8, 8).astype(np.float32))}
+    opt = Adafactor(beta1=0.9)
+    state = opt.init_state(params)
+    assert state["m"]["w"].shape == (8, 8)
+    start = float(quad_loss(params))
+    for i in range(300):
+        grads = jax.grad(quad_loss)(params)
+        params, state = opt.apply_gradients(params, grads, state, i)
+    assert float(quad_loss(params)) < 0.5 * start

@@ -400,3 +400,38 @@ def test_pipeline_buffered_stages_rejected():
                          num_stages=2)
     with pytest.raises(ValueError, match="buffer-free"):
         PipelineParallel(pipe, num_microbatches=2)
+
+
+def test_load_flat_state_dict_maps_old_layout():
+    """Checkpoints from the pre-stacking revision (flat {j}__{suffix}
+    keys, [S, ...] each) load into the homogeneous stacked layout and
+    reproduce the same forward (r4 advisor finding)."""
+    import paddle_tpu as pt
+
+    class Block(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(8, 8)
+
+        def forward(self, x):
+            return jax.nn.relu(self.fc(x))
+
+    def build(seed):
+        pt.seed(seed)
+        return PipelineParallel(
+            PipelineLayer([LayerDesc(Block) for _ in range(8)],
+                          num_stages=4), num_microbatches=2)
+
+    pp = build(0)
+    sd = pp.state_dict()
+    assert sorted(sd.keys()) == ["fc__bias", "fc__weight"]  # stacked
+    x = jnp.asarray(np.random.RandomState(0).randn(4, 8)
+                    .astype(np.float32))
+    y_ref = np.asarray(pp(x))
+
+    flat = {f"{j}__{k}": np.asarray(v[:, j])
+            for k, v in sd.items() for j in range(v.shape[1])}
+    pp2 = build(1)
+    assert not np.allclose(np.asarray(pp2(x)), y_ref)
+    pp2.load_flat_state_dict(flat)
+    np.testing.assert_allclose(np.asarray(pp2(x)), y_ref, rtol=1e-6)

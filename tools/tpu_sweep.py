@@ -43,6 +43,19 @@ RUNS = [
     {"tag": "resnet50", "kind": "resnet", "batch": 256},
     {"tag": "bert", "kind": "bert", "batch": 64},
     {"tag": "bert", "kind": "bert", "batch": 128},
+    # config 5: CTR — device table and PS-analog host table
+    {"tag": "widedeep", "kind": "widedeep", "batch": 16384},
+    {"tag": "widedeep", "kind": "widedeep", "batch": 65536},
+    {"tag": "widedeep_host", "kind": "widedeep", "batch": 8192,
+     "table": "host"},
+    # config 4 family at single-chip max: GPT-2-XL 1.56B, Adafactor
+    # factored state + scan/remat (VERDICT r4 item 3)
+    {"tag": "gpt2_xl", "kind": "gpt", "batch": 8, "model_name": "gpt2-xl",
+     "optimizer": "adafactor", "scan_layers": True, "remat": True,
+     "iters": 10},
+    {"tag": "gpt2_xl", "kind": "gpt", "batch": 4, "model_name": "gpt2-xl",
+     "optimizer": "adafactor", "scan_layers": True, "remat": True,
+     "iters": 10},
 ]
 
 
@@ -58,6 +71,8 @@ def run_one(spec: dict) -> dict:
         rec = bench.bench_resnet(**kw)
     elif kind == "bert":
         rec = bench.bench_bert(**kw)
+    elif kind == "widedeep":
+        rec = bench.bench_widedeep(**kw)
     else:
         raise ValueError(kind)
     rec["tag"] = spec["tag"]
