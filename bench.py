@@ -215,6 +215,61 @@ def bench_widedeep(batch: int = 16384, warmup: int = 3, iters: int = 30,
 
 
 # ---------------------------------------------------------------------------
+# LLM decode serving (continuous batching; VERDICT r4 item 4)
+# ---------------------------------------------------------------------------
+
+def bench_llm_decode(n_requests: int = 16, max_seqs: int = 8,
+                     prompt_len: int = 128, gen_len: int = 128,
+                     cpu_smoke: bool = False,
+                     model_name: str = "gpt2-small"):
+    """Multi-client decode throughput through LLMEngine: n_requests
+    greedy generations (prompt_len ctx, gen_len new tokens) share one
+    engine with max_seqs slots. Metrics: aggregate generated tokens/sec
+    (the serving headline), mean per-request latency, mean TTFT."""
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.llm import LLMEngine
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt_config
+
+    paddle.seed(0)
+    if cpu_smoke:
+        cfg = gpt_config("gpt2-small", num_layers=2, hidden_size=128,
+                         num_heads=4, vocab_size=503,
+                         max_position_embeddings=256,
+                         hidden_dropout=0.0, attention_dropout=0.0)
+        n_requests, prompt_len, gen_len = 4, 16, 16
+    else:
+        cfg = gpt_config(model_name, hidden_dropout=0.0,
+                         attention_dropout=0.0)
+    net = GPTForCausalLM(cfg)
+    total = prompt_len + gen_len
+    pages = -(-total // 16) * max_seqs + 8
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, cfg.vocab_size, prompt_len).tolist()
+               for _ in range(n_requests)]
+    with LLMEngine(net, max_seqs=max_seqs, page_size=16,
+                   num_pages=pages, max_len=total,
+                   prefill_buckets=(prompt_len,)) as eng:
+        # warmup compiles prefill + decode
+        eng.generate([prompts[0]], max_new_tokens=2)
+        t0 = time.perf_counter()
+        futs = [eng.submit(p, max_new_tokens=gen_len) for p in prompts]
+        outs = [f.result() for f in futs]
+        dt = time.perf_counter() - t0
+    gen_tokens = sum(len(o["output_ids"]) for o in outs)
+    assert not any(o["truncated"] for o in outs)
+    return {"metric": "llm_decode_tokens_per_sec",
+            "value": round(gen_tokens / dt, 1), "unit": "tokens/sec",
+            "model": model_name, "n_requests": n_requests,
+            "max_seqs": max_seqs, "prompt_len": prompt_len,
+            "gen_len": gen_len,
+            "mean_latency_s": round(float(np.mean(
+                [o["latency_s"] for o in outs])), 3),
+            "mean_ttft_s": round(float(np.mean(
+                [o["ttft_s"] for o in outs])), 3),
+            "mfu": None}
+
+
+# ---------------------------------------------------------------------------
 # config 2: ResNet-50 ImageNet-shape
 # ---------------------------------------------------------------------------
 

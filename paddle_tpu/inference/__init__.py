@@ -539,3 +539,12 @@ class DynamicBatcher:
 
     def __exit__(self, *exc):
         self.close()
+
+
+def __getattr__(name):
+    # lazy: the LLM engine pulls in model/ops modules that plain
+    # CNN-artifact serving never needs
+    if name in ("LLMEngine", "serve_llm"):
+        from . import llm
+        return getattr(llm, name)
+    raise AttributeError(name)

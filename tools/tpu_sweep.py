@@ -48,6 +48,8 @@ RUNS = [
     {"tag": "widedeep", "kind": "widedeep", "batch": 65536},
     {"tag": "widedeep_host", "kind": "widedeep", "batch": 8192,
      "table": "host"},
+    # decode serving: 16 concurrent greedy generations, 8 slots
+    {"tag": "llm_decode", "kind": "llm_decode", "n_requests": 16},
     # config 4 family at single-chip max: GPT-2-XL 1.56B, Adafactor
     # factored state + scan/remat (VERDICT r4 item 3)
     {"tag": "gpt2_xl", "kind": "gpt", "batch": 8, "model_name": "gpt2-xl",
@@ -73,6 +75,8 @@ def run_one(spec: dict) -> dict:
         rec = bench.bench_bert(**kw)
     elif kind == "widedeep":
         rec = bench.bench_widedeep(**kw)
+    elif kind == "llm_decode":
+        rec = bench.bench_llm_decode(**kw)
     else:
         raise ValueError(kind)
     rec["tag"] = spec["tag"]

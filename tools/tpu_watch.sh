@@ -17,9 +17,18 @@ while true; do
   if timeout "$PROBE_TIMEOUT" python -c "import jax; jax.devices()" \
       >/dev/null 2>&1; then
     echo "$(date -u +%FT%TZ) chip up; sweeping $TAGS" >> "$LOG"
+    BEFORE=$(grep -c '"value"' PERF_SWEEP.jsonl 2>/dev/null || echo 0)
     python tools/tpu_sweep.py PERF_SWEEP.jsonl "$TAGS" 2>> "$LOG"
-    echo "$(date -u +%FT%TZ) sweep done rc=$?" >> "$LOG"
-    exit 0
+    RC=$?
+    AFTER=$(grep -c '"value"' PERF_SWEEP.jsonl 2>/dev/null || echo 0)
+    echo "$(date -u +%FT%TZ) sweep done rc=$RC rows=$((AFTER - BEFORE))" \
+      >> "$LOG"
+    # only stand down once the sweep actually landed a measurement —
+    # a chip that answers the probe but flakes mid-sweep must not
+    # cost the rest of the round's benchmark window
+    if [ "$RC" -eq 0 ] && [ "$AFTER" -gt "$BEFORE" ]; then
+      exit 0
+    fi
   fi
   echo "$(date -u +%FT%TZ) probe failed/timed out" >> "$LOG"
   sleep "$SLEEP_S"
