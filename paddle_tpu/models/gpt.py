@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import nn
+from ..core import rng
 from ..nn import functional as F
 from ..nn import initializer as I
 from ..nn.layer import Layer, LayerList
@@ -172,6 +173,15 @@ class GPTAttention(Layer):
                 position_ids=None):
         b, s, h = x.shape
         hd = self.cfg.head_dim
+        # [b, s] KEY-padding masks (the sp contract) are accepted by
+        # every branch: the dense paths expand them to the additive
+        # [b, 1, 1, s] broadcast form, so an sp-trained padded-batch
+        # config still evaluates on a single device unchanged
+        dense_mask = attn_mask
+        if attn_mask is not None and attn_mask.ndim == 2:
+            am = jnp.where(attn_mask, 0.0, -jnp.inf) \
+                if attn_mask.dtype == jnp.bool_ else attn_mask
+            dense_mask = am[:, None, None, :]
         qkv = self.qkv_proj(x)
         q, k, v = jnp.split(
             qkv, [h, h + self.num_kv_heads * hd], axis=-1)
@@ -205,10 +215,10 @@ class GPTAttention(Layer):
             key_pos = jnp.arange(kl)[None, None, None, :]
             qry_pos = (idx + jnp.arange(s))[None, None, :, None]
             causal_mask = jnp.where(key_pos <= qry_pos, 0.0, -jnp.inf)
-            if attn_mask is not None:  # e.g. padded-prompt mask
-                if attn_mask.dtype == jnp.bool_:
-                    attn_mask = jnp.where(attn_mask, 0.0, -jnp.inf)
-                causal_mask = causal_mask + attn_mask
+            if dense_mask is not None:  # e.g. padded-prompt mask
+                if dense_mask.dtype == jnp.bool_:
+                    dense_mask = jnp.where(dense_mask, 0.0, -jnp.inf)
+                causal_mask = causal_mask + dense_mask
             out = F.scaled_dot_product_attention(
                 q, k, v, attn_mask=causal_mask,
                 dropout_p=self.cfg.attention_dropout,
@@ -216,31 +226,36 @@ class GPTAttention(Layer):
         elif self.cfg.sequence_parallel and \
                 (sp_mesh := self._sp_mesh()) is not None:
             from ..ops.ring_attention import ring_attention
-            if attn_mask is not None:
-                # a silent dense fallback would all-gather the full
-                # sequence and defeat the O(s/sp) point — fail loudly
-                # like the dropout case below
+            if attn_mask is not None and attn_mask.shape != (b, s):
+                # a general [.., sq, sk] mask would have to be
+                # materialized per ring block pair; the serving/training
+                # case is padded batches, which is a KEY-padding mask —
+                # sharded and rotated with K/V, never fully materialized
                 raise NotImplementedError(
-                    "sequence_parallel attention does not take an "
-                    "attn_mask (ring blocks are causal-only); drop the "
-                    "mask or disable sequence_parallel")
-            if self.cfg.attention_dropout and self.training:
-                raise NotImplementedError(
-                    "sequence_parallel attention has no dropout lane; "
-                    "set attention_dropout=0.0")
+                    "sequence_parallel attention takes a KEY-padding "
+                    f"attn_mask of shape [batch, seq] = {(b, s)} (bool "
+                    "True=attend, or additive float); got "
+                    f"{attn_mask.shape}")
             if self.num_kv_heads != self.num_heads:
                 # ring blocks want matching head counts; expand GQA
                 # groups (correctness path — the K/V tiles are small)
                 rep = self.num_heads // self.num_kv_heads
                 k = jnp.repeat(k, rep, axis=2)
                 v = jnp.repeat(v, rep, axis=2)
-            out = ring_attention(q, k, v, causal=True, mesh=sp_mesh,
-                                 chunk_size=self.cfg.ring_chunk_size)
+            dp = self.cfg.attention_dropout if self.training else 0.0
+            out = ring_attention(
+                q, k, v, causal=True, mesh=sp_mesh,
+                chunk_size=self.cfg.ring_chunk_size,
+                key_padding_mask=attn_mask,
+                dropout_p=dp,
+                # same key on every sp rank; ring_attention folds in the
+                # block's global coordinates (pipeline tick-RNG trick)
+                dropout_key=rng.next_key("sp_attn") if dp else None)
         else:
             # always causal (decoder-only); an extra additive mask (e.g.
             # padding) composes with it rather than replacing it
             out = F.scaled_dot_product_attention(
-                q, k, v, attn_mask=attn_mask, is_causal=True,
+                q, k, v, attn_mask=dense_mask, is_causal=True,
                 dropout_p=self.cfg.attention_dropout,
                 training=self.training, use_flash=self.cfg.use_flash)
         out = self.out_proj(out.reshape(b, s, h))

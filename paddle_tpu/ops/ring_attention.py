@@ -18,6 +18,20 @@ linearly with the ring size.
 Differentiation: the scan + ppermute graph is transposed by jax autodiff
 (reverse ring rotation in the backward), so no hand-written VJP is
 needed; block attention math stays in f32 log-space for stability.
+
+Training-parity lanes (r4 VERDICT item 7 — these closed the
+models/gpt.py NotImplementedErrors):
+- ``key_padding_mask`` [b, s_global]: sharded over sp like K and
+  ROTATED around the ring with the K/V chunks, so each block masks its
+  own columns — no rank ever materializes the full mask.
+- ``dropout_p``/``dropout_key``: attention-weight dropout applied to
+  the softmax numerator per block (the denominator/LSE stay undropped,
+  which keeps the online merge exact). The per-block key is the step
+  key folded with the block's GLOBAL (q_base, k_base), so the pattern
+  is deterministic under jax.checkpoint recomputation and independent
+  across ring steps — the same tick-folding trick as the pipeline RNG
+  (parallel/pipeline.py). The realized mask depends on the (sp, chunk)
+  decomposition; it is iid Bernoulli over attention weights either way.
 """
 
 from __future__ import annotations
@@ -33,24 +47,40 @@ from jax.sharding import PartitionSpec as P
 NEG_INF = -1e30
 
 
-def _block_attention(q, k, v, sm_scale, mask):
+def _block_attention(q, k, v, sm_scale, mask, dropout_p: float = 0.0,
+                     dropout_key=None, q_base=0, k_base=0):
     """Partial attention of local queries against one K/V chunk.
 
-    q: [b, sq, h, d]; k/v: [b, sk, h, d]; mask: [sq, sk] additive or None.
-    Returns (out [b, sq, h, d] f32, lse [b, h, sq] f32) with
-    lse = -inf rows producing out = 0 (merged away by the combiner).
+    q: [b, sq, h, d]; k/v: [b, sk, h, d]; mask: additive, broadcastable
+    to [b, h, sq, sk], or None. Returns (out [b, sq, h, d] f32,
+    lse [b, h, sq] f32) with lse = -inf rows producing out = 0 (merged
+    away by the combiner). Attention-weight dropout (if any) drops
+    entries of the softmax NUMERATOR only — normalization and LSE come
+    from the undropped weights, exactly like dropout applied to a
+    fully-materialized softmax matrix.
     """
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * sm_scale
     if mask is not None:
-        logits = logits + mask[None, None, :, :]
+        logits = logits + mask
     m = jnp.max(logits, axis=-1, keepdims=True)          # [b,h,q,1]
     m_safe = jnp.maximum(m, NEG_INF)                     # avoid -inf - -inf
     p = jnp.exp(logits - m_safe)
+    if mask is not None:
+        # the sentinel is FINITE (-1e30): a fully-masked row would
+        # otherwise softmax uniformly over its sentinels instead of
+        # zeroing (surfaced when causal and padding masks stack)
+        p = jnp.where(mask > NEG_INF * 0.5, p, 0.0)
     denom = jnp.sum(p, axis=-1, keepdims=True)
     lse = (m_safe + jnp.log(jnp.maximum(denom, 1e-37)))[..., 0]  # [b,h,q]
     fully_masked = denom[..., 0] <= 0.0
     lse = jnp.where(fully_masked, NEG_INF, lse)
+    if dropout_p and dropout_key is not None:
+        blk_key = jax.random.fold_in(
+            jax.random.fold_in(dropout_key, q_base), k_base)
+        keep = 1.0 - dropout_p
+        keep_mask = jax.random.bernoulli(blk_key, keep, p.shape)
+        p = jnp.where(keep_mask, p / keep, 0.0)
     out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
     out = out / jnp.maximum(denom, 1e-37).transpose(0, 2, 1, 3)
     out = jnp.where(fully_masked.transpose(0, 2, 1)[..., None], 0.0, out)
@@ -67,8 +97,18 @@ def _merge(o1, lse1, o2, lse2):
     return o, lse
 
 
+def _pad_to_additive(kpm):
+    """[b, sk] bool (True = attend) or additive float → additive f32."""
+    if kpm is None:
+        return None
+    if kpm.dtype == jnp.bool_:
+        return jnp.where(kpm, 0.0, NEG_INF).astype(jnp.float32)
+    return kpm.astype(jnp.float32)
+
+
 def _block_attention_streamed(q, k, v, sm_scale, q_base, k_base,
-                              causal, chunk):
+                              causal, chunk, kpm=None,
+                              dropout_p: float = 0.0, dropout_key=None):
     """_block_attention with the K/V chunk streamed: an online-softmax
     lax.scan over ``chunk``-column tiles, so the per-device logits
     working set is [sq, chunk] instead of [sq, sk] — flash attention
@@ -77,34 +117,49 @@ def _block_attention_streamed(q, k, v, sm_scale, q_base, k_base,
     ``q_base``/``k_base`` are the blocks' global position offsets
     (traced scalars under shard_map) for the causal mask; the
     checkpointed scan body makes the O(chunk) claim structural.
-    Returns (out f32, lse f32) like _block_attention."""
+    ``kpm``: additive key-padding [b, sk] for THIS chunk, tiled along
+    with K/V. Returns (out f32, lse f32) like _block_attention."""
     b, sq, h, d = q.shape
     sk = k.shape[1]
     n = sk // chunk
     k_r = jnp.moveaxis(k.reshape(b, n, chunk, h, d), 1, 0)
     v_r = jnp.moveaxis(v.reshape(b, n, chunk, h, d), 1, 0)
+    kpm_r = None if kpm is None else \
+        jnp.moveaxis(kpm.reshape(b, n, chunk), 1, 0)      # [n, b, chunk]
 
     def body(carry, xs):
         o_acc, lse_acc = carry
-        k_i, v_i, i = xs
-        # q_base + r >= k_base + i*chunk + c, as a _causal_mask offset
-        mask = _causal_mask(sq, chunk, q_base - k_base - i * chunk) \
-            if causal else None
-        o_j, lse_j = _block_attention(q, k_i, v_i, sm_scale, mask)
+        if kpm_r is None:
+            k_i, v_i, i = xs
+            mask = None
+        else:
+            k_i, v_i, kpm_i, i = xs
+            mask = kpm_i[:, None, None, :]                # [b,1,1,chunk]
+        if causal:
+            # q_base + r >= k_base + i*chunk + c, as a _causal_mask offset
+            cm = _causal_mask(sq, chunk,
+                              q_base - k_base - i * chunk)[None, None]
+            mask = cm if mask is None else mask + cm
+        o_j, lse_j = _block_attention(
+            q, k_i, v_i, sm_scale, mask, dropout_p, dropout_key,
+            q_base, k_base + i * chunk)
         return _merge(o_acc, lse_acc, o_j, lse_j), None
 
     body = jax.checkpoint(body)
     o0 = jnp.zeros(q.shape, jnp.float32)
     lse0 = jnp.full((b, h, sq), NEG_INF, jnp.float32)
-    (o, lse), _ = lax.scan(body, (o0, lse0),
-                           (k_r, v_r, jnp.arange(n)))
+    xs = (k_r, v_r, jnp.arange(n)) if kpm_r is None else \
+        (k_r, v_r, kpm_r, jnp.arange(n))
+    (o, lse), _ = lax.scan(body, (o0, lse0), xs)
     return o, lse
 
 
 def ring_attention(q, k, v, *, causal: bool = False,
                    sm_scale: Optional[float] = None,
                    axis: str = "sp", mesh=None,
-                   chunk_size: Optional[int] = None):
+                   chunk_size: Optional[int] = None,
+                   key_padding_mask=None,
+                   dropout_p: float = 0.0, dropout_key=None):
     """Exact attention with Q/K/V sequence-sharded over mesh axis ``axis``.
 
     q, k, v: [b, s_global, h, d] GLOBAL arrays (sharded or to-be-sharded
@@ -116,6 +171,12 @@ def ring_attention(q, k, v, *, causal: bool = False,
     logits drop from [s/sp, s/sp] to [s/sp, chunk_size], making the
     per-device attention memory O(s·chunk/sp) (the flash-in-block
     lever for true long context; requires chunk_size | s/sp).
+
+    ``key_padding_mask``: [b, s_global] — bool (True = attend) or
+    additive float. Sequence-sharded and rotated with the K/V ring.
+
+    ``dropout_p`` with ``dropout_key``: attention-weight dropout (see
+    module docstring for the determinism contract).
     """
     from ..parallel.mesh import get_mesh
     mesh = mesh or get_mesh()
@@ -125,6 +186,15 @@ def ring_attention(q, k, v, *, causal: bool = False,
         raise ValueError(f"sequence {s} not divisible by sp={sp}")
     s_local = s // sp
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    if dropout_p and dropout_key is None:
+        raise ValueError("dropout_p > 0 requires dropout_key")
+    if not dropout_p:
+        dropout_key = None
+    kpm = _pad_to_additive(key_padding_mask)
+    if kpm is not None and kpm.shape != (b, s):
+        raise ValueError(
+            f"key_padding_mask must be [batch, seq] = {(b, s)}, got "
+            f"{kpm.shape}")
 
     if chunk_size is not None:
         if chunk_size <= 0:
@@ -137,16 +207,21 @@ def ring_attention(q, k, v, *, causal: bool = False,
     if sp == 1:
         if chunk_size is not None and chunk_size < s:
             out, _ = _block_attention_streamed(
-                q, k, v, scale, 0, 0, causal, chunk_size)
+                q, k, v, scale, 0, 0, causal, chunk_size, kpm,
+                dropout_p, dropout_key)
         else:
-            out, _ = _block_attention(
-                q, k, v, scale,
-                _causal_mask(s, s, 0) if causal else None)
+            mask = None if kpm is None else kpm[:, None, None, :]
+            if causal:
+                cm = _causal_mask(s, s, 0)[None, None]
+                mask = cm if mask is None else mask + cm
+            out, _ = _block_attention(q, k, v, scale, mask,
+                                      dropout_p, dropout_key, 0, 0)
         return out.astype(q.dtype)
 
     spec = P(None, axis, None, None)
+    kpm_spec = P(None, axis)
 
-    def per_shard(q_l, k_l, v_l):
+    def per_shard(q_l, k_l, v_l, kpm_l):
         rank = lax.axis_index(axis)
         ring = [(i, (i + 1) % sp) for i in range(sp)]
 
@@ -154,43 +229,57 @@ def ring_attention(q, k, v, *, causal: bool = False,
         cols = jnp.arange(s_local)
 
         def step(carry, j):
-            k_cur, v_cur, o_acc, lse_acc = carry
+            k_cur, v_cur, kpm_cur, o_acc, lse_acc = carry
             src = (rank - j) % sp  # which rank's chunk we now hold
             if chunk_size is not None and chunk_size < s_local:
                 o_j, lse_j = _block_attention_streamed(
                     q_l, k_cur, v_cur, scale, rank * s_local,
-                    src * s_local, causal, chunk_size)
+                    src * s_local, causal, chunk_size, kpm_cur,
+                    dropout_p, dropout_key)
             else:
+                mask = None if kpm_cur is None else \
+                    kpm_cur[:, None, None, :]
                 if causal:
                     # global positions: q row r -> rank*s_local + r,
                     # k col c -> src*s_local + c; attend iff
                     # q_pos >= k_pos
                     q_pos = rank * s_local + rows[:, None]
                     k_pos = src * s_local + cols[None, :]
-                    mask = jnp.where(q_pos >= k_pos, 0.0, NEG_INF)
-                else:
-                    mask = None
-                o_j, lse_j = _block_attention(q_l, k_cur, v_cur, scale,
-                                              mask)
+                    cm = jnp.where(q_pos >= k_pos, 0.0,
+                                   NEG_INF)[None, None]
+                    mask = cm if mask is None else mask + cm
+                o_j, lse_j = _block_attention(
+                    q_l, k_cur, v_cur, scale, mask, dropout_p,
+                    dropout_key, rank * s_local, src * s_local)
             o_acc, lse_acc = _merge(o_acc, lse_acc, o_j, lse_j)
             k_nxt = lax.ppermute(k_cur, axis, ring)
             v_nxt = lax.ppermute(v_cur, axis, ring)
-            return (k_nxt, v_nxt, o_acc, lse_acc), None
+            kpm_nxt = kpm_cur if kpm_cur is None else \
+                lax.ppermute(kpm_cur, axis, ring)
+            return (k_nxt, v_nxt, kpm_nxt, o_acc, lse_acc), None
 
         o0 = jnp.zeros(q_l.shape, jnp.float32)
         lse0 = jnp.full((b, h, s_local), NEG_INF, jnp.float32)
-        carry, _ = _scan_helper(step, (k_l, v_l, o0, lse0), sp)
-        return carry[2].astype(q_l.dtype)
+        carry, _ = _scan_helper(step, (k_l, v_l, kpm_l, o0, lse0), sp)
+        return carry[3].astype(q_l.dtype)
 
     # partial-manual: only the sp axis is manual (the ring's ppermute
     # needs it); batch/head dims stay in GSPMD auto mode so dp/fsdp/tp
     # shardings of the enclosing step pass through untouched — the same
     # trick the pipeline uses for tp-inside-pp (parallel/pipeline.py)
+    if kpm is None:
+        def no_pad(q_a, k_a, v_a):
+            return per_shard(q_a, k_a, v_a, None)
+        mapped = jax.shard_map(no_pad, mesh=mesh.mesh,
+                               in_specs=(spec, spec, spec),
+                               out_specs=spec, check_vma=False,
+                               axis_names={axis})
+        return mapped(q, k, v)
     mapped = jax.shard_map(per_shard, mesh=mesh.mesh,
-                           in_specs=(spec, spec, spec),
+                           in_specs=(spec, spec, spec, kpm_spec),
                            out_specs=spec, check_vma=False,
                            axis_names={axis})
-    return mapped(q, k, v)
+    return mapped(q, k, v, kpm)
 
 
 def _scan_helper(step, init, n):
