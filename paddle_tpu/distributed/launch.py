@@ -80,8 +80,16 @@ def main(argv=None) -> int:
                              "launch/controllers/collective.py Pod)")
     parser.add_argument("--node_rank", type=int, default=0)
     parser.add_argument("--rdzv_dir", type=str, default=None,
-                        help="shared rendezvous directory (required "
-                             "when --nnodes > 1; NFS/GCS-fuse on pods)")
+                        help="shared rendezvous directory (file "
+                             "backend; NFS/GCS-fuse on pods)")
+    parser.add_argument("--rdzv_backend", type=str, default="file",
+                        choices=("file", "tcp"),
+                        help="rendezvous store: 'file' (shared dir) or "
+                             "'tcp' (rank-0-hosted socket store, ref: "
+                             "distributed/store/tcp_store.h)")
+    parser.add_argument("--rdzv_endpoint", type=str, default=None,
+                        help="host:port of the tcp store (leader binds "
+                             "the port; peers connect)")
     parser.add_argument("--node_timeout", type=float, default=10.0,
                         help="seconds without a peer agent heartbeat "
                              "before declaring the node lost")
@@ -89,15 +97,29 @@ def main(argv=None) -> int:
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
     if args.nnodes > 1:
-        if not args.rdzv_dir:
-            parser.error("--nnodes > 1 requires --rdzv_dir")
+        if args.rdzv_backend == "file" and not args.rdzv_dir:
+            parser.error("--nnodes > 1 requires --rdzv_dir "
+                         "(or --rdzv_backend tcp --rdzv_endpoint)")
+        if args.rdzv_backend == "tcp" and not args.rdzv_endpoint:
+            parser.error("--rdzv_backend tcp requires --rdzv_endpoint")
         from .multinode import NodeAgent
-        return NodeAgent(
-            args.node_rank, args.nnodes, args.nproc_per_node,
-            args.training_script, args.script_args,
-            rdzv_dir=args.rdzv_dir, max_restarts=args.max_restarts,
-            node_timeout=args.node_timeout,
-            log_dir=args.log_dir).run()
+        from .tcp_store import StoreUnavailable
+        try:
+            return NodeAgent(
+                args.node_rank, args.nnodes, args.nproc_per_node,
+                args.training_script, args.script_args,
+                rdzv_dir=args.rdzv_dir, max_restarts=args.max_restarts,
+                node_timeout=args.node_timeout,
+                log_dir=args.log_dir,
+                rdzv_backend=args.rdzv_backend,
+                rdzv_endpoint=args.rdzv_endpoint).run()
+        except StoreUnavailable as e:
+            # leader's store never came up inside the rendezvous
+            # window: same exit as a rendezvous timeout, so the
+            # platform treats it as a job-level restart
+            print(f"[launch] rendezvous store unavailable: {e}",
+                  file=sys.stderr)
+            return 2
     return launch(args.nproc_per_node, args.training_script,
                   args.script_args, master=args.master,
                   log_dir=args.log_dir, max_restarts=args.max_restarts,

@@ -210,12 +210,14 @@ class NodeAgent:
 
     def __init__(self, node_rank: int, nnodes: int, nproc_per_node: int,
                  training_script: str, script_args: List[str],
-                 rdzv_dir: str, max_restarts: int = 0,
+                 rdzv_dir: Optional[str] = None, max_restarts: int = 0,
                  node_timeout: float = 10.0,
                  rdzv_timeout: float = 300.0,
                  log_dir: Optional[str] = None,
                  env_extra: Optional[Dict[str, str]] = None,
-                 poll_interval: float = 0.1):
+                 poll_interval: float = 0.1,
+                 rdzv_backend: str = "file",
+                 rdzv_endpoint: Optional[str] = None):
         self.node_rank = node_rank
         self.nnodes = nnodes
         self.nproc = nproc_per_node
@@ -227,7 +229,22 @@ class NodeAgent:
         self.log_dir = log_dir
         self.env_extra = env_extra or {}
         self.poll_interval = poll_interval
-        self.rdzv = FileRendezvous(rdzv_dir, node_rank, nnodes)
+        if rdzv_backend == "tcp":
+            # clusters without a shared filesystem: rank 0 hosts the
+            # socket store (ref: distributed/store/tcp_store.h)
+            if not rdzv_endpoint:
+                raise ValueError(
+                    "rdzv_backend='tcp' requires rdzv_endpoint "
+                    "host:port (the leader binds it; peers connect)")
+            from .tcp_store import TCPRendezvous
+            self.rdzv = TCPRendezvous(rdzv_endpoint, node_rank, nnodes,
+                                      startup_timeout=rdzv_timeout)
+        elif rdzv_backend == "file":
+            if not rdzv_dir:
+                raise ValueError("rdzv_backend='file' requires rdzv_dir")
+            self.rdzv = FileRendezvous(rdzv_dir, node_rank, nnodes)
+        else:
+            raise ValueError(f"unknown rdzv_backend {rdzv_backend!r}")
         self._procs: List[subprocess.Popen] = []
         self._logs = []
 
@@ -366,6 +383,7 @@ class NodeAgent:
         ``max_generations`` backstops runaway budget-free restart loops
         (a node flapping forever), like the single-host manager's
         ``max_preemptions``."""
+        from .tcp_store import StoreUnavailable
         try:
             while True:
                 generation = self.rdzv.next_generation()
@@ -394,6 +412,13 @@ class NodeAgent:
                     return 0
                 print(f"[multinode {self.node_rank}] generation "
                       f"{generation} -> restart", file=sys.stderr)
+        except StoreUnavailable as e:
+            # tcp backend: the leader hosting the store is gone — on a
+            # platform-scheduled pod that means the job is gone; exit
+            # like a rendezvous timeout and let the platform restart us
+            print(f"[multinode {self.node_rank}] rendezvous store "
+                  f"lost: {e}", file=sys.stderr)
+            return 2
         finally:
             self.rdzv.stop()
             self._teardown()

@@ -1,0 +1,313 @@
+"""Socket-backed rendezvous store for multinode jobs.
+
+Reference being replaced: the TCPStore the reference's collective
+bootstrap runs on (reference: paddle/fluid/distributed/store/
+tcp_store.h — rank 0 hosts a key-value server; peers connect with
+blocking get/set/wait; fleet launch uses it as the master endpoint).
+The file rendezvous (multinode.py FileRendezvous) assumes a shared
+filesystem; real clusters without NFS need exactly this: one socket
+endpoint, known a priori, everything else derived.
+
+Design:
+- ``TCPStoreServer``: a tiny threaded key-value server. Values are
+  JSON; every SET is stamped with SERVER receive time, so liveness
+  ("age of this key") is judged on one clock — no cross-node clock
+  skew in the heartbeat protocol, which the file store could not
+  avoid (mtime is whichever node's NFS client wrote last).
+- ``TCPStoreClient``: one request per connection; the watch loop's
+  polls are absorbed by a 0.25 s read cache in the facade, so the
+  wire carries only a few requests/sec/node and the
+  persistent-connection bookkeeping a busier protocol would need
+  stays out. Retries transient failures, then raises
+  ``StoreUnavailable`` — the leader hosting the store is gone, which
+  on a platform-scheduled pod means the JOB is gone; the NodeAgent
+  maps it to its rendezvous-lost exit.
+- ``TCPRendezvous``: the FileRendezvous-compatible facade (same
+  protocol surface: heartbeats, generation state, restart flags,
+  done flags) over the store. The leader (node 0) hosts the server
+  in-process — rank-0-hosted exactly like the reference's TCPStore.
+
+Wire format: one JSON line request, one JSON line response, per
+connection. Ops: set k v | get k | ages prefix | list prefix.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import socketserver
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+
+class StoreUnavailable(RuntimeError):
+    """The store endpoint is gone (leader dead / never started)."""
+
+
+class TCPStoreServer:
+    """Threaded key-value server with server-side age stamping."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0):
+        self._data: Dict[str, Tuple[str, float]] = {}
+        self._mu = threading.Lock()
+        store = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                try:
+                    line = self.rfile.readline(1 << 20)
+                    req = json.loads(line)
+                    resp = store._dispatch(req)
+                except Exception as e:  # noqa: BLE001 — protocol error
+                    resp = {"ok": False, "error": str(e)[:200]}
+                try:
+                    self.wfile.write(json.dumps(resp).encode() + b"\n")
+                except OSError:
+                    pass  # client went away; its retry will re-ask
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._srv = Server((host, port), Handler)
+        self.port = self._srv.server_address[1]
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def _dispatch(self, req: dict) -> dict:
+        op = req.get("op")
+        now = time.monotonic()
+        with self._mu:
+            if op == "set":
+                self._data[req["k"]] = (req["v"], now)
+                return {"ok": True}
+            if op == "get":
+                ent = self._data.get(req["k"])
+                if ent is None:
+                    return {"ok": True, "v": None, "age": None}
+                return {"ok": True, "v": ent[0], "age": now - ent[1]}
+            if op == "ages":
+                pref = req.get("prefix", "")
+                return {"ok": True, "ages": {
+                    k: now - t for k, (v, t) in self._data.items()
+                    if k.startswith(pref)}}
+            if op == "list":
+                pref = req.get("prefix", "")
+                return {"ok": True, "items": {
+                    k: v for k, (v, t) in self._data.items()
+                    if k.startswith(pref)}}
+        return {"ok": False, "error": f"unknown op {op!r}"}
+
+    def close(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+class TCPStoreClient:
+    def __init__(self, endpoint: str, timeout: float = 5.0,
+                 retries: int = 3, retry_delay: float = 0.3):
+        host, port = endpoint.rsplit(":", 1)
+        self.addr = (host, int(port))
+        self.timeout = timeout
+        self.retries = retries
+        self.retry_delay = retry_delay
+
+    def request(self, req: dict) -> dict:
+        last: Optional[Exception] = None
+        for _ in range(self.retries):
+            try:
+                with socket.create_connection(
+                        self.addr, timeout=self.timeout) as s:
+                    s.sendall(json.dumps(req).encode() + b"\n")
+                    f = s.makefile("rb")
+                    resp = json.loads(f.readline(1 << 20))
+                if not resp.get("ok"):
+                    raise StoreUnavailable(resp.get("error", "store error"))
+                return resp
+            except StoreUnavailable:
+                raise
+            except (OSError, ValueError) as e:
+                last = e
+                time.sleep(self.retry_delay)
+        raise StoreUnavailable(
+            f"rendezvous store at {self.addr} unreachable: {last!r}")
+
+
+AGENT_BEAT_INTERVAL = 0.5
+
+
+class TCPRendezvous:
+    """FileRendezvous-compatible protocol facade over the TCP store.
+
+    Node 0 hosts the server in-process (``serve=True``); every node —
+    including the leader — talks to it through the client, so one code
+    path is tested. Heartbeats are SET requests whose freshness the
+    SERVER judges (single clock)."""
+
+    def __init__(self, endpoint: str, node_rank: int, nnodes: int,
+                 serve: Optional[bool] = None,
+                 startup_timeout: float = 300.0):
+        self.node_rank = node_rank
+        self.nnodes = nnodes
+        self.server: Optional[TCPStoreServer] = None
+        if serve is None:
+            serve = node_rank == 0
+        if serve:
+            host, port = endpoint.rsplit(":", 1)
+            self.server = TCPStoreServer("0.0.0.0", int(port))
+            # port 0 = ephemeral (tests); real jobs pass a fixed port
+            endpoint = f"{host}:{self.server.port}"
+        self.endpoint = endpoint
+        self.client = TCPStoreClient(endpoint)
+        self._stop = threading.Event()
+        self._cache: Dict[str, Tuple[float, dict]] = {}
+        self._wait_server_then_beat(startup_timeout)
+        self._thread = threading.Thread(target=self._beat_loop,
+                                        daemon=True)
+        self._thread.start()
+
+    def _wait_server_then_beat(self, timeout: float):
+        """Followers may start before the leader's server is up — wait
+        the full rendezvous window (the platform may still be
+        provisioning the leader's VM)."""
+        deadline = time.time() + timeout
+        while True:
+            try:
+                self.beat()
+                return
+            except StoreUnavailable:
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.5)
+
+    # -- heartbeats ---------------------------------------------------
+    def beat(self) -> None:
+        self.client.request({"op": "set",
+                             "k": f"agent.{self.node_rank}", "v": "1"})
+
+    def _beat_loop(self) -> None:
+        while not self._stop.wait(AGENT_BEAT_INTERVAL):
+            try:
+                self.beat()
+            except StoreUnavailable:
+                # judged by the watch loop's own store calls
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self.client.request({"op": "set",
+                                 "k": f"bye.{self.node_rank}", "v": "1"})
+        except StoreUnavailable:
+            pass
+        if self.server is not None:
+            # shutdown handshake: peers observe job completion THROUGH
+            # this store, so the leader must not tear it down until
+            # every peer said goodbye (bounded — a killed peer never
+            # will, and its exit path doesn't need the store)
+            deadline = time.time() + 10.0
+            while time.time() < deadline:
+                try:
+                    items = self.client.request(
+                        {"op": "list", "prefix": "bye."})["items"]
+                except StoreUnavailable:
+                    break
+                if all(f"bye.{n}" in items
+                       for n in range(self.nnodes)):
+                    break
+                time.sleep(0.2)
+            self.server.close()
+
+    # The NodeAgent watch loop polls restart/done/heartbeat state every
+    # ~0.1 s; uncached that is ~20-30 connections/s/node multiplying on
+    # the leader's server. Reads served from a 0.25 s TTL cache cut
+    # that to ~8/s/node without touching the protocol's timescales
+    # (node_timeout is seconds); local writes invalidate immediately.
+    _CACHE_TTL = 0.25
+
+    def _cached_request(self, req: dict) -> dict:
+        key = json.dumps(req, sort_keys=True)
+        hit = self._cache.get(key)
+        now = time.monotonic()
+        if hit is not None and now - hit[0] < self._CACHE_TTL:
+            return hit[1]
+        resp = self.client.request(req)
+        self._cache[key] = (now, resp)
+        return resp
+
+    def _write(self, req: dict) -> dict:
+        self._cache.clear()
+        return self.client.request(req)
+
+    def stale_peers(self, timeout: float) -> List[int]:
+        ages = self._cached_request(
+            {"op": "ages", "prefix": "agent."})["ages"]
+        out = []
+        for n in range(self.nnodes):
+            if n == self.node_rank:
+                continue
+            age = ages.get(f"agent.{n}")
+            if age is None or age > timeout:
+                out.append(n)
+        return out
+
+    def peers_all_fresh(self, timeout: float) -> bool:
+        return not self.stale_peers(timeout)
+
+    # -- generation state ---------------------------------------------
+    def read(self) -> Optional[dict]:
+        v = self._cached_request({"op": "get", "k": "rdzv"})["v"]
+        return None if v is None else json.loads(v)
+
+    def publish(self, generation: int, master: str, nproc: int) -> None:
+        self._write({"op": "set", "k": "rdzv", "v": json.dumps({
+            "generation": generation, "master": master,
+            "nnodes": self.nnodes, "nproc_per_node": nproc})})
+
+    def _flag_items(self, generation: int) -> Dict[str, dict]:
+        items = self._cached_request(
+            {"op": "list", "prefix": f"restart.g{generation}.n"})["items"]
+        return {k: json.loads(v) for k, v in items.items()}
+
+    def restart_requested(self, generation: int) -> bool:
+        return bool(self._flag_items(generation))
+
+    def request_restart(self, generation: int, reason: str,
+                        code: int = 0) -> None:
+        self._write({
+            "op": "set",
+            "k": f"restart.g{generation}.n{self.node_rank}",
+            "v": json.dumps({"reason": reason, "code": code,
+                             "node": self.node_rank,
+                             "ts": time.time()})})
+
+    def next_generation(self) -> int:
+        state = self.read()
+        g = int(state["generation"]) if state else 0
+        while self.restart_requested(g):
+            g += 1
+        return g
+
+    def burned_restarts(self, upto_generation: int) -> int:
+        burned = 0
+        for g in range(upto_generation):
+            reasons = [d.get("reason", "failure")
+                       for d in self._flag_items(g).values()]
+            if any(r == "failure" for r in reasons):
+                burned += 1
+        return burned
+
+    def mark_done(self, generation: int) -> None:
+        self._write({
+            "op": "set", "k": f"done.g{generation}.n{self.node_rank}",
+            "v": json.dumps({"node": self.node_rank,
+                             "ts": time.time()})})
+
+    def all_done(self, generation: int) -> bool:
+        items = self._cached_request(
+            {"op": "list", "prefix": f"done.g{generation}.n"})["items"]
+        return all(f"done.g{generation}.n{n}" in items
+                   for n in range(self.nnodes))

@@ -176,12 +176,21 @@ class GPTAttention(Layer):
         # [b, s] KEY-padding masks (the sp contract) are accepted by
         # every branch: the dense paths expand them to the additive
         # [b, 1, 1, s] broadcast form, so an sp-trained padded-batch
-        # config still evaluates on a single device unchanged
+        # config still evaluates on a single device unchanged. The
+        # sentinel is FINITE (softmax over an all--inf row is NaN) and
+        # rows whose whole causal window is padded are zeroed after
+        # attention — exactly what the ring path's fully-masked
+        # handling produces (ops/ring_attention.py), keeping
+        # dense/sp numerics interchangeable even for left-padding.
         dense_mask = attn_mask
+        row_has_key = None
         if attn_mask is not None and attn_mask.ndim == 2:
-            am = jnp.where(attn_mask, 0.0, -jnp.inf) \
-                if attn_mask.dtype == jnp.bool_ else attn_mask
+            kpm_bool = attn_mask if attn_mask.dtype == jnp.bool_ \
+                else attn_mask > -1e29
+            am = jnp.where(kpm_bool, 0.0, -1e30).astype(jnp.float32)
             dense_mask = am[:, None, None, :]
+            # causal: query r has a valid key iff any kpm[:, :r+1]
+            row_has_key = jnp.cumsum(kpm_bool, axis=1) > 0   # [b, s]
         qkv = self.qkv_proj(x)
         q, k, v = jnp.split(
             qkv, [h, h + self.num_kv_heads * hd], axis=-1)
@@ -258,6 +267,8 @@ class GPTAttention(Layer):
                 q, k, v, attn_mask=dense_mask, is_causal=True,
                 dropout_p=self.cfg.attention_dropout,
                 training=self.training, use_flash=self.cfg.use_flash)
+            if row_has_key is not None:
+                out = jnp.where(row_has_key[:, :, None, None], out, 0.0)
         out = self.out_proj(out.reshape(b, s, h))
         if cache is not None:
             return out, cache

@@ -404,3 +404,36 @@ def test_key_padding_mask_works_dense_single_device():
     # causal means rows < 12 can't see cols >= 12 anyway, so compare
     # the full tensors: padded rows DO differ
     assert not np.allclose(out_m, out_p)
+
+
+def test_left_padded_rows_zero_not_nan_dense():
+    """Left padding: queries whose whole causal window is padded come
+    out ZERO on the dense path (finite sentinel + row zeroing), exactly
+    like the ring path's fully-masked handling — train-under-sp then
+    eval-dense stays NaN-free (r5 review finding)."""
+    import paddle_tpu as pt
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    pt.seed(0)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_heads=4, max_position_embeddings=32,
+                    hidden_dropout=0.0, attention_dropout=0.0,
+                    use_flash=False, sequence_parallel=True)
+    net = GPTForCausalLM(cfg)
+    net.eval()
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 64, (2, 16)))
+    kpm = np.ones((2, 16), bool)
+    kpm[:, :4] = False                     # LEFT padding
+    dense = np.asarray(net(ids, attn_mask=jnp.asarray(kpm)))
+    assert np.isfinite(dense).all()
+    mesh = parallel.init_mesh(sp=4, dp=2)
+    try:
+        from paddle_tpu.nn.layer import functional_call, split_state
+        p_, b_ = split_state(net)
+        ring = jax.jit(lambda p, i, m: functional_call(
+            net, p, b_, i, None, m, training=False)[0])(
+                p_, ids, jnp.asarray(kpm))
+    finally:
+        parallel.set_mesh(None)
+    np.testing.assert_allclose(dense, np.asarray(ring), atol=2e-5,
+                               rtol=2e-5)
