@@ -186,7 +186,8 @@ def _conv_dim_numbers(ndim: int, channels_last: bool):
 
 
 def conv_nd(x, weight, bias=None, stride=1, padding=0, dilation=1,
-            groups: int = 1, data_format: str = "NCHW"):
+            groups: int = 1, data_format: str = "NCHW",
+            preferred_element_type=None):
     from .. import amp
     x, weight = amp.white_cast(x, weight, op="conv2d")
     ndim = x.ndim - 2
@@ -204,7 +205,9 @@ def conv_nd(x, weight, bias=None, stride=1, padding=0, dilation=1,
         x, weight, window_strides=stride, padding=pad,
         rhs_dilation=dilation, dimension_numbers=dn,
         feature_group_count=groups,
-        preferred_element_type=jnp.result_type(x.dtype, weight.dtype))
+        # int8 x int8 (quant serving) must accumulate in int32
+        preferred_element_type=preferred_element_type
+        or jnp.result_type(x.dtype, weight.dtype))
     if bias is not None:
         if channels_last:
             out = out + bias

@@ -204,6 +204,149 @@ class QATLinear(Layer):
         return F.linear(x, wq, self.inner.bias)
 
 
+class QuantizedConv2D(Layer):
+    """Int8 conv (ref: the mkldnn int8 conv path the reference serves
+    CNNs through, fluid/inference/api/mkldnn_quantizer.cc + TRT int8).
+
+    Weights stored int8 OIHW with per-OUT-channel absmax scales
+    [O,1,1,1] (the reference's channel_wise_abs_max for conv); with a
+    calibrated ``act_scale`` the forward quantizes activations and runs
+    an int8xint8 conv accumulating in int32 — the MXU's integer path —
+    then rescales; without one it is weight-only (dequant fused into
+    the conv's operand load by XLA)."""
+
+    def __init__(self, conv, bits: int = 8, act_scale=None):
+        super().__init__()
+        self.bits = bits
+        self.stride = conv.stride
+        self.padding = conv.padding
+        self.dilation = conv.dilation
+        self.groups = conv.groups
+        self.data_format = conv.data_format
+        q, s = quantize_weight(conv.weight, axis=(1, 2, 3), bits=bits)
+        self.register_buffer("qweight", q)
+        self.register_buffer("wscale", s)          # [O, 1, 1, 1]
+        self.register_buffer("bias", conv.bias)
+        self.register_buffer(
+            "act_scale",
+            None if act_scale is None
+            else jnp.asarray(act_scale, jnp.float32))
+
+    def _out_scale(self, ndim_out: int):
+        # [O,1,1,1] -> broadcast over NCHW/NHWC output layout
+        s = self.wscale.reshape(-1)
+        if self.data_format == "NHWC":
+            return s
+        return s.reshape((1, -1) + (1,) * (ndim_out - 2))
+
+    def forward(self, x):
+        if self.act_scale is not None:
+            qmax = 2 ** (self.bits - 1) - 1
+            qx = jnp.clip(jnp.round(x / self.act_scale),
+                          -qmax, qmax).astype(jnp.int8)
+            acc = F.conv_nd(qx, self.qweight, None, self.stride,
+                            self.padding, self.dilation, self.groups,
+                            self.data_format,
+                            preferred_element_type=jnp.int32)
+            y = acc.astype(jnp.float32) * self.act_scale * \
+                self._out_scale(acc.ndim)
+        else:
+            w = dequantize_weight(self.qweight, self.wscale, x.dtype)
+            y = F.conv_nd(x, w, None, self.stride, self.padding,
+                          self.dilation, self.groups, self.data_format)
+        if self.bias is not None:
+            bias = self.bias if self.data_format == "NHWC" else \
+                self.bias.reshape((1, -1) + (1,) * (y.ndim - 2))
+            y = y + bias
+        return y
+
+
+def fold_conv_bn(net: Layer, example_inputs) -> int:
+    """Fold inference-mode BatchNorm into the preceding conv
+    (ref: the quant passes' conv-bn fuse, slim/quantization/
+    quantization_pass.py _fuse_conv_bn; mkldnn_quantizer.cc assumes
+    fused conv). Pairing is discovered by TRACING one eager forward —
+    a BN whose input IS a conv's output object (nothing in between)
+    folds — so any container structure works, and conv→relu→bn or
+    shared convs are correctly left alone. Returns #pairs folded.
+
+    ASSUMPTION (the standard conv-bn idiom): a folded conv's output is
+    consumed ONLY by its BN. A net where the raw conv output fans out
+    to another consumer besides the BN (e.g. ``bn(y) + y``) would see
+    that consumer's values change after folding — layer hooks cannot
+    observe raw-op consumers, so exclude such convs via the net's
+    structure (don't fold, or quantize weight-only without folding).
+
+    Math: y = gamma*(conv(x)+b-mean)/sqrt(var+eps)+beta collapses to
+    conv'(x)+b' with W' = W*s_o, b' = (b-mean)*s + beta,
+    s = gamma/sqrt(var+eps) per out-channel. BNs are replaced by
+    identity layers in place."""
+    from ..nn.layers.conv import Conv2D
+    from ..nn.layers.norm import _BatchNormBase
+
+    pairs = []
+    # keep the output OBJECT alive alongside the owner: a bare id()
+    # key could be reused by a later allocation after the conv output
+    # is freed, falsely pairing a BN across an intervening op
+    out_owner: Dict[int, tuple] = {}
+    hooks = []
+
+    def conv_post(layer, args, out):
+        out_owner[id(out)] = (layer, out)
+
+    def bn_pre(layer, args):
+        ent = out_owner.get(id(args[0]))
+        if ent is not None and ent[1] is args[0]:
+            pairs.append((ent[0], layer))
+
+    for sub in net.sublayers(include_self=True):
+        if isinstance(sub, Conv2D):
+            hooks.append(sub.register_forward_post_hook(conv_post))
+        elif isinstance(sub, _BatchNormBase):
+            hooks.append(sub.register_forward_pre_hook(bn_pre))
+    was_training = net.training
+    net.eval()
+    try:
+        ex = example_inputs if isinstance(example_inputs, (tuple, list)) \
+            else (example_inputs,)
+        net(*ex)
+    finally:
+        for h in hooks:
+            h.remove()
+        if was_training:
+            net.train()
+
+    # one-to-one only: a conv feeding two BNs (weight sharing) or a BN
+    # fed by two convs cannot fold into a single weight rewrite
+    from collections import Counter
+    conv_uses = Counter(id(c) for c, _ in pairs)
+    bn_uses = Counter(id(b) for _, b in pairs)
+    folded_bns = {}
+    for conv, bn in pairs:
+        if conv_uses[id(conv)] != 1 or bn_uses[id(bn)] != 1:
+            continue
+        s = (bn.weight if bn.weight is not None else 1.0) / \
+            jnp.sqrt(bn._variance + bn.epsilon)
+        conv.weight = conv.weight * s.reshape(-1, 1, 1, 1)
+        b0 = conv.bias if conv.bias is not None else 0.0
+        beta = bn.bias if bn.bias is not None else 0.0
+        new_bias = (b0 - bn._mean) * s + beta
+        if conv.bias is not None:
+            conv.bias = new_bias
+        else:
+            conv.bias = conv.create_parameter(
+                [conv.weight.shape[0]],
+                initializer=lambda shape, dtype=None: new_bias)
+        folded_bns[id(bn)] = True
+
+    class _Identity(Layer):
+        def forward(self, x):
+            return x
+
+    return _swap_layers(net, lambda l: id(l) in folded_bns,
+                        lambda l: _Identity())
+
+
 # ---------------------------------------------------------------------------
 # model transforms
 # ---------------------------------------------------------------------------
@@ -222,14 +365,19 @@ def quantize_post_training(net: Layer, calibration_batches=None,
                            bits: int = 8,
                            quant_act: Optional[bool] = None,
                            skip=lambda layer: False) -> int:
-    """PTQ in place: swap every nn.Linear for QuantizedLinear
-    (ref: PostTrainingQuantization.quantize). Passing
-    ``calibration_batches`` runs them through the net first, observing
-    per-layer input absmax to set activation scales (absmax
-    calibration) — int8 activations, like the reference, which always
-    calibrates when given data. Without batches the result is
-    weight-only int8. Returns #layers swapped."""
+    """PTQ in place: swap every nn.Linear for QuantizedLinear and
+    every nn.Conv2D for QuantizedConv2D
+    (ref: PostTrainingQuantization.quantize; conv int8 path:
+    mkldnn_quantizer.cc). Passing ``calibration_batches`` runs them
+    through the net first, observing per-layer input absmax to set
+    activation scales (absmax calibration) — int8 activations, like
+    the reference, which always calibrates when given data. Without
+    batches the result is weight-only int8. Run
+    :func:`fold_conv_bn` FIRST for conv nets — a BN between conv and
+    the next layer otherwise re-scales the carefully-quantized output
+    ranges. Returns #layers swapped."""
     from ..nn.layers.common import Linear
+    from ..nn.layers.conv import Conv2D
 
     if quant_act is None:
         quant_act = calibration_batches is not None
@@ -244,7 +392,7 @@ def quantize_post_training(net: Layer, calibration_batches=None,
         observed: Dict[int, float] = {}
         hooks = []
         for layer in net.sublayers(include_self=True):
-            if isinstance(layer, Linear):
+            if isinstance(layer, (Linear, Conv2D)):
                 def hook(l, args, _observed=observed):
                     x = args[0]
                     m = float(jnp.max(jnp.abs(x)))
@@ -258,10 +406,17 @@ def quantize_post_training(net: Layer, calibration_batches=None,
             h.remove()
         act_scales = {k: max(v, 1e-8) / qmax for k, v in observed.items()}
 
+    def build(layer):
+        if isinstance(layer, Conv2D):
+            return QuantizedConv2D(layer, bits=bits,
+                                   act_scale=act_scales.get(id(layer)))
+        return QuantizedLinear.from_linear(
+            layer, bits=bits, act_scale=act_scales.get(id(layer)))
+
     return _swap_layers(
-        net, lambda l: isinstance(l, Linear) and not skip(l),
-        lambda l: QuantizedLinear.from_linear(
-            l, bits=bits, act_scale=act_scales.get(id(l))))
+        net,
+        lambda l: isinstance(l, (Linear, Conv2D)) and not skip(l),
+        build)
 
 
 def prepare_qat(net: Layer, bits: int = 8, quant_act: bool = True) -> int:
