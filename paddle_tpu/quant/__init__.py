@@ -291,7 +291,10 @@ def fold_conv_bn(net: Layer, example_inputs) -> int:
     out_owner: Dict[int, tuple] = {}
     hooks = []
 
+    fires: Dict[int, int] = {}
+
     def conv_post(layer, args, out):
+        fires[id(layer)] = fires.get(id(layer), 0) + 1
         out_owner[id(out)] = (layer, out)
 
     def bn_pre(layer, args):
@@ -317,13 +320,17 @@ def fold_conv_bn(net: Layer, example_inputs) -> int:
             net.train()
 
     # one-to-one only: a conv feeding two BNs (weight sharing) or a BN
-    # fed by two convs cannot fold into a single weight rewrite
+    # fed by two convs cannot fold into a single weight rewrite; a
+    # conv INVOKED more than once (weight tying) is also out even if
+    # only one invocation met a BN — the other call path would see the
+    # rescaled weights
     from collections import Counter
     conv_uses = Counter(id(c) for c, _ in pairs)
     bn_uses = Counter(id(b) for _, b in pairs)
     folded_bns = {}
     for conv, bn in pairs:
-        if conv_uses[id(conv)] != 1 or bn_uses[id(bn)] != 1:
+        if conv_uses[id(conv)] != 1 or bn_uses[id(bn)] != 1 or \
+                fires.get(id(conv), 0) != 1:
             continue
         s = (bn.weight if bn.weight is not None else 1.0) / \
             jnp.sqrt(bn._variance + bn.epsilon)
@@ -339,12 +346,9 @@ def fold_conv_bn(net: Layer, example_inputs) -> int:
                 initializer=lambda shape, dtype=None: new_bias)
         folded_bns[id(bn)] = True
 
-    class _Identity(Layer):
-        def forward(self, x):
-            return x
-
+    from ..nn.layers.common import Identity
     return _swap_layers(net, lambda l: id(l) in folded_bns,
-                        lambda l: _Identity())
+                        lambda l: Identity())
 
 
 # ---------------------------------------------------------------------------

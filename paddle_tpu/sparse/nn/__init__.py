@@ -1,0 +1,3 @@
+"""paddle_tpu.sparse.nn (ref: python/paddle/incubate/sparse/nn)."""
+
+from . import functional  # noqa: F401
