@@ -19,6 +19,7 @@ from . import launch  # noqa
 from . import elastic  # noqa
 from . import fleet  # noqa
 from . import fs  # noqa
+from . import index_dataset  # noqa
 from .elastic import ElasticManager, ElasticStatus, Heartbeat  # noqa
 from .spawn import ProcessContext, spawn  # noqa
 from .comm import (  # noqa: E402,F401

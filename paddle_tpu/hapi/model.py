@@ -350,14 +350,43 @@ class Model:
             cbks.on_epoch_begin(epoch)
             for m in self._metrics:
                 m.reset()
-            for step, batch in enumerate(loader):
+            # model-perspective buckets for profiler.summary(): no-ops
+            # unless a Profiler is active (ref: profiler_statistic.py
+            # model perspective — Dataloader/Forward/.../Optimizer; the
+            # compiled step fuses fwd+bwd+opt, so the TPU-side split is
+            # Dataloader / TrainStep / Callbacks)
+            from ..profiler import _events as _prof_events
+            from ..profiler import RecordEvent as _Rec
+            profiling = _prof_events.active
+            it = iter(loader)
+            step = 0
+            while True:
+                if profiling:
+                    with _Rec("Dataloader"):
+                        batch = next(it, None)
+                else:
+                    batch = next(it, None)
+                if batch is None:
+                    break
                 cbks.on_train_batch_begin(step)
                 inputs, labels = self._split_batch(batch)
-                logs = self.train_batch(inputs, labels)
-                cbks.on_train_batch_end(step, logs)
+                if profiling:
+                    with _Rec("TrainStep"):
+                        logs = self.train_batch(inputs, labels)
+                    with _Rec("Callbacks"):
+                        cbks.on_train_batch_end(step, logs)
+                else:
+                    logs = self.train_batch(inputs, labels)
+                    cbks.on_train_batch_end(step, logs)
+                step += 1
             if eval_loader is not None and epoch % eval_freq == 0:
-                eval_logs = self.evaluate(eval_loader, verbose=0,
-                                          _callbacks=cbks)
+                if profiling:
+                    with _Rec("Eval"):
+                        eval_logs = self.evaluate(eval_loader, verbose=0,
+                                                  _callbacks=cbks)
+                else:
+                    eval_logs = self.evaluate(eval_loader, verbose=0,
+                                              _callbacks=cbks)
                 logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
             cbks.on_epoch_end(epoch, logs)
         cbks.on_train_end(logs)

@@ -38,6 +38,15 @@ class ProfilerTarget(enum.Enum):
     TPU = 1
 
 
+class SortedKeys(enum.Enum):
+    """Sort keys for summary tables (ref: profiler_statistic.py
+    SortedKeys — the CPU* family; device time lives in XProf)."""
+    CPUTotal = "total"
+    CPUAvg = "avg"
+    CPUMax = "max"
+    Calls = "calls"
+
+
 class ProfilerState(enum.Enum):
     """ref: profiler/profiler.py:34 ProfilerState."""
     CLOSED = 0
@@ -199,21 +208,48 @@ class Profiler:
         self.stop()
 
     # -- host-side stats (ref: profiler/profiler_statistic.py tables) ----
-    def summary(self, sorted_by: str = "total") -> str:
-        rows = []
+    def summary(self, sorted_by="total") -> str:
+        """Statistic report (ref: profiler_statistic.py SummaryView):
+        a model-perspective table (Dataloader / TrainStep / Callbacks
+        buckets, auto-recorded by ``Model.fit`` while profiling, with
+        time ratios) followed by the full host-event table. Device-side
+        kernel timelines live in the XProf trace under ``log_dir``
+        (view with xprof/tensorboard); the host tables cover what the
+        reference's CPU-time columns did."""
+        if isinstance(sorted_by, SortedKeys):
+            sorted_by = sorted_by.value
         with _events.lock:
             snapshot = {k: list(v) for k, v in _events.stats.items()}
-        for name, times in snapshot.items():
-            rows.append((name, len(times), sum(times),
-                         sum(times) / len(times), max(times)))
+        rows = [(name, len(t), sum(t), sum(t) / len(t), max(t))
+                for name, t in snapshot.items()]
         key = {"total": 2, "avg": 3, "max": 4, "calls": 1}[sorted_by]
         rows.sort(key=lambda r: -r[key])
-        lines = [f"{'Event':<40}{'Calls':>8}{'Total(s)':>12}"
-                 f"{'Avg(s)':>12}{'Max(s)':>12}"]
-        for name, calls, total, avg, mx in rows:
-            lines.append(f"{name[:39]:<40}{calls:>8}{total:>12.6f}"
-                         f"{avg:>12.6f}{mx:>12.6f}")
-        return "\n".join(lines)
+
+        def table(title, rs, extra_ratio_of=None):
+            lines = [title,
+                     f"{'Event':<40}{'Calls':>8}{'Total(s)':>12}"
+                     f"{'Avg(s)':>12}{'Max(s)':>12}" +
+                     (f"{'Ratio':>9}" if extra_ratio_of else "")]
+            for name, calls, total, avg, mx in rs:
+                line = (f"{name[:39]:<40}{calls:>8}{total:>12.6f}"
+                        f"{avg:>12.6f}{mx:>12.6f}")
+                if extra_ratio_of:
+                    line += f"{100.0 * total / extra_ratio_of:>8.1f}%"
+                lines.append(line)
+            return lines
+
+        out = []
+        perspective = [r for r in rows
+                       if r[0] in ("Dataloader", "TrainStep",
+                                   "Callbacks", "Eval")]
+        if perspective:
+            wall = sum(r[2] for r in perspective)
+            out += table("---- Model Perspective "
+                         "(ref: model summary table) ----",
+                         perspective, extra_ratio_of=wall)
+            out.append("")
+        out += table("---- Host Events ----", rows)
+        return "\n".join(out)
 
 
 @contextlib.contextmanager

@@ -321,22 +321,32 @@ def softmax(sp: SparseCooTensor, axis: int = -1) -> SparseCooTensor:
             "ids built from all leading index columns)")
     # nse pinned so the op stays jit-able (abstract evaluation cannot
     # shrink the buffer; duplicate slots merge values and pad with
-    # out-of-range indices, which the segment ops then drop)
+    # out-of-range indices, which segment_softmax zeroes)
     b = sp._bcoo.sum_duplicates(nse=sp._bcoo.nse)
-    rows = b.indices[:, 0]
-    n_rows = b.shape[0]
-    import jax
-    row_max = jax.ops.segment_max(b.data, rows, n_rows)
-    e = jnp.exp(b.data - row_max[rows])
-    denom = jax.ops.segment_sum(e, rows, n_rows)
-    # padded slots (sum_duplicates' out-of-bounds indices) must keep
-    # ZERO data per the BCOO padding convention — the gather above
-    # clamps their row and would otherwise store exp-garbage (or inf
-    # when the clamped row is empty) into the output values
-    vals = jnp.where(rows < n_rows,
-                     e / jnp.maximum(denom[rows], 1e-37), 0.0)
+    vals = segment_softmax(b.data, b.indices[:, 0], b.shape[0])
     return SparseCooTensor(jsparse.BCOO((vals, b.indices),
                                         shape=b.shape))
+
+
+def segment_softmax(vals, rows, n_rows):
+    """Softmax over the value groups sharing a row id — the shared
+    core of sparse ``softmax`` and ``nn.functional.attention``.
+
+    Padded / out-of-range slots (``rows >= n_rows``, the BCOO
+    sum_duplicates padding convention) come out ZERO; the masking is
+    applied BEFORE the exp (double-where), because a padded slot's
+    clamped row-max gather can be -inf (empty last row) and
+    ``where(…, exp(inf), 0)`` would still poison reverse-mode with
+    0·inf = NaN."""
+    import jax
+    valid = rows < n_rows
+    row_max = jax.ops.segment_max(vals, rows, n_rows)  # OOB dropped
+    gm = row_max[jnp.clip(rows, 0, max(n_rows - 1, 0))]
+    shifted = jnp.where(valid & jnp.isfinite(gm), vals - gm, 0.0)
+    e = jnp.exp(shifted) * valid
+    den = jax.ops.segment_sum(e, rows, n_rows)
+    dg = den[jnp.clip(rows, 0, max(n_rows - 1, 0))]
+    return jnp.where(valid & (dg > 0), e / jnp.maximum(dg, 1e-37), 0.0)
 
 
 def addmm(input, x: SparseCooTensor, y, beta: float = 1.0,

@@ -50,15 +50,9 @@ def attention(query, key, value, sparse_mask: SparseCooTensor,
     logits = jnp.einsum("bnd,bnd->bn", q[:, rows, :],
                         k[:, cols, :]) * scale       # [bh, nnz]
 
-    def row_softmax(vals):
-        m = jax.ops.segment_max(vals, rows, s)
-        e = jnp.exp(vals - m[rows])
-        den = jax.ops.segment_sum(e, rows, s)
-        # rows absent from the pattern: 0, not NaN
-        return jnp.where(den[rows] > 0, e / jnp.maximum(den[rows], 1e-37),
-                         0.0)
-
-    p = jax.vmap(row_softmax)(logits)                # [bh, nnz]
+    from .. import segment_softmax
+    p = jax.vmap(lambda lv: segment_softmax(lv, rows, s))(
+        logits)                                      # [bh, nnz]
     out = jax.vmap(
         lambda pv, vg: jax.ops.segment_sum(pv[:, None] * vg, rows, s))(
             p, v[:, cols, :])                        # [bh, s, d]

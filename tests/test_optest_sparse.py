@@ -67,14 +67,14 @@ def _np_softmax_values(vals):
     return np.nan_to_num(p)[ROWS, COLS]
 
 
-_CAUSAL8 = np.zeros((8, 8), np.float32)
-_CAUSAL8[np.tril_indices(8)] = 1.0
-# built OUTSIDE the jitted op: fromdense needs a concrete nse
-_CAUSAL8_SP = sparse.SparseCooTensor.from_dense(jnp.asarray(_CAUSAL8))
-
-
 def _attention(q, k, v):
-    return SF.attention(q, k, v, _CAUSAL8_SP)
+    # pattern built per call from numpy constants: static nnz (no
+    # fromdense/concrete-nse issue), nothing device-side at pytest
+    # collection, and no tracer-backed arrays cached across jits
+    r, c = np.tril_indices(8)
+    sp = sparse.sparse_coo_tensor(np.stack([r, c]),
+                                  np.ones(len(r), np.float32), (8, 8))
+    return SF.attention(q, k, v, sp)
 
 
 def _np_attention(q, k, v):

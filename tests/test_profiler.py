@@ -58,3 +58,34 @@ def test_stat_registry():
     reg.set("lr", 0.1)
     snap = reg.snapshot()
     assert snap["lr"] == 0.1 and snap["batches"] == 4
+
+
+def test_summary_model_perspective_table(tmp_path):
+    """Model.fit under an active Profiler auto-fills the
+    Dataloader/TrainStep/Callbacks buckets and summary() renders the
+    reference-style model-perspective table with ratios
+    (ref: profiler_statistic.py SummaryView model table)."""
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu import nn
+    from paddle_tpu.profiler import Profiler, SortedKeys
+
+    pt.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+    m = pt.Model(net)
+    m.prepare(optimizer=pt.optimizer.SGD(learning_rate=0.1,
+                                         parameters=net),
+              loss=nn.CrossEntropyLoss())
+    from paddle_tpu.io import TensorDataset
+    x = np.random.RandomState(0).randn(64, 8).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 2, (64, 1))
+    prof = Profiler(log_dir=str(tmp_path / "prof"))
+    prof.start()
+    m.fit(TensorDataset([x, y]), batch_size=16, epochs=2, verbose=0)
+    prof.stop()
+    rep = prof.summary(sorted_by=SortedKeys.CPUTotal)
+    assert "Model Perspective" in rep
+    for bucket in ("Dataloader", "TrainStep", "Callbacks"):
+        assert bucket in rep, rep
+    assert "%" in rep and "Host Events" in rep

@@ -1,0 +1,110 @@
+"""TreeIndex / layerwise sampler (the TDM retrieval index; ref:
+python/paddle/distributed/fleet/dataset/index_dataset.py TreeIndex,
+distributed/index_dataset/index_wrapper.h:33, index_sampler.h
+LayerWiseSampler) — closes the last 'absent' inventory row."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.index_dataset import TreeIndex
+
+pytestmark = pytest.mark.slow  # smoke tier skips (tools/ci.sh --smoke)
+
+
+def test_tree_structure_and_codes():
+    items = list(range(100, 110))          # 10 items
+    t = TreeIndex.from_items("t", items, branch=2)
+    assert t.branch() == 2
+    assert t.height() == 5                 # 16 leaf slots at level 4
+    leafs = t.get_all_leafs()
+    assert [n.id() for n in leafs] == items
+    assert all(n.is_leaf() for n in leafs)
+    # travel codes: leaf -> root, parent relation holds
+    path = t.get_travel_codes(items[3])
+    assert len(path) == 5 and path[-1] == 0
+    for child, parent in zip(path, path[1:]):
+        assert (child - 1) // 2 == parent
+    # ancestor at level 1 consistent with travel
+    anc = t.get_ancestor_codes([items[3]], 1)[0]
+    assert anc == path[-2]
+    assert t.get_pi_relation([items[3]], 1) == {items[3]: anc}
+    # children of root at level 2 are exactly the level-2 codes
+    assert sorted(t.get_children_codes(0, 2)) == \
+        t.get_layer_codes(2).tolist()
+    # travel path child->ancestor excludes the ancestor
+    tp = t.get_travel_path(path[0], path[2])
+    assert tp == [path[0], path[1]]
+    # node ids: leaves keep item ids; ancestors get fresh ids
+    assert t.emb_size() > max(items)
+    assert t.total_node_nums() == sum(
+        len(t.get_layer_codes(lv)) for lv in range(t.height()))
+
+
+def test_save_load_roundtrip(tmp_path):
+    t = TreeIndex.from_items("t", [5, 7, 9, 11], branch=2)
+    p = str(tmp_path / "tree.npz")
+    t.save(p)
+    t2 = TreeIndex("t2", p)
+    assert t2.height() == t.height()
+    assert [n.id() for n in t2.get_all_leafs()] == [5, 7, 9, 11]
+    assert t2.get_travel_codes(9) == t.get_travel_codes(9)
+
+
+def test_embedding_tree_clusters_similar_items():
+    """Items with similar embeddings share deeper subtrees: two tight
+    clusters end up split at the root."""
+    rng = np.random.RandomState(0)
+    a = rng.randn(8, 4) * 0.1 + 5.0
+    b = rng.randn(8, 4) * 0.1 - 5.0
+    embs = np.concatenate([a, b])
+    ids = list(range(16))
+    t = TreeIndex.from_embeddings("e", ids, embs, branch=2)
+    side = {i: t.get_ancestor_codes([i], 1)[0] for i in ids}
+    left = {side[i] for i in range(8)}
+    right = {side[i] for i in range(8, 16)}
+    assert len(left) == 1 and len(right) == 1 and left != right
+
+
+def test_layerwise_sampler_reference_format():
+    items = list(range(200, 216))
+    t = TreeIndex.from_items("t", items, branch=2)
+    counts = [1, 2, 2, 3]                   # height 5, start layer 1
+    t.init_layerwise_sampler(counts, start_sample_layer=1, seed=0)
+    users = [[1, 2], [3, 4]]
+    rows = t.layerwise_sample(users, [items[0], items[5]])
+    # per pair: one positive + counts[j] negatives per layer
+    per_pair = sum(1 + c for c in counts)
+    assert len(rows) == 2 * per_pair
+    for row in rows:
+        assert len(row) == 4                # 2 user feats + node + label
+        assert row[-1] in (0, 1)
+    pos = [r for r in rows if r[-1] == 1]
+    assert len(pos) == 2 * len(counts)
+    # positives on the first pair's path are its ancestors' ids
+    path_ids = {t._id_by_code[c]
+                for c in t.get_travel_codes(items[0], 1)}
+    assert {r[2] for r in pos[:len(counts)]} <= path_ids
+
+
+def test_layerwise_sampler_fixed_shape_arrays():
+    items = list(range(32))
+    t = TreeIndex.from_items("t", items, branch=2)
+    counts = [2, 4, 8, 8, 8][:t.height() - 1]
+    t.init_layerwise_sampler(counts, seed=1)
+    ids, labels, mask = t._layerwise_sampler.sample_arrays(
+        np.asarray([0, 17, 31]))
+    B, L, W = ids.shape
+    assert (B, L, W) == (3, len(counts), 1 + max(counts))
+    assert (labels[:, :, 0] == 1).all() and (labels[:, :, 1:] == 0).all()
+    assert mask[:, :, 0].all()
+    # negatives are distinct from the positive within a layer
+    for b in range(B):
+        for j in range(L):
+            negs = ids[b, j, 1:][mask[b, j, 1:]]
+            assert ids[b, j, 0] not in negs
+
+
+def test_sampler_count_validation():
+    t = TreeIndex.from_items("t", list(range(8)), branch=2)
+    with pytest.raises(ValueError, match="needs"):
+        t.init_layerwise_sampler([1, 2])    # wrong layer count
