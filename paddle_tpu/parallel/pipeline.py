@@ -50,6 +50,30 @@ With remat the per-rank residual is smaller than 1F1B's whenever
 transformer blocks at realistic microbatch counts; the recompute cost is
 one extra forward, the standard TPU trade.
 
+LONG-SEQUENCE DECISION RECORD (r5; boundary = [mb, s, h] grows with s):
+the 1F1B-style bounded-activation schedule is expressed here as
+WAVE-ACCUMULATION, not a new schedule: run the pipeline with
+``num_microbatches = w`` (a wave, e.g. w = pp) and accumulate grads
+across ``m/w`` waves — per jitted step with an inner fori/grad loop, or
+across trainer steps with the existing gradient-accumulation facility.
+Each wave's backward residuals are freed before the next wave, so the
+per-rank boundary set is ``w·v + pp - 1`` — independent of total
+microbatch count, which is 1F1B's bounded-memory property (1F1B keeps
+<= pp microbatches in flight; w = pp matches it). Measured with XLA's
+compiled memory analysis (tools/pp_longseq_memory.py, pp=4, 16
+microbatches, wave=4; per-device temp bytes, CPU-mesh compile):
+
+    s=4096   single-scan  58.0 MiB   wave=4  30.1 MiB   ratio 0.52
+    s=8192   single-scan 116.1 MiB   wave=4  60.1 MiB   ratio 0.52
+    s=16384  single-scan 232.1 MiB   wave=4 120.1 MiB   ratio 0.52
+
+(boundaries alone predict 7/19 = 0.37; the measured 0.52 includes the
+fori carry of accumulated grads and the input slice.) The trade is the
+per-wave fill/drain bubble, (pp-1)/(w·v+pp-1) vs the single scan's
+(pp-1)/(m·v+pp-1) — exactly the bubble 1F1B's schedule pays against
+steady-state GPipe. Pinned by tests/test_pipeline.py
+test_wave_accumulation_bounds_boundary_memory.
+
 Tensor parallelism INSIDE the pipeline (the reference's mp×pp hybrid,
 fleet/meta_optimizers/sharding_optimizer.py:123-135 wrap order): the
 shard_map is *partially manual* — manual over ``pp`` only

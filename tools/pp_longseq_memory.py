@@ -1,0 +1,120 @@
+"""Long-sequence pipeline memory study (VERDICT r4 item 8).
+
+Question: the SPMD pipeline is GPipe-with-remat — its backward holds
+one BOUNDARY activation per tick, (m·v + pp - 1) of them, each
+[mb, s, h]. At s >= 8k does that beat a 1F1B-style bounded schedule?
+
+Answer measured here: the bounded-activation schedule is ALREADY
+EXPRESSIBLE as wave-accumulation — run the pipeline scan on a WAVE of
+w microbatches, jax.grad per wave, accumulate grads across m/w waves
+inside one jitted step (lax.fori or an unrolled loop; the trainer's
+gradient-accumulation facility composes the same way across steps).
+Per-wave backward residuals are freed before the next wave, so the
+boundary set is (w·v + pp - 1) per rank — independent of the total
+microbatch count, which is exactly 1F1B's bounded-memory property
+(1F1B holds <= pp in-flight microbatches; a wave of w = pp matches it)
+— while the bubble grows from (pp-1)/(m·v+pp-1) to per-wave
+(pp-1)/(w·v+pp-1), the same memory/bubble trade 1F1B's schedule makes
+against steady-state GPipe.
+
+Run: python tools/pp_longseq_memory.py  (8-device CPU mesh)
+Prints per-device temp bytes per (s, schedule) and the ratio.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8"
+                           ).strip()
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+import paddle_tpu as pt  # noqa: E402
+from paddle_tpu import nn, parallel  # noqa: E402
+from paddle_tpu.cost_model import memory_profile  # noqa: E402
+from paddle_tpu.nn.layer import functional_call, split_state  # noqa: E402
+from paddle_tpu.parallel.pipeline import (LayerDesc,  # noqa: E402
+                                          PipelineLayer,
+                                          PipelineParallel)
+
+H = 64
+PP = 4
+
+
+class SeqBlock(nn.Layer):
+    """[mb, s, H] -> [mb, s, H] MLP block: internals are recomputed by
+    the chunk remat, so compiled temps expose exactly the BOUNDARY
+    activation story the schedules differ on."""
+
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(H, 4 * H)
+        self.fc2 = nn.Linear(4 * H, H)
+
+    def forward(self, x):
+        return x + self.fc2(jax.nn.gelu(self.fc1(x)))
+
+
+def temp_bytes(s: int, total_mb: int, wave: int) -> int:
+    """Per-device temp bytes of one compiled train step processing
+    ``total_mb`` microbatches of [1, s, H] through a pp=4 pipeline,
+    ``wave`` microbatches per pipeline scan, grads accumulated across
+    waves inside the step."""
+    pt.seed(0)
+    mesh = parallel.init_mesh(pp=PP, dp=8 // PP)
+    try:
+        pipe = PipelineLayer([LayerDesc(SeqBlock) for _ in range(PP)],
+                             num_stages=PP)
+        pp_layer = PipelineParallel(pipe, num_microbatches=wave,
+                                    mesh=mesh)
+        params, buffers = split_state(pp_layer)
+        x = jnp.zeros((total_mb, s, H), jnp.float32)
+        n_waves = total_mb // wave
+
+        def wave_loss(p, xw):
+            out, _ = functional_call(pp_layer, p, buffers, xw)
+            return (out ** 2).mean()
+
+        def step(p, x):
+            def body(i, acc):
+                xw = jax.lax.dynamic_slice_in_dim(x, i * wave, wave, 0)
+                g = jax.grad(wave_loss)(p, xw)
+                return jax.tree_util.tree_map(jnp.add, acc, g)
+            zero = jax.tree_util.tree_map(jnp.zeros_like, p)
+            g = jax.lax.fori_loop(0, n_waves, body, zero)
+            return jax.tree_util.tree_map(
+                lambda gg: gg / n_waves, g)
+
+        prof = memory_profile(step, (params, x))
+        return prof.temp_bytes
+    finally:
+        parallel.set_mesh(None)
+
+
+def main():
+    total_mb = 16
+    rows = []
+    for s in (4096, 8192, 16384):
+        full = temp_bytes(s, total_mb, wave=total_mb)  # one scan
+        waved = temp_bytes(s, total_mb, wave=PP)       # bounded
+        rows.append((s, full, waved, waved / full))
+        print(f"s={s:6d}  single-scan {full / 2**20:9.1f} MiB   "
+              f"wave={PP} accum {waved / 2**20:9.1f} MiB   "
+              f"ratio {waved / full:.2f}", flush=True)
+    print("\nboundary model: single scan holds (m*v+pp-1)="
+          f"{total_mb + PP - 1} boundaries; wave={PP} holds "
+          f"(w*v+pp-1)={2 * PP - 1} per wave -> predicted ratio "
+          f"{(2 * PP - 1) / (total_mb + PP - 1):.2f}")
+    return rows
+
+
+if __name__ == "__main__":
+    main()
