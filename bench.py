@@ -410,9 +410,13 @@ def _orchestrate():
             p = subprocess.run([sys.executable, "-c", probe_src],
                                capture_output=True, text=True,
                                timeout=probe_timeout)
-            ok = p.returncode == 0
-            err = "" if ok else f"probe rc {p.returncode}: " \
-                f"{(p.stderr or '')[-200:]}"
+            # a fast-failing plugin falls back to the CPU backend with
+            # rc 0 — that is NOT TPU acquisition; check the device kind
+            ok = p.returncode == 0 and "TPU" in (p.stdout or "")
+            err = "" if ok else (
+                f"probe rc {p.returncode}, device "
+                f"{(p.stdout or '').strip()[:40]!r}: "
+                f"{(p.stderr or '')[-200:]}")
         except subprocess.TimeoutExpired:
             ok = False
             err = (f"device init exceeded {probe_timeout:.0f}s — TPU "
@@ -568,6 +572,12 @@ def main():
         if "exc" in box:
             print(f"bench worker: device init failed: "
                   f"{box['exc']!r:.300}", file=sys.stderr)
+            os._exit(7)
+        if jax.default_backend() == "cpu":
+            # plugin fell back between the orchestrator's probe and us:
+            # a TPU worker must not silently produce a CPU record
+            print("bench worker: backend fell back to CPU",
+                  file=sys.stderr)
             os._exit(7)
     cpu_smoke = jax.default_backend() == "cpu"
     extra = {}

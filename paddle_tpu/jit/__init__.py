@@ -133,6 +133,11 @@ def to_static(fn=None, input_spec=None, **_ignored):
 # ---------------------------------------------------------------------------
 
 _PROGRAM_FILE = "program.stablehlo"
+
+
+class _SkipTwins(Exception):
+    """Control-flow marker: encrypted artifacts write no native twins."""
+
 _PARAMS_FILE = "params.pkl"
 _META_FILE = "meta.json"
 # C-consumable twins (read by the native predictor,
@@ -170,7 +175,8 @@ def _write_pbin(path: str, named_arrays) -> None:
             f.write(raw)
 
 
-def save(layer, path: str, input_spec: Sequence[InputSpec] = None) -> None:
+def save(layer, path: str, input_spec: Sequence[InputSpec] = None,
+         encrypt_key: bytes = None) -> None:
     """Export layer → serialized StableHLO + params
     (ref: paddle.jit.save → __model__ + params; static/io.py:435).
 
@@ -178,6 +184,13 @@ def save(layer, path: str, input_spec: Sequence[InputSpec] = None) -> None:
     (params..., inputs...) explicitly so the artifact can be re-targeted
     (params swappable at serve time — the analog of separate
     __model__/params files).
+
+    ``encrypt_key``: encrypt the program/params artifact files at rest
+    (ref: framework/io/crypto AESCipher; scheme in io/crypto.py —
+    authenticated XOF stream cipher from the stdlib). ``load`` needs
+    the same key; the native-predictor twins are not written for an
+    encrypted artifact (the C++ side serves plaintext artifacts only —
+    decrypt-and-reexport to serve natively).
     """
     if isinstance(layer, StaticFunction):
         input_spec = input_spec or layer.input_spec
@@ -205,12 +218,21 @@ def save(layer, path: str, input_spec: Sequence[InputSpec] = None) -> None:
         # set_code_level analog: the transformed-code dump here is the
         # exported StableHLO module
         print(exported.mlir_module())
-    with open(os.path.join(path, _PROGRAM_FILE), "wb") as f:
-        f.write(exported.serialize())
+    def _write_artifact(fname, data):
+        # encrypted artifacts never hit disk as plaintext: a crash
+        # between write and a later encrypt-in-place would leave valid
+        # plaintext at the final filenames (and journal remanence
+        # even on success)
+        if encrypt_key is not None:
+            from ..io import crypto
+            data = crypto.encrypt_bytes(data, encrypt_key)
+        with open(os.path.join(path, fname), "wb") as f:
+            f.write(data)
+
+    _write_artifact(_PROGRAM_FILE, exported.serialize())
     state = {"params": {k: np.asarray(v) for k, v in params.items()},
              "buffers": {k: np.asarray(v) for k, v in buffers.items()}}
-    with open(os.path.join(path, _PARAMS_FILE), "wb") as f:
-        pickle.dump(state, f)
+    _write_artifact(_PARAMS_FILE, pickle.dumps(state))
 
     # C-consumable twins for the native predictor. The exported main's
     # leading arguments are the flattened (params, buffers) pytree —
@@ -218,7 +240,11 @@ def save(layer, path: str, input_spec: Sequence[InputSpec] = None) -> None:
     # them positionally with no pytree logic. Best-effort like the
     # compile-options twin: an exotic dtype or symbolic shape disables
     # native serving but never breaks the Python artifact.
+    # native twins are documented-off for encrypted artifacts (the
+    # C++ predictor serves plaintext only); not a warning-worthy event
     try:
+        if encrypt_key is not None:
+            raise _SkipTwins
         with open(os.path.join(path, _MLIR_FILE), "wb") as f:
             f.write(exported.mlir_module_serialized)
         flat_named = (
@@ -228,6 +254,8 @@ def save(layer, path: str, input_spec: Sequence[InputSpec] = None) -> None:
         from jax._src.lib import xla_client as _xc
         with open(os.path.join(path, _COPTS_FILE), "wb") as f:
             f.write(_xc.CompileOptions().SerializeAsString())
+    except _SkipTwins:
+        pass
     except Exception as e:
         import warnings
         warnings.warn(f"native serving twins not written ({e}); "
@@ -286,12 +314,32 @@ class TranslatedLayer:
                 self._buffers[k] = jnp.asarray(state[k])
 
 
-def load(path: str) -> TranslatedLayer:
-    """ref: paddle.jit.load."""
-    with open(os.path.join(path, _PROGRAM_FILE), "rb") as f:
-        exported = jax_export.deserialize(f.read())
-    with open(os.path.join(path, _PARAMS_FILE), "rb") as f:
-        state = pickle.load(f)
+def load(path: str, decrypt_key: bytes = None) -> TranslatedLayer:
+    """ref: paddle.jit.load. Pass ``decrypt_key`` for artifacts saved
+    with ``encrypt_key`` (authentication failure raises before any
+    bytes are deserialized)."""
+    from ..io import crypto
+
+    def read(fname):
+        full = os.path.join(path, fname)
+        if crypto.is_encrypted(full):
+            if decrypt_key is None:
+                raise ValueError(
+                    f"{fname} is encrypted; pass decrypt_key")
+            return crypto.decrypt_file_bytes(full, decrypt_key)
+        if decrypt_key is not None:
+            # a caller holding a key expects AUTHENTICATED artifacts;
+            # accepting a plaintext file here would let an attacker
+            # strip the encryption and feed an unauthenticated pickle
+            raise ValueError(
+                f"{fname} is NOT encrypted but decrypt_key was given "
+                "— refusing to load an unauthenticated artifact")
+        with open(full, "rb") as f:
+            return f.read()
+
+    exported = jax_export.deserialize(read(_PROGRAM_FILE))
+    import io as _io
+    state = pickle.load(_io.BytesIO(read(_PARAMS_FILE)))
     params = {k: jnp.asarray(v) for k, v in state["params"].items()}
     buffers = {k: jnp.asarray(v) for k, v in state["buffers"].items()}
     return TranslatedLayer(exported, params, buffers)

@@ -157,7 +157,8 @@ def distributed_model(model, strategy: Optional[DistributedStrategy] = None,
                       mesh: Optional[DeviceMesh] = None,
                       rules: Optional[LogicalRules] = None,
                       global_batch: Optional[int] = None,
-                      seq_len: Optional[int] = None):
+                      seq_len: Optional[int] = None,
+                      act_dtype_bytes: Optional[int] = None):
     """Attach sharding to a hapi ``Model`` (ref: fleet_base.py:947
     ``distributed_model`` wrapping TP→PP→Sharding→DP; here one call
     installs param/batch placement hooks and the compiled step becomes the
@@ -187,7 +188,8 @@ def distributed_model(model, strategy: Optional[DistributedStrategy] = None,
                 from . import planner
                 best = planner.plan(model.network, jax.device_count(),
                                     global_batch=global_batch,
-                                    seq_len=seq_len, rules=rules)
+                                    seq_len=seq_len, rules=rules,
+                                    act_dtype_bytes=act_dtype_bytes)
                 if not best.fits:
                     import warnings
                     warnings.warn(
@@ -201,7 +203,8 @@ def distributed_model(model, strategy: Optional[DistributedStrategy] = None,
                 model._planner_ctx = {
                     "n_devices": jax.device_count(),
                     "global_batch": global_batch, "seq_len": seq_len,
-                    "rules": rules, "chip": None}
+                    "rules": rules, "chip": None,
+                    "act_dtype_bytes": act_dtype_bytes}
             else:
                 axes = strategy.mesh_axes() if strategy else {"dp": -1}
                 mesh = init_mesh(**(axes or {"dp": -1}))
