@@ -111,7 +111,7 @@ def bench_gpt(batch: int = 8, seq: int = 1024, warmup: int = 3,
               iters: int = 20, cpu_smoke: bool = False,
               model_name: str = "gpt2-small", fused: bool = True,
               scan_layers: bool = False, remat: bool = False,
-              optimizer: str = "adamw"):
+              optimizer: str = "adamw", param_dtype: str = None):
     import paddle_tpu as paddle
     from paddle_tpu.models.gpt import (GPTForCausalLM,
                                        GPTFusedPretrainingCriterion,
@@ -134,14 +134,25 @@ def bench_gpt(batch: int = 8, seq: int = 1024, warmup: int = 3,
                          hidden_dropout=0.0, attention_dropout=0.0,
                          fused_loss=fused, scan_layers=scan_layers,
                          remat=remat)
-    net = GPTForCausalLM(cfg)
+    import contextlib
+    if param_dtype:
+        # the single-chip 1.5B recipe needs bf16 PARAM STORAGE
+        # (FEASIBILITY_XL.json: fp32 params+grads alone overflow 16 GiB);
+        # scoped so a later bench in this process builds fp32 again
+        from paddle_tpu.core.dtype import default_dtype_guard
+        guard = default_dtype_guard(param_dtype)
+    else:
+        guard = contextlib.nullcontext()
+    with guard:
+        net = GPTForCausalLM(cfg)
     model = paddle.Model(net)
     if optimizer == "adafactor":
         # the single-chip big-model configuration: factored second
         # moments keep optimizer state ~0 bytes/param vs AdamW's 8,
         # which is what lets GPT-2-XL (1.56B) train on one 16 GB chip
-        opt = paddle.optimizer.Adafactor(learning_rate=1e-4,
-                                         parameters=net)
+        opt = paddle.optimizer.Adafactor(
+            learning_rate=1e-4, parameters=net,
+            multi_precision=param_dtype is None)
     elif optimizer == "adamw":
         opt = paddle.optimizer.AdamW(learning_rate=1e-4, parameters=net,
                                      weight_decay=0.01)
@@ -166,7 +177,7 @@ def bench_gpt(batch: int = 8, seq: int = 1024, warmup: int = 3,
             "batch": batch, "seq": seq, "params": n_params,
             "model": model_name, "fused": cfg.fused_loss,
             "scan": cfg.scan_layers, "remat": cfg.remat,
-            "optimizer": optimizer,
+            "optimizer": optimizer, "param_dtype": param_dtype or "float32",
             "mfu": _mfu(tps * flops_per_token)}
 
 

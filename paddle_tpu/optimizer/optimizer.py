@@ -592,7 +592,8 @@ class Adafactor(Optimizer):
         def rms(x):
             return jnp.sqrt(jnp.mean(jnp.square(x)) + 1e-30)
 
-        def scaled_update(g, vr, vc, v, p):
+        def core(g, vr, vc, v, m, p):
+            """One LOGICAL parameter's update → (delta, vr, vc, v, m)."""
             g32 = g.astype(jnp.float32)
             g2 = jnp.square(g32) + eps1
             if self._factored(p):
@@ -608,9 +609,6 @@ class Adafactor(Optimizer):
                 u = g32 * jax.lax.rsqrt(v_n)
                 vr_n, vc_n = vr, vc
             u = u / jnp.maximum(1.0, rms(u) / self.clip_threshold)
-            return u, vr_n, vc_n, v_n
-
-        def finish(u, m, p):
             alpha = step_size * jnp.maximum(eps2, rms(p)) \
                 if self.scale_parameter else step_size
             if m is not None:
@@ -618,25 +616,57 @@ class Adafactor(Optimizer):
                 u = m
             delta = (-alpha * u - step_size * self.weight_decay *
                      p.astype(jnp.float32)).astype(p.dtype)
-            return delta, m
+            return delta, vr_n, vc_n, v_n, m
+
+        def leaf(g, vr, vc, v, m, p):
+            """ndim>=3 leaves are SCAN-STACKED logical parameters
+            ([L, r, c] from scan_layers / pipeline stacking): update
+            slices SEQUENTIALLY with lax.map, so the f32 transients
+            (g32/u/delta copies) peak at ONE slice, not the whole
+            stack — at 1.5B+ single-chip scale the whole-stack
+            transients are gigabytes (FEASIBILITY_XL.json) — and the
+            update-RMS clip / parameter-scale apply PER SLICE, i.e.
+            per logical parameter, matching the unstacked model.
+
+            Gated on big slices (>= 1 Mi elements): a conv kernel
+            [O, I, k] is also 3-D but its slices are tiny — hundreds
+            of sequential map steps would cost far more than the
+            bytes they save."""
+            if p.ndim == 3 and p.shape[-2] * p.shape[-1] >= (1 << 20):
+                if m is None:
+                    def body(xs):
+                        d, vrn, vcn, _, _ = core(
+                            xs[0], xs[1], xs[2],
+                            jnp.zeros((0,), jnp.float32), None, xs[3])
+                        return d, vrn, vcn
+                    d, vrn, vcn = jax.lax.map(body, (g, vr, vc, p))
+                    return d, vrn, vcn, v, None
+                def body(xs):
+                    d, vrn, vcn, _, mn = core(xs[0], xs[1], xs[2],
+                                              jnp.zeros((0,),
+                                                        jnp.float32),
+                                              xs[3], xs[4])
+                    return d, vrn, vcn, mn
+                d, vrn, vcn, mn = jax.lax.map(body, (g, vr, vc, m, p))
+                return d, vrn, vcn, v, mn
+            return core(g, vr, vc, v, m, p)
 
         is_t = lambda x: isinstance(x, tuple)  # noqa: E731
-        quads = _tree_map(scaled_update, grads, state["vr"], state["vc"],
-                          state["v"], params)
-        us = _tree_map(lambda q: q[0], quads, is_leaf=is_t)
-        new_state = dict(state)
-        new_state["vr"] = _tree_map(lambda q: q[1], quads, is_leaf=is_t)
-        new_state["vc"] = _tree_map(lambda q: q[2], quads, is_leaf=is_t)
-        new_state["v"] = _tree_map(lambda q: q[3], quads, is_leaf=is_t)
         if self.beta1 is not None:
-            pairs = _tree_map(lambda u, m, p: finish(u, m, p),
-                              us, state["m"], params)
-            updates = _tree_map(lambda pr: pr[0], pairs, is_leaf=is_t)
-            new_state["m"] = _tree_map(lambda pr: pr[1], pairs,
-                                       is_leaf=is_t)
+            outs = _tree_map(leaf, grads, state["vr"], state["vc"],
+                             state["v"], state["m"], params)
         else:
-            updates = _tree_map(lambda u, p: finish(u, None, p)[0],
-                                us, params)
+            outs = _tree_map(
+                lambda g, vr, vc, v, p: leaf(g, vr, vc, v, None, p),
+                grads, state["vr"], state["vc"], state["v"], params)
+        updates = _tree_map(lambda o: o[0], outs, is_leaf=is_t)
+        new_state = dict(state)
+        new_state["vr"] = _tree_map(lambda o: o[1], outs, is_leaf=is_t)
+        new_state["vc"] = _tree_map(lambda o: o[2], outs, is_leaf=is_t)
+        new_state["v"] = _tree_map(lambda o: o[3], outs, is_leaf=is_t)
+        if self.beta1 is not None:
+            new_state["m"] = _tree_map(lambda o: o[4], outs,
+                                       is_leaf=is_t)
         new_state["t"] = t
         return updates, new_state
 

@@ -218,3 +218,30 @@ def test_adafactor_relative_step_and_momentum():
         grads = jax.grad(quad_loss)(params)
         params, state = opt.apply_gradients(params, grads, state, i)
     assert float(quad_loss(params)) < 0.5 * start
+
+
+def test_adafactor_stacked_leaves_sequential_parity():
+    """[L, r, c] scan-stacked leaves (big slices) update via a
+    sequential lax.map; the result equals running Adafactor on each
+    slice as its own parameter (per-slice clip/scale semantics), and
+    the factored state stays per-slice shaped."""
+    from paddle_tpu.optimizer import Adafactor
+    np.random.seed(3)
+    L, r, c = 3, 1024, 1024           # slice >= 1Mi elements
+    stacked = {"w": jnp.asarray(np.random.randn(L, r, c)
+                                .astype(np.float32))}
+    g = {"w": jnp.asarray(np.random.randn(L, r, c)
+                          .astype(np.float32) * 0.1)}
+    opt = Adafactor(learning_rate=0.01)
+    s = opt.init_state(stacked)
+    assert s["vr"]["w"].shape == (L, r) and s["vc"]["w"].shape == (L, c)
+    new_stacked, _ = opt.apply_gradients(stacked, g, s, 0)
+
+    for i in range(L):
+        per = {"w": stacked["w"][i]}
+        opt_i = Adafactor(learning_rate=0.01)
+        s_i = opt_i.init_state(per)
+        new_i, _ = opt_i.apply_gradients(per, {"w": g["w"][i]}, s_i, 0)
+        np.testing.assert_allclose(np.asarray(new_stacked["w"][i]),
+                                   np.asarray(new_i["w"]),
+                                   rtol=1e-5, atol=1e-6)
