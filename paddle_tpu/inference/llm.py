@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from concurrent.futures import Future
 from typing import List, Optional, Sequence
 
@@ -154,7 +155,7 @@ class _PagedPrefill(Layer):
 class _Request:
     __slots__ = ("prompt", "max_new_tokens", "temperature", "future",
                  "tokens", "slot", "truncated", "t_submit", "t_first",
-                 "t_done")
+                 "t_done", "closing", "drain_after", "accepts_inflight")
 
     def __init__(self, prompt, max_new_tokens, temperature):
         self.prompt = list(map(int, prompt))
@@ -167,6 +168,15 @@ class _Request:
         self.t_submit = time.monotonic()
         self.t_first = None
         self.t_done = None
+        # lifecycle under lookahead: a "closing" request is no longer
+        # issued new steps, but its pages stay held until every
+        # already-issued step referencing its slot has been fetched
+        # (drain_after = the issue seq it must drain past)
+        self.closing = False
+        self.drain_after = -1
+        # a closer that still WANTS its in-flight tokens (closed for
+        # page/length-budget reasons, not EOS) keeps accepting them
+        self.accepts_inflight = False
 
 
 class LLMEngine:
@@ -184,13 +194,25 @@ class LLMEngine:
     predictor's analog failure is an OOM — here degradation is
     per-request and graceful); a request whose PROMPT alone can never
     fit the pool fails its future at admission.
+
+    ``lookahead``: issue up to this many decode steps ahead of the
+    token fetch. Steps CHAIN on device (each step's sampled tokens
+    feed the next without a host round-trip), so per-step host
+    traffic drops from one blocking fetch to one fetch per
+    ``lookahead+1`` steps — the lever when dispatch latency rivals
+    step compute (tunneled/remote devices). Token streams are
+    IDENTICAL to lookahead=0 (the chain computes the same values);
+    the costs are admission/EOS reaction lagging by up to
+    ``lookahead`` steps and up to ``lookahead`` wasted step-slots of
+    compute after a sequence finishes.
     """
 
     def __init__(self, net, max_seqs: int = 8, page_size: int = 16,
                  num_pages: int = 512, max_len: Optional[int] = None,
                  prefill_buckets: Sequence[int] = (64, 256, 1024),
                  eos_token_id: Optional[int] = None,
-                 cache_dtype=jnp.float32, seed: int = 0):
+                 cache_dtype=jnp.float32, seed: int = 0,
+                 lookahead: int = 0):
         cfg = net.cfg
         self.cfg = cfg
         self.max_seqs = max_seqs
@@ -213,10 +235,15 @@ class LLMEngine:
         self.block_tables = np.zeros((max_seqs, self.pages_per_seq),
                                      np.int32)
         self.context_lens = np.zeros((max_seqs,), np.int32)
-        self.last_tokens = np.zeros((max_seqs,), np.int32)
         self.temperatures = np.zeros((max_seqs,), np.float32)
         self._free_pages = list(range(num_pages - 1, 0, -1))  # 0=scratch
         self._slots: List[Optional[_Request]] = [None] * max_seqs
+        # device-chained last tokens (authoritative between fetches)
+        self._tokens_dev = jnp.zeros((max_seqs,), jnp.int32)
+        self.lookahead = int(lookahead)
+        self._inflight = deque()   # (issue_seq, active_slots, tokens)
+        self._issue_seq = 0
+        self._fetch_seq = 0
 
         decode = _PagedDecode(net)
         prefill = _PagedPrefill(net)
@@ -322,7 +349,9 @@ class LLMEngine:
         self.context_lens[slot] = 0
         self._slots[slot] = None
 
-    def _finish(self, slot: int, ok: bool = True):
+    def _finish(self, slot: int):
+        """Resolve + reclaim. Only callable once the slot has no
+        in-flight steps (enforced by the drain_after gate)."""
         req = self._slots[slot]
         req.t_done = time.monotonic()
         self._free_slot(slot)
@@ -334,6 +363,22 @@ class LLMEngine:
             if req.t_first else None,
             "latency_s": req.t_done - req.t_submit,
         })
+
+    def _begin_close(self, slot: int, accept_inflight: bool = False):
+        """Stop issuing for this slot; pages stay held (in-flight steps
+        still write them) until the issue stream drains past it.
+        ``accept_inflight``: the request still wants the tokens already
+        in flight (closed on budget, not on EOS/length-at-fetch)."""
+        req = self._slots[slot]
+        req.closing = True
+        req.accepts_inflight = accept_inflight
+        req.drain_after = self._issue_seq
+
+    def _maybe_finalize(self):
+        for slot, req in enumerate(self._slots):
+            if req is not None and req.closing \
+                    and self._fetch_seq >= req.drain_after:
+                self._finish(slot)
 
     def _bucket(self, n: int) -> int:
         for b in self.prefill_buckets:
@@ -376,7 +421,7 @@ class LLMEngine:
         req.tokens.append(int(nxt))
         self._slots[slot] = req
         self.context_lens[slot] = n
-        self.last_tokens[slot] = req.tokens[-1]
+        self._tokens_dev = self._tokens_dev.at[slot].set(req.tokens[-1])
         self.temperatures[slot] = req.temperature
         self.n_tokens += 1
         return "ok"
@@ -390,6 +435,10 @@ class LLMEngine:
             return True
         return len(req.tokens) >= req.max_new_tokens
 
+    def _live_slots(self) -> List[int]:
+        return [i for i, s in enumerate(self._slots)
+                if s is not None and not s.closing]
+
     def _loop(self):
         while True:
             try:
@@ -399,26 +448,45 @@ class LLMEngine:
                     self._pending = []
                 for req in pending:
                     self._harvest_admit(req)
-                active = [i for i, s in enumerate(self._slots)
-                          if s is not None]
-                if not active:
-                    if closed:
-                        with self._mu:
-                            leftovers = self._pending
-                            self._pending = []
-                        for req in leftovers:
-                            req.future.set_exception(
-                                RuntimeError("engine closed"))
-                        return
-                    self._wake.wait(timeout=0.05)
-                    self._wake.clear()
-                    continue
-                self._step(active)
+                live = self._live_slots()
+                if live:
+                    self._issue(live)
+                    # fetch with a lag: the chain keeps the device busy
+                    while len(self._inflight) > self.lookahead:
+                        self._drain_one()
+                else:
+                    while self._inflight:   # nothing to issue: drain
+                        self._drain_one()
+                    self._maybe_finalize()
+                    if not any(s is not None for s in self._slots):
+                        if closed:
+                            with self._mu:
+                                leftovers = self._pending
+                                self._pending = []
+                            for req in leftovers:
+                                req.future.set_exception(
+                                    RuntimeError("engine closed"))
+                            return
+                        self._wake.wait(timeout=0.05)
+                        self._wake.clear()
             except Exception as e:  # noqa: BLE001
                 # a device/compile error (e.g. a transient PJRT tunnel
                 # failure) must not kill the scheduler with futures
                 # pending: fail the in-flight requests, reclaim their
                 # pages, and keep serving — fresh requests may succeed
+                self._inflight.clear()
+                self._fetch_seq = self._issue_seq
+                # closers whose generation already completed (awaiting
+                # drain only) resolve successfully; ones still owed
+                # in-flight tokens resolve short with truncated=True —
+                # their tokens died with the error, but the request
+                # itself did not fail
+                for slot, s in enumerate(self._slots):
+                    if s is not None and s.closing:
+                        if s.accepts_inflight and \
+                                len(s.tokens) < s.max_new_tokens:
+                            s.truncated = True
+                        self._finish(slot)
                 for slot, s in enumerate(self._slots):
                     if s is not None:
                         self._free_slot(slot)
@@ -432,7 +500,7 @@ class LLMEngine:
 
     def _harvest_admit(self, req: _Request):
         """Admit, re-queue, or fail; immediately-finished admissions
-        (e.g. max_new_tokens=1) are resolved here."""
+        (e.g. max_new_tokens=1) resolve once drained."""
         verdict = self._admit(req)
         if verdict == "never":
             req.future.set_exception(ValueError(
@@ -446,36 +514,73 @@ class LLMEngine:
                 self._pending.append(req)
             return
         if self._harvest(req.slot):
-            self._finish(req.slot)
+            self._begin_close(req.slot)
+            self._maybe_finalize()
 
-    def _step(self, active: List[int]):
-        # allocate pages for the tokens this step writes
-        for slot in list(active):
+    def _issue(self, live: List[int]):
+        """Dispatch ONE decode step for the live slots; tokens chain
+        from the previous step ON DEVICE (no fetch here)."""
+        for slot in list(live):
+            req = self._slots[slot]
+            in_flight = sum(1 for _, sl, _ in self._inflight
+                            if slot in sl)
+            if len(req.tokens) + in_flight >= req.max_new_tokens:
+                # length completion is already provable on the host:
+                # issuing more would only burn pages/compute on tokens
+                # the drain will discard (and could starve a
+                # concurrent request into truncation)
+                self._begin_close(slot, accept_inflight=True)
+                live.remove(slot)
+                continue
             pos = int(self.context_lens[slot])
             if pos >= self.max_len or not self._ensure_page(slot, pos):
-                self._slots[slot].truncated = True
-                self._finish(slot)
-                active.remove(slot)
-        if not active:
+                # in-flight steps cannot cover the remainder (checked
+                # above), so this IS a truncation; the in-flight tokens
+                # are still wanted and delivered by the drain
+                req.truncated = True
+                self._begin_close(slot, accept_inflight=True)
+                live.remove(slot)
+        if not live:
             return
-        lens = np.where(self.context_lens > 0, self.context_lens + 1,
-                        0).astype(np.int32)
+        positions = np.zeros((self.max_seqs,), np.int32)
+        lens = np.zeros((self.max_seqs,), np.int32)
+        for slot in live:
+            positions[slot] = self.context_lens[slot]
+            lens[slot] = self.context_lens[slot] + 1
         tokens, self.k_pages, self.v_pages = self._decode_fn(
             self._params, self._buffers,
-            jnp.asarray(self.last_tokens), jnp.asarray(self.context_lens),
+            self._tokens_dev, jnp.asarray(positions),
             jnp.asarray(self.block_tables), jnp.asarray(lens),
             self.k_pages, self.v_pages, jnp.asarray(self.temperatures),
             self._next_key())
-        host_tokens = np.asarray(tokens)
-        self.n_steps += 1
-        for slot in active:
+        self._tokens_dev = tokens
+        self._issue_seq += 1
+        self._inflight.append((self._issue_seq, list(live), tokens))
+        for slot in live:
             self.context_lens[slot] += 1
-            tok = int(host_tokens[slot])
-            self._slots[slot].tokens.append(tok)
-            self.last_tokens[slot] = tok
+
+    def _drain_one(self):
+        """Fetch the oldest in-flight step's tokens and process them
+        (emission, EOS/length, finalization of drained closers)."""
+        seq, slots_list, tokens = self._inflight.popleft()
+        host = np.asarray(tokens)          # the only blocking fetch
+        self._fetch_seq = seq
+        self.n_steps += 1
+        for slot in slots_list:
+            req = self._slots[slot]
+            if req is None:
+                continue
+            if req.closing and (not req.accepts_inflight or
+                                len(req.tokens) >= req.max_new_tokens):
+                continue  # overrun token of a finished request
+            req.tokens.append(int(host[slot]))
             self.n_tokens += 1
-            if self._harvest(slot):
-                self._finish(slot)
+            if self.eos_token_id is not None and \
+                    req.tokens[-1] == self.eos_token_id:
+                req.accepts_inflight = False  # nothing after EOS
+            if not req.closing and self._harvest(slot):
+                self._begin_close(slot)
+        self._maybe_finalize()
 
 
 def serve_llm(engine: LLMEngine, host: str = "127.0.0.1",

@@ -35,16 +35,17 @@ def tiny_llama():
     return GPTForCausalLM(cfg)
 
 
+@pytest.mark.parametrize("lookahead", [0, 3], ids=["sync", "lookahead3"])
 @pytest.mark.parametrize("build", [tiny_gpt, tiny_llama],
                          ids=["gpt2", "llama-gqa"])
-def test_engine_greedy_matches_dense_generate(build):
+def test_engine_greedy_matches_dense_generate(build, lookahead):
     net = build()
     rng = np.random.RandomState(0)
     prompts = [rng.randint(0, 97, n).tolist() for n in (5, 11, 3)]
     want = [np.asarray(net.generate(jnp.asarray([p]), max_new_tokens=8)
                        )[0, len(p):].tolist() for p in prompts]
     with LLMEngine(net, max_seqs=4, page_size=4, num_pages=128,
-                   prefill_buckets=(16,)) as eng:
+                   prefill_buckets=(16,), lookahead=lookahead) as eng:
         outs = eng.generate(prompts, max_new_tokens=8)
     for got, ref, p in zip(outs, want, prompts):
         assert got["output_ids"] == ref, (p, got["output_ids"], ref)
@@ -188,3 +189,47 @@ def test_engine_rejects_impossible_requests_cleanly():
     ok = eng.submit([4, 5], max_new_tokens=3).result(timeout=60)
     assert len(ok["output_ids"]) == 3
     eng.close()
+
+
+
+def test_engine_lookahead_chains_and_discards_overrun():
+    """lookahead > 0: token streams are IDENTICAL to sync mode (the
+    chain computes the same values on device), finished requests never
+    exceed max_new_tokens despite overrun steps, pages all return, and
+    the host fetch count drops to ~1 per lookahead+1 steps."""
+    net = tiny_gpt()
+    rng = np.random.RandomState(4)
+    prompts = [rng.randint(0, 97, n).tolist() for n in (4, 7, 3, 9)]
+
+    def run(k):
+        pt.seed(0)
+        eng = LLMEngine(net, max_seqs=2, page_size=4, num_pages=64,
+                        prefill_buckets=(16,), lookahead=k)
+        free0 = len(eng._free_pages)
+        outs = eng.generate(prompts, max_new_tokens=11)
+        eng.close()
+        assert len(eng._free_pages) == free0
+        return outs
+
+    sync = run(0)
+    la = run(4)
+    for a, b in zip(sync, la):
+        assert a["output_ids"] == b["output_ids"]
+        assert len(b["output_ids"]) == 11
+
+
+def test_engine_lookahead_eos_and_truncation():
+    net = tiny_gpt()
+    with LLMEngine(net, max_seqs=2, page_size=4, num_pages=64,
+                   prefill_buckets=(8,), eos_token_id=7,
+                   lookahead=3) as eng:
+        out = eng.generate([[3, 1, 4]], max_new_tokens=40,
+                           temperature=1.0)[0]
+        if 7 in out["output_ids"]:
+            assert out["output_ids"][-1] == 7    # nothing after EOS
+    # pool exhaustion under lookahead still truncates gracefully
+    with LLMEngine(net, max_seqs=1, page_size=4, num_pages=4,
+                   prefill_buckets=(8,), lookahead=3) as eng:
+        out = eng.generate([[1, 2, 3, 4, 5]], max_new_tokens=40)[0]
+    assert out["truncated"]
+    assert 0 < len(out["output_ids"]) < 40

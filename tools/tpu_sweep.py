@@ -48,8 +48,11 @@ RUNS = [
     {"tag": "widedeep", "kind": "widedeep", "batch": 65536},
     {"tag": "widedeep_host", "kind": "widedeep", "batch": 8192,
      "table": "host"},
-    # decode serving: 16 concurrent greedy generations, 8 slots
+    # decode serving: 16 concurrent greedy generations, 8 slots;
+    # the lookahead row amortizes the tunnel's per-step dispatch fetch
     {"tag": "llm_decode", "kind": "llm_decode", "n_requests": 16},
+    {"tag": "llm_decode_la", "kind": "llm_decode", "n_requests": 16,
+     "lookahead": 4},
     # config 4 family at single-chip max: GPT-2-XL 1.56B, Adafactor
     # factored state + scan/remat (VERDICT r4 item 3)
     # pure-bf16 + Adafactor: the configuration FEASIBILITY_XL.json
