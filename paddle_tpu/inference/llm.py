@@ -44,7 +44,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..nn.layer import Layer, functional_call, split_state
-from ..ops.paged_attention import paged_attention
+from ..ops.paged_attention import paged_attention, paged_attention_kernel
 
 
 def _sample(logits, temperature, key):
@@ -64,9 +64,16 @@ class _PagedDecode(Layer):
     write its K/V into the pages, attend over the paged context,
     sample the next token on device."""
 
-    def __init__(self, net):
+    def __init__(self, net, attention_impl: str = "xla"):
         super().__init__()
         self.net = net
+        self.attention_impl = attention_impl
+
+    def _paged_attention(self, q, k_pages, v_pages, tables, lens):
+        if self.attention_impl == "pallas":
+            return paged_attention_kernel(q, k_pages, v_pages, tables,
+                                          lens)
+        return paged_attention(q, k_pages, v_pages, tables, lens)
 
     def forward(self, tokens, positions, block_tables, context_lens,
                 k_pages, v_pages, temperature, key):
@@ -108,8 +115,9 @@ class _PagedDecode(Layer):
                 k[:, 0].astype(k_pages.dtype))
             v_pages = v_pages.at[i, page_idx, offs].set(
                 v[:, 0].astype(v_pages.dtype))
-            att = paged_attention(q[:, 0], k_pages[i], v_pages[i],
-                                  block_tables, context_lens)
+            att = self._paged_attention(q[:, 0], k_pages[i],
+                                        v_pages[i], block_tables,
+                                        context_lens)
             x = x + layer.attn.out_proj(
                 att.reshape(b, 1, cfg.hidden_size))
             x = x + layer.mlp(layer.ln_2(x))
@@ -212,7 +220,7 @@ class LLMEngine:
                  prefill_buckets: Sequence[int] = (64, 256, 1024),
                  eos_token_id: Optional[int] = None,
                  cache_dtype=jnp.float32, seed: int = 0,
-                 lookahead: int = 0):
+                 lookahead: int = 0, attention_impl: str = "xla"):
         cfg = net.cfg
         self.cfg = cfg
         self.max_seqs = max_seqs
@@ -245,7 +253,9 @@ class LLMEngine:
         self._issue_seq = 0
         self._fetch_seq = 0
 
-        decode = _PagedDecode(net)
+        if attention_impl not in ("xla", "pallas"):
+            raise ValueError(f"unknown attention_impl {attention_impl!r}")
+        decode = _PagedDecode(net, attention_impl)
         prefill = _PagedPrefill(net)
         # both wrappers share `net` as their only sublayer, so one
         # "net."-prefixed param dict serves decode and prefill alike

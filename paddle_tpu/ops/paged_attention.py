@@ -26,6 +26,11 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .flash_attention import _LANES, _on_cpu
+from .flash_attention import DEFAULT_MASK_VALUE as _MASK_VALUE
 
 
 class PagedKVCache:
@@ -89,6 +94,115 @@ class PagedKVCache:
                 v_new[i:i + span])
             i += span
         self.context_lens = self.context_lens.at[seq].set(start + t)
+
+
+def paged_attention_kernel(q, k_pages, v_pages, block_tables,
+                           context_lens, scale: Optional[float] = None,
+                           interpret: Optional[bool] = None):
+    """Fused Pallas decode attention over paged KV (the "fancy kernel"
+    the module docstring deferred; Ragged-Paged-Attention lineage).
+
+    Same contract as :func:`paged_attention`. The difference is the
+    memory traffic: the XLA path GATHERS every sequence's full padded
+    context ([B, pages_per_seq*page_size, H, D]) into HBM before the
+    dense attention reads it again; here the kernel's BlockSpec index
+    map reads the SCALAR-PREFETCHED block table directly, so each grid
+    step streams exactly one real page from the pool into VMEM —
+    traffic scales with the true context length (``pl.when`` skips
+    pages past it entirely), and nothing is materialized in between.
+
+    Grid: (batch, kv_heads, pages_per_seq); the page dim is sequential
+    so the online-softmax scratch (acc/m/l) carries across it. GQA is
+    native: the q block per kv head is its [group, D] query rows
+    (group = heads // kv_heads), matching the repeat-kv convention.
+    """
+    if interpret is None:
+        interpret = _on_cpu()  # same convention as flash_attention
+    b, n_heads, d = q.shape
+    n_pages, page_size, kv_heads, _ = k_pages.shape
+    pages_per_seq = block_tables.shape[1]
+    group = n_heads // kv_heads
+    sm_scale = scale if scale is not None else 1.0 / math.sqrt(d)
+
+    qg = q.reshape(b, kv_heads, group, d)
+    tables = jnp.clip(block_tables, 0).astype(jnp.int32)
+    lens = context_lens.astype(jnp.int32)
+
+    def kernel(ctx_ref, tbl_ref, q_ref, k_ref, v_ref, o_ref,
+               acc_ref, m_ref, l_ref):
+        bi = pl.program_id(0)
+        j = pl.program_id(2)
+
+        @pl.when(j == 0)
+        def _init():
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+            m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+            l_ref[...] = jnp.zeros_like(l_ref)
+
+        ctx = ctx_ref[bi]
+
+        @pl.when(j * page_size < ctx)
+        def _compute():
+            qb = q_ref[0, 0]                     # [group, d]
+            k = k_ref[0, :, 0, :]                # [page_size, d]
+            v = v_ref[0, :, 0, :]
+            s = jax.lax.dot_general(
+                qb.astype(jnp.float32), k.astype(jnp.float32),
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * sm_scale
+            col = jax.lax.broadcasted_iota(
+                jnp.int32, (group, page_size), 1)
+            s = jnp.where(col < ctx - j * page_size, s,
+                          _MASK_VALUE)           # [group, page_size]
+            m_prev = m_ref[:, :1]
+            l_prev = l_ref[:, :1]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1,
+                                                keepdims=True))
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.exp(s - m_new)
+            l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+            acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+                p, v.astype(jnp.float32), (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+            l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+        @pl.when(j == pages_per_seq - 1)
+        def _finalize():
+            l = l_ref[:, :1]
+            l_safe = jnp.where(l == 0.0, 1.0, l)  # empty slot → zeros
+            o_ref[0, 0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, kv_heads, pages_per_seq),
+        in_specs=[
+            pl.BlockSpec((1, 1, group, d),
+                         lambda bi, h, j, ctx, tbl: (bi, h, 0, 0)),
+            # the paged gather: this index map IS the block table read
+            pl.BlockSpec((1, page_size, 1, d),
+                         lambda bi, h, j, ctx, tbl: (tbl[bi, j], 0, h,
+                                                     0)),
+            pl.BlockSpec((1, page_size, 1, d),
+                         lambda bi, h, j, ctx, tbl: (tbl[bi, j], 0, h,
+                                                     0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, group, d),
+                               lambda bi, h, j, ctx, tbl: (bi, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((group, d), jnp.float32),
+            pltpu.VMEM((group, _LANES), jnp.float32),
+            pltpu.VMEM((group, _LANES), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, kv_heads, group, d), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(lens, tables, qg, k_pages, v_pages)
+    return out.reshape(b, n_heads, d)
 
 
 def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
