@@ -1,0 +1,29 @@
+"""Shared build-on-first-use helper for the native (.cc → .so) pieces.
+
+One place for the compile command, mtime-based rebuild check, and the
+``PTDF_CC`` compiler override used by the datafeed, the sparse
+accessor, and any future native module. (The PJRT predictor keeps its
+own build — it needs the TensorFlow include path.)
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+
+_BUILD_LOCK = threading.Lock()
+
+
+def build_native_lib(src: str, so: str, extra_flags=()) -> str:
+    """Compile ``src`` to ``so`` if missing/stale; returns ``so``.
+    Raises on compile failure — callers decide whether that is fatal
+    (datafeed) or degrades to a Python path (accessor)."""
+    with _BUILD_LOCK:
+        if (not os.path.exists(so) or
+                os.path.getmtime(so) < os.path.getmtime(src)):
+            cc = os.environ.get("PTDF_CC", "g++")
+            cmd = [cc, "-O2", "-std=c++17", "-shared", "-fPIC",
+                   "-pthread", *extra_flags, src, "-o", so]
+            subprocess.run(cmd, check=True, capture_output=True)
+    return so

@@ -15,11 +15,12 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
 import threading
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
+
+from ..core.native_build import build_native_lib
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "native")
@@ -29,22 +30,12 @@ _BUILD_LOCK = threading.Lock()
 _LIB = None
 
 
-def _build_so() -> str:
-    cc = os.environ.get("PTDF_CC", "g++")
-    cmd = [cc, "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
-           _SRC, "-o", _SO]
-    subprocess.run(cmd, check=True, capture_output=True)
-    return _SO
-
-
 def _lib():
     global _LIB
     with _BUILD_LOCK:
         if _LIB is not None:
             return _LIB
-        if (not os.path.exists(_SO) or
-                os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
-            _build_so()
+        build_native_lib(_SRC, _SO)
         lib = ctypes.CDLL(_SO)
         lib.ptdf_create.restype = ctypes.c_void_p
         lib.ptdf_create.argtypes = [

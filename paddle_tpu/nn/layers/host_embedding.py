@@ -595,15 +595,32 @@ class HostOffloadedEmbedding(Layer):
             merged[dup] = [gs[b:b + c].sum(axis=0)
                            for b, c in zip(bounds, counts[dup])]
         lr = self.learning_rate
+        # fused native accessor (one cache pass per row, threaded — the
+        # numpy expression below is ~6 passes with temporaries;
+        # measured: whole push 15.8 -> 6.5 ms (2.4x) at CTR shapes
+        # batch 512x16 dim 64, see native/sparse_accessor.cc). Probed
+        # OUTSIDE the table lock: the first call may compile the .so
+        from . import native_accessor
+        use_native = native_accessor.available()
         with self._lock:
             slots = self._slots_of(uniq, create=False)
-            live = slots >= 0  # never pulled → nothing to update
+            # never-pulled rows (slot -1) have nothing to update, and
+            # padding never trains — mark both skipped
             if self.padding_idx is not None:
-                live &= uniq != self.padding_idx
+                slots = np.where(uniq == self.padding_idx, -1, slots)
+            if self.optimizer == "adagrad":
+                pool_acc = self._ensure_acc_pool()
+                if use_native and native_accessor.adagrad_push(
+                        self._pool_vals, pool_acc, self._acc_set,
+                        slots, merged, lr, self.initial_accumulator):
+                    return np.zeros((), np.float32)
+            elif use_native and native_accessor.sgd_push(
+                    self._pool_vals, slots, merged, lr):
+                return np.zeros((), np.float32)
+            live = slots >= 0
             s = slots[live]
             gr = merged[live]
             if self.optimizer == "adagrad":
-                pool_acc = self._ensure_acc_pool()
                 acc = np.where(self._acc_set[s][:, None], pool_acc[s],
                                self.initial_accumulator) + gr * gr
                 pool_acc[s] = acc

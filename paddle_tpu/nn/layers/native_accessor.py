@@ -1,0 +1,97 @@
+"""ctypes binding for the native sparse accessor
+(paddle_tpu/native/sparse_accessor.cc — fused per-row PS update rules,
+the C++ twin of the reference's sparse_sgd_rule.cc; see the .cc header
+for why this path is native there and here).
+
+Built on first use with g++ (same pattern as io/native_feed.py); any
+build/load failure degrades silently to the numpy path — the accessor
+is an optimization, never a requirement. Disable explicitly with
+``PT_NATIVE_ACCESSOR=0``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+
+import numpy as np
+
+from ...core.native_build import build_native_lib
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+_SRC = os.path.join(_NATIVE_DIR, "sparse_accessor.cc")
+_SO = os.path.join(_NATIVE_DIR, "libptsaccessor.so")
+_LOAD_LOCK = threading.Lock()
+_LIB = None
+_FAILED = False
+
+
+def _lib():
+    global _LIB, _FAILED
+    if _LIB is not None:  # lock-free fast path (GIL-safe global read)
+        return _LIB
+    if _FAILED or os.environ.get("PT_NATIVE_ACCESSOR") == "0":
+        return None
+    with _LOAD_LOCK:
+        if _LIB is not None:
+            return _LIB
+        try:
+            build_native_lib(_SRC, _SO)
+            lib = ctypes.CDLL(_SO)
+            f32p = ctypes.POINTER(ctypes.c_float)
+            i64p = ctypes.POINTER(ctypes.c_int64)
+            u8p = ctypes.POINTER(ctypes.c_uint8)
+            lib.ptsa_adagrad_push.argtypes = [
+                f32p, f32p, u8p, i64p, f32p,
+                ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_float, ctypes.c_float]
+            lib.ptsa_sgd_push.argtypes = [
+                f32p, i64p, f32p, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_float]
+            _LIB = lib
+        except Exception:  # noqa: BLE001 — numpy path takes over
+            _FAILED = True
+            return None
+        return _LIB
+
+
+def available() -> bool:
+    """Build/load (if needed) and report availability — call OUTSIDE
+    hot locks: the first call may run the g++ compile."""
+    return _lib() is not None
+
+
+def _ptr(a, ctype):
+    return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def adagrad_push(vals: np.ndarray, acc: np.ndarray, acc_set: np.ndarray,
+                 slots: np.ndarray, grads: np.ndarray, lr: float,
+                 init_acc: float) -> bool:
+    """Fused in-place adagrad push; False -> caller uses numpy."""
+    lib = _lib()
+    if lib is None:
+        return False
+    assert acc_set.dtype == np.bool_ and acc_set.itemsize == 1
+    lib.ptsa_adagrad_push(
+        _ptr(vals, ctypes.c_float), _ptr(acc, ctypes.c_float),
+        _ptr(acc_set.view(np.uint8), ctypes.c_uint8),
+        _ptr(np.ascontiguousarray(slots, np.int64), ctypes.c_int64),
+        _ptr(np.ascontiguousarray(grads, np.float32), ctypes.c_float),
+        len(slots), grads.shape[1], float(lr), float(init_acc))
+    return True
+
+
+def sgd_push(vals: np.ndarray, slots: np.ndarray, grads: np.ndarray,
+             lr: float) -> bool:
+    lib = _lib()
+    if lib is None:
+        return False
+    lib.ptsa_sgd_push(
+        _ptr(vals, ctypes.c_float),
+        _ptr(np.ascontiguousarray(slots, np.int64), ctypes.c_int64),
+        _ptr(np.ascontiguousarray(grads, np.float32), ctypes.c_float),
+        len(slots), grads.shape[1], float(lr))
+    return True
