@@ -28,9 +28,9 @@ jax.config.update('jax_num_cpu_devices', 8); \
 import __graft_entry__ as g; g.dryrun_multichip(4)"
 
 echo "== bench smoke (CPU backend)"
-python -c "import jax; jax.config.update('jax_platforms','cpu'); \
-import runpy, sys; sys.argv=['bench.py']; \
-runpy.run_path('bench.py', run_name='__main__')"
+# PT_BENCH_FORCE_CPU: run the measuring child directly on CPU — the
+# default orchestrator mode would spend its TPU probe windows first
+PT_BENCH_FORCE_CPU=1 python bench.py
 
 echo "== wheel build + import smoke"
 tmp=$(mktemp -d)
