@@ -590,3 +590,4 @@ class WeightedRandomSampler(Sampler):
 
     def __len__(self):
         return self.num_samples
+from . import checkpoint  # noqa: E402,F401  (io.checkpoint.AutoCheckpoint)
