@@ -129,6 +129,69 @@ class _PagedDecode(Layer):
         return nxt, k_pages, v_pages
 
 
+class _PagedVerify(Layer):
+    """Speculative-verify step: feed K tokens per slot (the committed
+    last token + K-1 draft proposals), write their K/V into the pages,
+    attend with per-token causal limits, and return the TARGET model's
+    greedy choice after each — one pass instead of K decode steps.
+    Exactness: position j's logits see precisely the same cached
+    context as the j-th sequential decode step would, so the greedy
+    tokens are identical by construction (pinned by test)."""
+
+    def __init__(self, net):
+        super().__init__()
+        self.net = net
+
+    def forward(self, tokens, base_lens, block_tables, k_pages,
+                v_pages):
+        from ..ops.paged_attention import paged_attention_chunk
+        net, cfg = self.net, self.net.cfg
+        gpt = net.gpt
+        b, kq = tokens.shape
+        ps = k_pages.shape[2]
+        hd = cfg.head_dim
+
+        pos_ids = base_lens[:, None] + jnp.arange(kq)[None, :]  # [B,K]
+        x = gpt.embeddings(tokens, position_ids=pos_ids)
+        active = base_lens > 0
+        page_idx = jnp.take_along_axis(
+            jnp.clip(block_tables, 0), pos_ids // ps, axis=1)
+        page_idx = jnp.where(active[:, None], page_idx, 0)
+        offs = pos_ids % ps
+
+        if cfg.use_rope:
+            from ..ops.rotary import apply_rotary_pos_emb, rope_tables
+            cos, sin = rope_tables(hd, cfg.max_position_embeddings,
+                                   cfg.rope_base)
+
+        for i, layer in enumerate(gpt.layers):
+            h = layer.ln_1(x)
+            qkv = layer.attn.qkv_proj(h)
+            q, k, v = jnp.split(
+                qkv, [cfg.hidden_size,
+                      cfg.hidden_size + cfg.num_kv_heads * hd], axis=-1)
+            q = q.reshape(b, kq, cfg.num_heads, hd)
+            k = k.reshape(b, kq, cfg.num_kv_heads, hd)
+            v = v.reshape(b, kq, cfg.num_kv_heads, hd)
+            if cfg.use_rope:
+                q, k = apply_rotary_pos_emb(q, k, cos, sin,
+                                            position_ids=pos_ids)
+            k_pages = k_pages.at[i, page_idx, offs].set(
+                k.astype(k_pages.dtype))
+            v_pages = v_pages.at[i, page_idx, offs].set(
+                v.astype(v_pages.dtype))
+            att = paged_attention_chunk(q, k_pages[i], v_pages[i],
+                                        block_tables, base_lens)
+            x = x + layer.attn.out_proj(
+                att.reshape(b, kq, cfg.hidden_size))
+            x = x + layer.mlp(layer.ln_2(x))
+        x = gpt.ln_f(x)
+        from ..models.gpt import _lm_logits
+        logits = _lm_logits(cfg, gpt.embeddings, x,
+                            getattr(net, "lm_head", None))  # [B,K,V]
+        return jnp.argmax(logits, axis=-1), k_pages, v_pages
+
+
 class _PagedPrefill(Layer):
     """Prompt prefill for ONE sequence: dense causal forward (the
     existing cache path computes per-layer K/V), scattered into the
@@ -203,6 +266,15 @@ class LLMEngine:
     per-request and graceful); a request whose PROMPT alone can never
     fit the pool fails its future at admission.
 
+    ``draft_net``/``spec_tokens``: SPECULATIVE DECODING (greedy-only
+    v1) — a small draft model proposes ``spec_tokens - 1`` tokens per
+    round through its own paged cache (sharing the block tables), and
+    ONE target pass verifies them all (`_PagedVerify`); the greedy
+    prefix-acceptance rule makes outputs EXACTLY equal to plain
+    decoding (test-pinned), while the big model runs once per accepted
+    run instead of once per token. Does not compose with lookahead
+    (the verify fetch is the round barrier).
+
     ``lookahead``: issue up to this many decode steps ahead of the
     token fetch. Steps CHAIN on device (each step's sampled tokens
     feed the next without a host round-trip), so per-step host
@@ -220,7 +292,8 @@ class LLMEngine:
                  prefill_buckets: Sequence[int] = (64, 256, 1024),
                  eos_token_id: Optional[int] = None,
                  cache_dtype=jnp.float32, seed: int = 0,
-                 lookahead: int = 0, attention_impl: str = "xla"):
+                 lookahead: int = 0, attention_impl: str = "xla",
+                 draft_net=None, spec_tokens: int = 4):
         cfg = net.cfg
         self.cfg = cfg
         self.max_seqs = max_seqs
@@ -255,6 +328,65 @@ class LLMEngine:
 
         if attention_impl not in ("xla", "pallas"):
             raise ValueError(f"unknown attention_impl {attention_impl!r}")
+        # speculative decoding (greedy-only v1): a draft model proposes
+        # spec_tokens-1 tokens per round, ONE target pass verifies them
+        # (prefix acceptance is exact for greedy — test-pinned), so the
+        # big model runs once per accepted run instead of once per
+        # token. The draft shares the target's page allocator/block
+        # tables; its pools have its own kv dims.
+        self.spec_k = 0
+        if draft_net is not None:
+            if lookahead:
+                raise ValueError(
+                    "speculative decoding does not compose with "
+                    "lookahead (the verify fetch is the round barrier)")
+            if spec_tokens < 2:
+                raise ValueError("spec_tokens must be >= 2")
+            if draft_net.cfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    "draft and target models must share a vocabulary")
+            self.spec_k = int(spec_tokens)
+            draft_net.eval()
+            dcfg = draft_net.cfg
+            self.draft_k_pages = jnp.zeros(
+                (dcfg.num_layers, num_pages, page_size,
+                 dcfg.num_kv_heads, dcfg.head_dim), cache_dtype)
+            self.draft_v_pages = jnp.zeros_like(self.draft_k_pages)
+            ddecode = _PagedDecode(draft_net, attention_impl)
+            dprefill = _PagedPrefill(draft_net)
+            self._draft_params, self._draft_buffers = \
+                split_state(ddecode)
+
+            def draft_decode_fn(params, buffers, tokens, positions,
+                                tables, lens, kp, vp, temps, key):
+                (out, _) = functional_call(
+                    ddecode, params, buffers, tokens, positions,
+                    tables, lens, kp, vp, temps, key, training=False)
+                return out
+
+            def draft_prefill_fn(params, buffers, ids, true_len, row,
+                                 kp, vp, temp, key):
+                (out, _) = functional_call(
+                    dprefill, params, buffers, ids, true_len, row, kp,
+                    vp, temp, key, training=False)
+                return out
+
+            verify = _PagedVerify(net)
+
+            def verify_fn(params, buffers, tokens, base_lens, tables,
+                          kp, vp):
+                (out, _) = functional_call(
+                    verify, params, buffers, tokens, base_lens,
+                    tables, kp, vp, training=False)
+                return out
+
+            self._draft_decode_fn = jax.jit(draft_decode_fn,
+                                            donate_argnums=(6, 7))
+            self._draft_prefill_fn = jax.jit(draft_prefill_fn,
+                                             donate_argnums=(5, 6))
+            self._verify_fn = jax.jit(verify_fn, donate_argnums=(5, 6))
+            self.n_spec_rounds = 0
+            self.n_draft_steps = 0
         decode = _PagedDecode(net, attention_impl)
         prefill = _PagedPrefill(net)
         # both wrappers share `net` as their only sublayer, so one
@@ -306,6 +438,10 @@ class LLMEngine:
                 f"prefill_buckets")
         if not prompt_ids:
             raise ValueError("empty prompt")
+        if self.spec_k and temperature > 0.0:
+            raise ValueError(
+                "speculative decoding is greedy-only (v1); use "
+                "temperature=0 or an engine without draft_net")
         req = _Request(prompt_ids, max_new_tokens, temperature)
         with self._mu:
             if self._closed:
@@ -426,6 +562,17 @@ class LLMEngine:
             jnp.int32(n), jnp.asarray(self.block_tables[slot]),
             self.k_pages, self.v_pages, jnp.float32(req.temperature),
             self._next_key())
+        if self.spec_k:
+            # the draft needs the prompt's KV too (its own cache dims,
+            # SAME block table); its prefill token is discarded — the
+            # target owns sampling
+            _, self.draft_k_pages, self.draft_v_pages = \
+                self._draft_prefill_fn(
+                    self._draft_params, self._draft_buffers,
+                    jnp.asarray(ids), jnp.int32(n),
+                    jnp.asarray(self.block_tables[slot]),
+                    self.draft_k_pages, self.draft_v_pages,
+                    jnp.float32(0.0), self._next_key())
         req.slot = slot
         req.t_first = time.monotonic()
         req.tokens.append(int(nxt))
@@ -459,7 +606,9 @@ class LLMEngine:
                 for req in pending:
                     self._harvest_admit(req)
                 live = self._live_slots()
-                if live:
+                if live and self.spec_k:
+                    self._spec_round(live)
+                elif live:
                     self._issue(live)
                     # fetch with a lag: the chain keeps the device busy
                     while len(self._inflight) > self.lookahead:
@@ -590,6 +739,92 @@ class LLMEngine:
                 req.accepts_inflight = False  # nothing after EOS
             if not req.closing and self._harvest(slot):
                 self._begin_close(slot)
+        self._maybe_finalize()
+
+    def _spec_round(self, live: List[int]):
+        """One speculative round: K draft steps propose, ONE target pass
+        verifies; the greedy prefix-acceptance commits 1..K tokens. The
+        K-th draft step exists for cache coverage (it writes d_{K-1}'s KV
+        so a fully-accepted round leaves no draft-cache gap); its output
+        is discarded."""
+        K = self.spec_k
+        # per-slot CACHE CAPACITY this round: how many of positions
+        # base..base+K-1 are actually writable (max_len + pages).
+        # cap < K does NOT close the slot — acceptance is clamped to
+        # cap on the host instead, so a request near its length/page
+        # limit still advances exactly like plain decode (parity);
+        # only cap == 0 (the NEXT token can't be cached — the same
+        # condition plain decode closes on) truncates
+        caps = {}
+        for slot in list(live):
+            req = self._slots[slot]
+            base = int(self.context_lens[slot])
+            cap = 0
+            for pos in range(base, base + K):
+                if pos >= self.max_len or not self._ensure_page(slot,
+                                                                pos):
+                    break
+                cap += 1
+            if cap == 0:
+                req.truncated = len(req.tokens) < req.max_new_tokens
+                self._begin_close(slot)
+                live.remove(slot)
+            else:
+                caps[slot] = cap
+        if not live:
+            self._maybe_finalize()
+            return
+
+        base_arr = np.zeros((self.max_seqs,), np.int32)
+        for slot in live:
+            base_arr[slot] = self.context_lens[slot]
+        tables = jnp.asarray(self.block_tables)
+        zeros_temp = jnp.zeros((self.max_seqs,), jnp.float32)
+        cur = self._tokens_dev
+        tok_cols = [cur]
+        for j in range(K):
+            pos = np.where(base_arr > 0, base_arr + j, 0).astype(np.int32)
+            lens = np.where(base_arr > 0, base_arr + j + 1,
+                            0).astype(np.int32)
+            cur, self.draft_k_pages, self.draft_v_pages = \
+                self._draft_decode_fn(
+                    self._draft_params, self._draft_buffers, cur,
+                    jnp.asarray(pos), tables, jnp.asarray(lens),
+                    self.draft_k_pages, self.draft_v_pages, zeros_temp,
+                    self._next_key())
+            self.n_draft_steps += 1
+            if j < K - 1:
+                tok_cols.append(cur)
+        tokens_mat = jnp.stack(tok_cols, axis=1)            # [B, K]
+        greedy, self.k_pages, self.v_pages = self._verify_fn(
+            self._params, self._buffers, tokens_mat,
+            jnp.asarray(base_arr), tables, self.k_pages, self.v_pages)
+        self.n_steps += 1
+        self.n_spec_rounds += 1
+        host_g = np.asarray(greedy)                         # the round sync
+        host_d = np.asarray(tokens_mat)
+        new_last = np.asarray(self._tokens_dev).copy()
+        for slot in live:
+            g, d = host_g[slot], host_d[slot]
+            # accept within cache capacity: positions >= base+cap were
+            # scattered to the scratch page, so tokens there (and the
+            # queries after them) are not backed by real KV
+            i = 0
+            while i < min(K - 1, caps[slot] - 1) and d[i + 1] == g[i]:
+                i += 1
+            req = self._slots[slot]
+            for tok in list(d[1:i + 1]) + [int(g[i])]:
+                req.tokens.append(int(tok))
+                self.n_tokens += 1
+                if self._harvest(slot):
+                    break
+            # cached-valid count advances over t0..d_i only; the bonus
+            # g_i is next round's input (cached when fed)
+            self.context_lens[slot] = int(base_arr[slot]) + i + 1
+            new_last[slot] = int(g[i])
+            if self._harvest(slot):
+                self._begin_close(slot)
+        self._tokens_dev = jnp.asarray(new_last)
         self._maybe_finalize()
 
 

@@ -205,6 +205,27 @@ def paged_attention_kernel(q, k_pages, v_pages, block_tables,
     return out.reshape(b, n_heads, d)
 
 
+def paged_attention_chunk(q, k_pages, v_pages, block_tables, base_lens,
+                          scale: Optional[float] = None):
+    """Multi-query decode attention over paged KV (the speculative-
+    verify / chunked-prefill step): ``q`` carries K NEW tokens per
+    sequence whose K/V were just written at positions
+    ``base_lens[b] .. base_lens[b]+K-1``; query j attends the first
+    ``base_lens[b]+j+1`` cached positions (its own inclusive) —
+    causal within the chunk, full context before it.
+
+    q: [B, K, heads, d]; base_lens [B] = valid tokens BEFORE the chunk
+    (0 = inactive slot → zero output rows). Returns [B, K, heads, d].
+    """
+    kq, d = q.shape[1], q.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    limit = jnp.where(base_lens[:, None] > 0,
+                      base_lens[:, None] + jnp.arange(kq)[None, :] + 1,
+                      0)                                  # [B, K]
+    return _gathered_attention(q, k_pages, v_pages, block_tables,
+                               limit, scale)
+
+
 def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
                     scale: Optional[float] = None):
     """Single-query attention over paged KV (the decode step).
@@ -213,10 +234,25 @@ def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
     block_tables: [B, pages_per_seq] page ids (-1 pads);
     context_lens: [B] valid token counts. Returns [B, heads, d].
     GQA: heads may be a multiple of kv_heads."""
-    b, n_heads, d = q.shape
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    # the K=1 case of the chunk core, with limit = context_lens
+    # DIRECTLY (so a single cached token — limit 1 — still attends,
+    # unlike the chunk's base-exclusive convention)
+    out = _gathered_attention(q[:, None], k_pages, v_pages,
+                              block_tables, context_lens[:, None],
+                              scale)
+    return out[:, 0]
+
+
+def _gathered_attention(q, k_pages, v_pages, block_tables, limit,
+                        scale):
+    """Shared decode-attention core: gather the block table's pages,
+    expand GQA, masked fp32 softmax. q [B, K, H, d]; limit [B, K] =
+    attendable cached positions per query (0 → zero output row)."""
+    b, kq, n_heads, d = q.shape
     _, page_size, kv_heads, _ = k_pages.shape
     pages_per_seq = block_tables.shape[1]
-    scale = scale if scale is not None else 1.0 / math.sqrt(d)
 
     tables = jnp.clip(block_tables, 0)               # [B, P]
     k = jnp.take(k_pages, tables, axis=0)            # [B, P, ps, KVH, d]
@@ -229,13 +265,12 @@ def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
 
-    logits = jnp.einsum("bhd,blhd->bhl", q.astype(jnp.float32),
-                        k.astype(jnp.float32)) * scale
-    mask = jnp.arange(L)[None, :] < context_lens[:, None]    # [B, L]
-    logits = jnp.where(mask[:, None, :], logits, -jnp.inf)
+    logits = jnp.einsum("bqhd,blhd->bhql", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale   # [B,H,K,L]
+    mask = jnp.arange(L)[None, None, :] < limit[:, :, None]  # [B,K,L]
+    logits = jnp.where(mask[:, None], logits, -jnp.inf)
     p = jax.nn.softmax(logits, axis=-1)
-    # an empty sequence (context_len 0, e.g. a freed batch slot) has an
-    # all -inf row; return zeros instead of softmax's NaN
-    p = jnp.where(context_lens[:, None, None] > 0, p, 0.0)
-    out = jnp.einsum("bhl,blhd->bhd", p, v.astype(jnp.float32))
+    # fully-masked rows (limit 0, e.g. a freed slot): zeros, not NaN
+    p = jnp.where(limit[:, None, :, None] > 0, p, 0.0)
+    out = jnp.einsum("bhql,blhd->bqhd", p, v.astype(jnp.float32))
     return out.astype(q.dtype)
