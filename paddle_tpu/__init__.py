@@ -51,6 +51,7 @@ from . import autograd  # noqa: F401
 from . import device  # noqa: F401
 from . import distribution  # noqa: F401
 from . import distributed  # noqa: F401
+from . import observability  # noqa: F401
 from . import profiler  # noqa: F401
 from . import quant  # noqa: F401
 from . import cost_model  # noqa: F401

@@ -44,7 +44,51 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..nn.layer import Layer, functional_call, split_state
+from ..observability import metrics as _obs
 from ..ops.paged_attention import paged_attention, paged_attention_kernel
+
+
+def _engine_metrics():
+    """Serving instruments in the process-wide registry (shared across
+    engines by design — one serving process, one scrape surface). The
+    names are the standard paged-attention-engine lens (PAPERS.md
+    "Ragged Paged Attention" evaluates on exactly these)."""
+    reg = _obs.default_registry()
+    return {
+        "ttft": reg.histogram(
+            "llm_ttft_seconds",
+            "submit → first token latency (prefill + queue)"),
+        "queue_wait": reg.histogram(
+            "llm_queue_wait_seconds",
+            "submit → admission wait (slot/page availability)"),
+        "step": reg.histogram(
+            "llm_decode_step_seconds",
+            "wall time between consecutive decode-step fetches"),
+        "tps": reg.histogram(
+            "llm_decode_tokens_per_second",
+            "tokens emitted per second of decode wall time",
+            buckets=_obs.RATE_BUCKETS),
+        "occupancy": reg.histogram(
+            "llm_batch_occupancy",
+            "live slots / max_seqs at each issued step",
+            buckets=_obs.RATIO_BUCKETS),
+        "kv_util": reg.gauge(
+            "llm_kv_page_utilization",
+            "allocated KV pages / usable pool size"),
+        "tokens": reg.counter(
+            "llm_tokens_generated", "tokens emitted to requests"),
+        "prefills": reg.counter(
+            "llm_prefills", "admitted prompts (one prefill each)"),
+        "completed": reg.counter(
+            "llm_requests_completed",
+            "requests resolved in full (disjoint from truncated/failed)"),
+        "truncated": reg.counter(
+            "llm_requests_truncated",
+            "requests finished early on pool/length pressure"),
+        "failed": reg.counter(
+            "llm_requests_failed",
+            "requests whose future resolved with an exception"),
+    }
 
 
 def _sample(logits, temperature, key):
@@ -417,11 +461,13 @@ class LLMEngine:
         self._pending: List[_Request] = []
         self._closed = False
         self._wake = threading.Event()
-        self._worker = threading.Thread(target=self._loop, daemon=True)
-        self._worker.start()
         # serving stats
         self.n_steps = 0
         self.n_tokens = 0
+        self._m = _engine_metrics()
+        self._last_fetch_t: Optional[float] = None
+        self._worker = threading.Thread(target=self._loop, daemon=True)
+        self._worker.start()
 
     # -- public API ---------------------------------------------------------
     def submit(self, prompt_ids: Sequence[int],
@@ -486,6 +532,10 @@ class LLMEngine:
             self.block_tables[slot, idx] = page
         return True
 
+    def _update_kv_gauge(self):
+        usable = self.num_pages - 1
+        self._m["kv_util"].set((usable - len(self._free_pages)) / usable)
+
     def _free_slot(self, slot: int):
         for idx in range(self.pages_per_seq):
             page = int(self.block_tables[slot, idx])
@@ -494,6 +544,7 @@ class LLMEngine:
         self.block_tables[slot] = 0
         self.context_lens[slot] = 0
         self._slots[slot] = None
+        self._update_kv_gauge()
 
     def _finish(self, slot: int):
         """Resolve + reclaim. Only callable once the slot has no
@@ -501,6 +552,11 @@ class LLMEngine:
         req = self._slots[slot]
         req.t_done = time.monotonic()
         self._free_slot(slot)
+        # disjoint outcomes: completed + truncated + failed = submitted
+        if req.truncated:
+            self._m["truncated"].inc()
+        else:
+            self._m["completed"].inc()
         req.future.set_result({
             "prompt_ids": req.prompt,
             "output_ids": req.tokens,
@@ -552,6 +608,9 @@ class LLMEngine:
             # empty while IDLE can never satisfy the request
             active = any(s is not None for s in self._slots)
             return "retry" if active else "never"
+        # admission decided: everything before this instant was queue
+        # wait (slot/page availability), everything after is prefill
+        self._m["queue_wait"].observe(time.monotonic() - req.t_submit)
         for idx in range(need):
             self.block_tables[slot, idx] = self._alloc_page()
         bucket = self._bucket(n)
@@ -574,13 +633,18 @@ class LLMEngine:
                     self.draft_k_pages, self.draft_v_pages,
                     jnp.float32(0.0), self._next_key())
         req.slot = slot
-        req.t_first = time.monotonic()
-        req.tokens.append(int(nxt))
+        tok = int(nxt)        # blocks until the prefill has executed —
+        req.t_first = time.monotonic()   # TTFT includes device time
+        req.tokens.append(tok)
         self._slots[slot] = req
         self.context_lens[slot] = n
         self._tokens_dev = self._tokens_dev.at[slot].set(req.tokens[-1])
         self.temperatures[slot] = req.temperature
         self.n_tokens += 1
+        self._m["ttft"].observe(req.t_first - req.t_submit)
+        self._m["prefills"].inc()
+        self._m["tokens"].inc()
+        self._update_kv_gauge()
         return "ok"
 
     def _harvest(self, slot: int) -> bool:
@@ -617,6 +681,10 @@ class LLMEngine:
                     while self._inflight:   # nothing to issue: drain
                         self._drain_one()
                     self._maybe_finalize()
+                    # idle gap ends here: without this reset the first
+                    # fetch after a quiet period would record the whole
+                    # wait as one decode step (and a ~0 tokens/sec)
+                    self._last_fetch_t = None
                     if not any(s is not None for s in self._slots):
                         if closed:
                             with self._mu:
@@ -649,9 +717,11 @@ class LLMEngine:
                 for slot, s in enumerate(self._slots):
                     if s is not None:
                         self._free_slot(slot)
+                        self._m["failed"].inc()
                         s.future.set_exception(e)
                 for req in pending:
                     if not req.future.done():
+                        self._m["failed"].inc()
                         req.future.set_exception(e)
                 with self._mu:  # drop re-queued copies of failed reqs
                     self._pending = [r for r in self._pending
@@ -662,6 +732,7 @@ class LLMEngine:
         (e.g. max_new_tokens=1) resolve once drained."""
         verdict = self._admit(req)
         if verdict == "never":
+            self._m["failed"].inc()
             req.future.set_exception(ValueError(
                 f"prompt of {len(req.prompt)} tokens cannot fit the "
                 f"KV page pool ({self.num_pages - 1} usable pages of "
@@ -717,6 +788,8 @@ class LLMEngine:
         self._inflight.append((self._issue_seq, list(live), tokens))
         for slot in live:
             self.context_lens[slot] += 1
+        self._m["occupancy"].observe(len(live) / self.max_seqs)
+        self._update_kv_gauge()
 
     def _drain_one(self):
         """Fetch the oldest in-flight step's tokens and process them
@@ -725,6 +798,7 @@ class LLMEngine:
         host = np.asarray(tokens)          # the only blocking fetch
         self._fetch_seq = seq
         self.n_steps += 1
+        emitted = 0
         for slot in slots_list:
             req = self._slots[slot]
             if req is None:
@@ -734,12 +808,29 @@ class LLMEngine:
                 continue  # overrun token of a finished request
             req.tokens.append(int(host[slot]))
             self.n_tokens += 1
+            emitted += 1
             if self.eos_token_id is not None and \
                     req.tokens[-1] == self.eos_token_id:
                 req.accepts_inflight = False  # nothing after EOS
             if not req.closing and self._harvest(slot):
                 self._begin_close(slot)
+        self._observe_step(emitted)
         self._maybe_finalize()
+
+    def _observe_step(self, emitted: int):
+        """Per-fetch timing → step-time and tokens/sec histograms.
+        Fetch-to-fetch wall time is the honest denominator under
+        lookahead (the issue is async; the fetch is where the engine
+        actually pays)."""
+        now = time.monotonic()
+        if self._last_fetch_t is not None:
+            dt = now - self._last_fetch_t
+            self._m["step"].observe(dt)
+            if dt > 0 and emitted:
+                self._m["tps"].observe(emitted / dt)
+        if emitted:
+            self._m["tokens"].inc(emitted)
+        self._last_fetch_t = now
 
     def _spec_round(self, live: List[int]):
         """One speculative round: K draft steps propose, ONE target pass
@@ -801,8 +892,11 @@ class LLMEngine:
             jnp.asarray(base_arr), tables, self.k_pages, self.v_pages)
         self.n_steps += 1
         self.n_spec_rounds += 1
+        self._m["occupancy"].observe(len(live) / self.max_seqs)
+        self._update_kv_gauge()
         host_g = np.asarray(greedy)                         # the round sync
         host_d = np.asarray(tokens_mat)
+        emitted = 0
         new_last = np.asarray(self._tokens_dev).copy()
         for slot in live:
             g, d = host_g[slot], host_d[slot]
@@ -816,6 +910,7 @@ class LLMEngine:
             for tok in list(d[1:i + 1]) + [int(g[i])]:
                 req.tokens.append(int(tok))
                 self.n_tokens += 1
+                emitted += 1
                 if self._harvest(slot):
                     break
             # cached-valid count advances over t0..d_i only; the bonus
@@ -825,6 +920,7 @@ class LLMEngine:
             if self._harvest(slot):
                 self._begin_close(slot)
         self._tokens_dev = jnp.asarray(new_last)
+        self._observe_step(emitted)
         self._maybe_finalize()
 
 

@@ -23,12 +23,25 @@ import itertools
 import math
 import queue
 import threading
+import time
 from typing import Any, Callable, Iterable, Iterator, List, Optional, Sequence
 
 import jax
 import numpy as np
 
 from ..core import rng as rng_mod
+from ..observability import metrics as _obs
+
+
+def _loader_metrics():
+    reg = _obs.default_registry()
+    return {
+        "wait": reg.histogram(
+            "dataloader_next_wait_seconds",
+            "time the consumer blocked waiting for the next batch"),
+        "batches": reg.counter(
+            "dataloader_batches", "batches handed to the train loop"),
+    }
 
 
 class Dataset:
@@ -288,6 +301,7 @@ class _PrefetchIterator:
         self._err: Optional[BaseException] = None
         self._produce = produce
         self._stop = threading.Event()
+        self._obs = _loader_metrics()
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
@@ -309,11 +323,16 @@ class _PrefetchIterator:
         return self
 
     def __next__(self):
+        t0 = time.perf_counter()
         item = self._q.get()
         if item is self._SENTINEL:
             if self._err is not None:
                 raise self._err
             raise StopIteration
+        # wait ≈ how starved the train loop is for input: near zero
+        # when prefetch keeps up, ≈ batch production time when not
+        self._obs["wait"].observe(time.perf_counter() - t0)
+        self._obs["batches"].inc()
         return item
 
     def close(self):

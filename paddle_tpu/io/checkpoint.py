@@ -21,15 +21,62 @@ Paddle-shaped API and wires it to hapi Model and callbacks.
 from __future__ import annotations
 
 import os
+import time
 from typing import Any, Dict, Optional
 
 import jax
 import numpy as np
 
+from ..core.monitor import stat_add
+from ..observability import metrics as _obs
+
 
 def _ocp():
     import orbax.checkpoint as ocp
     return ocp
+
+
+def _ckpt_metrics():
+    reg = _obs.default_registry()
+    return {
+        "save": reg.histogram(
+            "checkpoint_save_seconds",
+            "checkpoint save wall time (dispatch only when async)"),
+        "restore": reg.histogram(
+            "checkpoint_restore_seconds", "checkpoint restore wall time"),
+        "bytes": reg.counter(
+            "checkpoint_bytes_written",
+            "array bytes handed to checkpoint saves"),
+    }
+
+
+def _tree_bytes(tree: Any) -> int:
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        nbytes = getattr(leaf, "nbytes", None)
+        if nbytes is not None:
+            total += int(nbytes)
+    return total
+
+
+def _record_save(dt: float, tree: Any) -> None:
+    m = _ckpt_metrics()
+    nbytes = _tree_bytes(tree)
+    m["save"].observe(dt)
+    m["bytes"].inc(nbytes)
+    # STAT_ADD wiring (monitor.h idiom) so a train-with-restart run's
+    # snapshot() is non-empty. Names must not sanitize to the same
+    # Prometheus name as the histograms above (checkpoint.save_seconds
+    # → checkpoint_save_seconds would collide and corrupt the scrape).
+    stat_add("checkpoint.saves", 1)
+    stat_add("checkpoint.save_wall_seconds", dt)
+    stat_add("checkpoint.saved_bytes", nbytes)
+
+
+def _record_restore(dt: float) -> None:
+    _ckpt_metrics()["restore"].observe(dt)
+    stat_add("checkpoint.restores", 1)
+    stat_add("checkpoint.restore_wall_seconds", dt)
 
 
 class CheckpointManager:
@@ -55,8 +102,12 @@ class CheckpointManager:
 
     def save(self, step: int, tree: Any, force: bool = False) -> bool:
         ocp = _ocp()
-        return self._mgr.save(step, args=ocp.args.StandardSave(tree),
-                              force=force)
+        t0 = time.perf_counter()
+        saved = self._mgr.save(step, args=ocp.args.StandardSave(tree),
+                               force=force)
+        if saved:
+            _record_save(time.perf_counter() - t0, tree)
+        return saved
 
     def restore(self, step: Optional[int] = None, like: Any = None) -> Any:
         ocp = _ocp()
@@ -64,10 +115,15 @@ class CheckpointManager:
         if step is None:
             raise FileNotFoundError(
                 f"no checkpoints under {self.directory}")
-        if like is not None:
-            return self._mgr.restore(
-                step, args=ocp.args.StandardRestore(like))
-        return self._mgr.restore(step)
+        t0 = time.perf_counter()
+        # always pass StandardRestore: a manager REOPENED over an
+        # existing directory (the restart path) has no handler
+        # registered for the saved item and a bare restore(step)
+        # KeyErrors on current orbax
+        tree = self._mgr.restore(
+            step, args=ocp.args.StandardRestore(like))
+        _record_restore(time.perf_counter() - t0)
+        return tree
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
@@ -101,8 +157,10 @@ def save_checkpoint(path: str, model, optimizer_state=None,
         tree["optimizer"] = optimizer_state
     tree.update(extra)
     ckptr = ocp.StandardCheckpointer()
+    t0 = time.perf_counter()
     ckptr.save(os.path.abspath(path), tree, force=True)
     ckptr.wait_until_finished()
+    _record_save(time.perf_counter() - t0, tree)
 
 
 def load_checkpoint(path: str, model=None, like: Any = None) -> Dict:
@@ -110,10 +168,12 @@ def load_checkpoint(path: str, model=None, like: Any = None) -> Dict:
     state_dict is applied in place (ref: paddle.load + set_state_dict)."""
     ocp = _ocp()
     ckptr = ocp.StandardCheckpointer()
+    t0 = time.perf_counter()
     if like is not None:
         tree = ckptr.restore(os.path.abspath(path), like)
     else:
         tree = ckptr.restore(os.path.abspath(path))
+    _record_restore(time.perf_counter() - t0)
     if model is not None and "model" in tree:
         model.set_state_dict(tree["model"])
     return tree

@@ -1,0 +1,36 @@
+"""paddle_tpu.observability — unified metrics + trace export.
+
+The measurement layer the north star requires (ROADMAP: serve heavy
+traffic, run as fast as the hardware allows — neither is checkable
+without numbers). Two halves:
+
+- metrics: Counter / Gauge / Histogram families with labels, one
+  process-wide ``MetricRegistry`` (the superset of the reference's
+  platform/monitor.h StatRegistry, which ``core.monitor`` now fronts);
+- exporters: Prometheus text exposition, chrome://tracing JSON for the
+  profiler's host annotations (the ChromeTracingLogger analog), a
+  periodic JSONL file reporter, and jax device-memory gauges.
+
+Hot paths ship instrumented: ``inference.llm`` (TTFT, tokens/sec,
+batch occupancy, KV-page utilization, queue wait), ``hapi.Model``
+(step time, examples/sec, compile count/time), ``io.checkpoint``
+(durations, bytes), ``distributed.elastic`` (restart/preemption
+counters), and the DataLoader prefetch path. Metric names are tabled
+in docs/OBSERVABILITY.md.
+"""
+
+from .metrics import (BYTE_BUCKETS, DEFAULT_BUCKETS,  # noqa: F401
+                      RATE_BUCKETS, RATIO_BUCKETS, CounterChild,
+                      GaugeChild, HistogramChild, MetricFamily,
+                      MetricRegistry, default_registry)
+from .exporters import (JSONLReporter, export_chrome_tracing,  # noqa: F401
+                        prometheus_text, sample_device_memory,
+                        write_prometheus)
+
+__all__ = [
+    "BYTE_BUCKETS", "DEFAULT_BUCKETS", "RATE_BUCKETS", "RATIO_BUCKETS",
+    "CounterChild", "GaugeChild", "HistogramChild",
+    "MetricFamily", "MetricRegistry", "default_registry",
+    "JSONLReporter", "export_chrome_tracing", "prometheus_text",
+    "sample_device_memory", "write_prometheus",
+]

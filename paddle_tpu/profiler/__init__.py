@@ -85,16 +85,29 @@ def make_scheduler(*, closed: int, ready: int, record: int,
 
 class _HostEvents:
     """Process-wide so events from worker threads (data loading, async
-    checkpointing) land in the same summary() table."""
+    checkpointing) land in the same summary() table.
+
+    Two views of the same stream: ``stats`` (per-name durations, feeds
+    summary()) and ``trace`` (timestamped complete events, feeds
+    observability.export_chrome_tracing — the ChromeTracingLogger
+    analog). The trace is bounded so a long profiled run can't grow
+    host memory without limit; the per-name aggregates keep counting
+    past the cap."""
+
+    TRACE_CAP = 200_000
 
     def __init__(self):
         self.stats: Dict[str, list] = collections.defaultdict(list)
+        self.trace: collections.deque = collections.deque(
+            maxlen=self.TRACE_CAP)
         self.active = False
         self.lock = threading.Lock()
 
-    def record(self, name: str, dt: float) -> None:
+    def record(self, name: str, t0: float, dt: float) -> None:
         with self.lock:
             self.stats[name].append(dt)
+            self.trace.append({"name": name, "ts": t0, "dur": dt,
+                               "tid": threading.get_ident()})
 
 
 _events = _HostEvents()
@@ -121,7 +134,7 @@ class RecordEvent:
             self._ann.__exit__(None, None, None)
             self._ann = None
         if _events.active:
-            _events.record(self.name, dt)
+            _events.record(self.name, self._t0, dt)
 
     def __enter__(self):
         self.begin()
@@ -175,8 +188,14 @@ class Profiler:
 
     # -- lifecycle ------------------------------------------------------
     def start(self):
+        # clear UNDER the lock: worker threads may be inside
+        # RecordEvent.end() → _events.record() concurrently, and a
+        # bare clear() races their defaultdict append (lost events /
+        # dict-mutated-during-iteration in summary)
+        with _events.lock:
+            _events.stats.clear()
+            _events.trace.clear()
         _events.active = True
-        _events.stats.clear()
         self._transition(self.scheduler(self.step_num))
 
     def step(self):
@@ -263,5 +282,8 @@ def profile(log_dir: str = "./paddle_tpu_profile"):
         p.stop()
 
 
-export_chrome_tracing = None  # reference parity marker: XProf traces are
-# TensorBoard-format; use `tensorboard --logdir <log_dir>` or xprof.
+# Host-annotation chrome://tracing export (ref: ChromeTracingLogger).
+# Device-side timelines remain in the XProf dump under log_dir
+# (`tensorboard --logdir <log_dir>` or xprof); this file carries the
+# RecordEvent host events the summary() table aggregates.
+from ..observability.exporters import export_chrome_tracing  # noqa: E402,F401

@@ -27,6 +27,9 @@ python -c "import jax; jax.config.update('jax_platforms','cpu'); \
 jax.config.update('jax_num_cpu_devices', 8); \
 import __graft_entry__ as g; g.dryrun_multichip(4)"
 
+echo "== observability smoke (train loop -> prometheus + chrome trace + jsonl)"
+python tools/obs_smoke.py "$(mktemp -d)"
+
 echo "== bench smoke (CPU backend)"
 # PT_BENCH_FORCE_CPU: run the measuring child directly on CPU — the
 # default orchestrator mode would spend its TPU probe windows first

@@ -1,0 +1,89 @@
+"""Observability smoke gate (tools/ci.sh step): run a tiny instrumented
+train loop under the profiler, dump every exporter, and assert the
+artifacts parse — Prometheus text exposition, the chrome://tracing JSON
+(≥1 complete "X" event per recorded host annotation), and the JSONL
+reporter stream. Exits non-zero on any missing signal so a refactor
+that silently unhooks an instrument fails CI, not a 3am bench round.
+
+Run: python tools/obs_smoke.py [outdir]
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def main(outdir: str = "/tmp/pt_obs_smoke") -> int:
+    import paddle_tpu as pt
+    from paddle_tpu import nn, observability
+    from paddle_tpu.io import TensorDataset
+    from paddle_tpu.profiler import Profiler, export_chrome_tracing
+
+    os.makedirs(outdir, exist_ok=True)
+    pt.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+    model = pt.Model(net)
+    model.prepare(optimizer=pt.optimizer.SGD(learning_rate=0.1,
+                                             parameters=net),
+                  loss=nn.CrossEntropyLoss())
+    x = np.random.RandomState(0).randn(64, 8).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 2, (64, 1))
+
+    jsonl_path = os.path.join(outdir, "metrics.jsonl")
+    prof = Profiler(log_dir=os.path.join(outdir, "xprof"))
+    with observability.JSONLReporter(jsonl_path, interval=0.2):
+        prof.start()
+        model.fit(TensorDataset([x, y]), batch_size=16, epochs=2,
+                  verbose=0)
+        prof.stop()
+    observability.sample_device_memory()
+
+    # -- chrome trace: loads, and covers every recorded annotation ------
+    trace_path = export_chrome_tracing(prof,
+                                       os.path.join(outdir, "trace.json"))
+    with open(trace_path) as f:
+        trace = json.load(f)
+    events = trace["traceEvents"]
+    assert events, "empty chrome trace"
+    assert all(ev["ph"] == "X" and ev["dur"] >= 0 for ev in events)
+    names = {ev["name"] for ev in events}
+    for bucket in ("Dataloader", "TrainStep", "Callbacks"):
+        assert bucket in names, (bucket, names)
+
+    # -- prometheus text: parses line-by-line, has the train signals ----
+    prom_path = observability.write_prometheus(
+        os.path.join(outdir, "metrics.prom"))
+    with open(prom_path) as f:
+        text = f.read()
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name_part, value = line.rsplit(" ", 1)
+        float(value)            # every sample value is a number
+        assert name_part[0].isalpha() or name_part[0] == "_", line
+    assert "train_step_seconds_count" in text
+    assert "dataloader_batches" in text
+
+    # -- jsonl stream: every line self-contained JSON with metrics ------
+    with open(jsonl_path) as f:
+        lines = [json.loads(ln) for ln in f if ln.strip()]
+    assert lines, "JSONL reporter wrote nothing"
+    assert any(rec["metrics"].get("train_step_seconds_count", 0) > 0
+               for rec in lines), "no step metrics reached the JSONL dump"
+
+    print(f"observability smoke OK: {len(events)} trace events, "
+          f"{len(text.splitlines())} prom lines, {len(lines)} jsonl rows "
+          f"-> {outdir}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(*sys.argv[1:]))
