@@ -547,4 +547,7 @@ def __getattr__(name):
     if name in ("LLMEngine", "serve_llm"):
         from . import llm
         return getattr(llm, name)
+    if name == "PrefixCache":
+        from .prefix_cache import PrefixCache
+        return PrefixCache
     raise AttributeError(name)

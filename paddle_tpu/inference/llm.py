@@ -88,15 +88,51 @@ def _engine_metrics():
         "failed": reg.counter(
             "llm_requests_failed",
             "requests whose future resolved with an exception"),
+        # prefix cache + chunked prefill (this PR's lens)
+        "prompt_tokens": reg.counter(
+            "llm_prompt_tokens", "prompt tokens submitted (admitted "
+            "requests; reused + recomputed)"),
+        "cache_hit_tokens": reg.counter(
+            "llm_prefix_cache_hit_tokens",
+            "prompt tokens served from cached prefix pages (not "
+            "recomputed)"),
+        "cache_hit_rate": reg.gauge(
+            "llm_prefix_cache_hit_rate",
+            "cumulative prefix-cache hit rate: reused / prompt tokens"),
+        "shared_pages": reg.gauge(
+            "llm_prefix_cache_pages",
+            "refcounted pages resident in the prefix cache (shared + "
+            "evictable)"),
+        "prefill_queue": reg.gauge(
+            "llm_prefill_queue_depth",
+            "admitted requests with un-prefilled prompt tokens"),
+        "prefill_ticks": reg.counter(
+            "llm_prefill_ticks",
+            "chunked-prefill engine ticks (one chunk each)"),
+        "decode_ticks": reg.counter(
+            "llm_decode_ticks", "decode engine ticks (one step each)"),
+        "tick_ratio": reg.gauge(
+            "llm_prefill_decode_tick_ratio",
+            "prefill ticks / decode ticks since engine start"),
     }
 
 
-def _sample(logits, temperature, key):
+def _sample(logits, temperature, key, nonces, positions):
     """Per-slot device sampling: temperature<=0 → greedy.
-    logits [B, V], temperature [B], key scalar PRNGKey."""
+    logits [B, V], temperature [B], key scalar PRNGKey.
+
+    The per-token key is fold_in(fold_in(key, nonce), position): nonce
+    is the request's submission sequence number, position the prompt
+    index of the token being fed. Keys therefore depend only on WHAT
+    is sampled, never on HOW the scheduler got there — prefix-cache
+    hits, chunked prefill, and lookahead all change the device-call
+    stream but reproduce identical sampled tokens (test-pinned)."""
     greedy = jnp.argmax(logits, axis=-1)
-    keys = jax.vmap(jax.random.fold_in, (None, 0))(
-        key, jnp.arange(logits.shape[0]))
+
+    def mk(n, p):
+        return jax.random.fold_in(jax.random.fold_in(key, n), p)
+
+    keys = jax.vmap(mk)(nonces, positions)
     scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
     sampled = jax.vmap(jax.random.categorical)(keys, scaled)
     return jnp.where(temperature > 0.0, sampled, greedy)
@@ -120,7 +156,7 @@ class _PagedDecode(Layer):
         return paged_attention(q, k_pages, v_pages, tables, lens)
 
     def forward(self, tokens, positions, block_tables, context_lens,
-                k_pages, v_pages, temperature, key):
+                k_pages, v_pages, temperature, nonces, key):
         net, cfg = self.net, self.net.cfg
         gpt = net.gpt
         b = tokens.shape[0]
@@ -169,7 +205,7 @@ class _PagedDecode(Layer):
         from ..models.gpt import _lm_logits
         logits = _lm_logits(cfg, gpt.embeddings, x,
                             getattr(net, "lm_head", None))[:, 0]
-        nxt = _sample(logits, temperature, key)
+        nxt = _sample(logits, temperature, key, nonces, positions)
         return nxt, k_pages, v_pages
 
 
@@ -247,7 +283,7 @@ class _PagedPrefill(Layer):
         self.net = net
 
     def forward(self, ids, true_len, block_row, k_pages, v_pages,
-                temperature, key):
+                temperature, nonce, key):
         net, cfg = self.net, self.net.cfg
         s = ids.shape[1]
         ps = k_pages.shape[2]
@@ -263,14 +299,95 @@ class _PagedPrefill(Layer):
             v_pages = v_pages.at[i, page_idx, offs].set(
                 v_c[0].astype(v_pages.dtype))
         last = logits[0, true_len - 1][None]              # [1, V]
-        nxt = _sample(last, temperature[None], key)[0]
+        nxt = _sample(last, temperature[None], key, nonce[None],
+                      (true_len - 1)[None])[0]
+        return nxt, k_pages, v_pages
+
+
+class _ChunkedPrefill(Layer):
+    """One RAGGED prefill chunk: a fixed budget of T prompt tokens
+    drawn from one or MORE requests' uncached suffixes, processed as a
+    single batched forward. Each token carries its own block-table row
+    and position; attention runs per token over its sequence's already-
+    cached pages (shared prefix pages included) via
+    :func:`paged_attention_ragged` — causal inside the chunk because a
+    token's limit is its own position + 1 and earlier chunk tokens'
+    K/V are scattered into the pool before the attention reads it.
+
+    Sampling: for each slot whose prompt COMPLETES inside this chunk,
+    ``sample_idx`` points at its last prompt token's row; that row's
+    logits are sampled into the returned [max_seqs] token vector (rows
+    of non-finishing slots are ignored by the host). Everything stays
+    on device — admission never fetches."""
+
+    def __init__(self, net, attention_impl: str = "xla"):
+        super().__init__()
+        self.net = net
+        self.attention_impl = attention_impl
+
+    def forward(self, tokens, positions, limits, tables, sample_idx,
+                sample_pos, k_pages, v_pages, temperatures, nonces,
+                key):
+        from ..ops.paged_attention import paged_attention_ragged
+        net, cfg = self.net, self.net.cfg
+        gpt = net.gpt
+        t = tokens.shape[0]
+        ps = k_pages.shape[2]
+        hd = cfg.head_dim
+
+        pos_ids = positions[None, :]                       # [1, T]
+        x = gpt.embeddings(tokens[None, :], position_ids=pos_ids)
+        active = limits > 0
+        page_idx = jnp.take_along_axis(
+            jnp.clip(tables, 0), (positions // ps)[:, None],
+            axis=1)[:, 0]
+        page_idx = jnp.where(active, page_idx, 0)  # pads → scratch 0
+        offs = positions % ps
+
+        if cfg.use_rope:
+            from ..ops.rotary import apply_rotary_pos_emb, rope_tables
+            cos, sin = rope_tables(hd, cfg.max_position_embeddings,
+                                   cfg.rope_base)
+
+        for i, layer in enumerate(gpt.layers):
+            h = layer.ln_1(x)
+            qkv = layer.attn.qkv_proj(h)
+            q, k, v = jnp.split(
+                qkv, [cfg.hidden_size,
+                      cfg.hidden_size + cfg.num_kv_heads * hd], axis=-1)
+            q = q.reshape(1, t, cfg.num_heads, hd)
+            k = k.reshape(1, t, cfg.num_kv_heads, hd)
+            v = v.reshape(1, t, cfg.num_kv_heads, hd)
+            if cfg.use_rope:
+                q, k = apply_rotary_pos_emb(q, k, cos, sin,
+                                            position_ids=pos_ids)
+            k_pages = k_pages.at[i, page_idx, offs].set(
+                k[0].astype(k_pages.dtype))
+            v_pages = v_pages.at[i, page_idx, offs].set(
+                v[0].astype(v_pages.dtype))
+            att = paged_attention_ragged(q[0], k_pages[i], v_pages[i],
+                                         tables, limits,
+                                         impl=self.attention_impl)
+            x = x + layer.attn.out_proj(
+                att.reshape(1, t, cfg.hidden_size))
+            x = x + layer.mlp(layer.ln_2(x))
+        x = gpt.ln_f(x)
+        from ..models.gpt import _lm_logits
+        # only the finishing slots' last-token rows need the LM head:
+        # [max_seqs, H] gathered rows, not [T, V] full logits
+        rows = jnp.take(x[0], sample_idx, axis=0)          # [B, H]
+        logits = _lm_logits(cfg, gpt.embeddings, rows[:, None],
+                            getattr(net, "lm_head", None))[:, 0]
+        nxt = _sample(logits, temperatures, key, nonces, sample_pos)
         return nxt, k_pages, v_pages
 
 
 class _Request:
     __slots__ = ("prompt", "max_new_tokens", "temperature", "future",
                  "tokens", "slot", "truncated", "t_submit", "t_first",
-                 "t_done", "closing", "drain_after", "accepts_inflight")
+                 "t_done", "closing", "drain_after", "accepts_inflight",
+                 "nonce", "prefill_pos", "prefill_done", "digests",
+                 "n_cached", "n_reg_pages")
 
     def __init__(self, prompt, max_new_tokens, temperature):
         self.prompt = list(map(int, prompt))
@@ -292,6 +409,16 @@ class _Request:
         # a closer that still WANTS its in-flight tokens (closed for
         # page/length-budget reasons, not EOS) keeps accepting them
         self.accepts_inflight = False
+        # chunked-prefill lifecycle: nonce = submission sequence number
+        # (sampling-key salt, scheduler-independent); prefill_pos = next
+        # prompt position to compute (starts past the cached prefix);
+        # prefill_done gates entry into the decode batch
+        self.nonce = 0
+        self.prefill_pos = 0
+        self.prefill_done = False
+        self.digests: List[bytes] = []
+        self.n_cached = 0
+        self.n_reg_pages = 0    # prompt pages promoted to shared so far
 
 
 class LLMEngine:
@@ -329,6 +456,24 @@ class LLMEngine:
     the costs are admission/EOS reaction lagging by up to
     ``lookahead`` steps and up to ``lookahead`` wasted step-slots of
     compute after a sequence finishes.
+
+    ``prefix_cache`` + ``prefill_chunk``: PREFIX CACHING over the page
+    pool (full prompt pages become immutable, refcounted, and keyed by
+    a rolling hash — a new request whose prompt prefix matches maps
+    those pages read-only and prefills only the uncached suffix; LRU
+    eviction reclaims refcount-zero pages under pressure) and CHUNKED
+    RAGGED PREFILL (admission enqueues prefill work; ``_loop``
+    processes a fixed ``prefill_chunk``-token budget per tick,
+    interleaved with decode ticks, so a long prompt no longer stalls
+    in-flight decodes and admission performs no blocking device
+    fetch — the first token is harvested asynchronously like decode
+    tokens). Generations are token-identical with the cache on or off
+    (shared pages hold bitwise-identical KV; sampling keys depend only
+    on request nonce + position — test-pinned). ``prefill_chunk``
+    defaults to the smallest prefill bucket. Speculative engines
+    (``draft_net``) keep the inline one-shot prefill path and force
+    the prefix cache off (the draft's paged KV would need the same
+    sharing treatment; deferred).
     """
 
     def __init__(self, net, max_seqs: int = 8, page_size: int = 16,
@@ -337,7 +482,9 @@ class LLMEngine:
                  eos_token_id: Optional[int] = None,
                  cache_dtype=jnp.float32, seed: int = 0,
                  lookahead: int = 0, attention_impl: str = "xla",
-                 draft_net=None, spec_tokens: int = 4):
+                 draft_net=None, spec_tokens: int = 4,
+                 prefix_cache: bool = True,
+                 prefill_chunk: Optional[int] = None):
         cfg = net.cfg
         self.cfg = cfg
         self.max_seqs = max_seqs
@@ -366,9 +513,16 @@ class LLMEngine:
         # device-chained last tokens (authoritative between fetches)
         self._tokens_dev = jnp.zeros((max_seqs,), jnp.int32)
         self.lookahead = int(lookahead)
-        self._inflight = deque()   # (issue_seq, active_slots, tokens)
+        self._inflight = deque()   # (issue_seq, slots, tokens, kind)
         self._issue_seq = 0
         self._fetch_seq = 0
+        # per-slot sampling-key salts (the occupant request's nonce)
+        self._nonces = np.zeros((max_seqs,), np.int32)
+        self._nonce_seq = 0
+        # chunked-prefill work queue (admitted, suffix not yet computed)
+        self._prefill_q: deque = deque()
+        self.prefill_chunk = int(prefill_chunk or
+                                 self.prefill_buckets[0])
 
         if attention_impl not in ("xla", "pallas"):
             raise ValueError(f"unknown attention_impl {attention_impl!r}")
@@ -402,17 +556,19 @@ class LLMEngine:
                 split_state(ddecode)
 
             def draft_decode_fn(params, buffers, tokens, positions,
-                                tables, lens, kp, vp, temps, key):
+                                tables, lens, kp, vp, temps, nonces,
+                                key):
                 (out, _) = functional_call(
                     ddecode, params, buffers, tokens, positions,
-                    tables, lens, kp, vp, temps, key, training=False)
+                    tables, lens, kp, vp, temps, nonces, key,
+                    training=False)
                 return out
 
             def draft_prefill_fn(params, buffers, ids, true_len, row,
-                                 kp, vp, temp, key):
+                                 kp, vp, temp, nonce, key):
                 (out, _) = functional_call(
                     dprefill, params, buffers, ids, true_len, row, kp,
-                    vp, temp, key, training=False)
+                    vp, temp, nonce, key, training=False)
                 return out
 
             verify = _PagedVerify(net)
@@ -432,31 +588,54 @@ class LLMEngine:
             self.n_spec_rounds = 0
             self.n_draft_steps = 0
         decode = _PagedDecode(net, attention_impl)
-        prefill = _PagedPrefill(net)
-        # both wrappers share `net` as their only sublayer, so one
+        # all wrappers share `net` as their only sublayer, so one
         # "net."-prefixed param dict serves decode and prefill alike
         self._params, self._buffers = split_state(decode)
 
         def decode_fn(params, buffers, tokens, positions, tables, lens,
-                      kp, vp, temps, key):
+                      kp, vp, temps, nonces, key):
             (out, _) = functional_call(
                 decode, params, buffers, tokens, positions, tables,
-                lens, kp, vp, temps, key, training=False)
-            return out
-
-        def prefill_fn(params, buffers, ids, true_len, row, kp, vp,
-                       temp, key):
-            (out, _) = functional_call(
-                prefill, params, buffers, ids, true_len, row, kp, vp,
-                temp, key, training=False)
+                lens, kp, vp, temps, nonces, key, training=False)
             return out
 
         # donate the pools: XLA updates pages in place step to step
         self._decode_fn = jax.jit(decode_fn, donate_argnums=(6, 7))
-        self._prefill_fn = jax.jit(prefill_fn, donate_argnums=(5, 6))
+
+        if self.spec_k:
+            # speculative engines keep the inline one-shot prefill
+            # (round-synced anyway; the draft pool would need the same
+            # prefix-sharing treatment) and run without a prefix cache
+            prefill = _PagedPrefill(net)
+
+            def prefill_fn(params, buffers, ids, true_len, row, kp, vp,
+                           temp, nonce, key):
+                (out, _) = functional_call(
+                    prefill, params, buffers, ids, true_len, row, kp,
+                    vp, temp, nonce, key, training=False)
+                return out
+
+            self._prefill_fn = jax.jit(prefill_fn,
+                                       donate_argnums=(5, 6))
+            self._cache = None
+        else:
+            chunked = _ChunkedPrefill(net, attention_impl)
+
+            def chunk_fn(params, buffers, tokens, positions, limits,
+                         tables, sample_idx, sample_pos, kp, vp, temps,
+                         nonces, key):
+                (out, _) = functional_call(
+                    chunked, params, buffers, tokens, positions,
+                    limits, tables, sample_idx, sample_pos, kp, vp,
+                    temps, nonces, key, training=False)
+                return out
+
+            self._chunk_fn = jax.jit(chunk_fn, donate_argnums=(8, 9))
+            from .prefix_cache import PrefixCache
+            self._cache = PrefixCache(page_size) if prefix_cache \
+                else None
 
         self._key = jax.random.PRNGKey(seed)
-        self._step_i = 0
         self._mu = threading.Lock()
         self._pending: List[_Request] = []
         self._closed = False
@@ -464,6 +643,13 @@ class LLMEngine:
         # serving stats
         self.n_steps = 0
         self.n_tokens = 0
+        self.n_prompt_tokens = 0    # admitted prompt tokens
+        self.n_cached_tokens = 0    # of those, served from the cache
+        self.n_prefill_ticks = 0
+        self.n_decode_ticks = 0
+        # recent tick kinds ('p'refill / 'd'ecode): the interleaving
+        # witness — a long prompt's chunks must bracket decode ticks
+        self.tick_history: deque = deque(maxlen=512)
         self._m = _engine_metrics()
         self._last_fetch_t: Optional[float] = None
         self._worker = threading.Thread(target=self._loop, daemon=True)
@@ -477,7 +663,9 @@ class LLMEngine:
             raise ValueError(
                 f"prompt {len(prompt_ids)} + max_new_tokens "
                 f"{max_new_tokens} exceeds engine max_len {self.max_len}")
-        if len(prompt_ids) > self.prefill_buckets[-1]:
+        if self.spec_k and len(prompt_ids) > self.prefill_buckets[-1]:
+            # only the speculative INLINE prefill is bucket-shaped; the
+            # chunked ragged path handles any length up to max_len
             raise ValueError(
                 f"prompt {len(prompt_ids)} exceeds the largest prefill "
                 f"bucket {self.prefill_buckets[-1]}; raise "
@@ -492,6 +680,11 @@ class LLMEngine:
         with self._mu:
             if self._closed:
                 raise RuntimeError("engine closed")
+            # nonce = submission order: the sampling-key salt is fixed
+            # HERE, so scheduler choices (cache hits, chunking, retry
+            # timing) can never change a request's sampled stream
+            req.nonce = self._nonce_seq
+            self._nonce_seq += 1
             self._pending.append(req)
         self._wake.set()
         return req.future
@@ -508,6 +701,13 @@ class LLMEngine:
             self._closed = True
         self._wake.set()
         self._worker.join(timeout=60)
+        if self._cache is not None and not self._worker.is_alive():
+            # worker exited -> all requests are resolved and every
+            # shared page is at refcount zero: flushing returns the
+            # pool to its full free size (page-leak accounting stays
+            # exact). If the join TIMED OUT (wedged device call), the
+            # worker still owns these structures — don't touch them.
+            self._free_pages.extend(self._cache.flush())
 
     def __enter__(self):
         return self
@@ -517,7 +717,21 @@ class LLMEngine:
 
     # -- scheduler ----------------------------------------------------------
     def _alloc_page(self) -> Optional[int]:
-        return self._free_pages.pop() if self._free_pages else None
+        if self._free_pages:
+            return self._free_pages.pop()
+        if self._cache is not None and self._cache.evictable_count:
+            # LRU eviction over refcount-zero cached pages; pages
+            # mapped by a live sequence (ref > 0) are never candidates
+            return self._cache.evict_one()
+        return None
+
+    def _avail_pages(self) -> int:
+        """Pages the allocator could produce right now (free pool +
+        evictable refcount-zero cache residents)."""
+        n = len(self._free_pages)
+        if self._cache is not None:
+            n += self._cache.evictable_count
+        return n
 
     def _ensure_page(self, slot: int, pos: int) -> bool:
         """Page for token position ``pos`` allocated? Allocate on
@@ -535,12 +749,21 @@ class LLMEngine:
     def _update_kv_gauge(self):
         usable = self.num_pages - 1
         self._m["kv_util"].set((usable - len(self._free_pages)) / usable)
+        if self._cache is not None:
+            self._m["shared_pages"].set(self._cache.shared_page_count)
 
     def _free_slot(self, slot: int):
         for idx in range(self.pages_per_seq):
             page = int(self.block_tables[slot, idx])
             if page > 0:
-                self._free_pages.append(page)
+                if self._cache is not None and \
+                        self._cache.is_shared(page):
+                    # shared page: drop this sequence's reference; at
+                    # zero it stays CACHED (evictable) — its KV is the
+                    # whole point of the prefix cache
+                    self._cache.release(page)
+                else:
+                    self._free_pages.append(page)
         self.block_tables[slot] = 0
         self.context_lens[slot] = 0
         self._slots[slot] = None
@@ -588,13 +811,77 @@ class LLMEngine:
                 return b
         return self.prefill_buckets[-1]
 
-    def _next_key(self):
-        self._step_i += 1
-        return jax.random.fold_in(self._key, self._step_i)
-
     def _admit(self, req: _Request) -> str:
         """"ok" (admitted), "retry" (transiently out of slots/pages),
-        or "never" (the prompt cannot fit this pool at all)."""
+        or "never" (the prompt cannot fit this pool at all).
+
+        Chunked path: admission only RESERVES — match the prefix
+        cache, map shared pages read-only, allocate suffix pages, and
+        enqueue the prefill work. No device call happens here; the
+        suffix is computed by ``_prefill_tick`` chunks interleaved
+        with decode, and the first token is harvested asynchronously
+        in ``_drain_one`` like any decode token."""
+        if self.spec_k:
+            return self._admit_inline(req)
+        n = len(req.prompt)
+        need_total = -(-n // self.page_size)
+        if need_total > min(self.num_pages - 1, self.pages_per_seq):
+            return "never"
+        slot = next((i for i, s in enumerate(self._slots) if s is None),
+                    None)
+        if slot is None:
+            return "retry"
+        matched: List[int] = []
+        if self._cache is not None:
+            if not req.digests:      # retries reuse the hashed prompt
+                from .prefix_cache import page_digests
+                req.digests = page_digests(req.prompt, self.page_size)
+            # cap the match at the last full page <= n-1 tokens: the
+            # final prompt position's logits must be COMPUTED to
+            # sample the first output token
+            matched = self._cache.lookup(req.digests[:(n - 1) //
+                                                     self.page_size])
+        m = len(matched)
+        # matched pages sitting in the LRU stop being evictable once
+        # acquired — don't count them as allocatable too
+        reserved = sum(1 for p in matched if self._cache.is_evictable(p)
+                       ) if self._cache is not None else 0
+        if need_total - m > self._avail_pages() - reserved:
+            # pages held by running sequences will free; a pool this
+            # empty while IDLE can never satisfy the request
+            active = any(s is not None for s in self._slots)
+            return "retry" if active else "never"
+        # admission decided: everything before this instant was queue
+        # wait (slot/page availability), everything after is prefill
+        self._m["queue_wait"].observe(time.monotonic() - req.t_submit)
+        for idx, page in enumerate(matched):
+            self._cache.acquire(page)
+            self.block_tables[slot, idx] = page
+        for idx in range(m, need_total):
+            self.block_tables[slot, idx] = self._alloc_page()
+        req.slot = slot
+        req.n_cached = m * self.page_size
+        req.prefill_pos = req.n_cached
+        req.n_reg_pages = m
+        self._slots[slot] = req
+        self.temperatures[slot] = req.temperature
+        self._nonces[slot] = req.nonce
+        self._prefill_q.append(req)
+        self.n_prompt_tokens += n
+        self.n_cached_tokens += req.n_cached
+        self._m["prompt_tokens"].inc(n)
+        if req.n_cached:
+            self._m["cache_hit_tokens"].inc(req.n_cached)
+        self._m["cache_hit_rate"].set(
+            self.n_cached_tokens / self.n_prompt_tokens)
+        self._m["prefills"].inc()
+        self._update_kv_gauge()
+        return "ok"
+
+    def _admit_inline(self, req: _Request) -> str:
+        """Legacy inline one-shot prefill (speculative engines only:
+        the draft pool shares block tables and would need the same
+        prefix treatment; rounds are host-synced anyway)."""
         n = len(req.prompt)
         need = -(-n // self.page_size)
         if need > min(self.num_pages - 1, self.pages_per_seq):
@@ -604,12 +891,8 @@ class LLMEngine:
         if slot is None:
             return "retry"
         if need > len(self._free_pages):
-            # pages held by running sequences will free; a pool this
-            # empty while IDLE can never satisfy the request
             active = any(s is not None for s in self._slots)
             return "retry" if active else "never"
-        # admission decided: everything before this instant was queue
-        # wait (slot/page availability), everything after is prefill
         self._m["queue_wait"].observe(time.monotonic() - req.t_submit)
         for idx in range(need):
             self.block_tables[slot, idx] = self._alloc_page()
@@ -620,27 +903,30 @@ class LLMEngine:
             self._params, self._buffers, jnp.asarray(ids),
             jnp.int32(n), jnp.asarray(self.block_tables[slot]),
             self.k_pages, self.v_pages, jnp.float32(req.temperature),
-            self._next_key())
-        if self.spec_k:
-            # the draft needs the prompt's KV too (its own cache dims,
-            # SAME block table); its prefill token is discarded — the
-            # target owns sampling
-            _, self.draft_k_pages, self.draft_v_pages = \
-                self._draft_prefill_fn(
-                    self._draft_params, self._draft_buffers,
-                    jnp.asarray(ids), jnp.int32(n),
-                    jnp.asarray(self.block_tables[slot]),
-                    self.draft_k_pages, self.draft_v_pages,
-                    jnp.float32(0.0), self._next_key())
+            jnp.int32(req.nonce), self._key)
+        # the draft needs the prompt's KV too (its own cache dims,
+        # SAME block table); its prefill token is discarded — the
+        # target owns sampling
+        _, self.draft_k_pages, self.draft_v_pages = \
+            self._draft_prefill_fn(
+                self._draft_params, self._draft_buffers,
+                jnp.asarray(ids), jnp.int32(n),
+                jnp.asarray(self.block_tables[slot]),
+                self.draft_k_pages, self.draft_v_pages,
+                jnp.float32(0.0), jnp.int32(req.nonce), self._key)
         req.slot = slot
         tok = int(nxt)        # blocks until the prefill has executed —
         req.t_first = time.monotonic()   # TTFT includes device time
         req.tokens.append(tok)
+        req.prefill_done = True
         self._slots[slot] = req
         self.context_lens[slot] = n
         self._tokens_dev = self._tokens_dev.at[slot].set(req.tokens[-1])
         self.temperatures[slot] = req.temperature
+        self._nonces[slot] = req.nonce
         self.n_tokens += 1
+        self.n_prompt_tokens += n
+        self._m["prompt_tokens"].inc(n)
         self._m["ttft"].observe(req.t_first - req.t_submit)
         self._m["prefills"].inc()
         self._m["tokens"].inc()
@@ -658,7 +944,88 @@ class LLMEngine:
 
     def _live_slots(self) -> List[int]:
         return [i for i, s in enumerate(self._slots)
-                if s is not None and not s.closing]
+                if s is not None and not s.closing and s.prefill_done]
+
+    def _prefill_tick(self):
+        """Process ONE chunk of prefill work: up to ``prefill_chunk``
+        prompt tokens from the queue's head request(s), packed ragged
+        into a single batched forward. Requests whose prompt completes
+        inside the chunk transition to decode — their sampled first
+        token chains into ``_tokens_dev`` ON DEVICE and is pushed as an
+        in-flight record, so decode steps can follow immediately and
+        the host fetches it later like any decode token."""
+        T = self.prefill_chunk
+        ps = self.page_size
+        tok = np.zeros((T,), np.int32)
+        pos = np.zeros((T,), np.int32)
+        lim = np.zeros((T,), np.int32)
+        tbl = np.zeros((T, self.pages_per_seq), np.int32)
+        sample_idx = np.zeros((self.max_seqs,), np.int32)
+        sample_pos = np.zeros((self.max_seqs,), np.int32)
+        finishing: List[_Request] = []
+        touched: List[_Request] = []
+        used = 0
+        while self._prefill_q and used < T:
+            req = self._prefill_q[0]
+            n = len(req.prompt)
+            take = min(T - used, n - req.prefill_pos)
+            row = self.block_tables[req.slot]
+            for j in range(take):
+                p = req.prefill_pos + j
+                tok[used + j] = req.prompt[p]
+                pos[used + j] = p
+                lim[used + j] = p + 1
+                tbl[used + j] = row
+            req.prefill_pos += take
+            used += take
+            touched.append(req)
+            if req.prefill_pos >= n:
+                self._prefill_q.popleft()
+                finishing.append(req)
+                sample_idx[req.slot] = used - 1
+                sample_pos[req.slot] = n - 1
+            else:
+                break   # chunk budget exhausted mid-prompt
+        nxt, self.k_pages, self.v_pages = self._chunk_fn(
+            self._params, self._buffers, jnp.asarray(tok),
+            jnp.asarray(pos), jnp.asarray(lim), jnp.asarray(tbl),
+            jnp.asarray(sample_idx), jnp.asarray(sample_pos),
+            self.k_pages, self.v_pages,
+            jnp.asarray(self.temperatures),
+            jnp.asarray(self._nonces), self._key)
+        if finishing:
+            mask = np.zeros((self.max_seqs,), bool)
+            for req in finishing:
+                mask[req.slot] = True
+            # first tokens chain on device; the host fetch happens in
+            # _drain_one, in issue order, like any decode step
+            self._tokens_dev = jnp.where(jnp.asarray(mask), nxt,
+                                         self._tokens_dev)
+            self._issue_seq += 1
+            self._inflight.append(
+                (self._issue_seq, [r.slot for r in finishing], nxt,
+                 "p"))
+            for req in finishing:
+                req.prefill_done = True
+                self.context_lens[req.slot] = len(req.prompt)
+        if self._cache is not None:
+            for req in touched:
+                # promote freshly-written FULL prompt pages to shared
+                # as soon as their chunk is issued (immutable from
+                # here on: every later write for this sequence lands
+                # at positions >= len(prompt) > the page). Incremental
+                # registration lets a request admitted while a long
+                # shared prompt is still mid-prefill hit its pages.
+                for i in range(req.n_reg_pages, req.prefill_pos // ps):
+                    self._cache.register(
+                        req.digests[i],
+                        int(self.block_tables[req.slot, i]))
+                req.n_reg_pages = max(req.n_reg_pages,
+                                      req.prefill_pos // ps)
+        self.n_prefill_ticks += 1
+        self.tick_history.append("p")
+        self._m["prefill_ticks"].inc()
+        self._update_kv_gauge()
 
     def _loop(self):
         while True:
@@ -669,11 +1036,27 @@ class LLMEngine:
                     self._pending = []
                 for req in pending:
                     self._harvest_admit(req)
+                busy = False
+                if self._prefill_q:
+                    # ONE chunk of prefill, then (below) ONE decode
+                    # step for the live batch: a long prompt's chunks
+                    # interleave with decode ticks instead of stalling
+                    # in-flight generations for its whole prefill
+                    self._prefill_tick()
+                    busy = True
+                self._m["prefill_queue"].set(len(self._prefill_q))
                 live = self._live_slots()
                 if live and self.spec_k:
                     self._spec_round(live)
+                    busy = True
                 elif live:
                     self._issue(live)
+                    busy = True
+                if self.n_decode_ticks or self.n_prefill_ticks:
+                    self._m["tick_ratio"].set(
+                        self.n_prefill_ticks /
+                        max(1, self.n_decode_ticks))
+                if busy:
                     # fetch with a lag: the chain keeps the device busy
                     while len(self._inflight) > self.lookahead:
                         self._drain_one()
@@ -702,6 +1085,7 @@ class LLMEngine:
                 # pending: fail the in-flight requests, reclaim their
                 # pages, and keep serving — fresh requests may succeed
                 self._inflight.clear()
+                self._prefill_q.clear()
                 self._fetch_seq = self._issue_seq
                 # closers whose generation already completed (awaiting
                 # drain only) resolve successfully; ones still owed
@@ -726,6 +1110,11 @@ class LLMEngine:
                 with self._mu:  # drop re-queued copies of failed reqs
                     self._pending = [r for r in self._pending
                                      if not r.future.done()]
+                if self._cache is not None:
+                    # every slot is free now, so all shared pages are
+                    # refcount-zero: drop them — a failed device call
+                    # may have left registered pages with garbage KV
+                    self._free_pages.extend(self._cache.flush())
 
     def _harvest_admit(self, req: _Request):
         """Admit, re-queue, or fail; immediately-finished admissions
@@ -743,7 +1132,9 @@ class LLMEngine:
             with self._mu:
                 self._pending.append(req)
             return
-        if self._harvest(req.slot):
+        if req.prefill_done and self._harvest(req.slot):
+            # inline (speculative) admissions already hold their first
+            # token; chunked admissions resolve through the drain path
             self._begin_close(req.slot)
             self._maybe_finalize()
 
@@ -752,7 +1143,7 @@ class LLMEngine:
         from the previous step ON DEVICE (no fetch here)."""
         for slot in list(live):
             req = self._slots[slot]
-            in_flight = sum(1 for _, sl, _ in self._inflight
+            in_flight = sum(1 for _, sl, _, _ in self._inflight
                             if slot in sl)
             if len(req.tokens) + in_flight >= req.max_new_tokens:
                 # length completion is already provable on the host:
@@ -782,22 +1173,27 @@ class LLMEngine:
             self._tokens_dev, jnp.asarray(positions),
             jnp.asarray(self.block_tables), jnp.asarray(lens),
             self.k_pages, self.v_pages, jnp.asarray(self.temperatures),
-            self._next_key())
+            jnp.asarray(self._nonces), self._key)
         self._tokens_dev = tokens
         self._issue_seq += 1
-        self._inflight.append((self._issue_seq, list(live), tokens))
+        self._inflight.append((self._issue_seq, list(live), tokens,
+                               "d"))
         for slot in live:
             self.context_lens[slot] += 1
+        self.n_decode_ticks += 1
+        self.tick_history.append("d")
+        self._m["decode_ticks"].inc()
         self._m["occupancy"].observe(len(live) / self.max_seqs)
         self._update_kv_gauge()
 
     def _drain_one(self):
         """Fetch the oldest in-flight step's tokens and process them
         (emission, EOS/length, finalization of drained closers)."""
-        seq, slots_list, tokens = self._inflight.popleft()
+        seq, slots_list, tokens, kind = self._inflight.popleft()
         host = np.asarray(tokens)          # the only blocking fetch
         self._fetch_seq = seq
-        self.n_steps += 1
+        if kind == "d":
+            self.n_steps += 1
         emitted = 0
         for slot in slots_list:
             req = self._slots[slot]
@@ -809,21 +1205,29 @@ class LLMEngine:
             req.tokens.append(int(host[slot]))
             self.n_tokens += 1
             emitted += 1
+            if req.t_first is None:
+                # chunked-prefill first token: admission never blocked
+                # on the device; TTFT lands here, at the async fetch
+                req.t_first = time.monotonic()
+                self._m["ttft"].observe(req.t_first - req.t_submit)
             if self.eos_token_id is not None and \
                     req.tokens[-1] == self.eos_token_id:
                 req.accepts_inflight = False  # nothing after EOS
             if not req.closing and self._harvest(slot):
                 self._begin_close(slot)
-        self._observe_step(emitted)
+        self._observe_step(emitted, timed=(kind == "d"))
         self._maybe_finalize()
 
-    def _observe_step(self, emitted: int):
+    def _observe_step(self, emitted: int, timed: bool = True):
         """Per-fetch timing → step-time and tokens/sec histograms.
         Fetch-to-fetch wall time is the honest denominator under
         lookahead (the issue is async; the fetch is where the engine
-        actually pays)."""
+        actually pays). ``timed=False`` (chunked-prefill first-token
+        fetches): count the tokens but keep prefill wall time OUT of
+        the decode step/tps histograms — still advance the fetch
+        clock so the next decode interval starts here."""
         now = time.monotonic()
-        if self._last_fetch_t is not None:
+        if timed and self._last_fetch_t is not None:
             dt = now - self._last_fetch_t
             self._m["step"].observe(dt)
             if dt > 0 and emitted:
@@ -882,7 +1286,7 @@ class LLMEngine:
                     self._draft_params, self._draft_buffers, cur,
                     jnp.asarray(pos), tables, jnp.asarray(lens),
                     self.draft_k_pages, self.draft_v_pages, zeros_temp,
-                    self._next_key())
+                    jnp.asarray(self._nonces), self._key)
             self.n_draft_steps += 1
             if j < K - 1:
                 tok_cols.append(cur)

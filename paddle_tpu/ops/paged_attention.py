@@ -195,10 +195,14 @@ def paged_attention_kernel(q, k_pages, v_pages, block_tables,
             pltpu.VMEM((group, _LANES), jnp.float32),
         ],
     )
+    # jax renamed TPUCompilerParams -> CompilerParams across versions;
+    # accept either so the kernel runs on every toolchain in the image
+    _params_cls = getattr(pltpu, "CompilerParams", None) or \
+        getattr(pltpu, "TPUCompilerParams")
     out = pl.pallas_call(
         kernel, grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, kv_heads, group, d), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_params_cls(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(lens, tables, qg, k_pages, v_pages)
@@ -224,6 +228,36 @@ def paged_attention_chunk(q, k_pages, v_pages, block_tables, base_lens,
                       0)                                  # [B, K]
     return _gathered_attention(q, k_pages, v_pages, block_tables,
                                limit, scale)
+
+
+def paged_attention_ragged(q, k_pages, v_pages, token_tables,
+                           token_lens, scale: Optional[float] = None,
+                           impl: str = "xla"):
+    """Ragged prefill attention over paged KV: ``q`` carries T tokens
+    drawn from ANY mix of sequences (a chunked-prefill tick packs one
+    or more prompts' uncached suffixes into one fixed-size chunk), each
+    token carrying its OWN block-table row and attendable length.
+
+    q: [T, heads, d]; token_tables: [T, pages_per_seq] — row t is the
+    block table of token t's sequence; token_lens: [T] — token t
+    attends the first ``token_lens[t]`` cached positions of its
+    sequence (its own inclusive; 0 = padding token -> zero output).
+    Returns [T, heads, d].
+
+    This is the ragged generalization of :func:`paged_attention` (the
+    T=batch case where all of a row's tokens share one table) and of
+    :func:`paged_attention_chunk` (the rectangular [B, K] case):
+    causality inside a chunk falls out of the per-token limit, because
+    a later token of the same sequence has a strictly larger
+    ``token_lens`` and earlier chunk tokens' K/V are already scattered
+    into the pool. ``impl="pallas"`` routes through the fused kernel
+    (:func:`paged_attention_kernel`), whose contract is identical —
+    each grid row reads its own prefetched table row."""
+    if impl == "pallas":
+        return paged_attention_kernel(q, k_pages, v_pages, token_tables,
+                                      token_lens, scale=scale)
+    return paged_attention(q, k_pages, v_pages, token_tables,
+                           token_lens, scale=scale)
 
 
 def paged_attention(q, k_pages, v_pages, block_tables, context_lens,

@@ -163,6 +163,38 @@ def test_kernel_matches_xla_path():
         np.testing.assert_allclose(got[2], 0.0)  # empty slot zeros
 
 
+def test_paged_attention_ragged_matches_chunk_and_kernel():
+    """The ragged prefill op: flattening the rectangular [B, K] chunk
+    case into T=B*K tokens with per-token tables/limits must reproduce
+    paged_attention_chunk exactly, on both the xla and pallas impls."""
+    from paddle_tpu.ops.paged_attention import (paged_attention_chunk,
+                                                paged_attention_ragged)
+
+    rng = np.random.RandomState(0)
+    B, K, H, KVH, PS, D, NP, P = 2, 3, 4, 2, 4, 16, 12, 3
+    q = jnp.asarray(rng.randn(B, K, H, D), jnp.float32)
+    kp = jnp.asarray(rng.randn(NP, PS, KVH, D), jnp.float32)
+    vp = jnp.asarray(rng.randn(NP, PS, KVH, D), jnp.float32)
+    tables = jnp.asarray([[1, 2, 3], [4, 5, 0]], jnp.int32)
+    base = jnp.asarray([5, 2], jnp.int32)
+
+    ref = np.asarray(paged_attention_chunk(q, kp, vp, tables, base))
+    qf = q.reshape(B * K, H, D)
+    tf = jnp.repeat(tables, K, axis=0)
+    lims = (base[:, None] + jnp.arange(K)[None, :] + 1).reshape(-1)
+    got = np.asarray(paged_attention_ragged(qf, kp, vp, tf, lims))
+    np.testing.assert_allclose(got, ref.reshape(B * K, H, D),
+                               atol=1e-6, rtol=1e-6)
+    got_k = np.asarray(paged_attention_ragged(qf, kp, vp, tf, lims,
+                                              impl="pallas"))
+    np.testing.assert_allclose(got_k, ref.reshape(B * K, H, D),
+                               atol=2e-5, rtol=2e-5)
+    # padding tokens (limit 0) produce zero rows
+    zero = np.asarray(paged_attention_ragged(
+        qf, kp, vp, tf, jnp.zeros((B * K,), jnp.int32)))
+    np.testing.assert_allclose(zero, 0.0)
+
+
 def test_engine_with_pallas_attention_matches_dense():
     """LLMEngine(attention_impl='pallas'): greedy decode through the
     fused kernel is token-identical to the dense generate."""

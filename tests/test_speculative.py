@@ -53,7 +53,8 @@ def _seed_pages(net, prompt):
     (t0, kp, vp), _ = functional_call(
         prefill, params, buffers, jnp.asarray(ids),
         jnp.int32(len(prompt)), jnp.asarray(tables[0]), kp, vp,
-        jnp.float32(0.0), jax.random.PRNGKey(0), training=False)
+        jnp.float32(0.0), jnp.int32(0), jax.random.PRNGKey(0),
+        training=False)
     return kp, vp, jnp.asarray(tables), len(prompt), int(t0)
 
 
@@ -74,7 +75,8 @@ def test_verify_pass_equals_sequential_decode(gqa):
             jnp.asarray([toks[-1]], jnp.int32),
             jnp.asarray([ctx + j], jnp.int32), tables,
             jnp.asarray([ctx + j + 1], jnp.int32), kp, vp,
-            jnp.asarray([0.0], jnp.float32), jax.random.PRNGKey(9),
+            jnp.asarray([0.0], jnp.float32),
+            jnp.asarray([0], jnp.int32), jax.random.PRNGKey(9),
             training=False)
         toks.append(int(nxt[0]))
     seq_pages = (np.asarray(kp), np.asarray(vp))
@@ -112,7 +114,8 @@ def test_verify_rejection_prefix_semantics():
         decode, params, buffers, jnp.asarray([t0], jnp.int32),
         jnp.asarray([ctx], jnp.int32), tables,
         jnp.asarray([ctx + 1], jnp.int32), kp, vp,
-        jnp.asarray([0.0], jnp.float32), jax.random.PRNGKey(0),
+        jnp.asarray([0.0], jnp.float32),
+        jnp.asarray([0], jnp.int32), jax.random.PRNGKey(0),
         training=False)
     wrong = (int(g1[0]) + 1) % 97
     kp2, vp2, tables2, ctx2, _ = _seed_pages(net, prompt)
@@ -187,6 +190,9 @@ def test_speculative_engine_eos_and_guards():
                    spec_tokens=3, eos_token_id=7) as eng:
         with pytest.raises(ValueError, match="greedy-only"):
             eng.submit([1, 2], max_new_tokens=4, temperature=0.9)
+        # the inline (bucketized) prefill path keeps the bucket bound
+        with pytest.raises(ValueError, match="prefill bucket"):
+            eng.submit(list(range(20)), max_new_tokens=2)
         out = eng.generate([[3, 1, 4]], max_new_tokens=40)[0]
         if 7 in out["output_ids"]:
             assert out["output_ids"][-1] == 7

@@ -30,6 +30,11 @@ import __graft_entry__ as g; g.dryrun_multichip(4)"
 echo "== observability smoke (train loop -> prometheus + chrome trace + jsonl)"
 python tools/obs_smoke.py "$(mktemp -d)"
 
+echo "== llm serving smoke (prefix cache + chunked ragged prefill)"
+# 4 shared-prefix prompts through the engine: asserts nonzero cache
+# hits, cache-on == cache-off generations, and a clean shutdown
+python tools/llm_bench.py --ci
+
 echo "== bench smoke (CPU backend)"
 # PT_BENCH_FORCE_CPU: run the measuring child directly on CPU — the
 # default orchestrator mode would spend its TPU probe windows first
