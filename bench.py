@@ -181,6 +181,80 @@ def bench_gpt(batch: int = 8, seq: int = 1024, warmup: int = 3,
             "mfu": _mfu(tps * flops_per_token)}
 
 
+def bench_steps_per_loop(ks=(1, 8, 32), cpu_smoke: bool = True):
+    """Dispatch-overhead sweep (ISSUE 3 / PERF.md "dispatch overhead"):
+    the SAME train step run K optimizer steps per XLA dispatch through
+    the fused lax.scan loop (`Model.train_loop_batch`). K=1 pays one
+    Python→XLA dispatch + one prefetch handoff per step; K>1 amortizes
+    both across the slab. Losses are bit-identical across K (pinned by
+    tests/test_train_loop.py), so the per-step wall-time delta IS the
+    dispatch overhead. Feed is pre-placed on device (`_device_feed`),
+    warmup slab excluded (compile), final loss fetched inside the timed
+    region (true sync)."""
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import (GPTForCausalLM,
+                                       GPTFusedPretrainingCriterion,
+                                       gpt_config)
+
+    if cpu_smoke:
+        # seq 64 stays under the flash-kernel block threshold: the XLA
+        # attention path keeps the step itself cheap, so the per-step
+        # delta is dominated by what this sweep measures — dispatch
+        batch, seq, total_steps = 2, 64, 32
+        cfg_kw = dict(num_layers=2, hidden_size=256, num_heads=4)
+    else:
+        batch, seq, total_steps = 8, 1024, 32
+        cfg_kw = {}
+    rs = np.random.RandomState(0)
+    rows = []
+    for k in ks:
+        n = total_steps - (total_steps % k)
+        if n == 0:
+            continue
+        paddle.seed(0)
+        cfg = gpt_config("gpt2-small", max_position_embeddings=seq,
+                         hidden_dropout=0.0, attention_dropout=0.0,
+                         fused_loss=True, **cfg_kw)
+        net = GPTForCausalLM(cfg)
+        model = paddle.Model(net)
+        model.prepare(
+            optimizer=paddle.optimizer.AdamW(learning_rate=1e-4,
+                                             parameters=net,
+                                             weight_decay=0.01),
+            loss=GPTFusedPretrainingCriterion(), amp_configs="O1")
+        ids = rs.randint(0, cfg.vocab_size, (batch, seq))
+        if k == 1:
+            feed = _device_feed(([ids], [ids]))
+            logs = model.train_batch(*feed)          # warmup + compile
+            float(np.asarray(logs["loss"]))
+            t0 = time.perf_counter()
+            for _ in range(n):
+                logs = model.train_batch(*feed)
+            float(np.asarray(logs["loss"]))          # true sync
+            dt = time.perf_counter() - t0
+        else:
+            slab = np.broadcast_to(ids, (k,) + ids.shape).copy()
+            feed = _device_feed(([slab], [slab]))
+            logs = model.train_loop_batch(*feed)     # warmup + compile
+            float(np.asarray(logs[-1]["loss"]))
+            t0 = time.perf_counter()
+            for _ in range(n // k):
+                logs = model.train_loop_batch(*feed)
+            float(np.asarray(logs[-1]["loss"]))      # true sync
+            dt = time.perf_counter() - t0
+        rows.append({"steps_per_loop": k, "steps": n,
+                     "per_step_ms": round(dt / n * 1e3, 3),
+                     "tokens_per_sec": round(batch * seq * n / dt, 1)})
+    base = next((r for r in rows if r["steps_per_loop"] == 1), None)
+    if base:
+        for r in rows:
+            r["speedup_vs_k1"] = round(
+                base["per_step_ms"] / r["per_step_ms"], 3)
+    return {"metric": "train_loop_dispatch_sweep", "batch": batch,
+            "seq": seq, "rows": rows}
+
+
 # ---------------------------------------------------------------------------
 # config 5: Wide&Deep CTR (sparse embedding + PS-analog host table)
 # ---------------------------------------------------------------------------
@@ -663,5 +737,26 @@ def main():
         raise
 
 
+def _steps_per_loop_cli():
+    """`python bench.py --steps-per-loop [1,8,32]`: run the fused-loop
+    dispatch-overhead sweep on whatever backend is available (pin CPU
+    with PT_BENCH_FORCE_CPU=1) and print one JSON line."""
+    i = sys.argv.index("--steps-per-loop")
+    ks = (1, 8, 32)
+    if len(sys.argv) > i + 1 and not sys.argv[i + 1].startswith("-"):
+        ks = tuple(int(v) for v in sys.argv[i + 1].split(","))
+    import jax
+    if os.environ.get("PT_BENCH_FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+    rec = bench_steps_per_loop(ks=ks,
+                               cpu_smoke=jax.default_backend() == "cpu")
+    rec["device"] = jax.devices()[0].device_kind
+    print(json.dumps(rec))
+    sys.stdout.flush()
+
+
 if __name__ == "__main__":
-    main()
+    if "--steps-per-loop" in sys.argv:
+        _steps_per_loop_cli()
+    else:
+        main()

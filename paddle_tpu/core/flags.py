@@ -147,3 +147,18 @@ define_flag("donate_buffers", True,
 define_flag("prefetch_to_device", 2,
             "DataLoader device-prefetch depth (ref: "
             "fluid/reader.py buffer_size / use_double_buffer).")
+define_flag("steps_per_loop", 1,
+            "Default number of optimizer steps Model.fit fuses into ONE "
+            "XLA dispatch (a lax.scan over K steps with donated state). "
+            "K=1 keeps the per-batch path; K>1 amortizes the Python->XLA "
+            "dispatch overhead and overlaps host->device transfer of the "
+            "next K-batch slab with compute. Losses are bit-identical to "
+            "K=1 (per-step keys are derived from the step index inside "
+            "the scan). fit(steps_per_loop=...) overrides per call.",
+            validator=lambda v: v >= 1)
+define_flag("compilation_cache_dir", "",
+            "Persistent XLA compilation cache directory (jax "
+            "jax_compilation_cache_dir), enabled at Model.prepare() "
+            "time. Repeated runs of the same program skip the 10-120 s "
+            "train-step compiles that the train_compile_seconds "
+            "histogram records. Empty disables (in-memory cache only).")

@@ -226,6 +226,16 @@ class CSVLogger(Callback):
                           newline="")
         self._writer = None
 
+    @staticmethod
+    def _coerce(v):
+        # logs carry device arrays / lazy deferred-metric views (the
+        # fused train loop never syncs per step) — a CSV cell is a
+        # display boundary, so coerce to a host float here
+        try:
+            return float(v)
+        except (TypeError, ValueError):
+            return v
+
     def on_epoch_end(self, epoch, logs=None):
         logs = dict(logs or {})
         logs["epoch"] = epoch
@@ -233,7 +243,8 @@ class CSVLogger(Callback):
             self._keys = list(logs.keys())
             self._writer = csv.DictWriter(self._file, fieldnames=self._keys)
             self._writer.writeheader()
-        self._writer.writerow({k: logs.get(k) for k in self._keys})
+        self._writer.writerow({k: self._coerce(logs.get(k))
+                               for k in self._keys})
         self._file.flush()
 
     def on_train_end(self, logs=None):

@@ -65,10 +65,192 @@ def _train_metrics():
     }
 
 
+def _loop_metrics():
+    """Fused multi-step loop instruments: one slab = one XLA dispatch
+    covering K optimizer steps (docs/OBSERVABILITY.md train_loop_*)."""
+    reg = _obs.default_registry()
+    return {
+        "dispatch": reg.histogram(
+            "train_loop_dispatch_seconds",
+            "wall time of one fused K-step slab dispatch (losses and "
+            "metrics stay on device)"),
+        "slab": reg.histogram(
+            "train_loop_slab_size",
+            "optimizer steps fused into each dispatched slab",
+            buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0)),
+        "drain": reg.histogram(
+            "train_loop_drain_seconds",
+            "host time coercing buffered device metrics/losses at "
+            "log_freq/epoch boundaries (the deferred sync)"),
+    }
+
+
 def _as_tuple(x):
     if isinstance(x, (list, tuple)):
         return tuple(x)
     return (x,)
+
+
+class _FloatView:
+    """Float-like lazy value: subclasses define __float__; comparisons,
+    arithmetic and formatting all coerce through it, so log consumers
+    that did math on the old plain-float entries keep working."""
+
+    __slots__ = ()
+
+    def __float__(self):  # pragma: no cover — abstract
+        raise NotImplementedError
+
+    def __format__(self, spec):
+        return format(float(self), spec)
+
+    def __repr__(self):
+        return repr(float(self))
+
+    def __bool__(self):
+        return bool(float(self))
+
+    def __eq__(self, other):
+        return float(self) == other
+
+    def __ne__(self, other):
+        return float(self) != other
+
+    def __lt__(self, other):
+        return float(self) < other
+
+    def __le__(self, other):
+        return float(self) <= other
+
+    def __gt__(self, other):
+        return float(self) > other
+
+    def __ge__(self, other):
+        return float(self) >= other
+
+    def __hash__(self):
+        return hash(float(self))
+
+    def __add__(self, other):
+        return float(self) + other
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return float(self) - other
+
+    def __rsub__(self, other):
+        return other - float(self)
+
+    def __mul__(self, other):
+        return float(self) * other
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return float(self) / other
+
+    def __rtruediv__(self, other):
+        return other / float(self)
+
+    def __neg__(self):
+        return -float(self)
+
+    def __abs__(self):
+        return abs(float(self))
+
+    def __int__(self):
+        return int(float(self))
+
+    def __round__(self, ndigits=None):
+        return round(float(self), ndigits)
+
+    def __trunc__(self):
+        import math
+        return math.trunc(float(self))
+
+
+class _SlabScalar(_FloatView):
+    """One step's loss inside a [K]-stacked device array — indexing and
+    host coercion happen only if the value is actually read (display,
+    CSV, bench sync), so the fused loop's K losses cost zero syncs when
+    nobody looks."""
+
+    __slots__ = ("_arr", "_idx")
+
+    def __init__(self, arr, idx: int):
+        self._arr = arr
+        self._idx = idx
+
+    def __float__(self):
+        return float(self._arr[self._idx])
+
+    def __array__(self, dtype=None):
+        out = np.asarray(np.asarray(self._arr)[self._idx])
+        return out.astype(dtype) if dtype is not None else out
+
+
+class _LazyMetricValue(_FloatView):
+    """Deferred metric read: Model.train_batch/train_loop_batch buffer
+    device-resident ``Metric.compute`` outputs instead of coercing them
+    per step; reading this value (float()/display/comparison) drains
+    the buffer into the metric accumulators — one host sync per log
+    boundary, not per optimizer step. The first read memoizes, so a log
+    value coerced at its display boundary stays correct even if the
+    metric is later reset (eval pass / next epoch); values NEVER read
+    before a reset reflect the post-reset accumulator."""
+
+    __slots__ = ("_model", "_metric", "_idx", "_val")
+
+    def __init__(self, model, metric, idx: int):
+        self._model = model
+        self._metric = metric
+        self._idx = idx
+        self._val = None
+
+    def __float__(self):
+        if self._val is None:
+            self._model._drain_metric_updates()
+            res = self._metric.accumulate()
+            res = res if isinstance(res, (list, tuple)) else [res]
+            self._val = float(res[self._idx])
+        return self._val
+
+
+_cache_dir_enabled = None
+
+
+def _enable_compilation_cache(path: str) -> None:
+    """Point jax's persistent compilation cache at ``path`` (flag
+    ``compilation_cache_dir``): repeated runs of the same program reload
+    compiled executables instead of re-running the 10-120 s XLA compiles
+    the train_compile_seconds histogram records. Threshold knobs drop to
+    zero so even fast-compiling steps are cached; failures degrade to
+    the in-memory cache (older jax without CPU-cache support)."""
+    global _cache_dir_enabled
+    if not path or _cache_dir_enabled == path:
+        return
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        for knob, val in (
+                ("jax_persistent_cache_min_compile_time_secs", 0.0),
+                ("jax_persistent_cache_min_entry_size_bytes", -1)):
+            try:
+                jax.config.update(knob, val)
+            except Exception:  # knob not in this jax version
+                pass
+        # anything jitted before prepare() initialized the cache
+        # singleton as disabled; re-initialize it against the new dir
+        try:
+            from jax._src.compilation_cache import reset_cache
+            reset_cache()
+        except Exception:
+            pass
+        _cache_dir_enabled = path
+    except Exception as e:  # noqa: BLE001 — cache is an optimization
+        import warnings
+        warnings.warn(f"compilation_cache_dir={path!r} not enabled: {e}")
 
 
 class Model:
@@ -89,15 +271,21 @@ class Model:
         self._opt_state = None
         self._step_count = 0
         self._train_step_fn = None
+        self._train_loop_fn = None    # fused K-step scan (steps_per_loop)
         self._eval_step_fn = None
         self._predict_fn = None
         # sharding hooks (set by parallel.DistributedModel)
         self._shard_params = None     # fn(params) -> sharded params
         self._shard_batch = None      # fn(batch) -> sharded batch
+        self._shard_superbatch = None  # fn([K,...] slab) -> sharded slab
         # recompile guard: distinct (shape, dtype) signatures seen
         self._shape_signatures = set()
+        # device metric outputs buffered until a log/display boundary
+        # coerces them (_drain_metric_updates) — no per-step host sync
+        self._metric_pending: List[Tuple[Tuple, int]] = []
         # observability handles, created lazily on the first step
         self._obs = None
+        self._obs_loop = None
 
     # -- preparation --------------------------------------------------------
     def prepare(self, optimizer: Optional[Optimizer] = None, loss=None,
@@ -113,8 +301,11 @@ class Model:
         self._metrics = list(metrics)
         self._amp_configs = amp_configs
         self._train_step_fn = None
+        self._train_loop_fn = None
         self._eval_step_fn = None
         self._predict_fn = None
+        self._metric_pending.clear()
+        _enable_compilation_cache(flags.get_flag("compilation_cache_dir"))
 
     def _sync_state_in(self):
         """Pull state out of the stateful network into device trees.
@@ -218,6 +409,57 @@ class Model:
         donate = (0, 2, 3) if flags.get_flag("donate_buffers") else ()
         return jax.jit(step, donate_argnums=donate)
 
+    def _build_train_loop(self):
+        """Fused multi-step train loop: ONE jitted program running a
+        lax.scan over the leading (steps) dim of a [K, batch, ...]
+        superbatch. Params/opt-state/buffers are carried and donated
+        across the whole slab — one Python→XLA dispatch per K optimizer
+        steps instead of per step. Each scan iteration derives its key
+        as ``fold_in(base_key, step_idx)``, exactly what
+        ``rng.split_for_step`` computes on the K=1 path, so the loss
+        stream is bit-identical to K separate train_batch calls
+        (pinned by tests/test_train_loop.py for the dense/transformer
+        family incl. AMP + dropout + fused vocab loss; conv backward
+        passes may reassociate one reduction differently between the
+        scanned and straight-line programs on XLA:CPU — ≤1 ULP/step).
+        Per-step losses and metric outputs come back stacked [K, ...]
+        and stay on device."""
+        optimizer = self._optimizer
+
+        def loop(params, frozen, opt_state, buffers, step0, base_key,
+                 inputs, labels):
+            def body(carry, xs):
+                p, opt_st, buf = carry
+                idx, inp, lab = xs
+                step_idx = step0 + idx
+
+                def loss_fn(pp):
+                    with rng.key_guard(jax.random.fold_in(
+                            base_key, step_idx)), self._amp_context():
+                        out, new_buf = functional_call(
+                            self.network, {**pp, **frozen}, buf, *inp,
+                            training=True)
+                    loss = self._compute_loss(out, lab)
+                    return loss.astype(jnp.float32), (out, new_buf)
+
+                (loss, (out, new_buf)), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(p)
+                new_p, new_opt = optimizer.apply_gradients(
+                    p, grads, opt_st, step_idx)
+                metric_outs = self._metric_outputs(out, lab)
+                # functional_call returns an OrderedDict; the scan carry
+                # must keep the input's plain-dict pytree type
+                return (new_p, new_opt, dict(new_buf)), (loss, metric_outs)
+
+            k = jax.tree_util.tree_leaves((inputs, labels))[0].shape[0]
+            (params, opt_state, buffers), (losses, metric_outs) = \
+                jax.lax.scan(body, (params, opt_state, buffers),
+                             (jnp.arange(k), inputs, labels))
+            return losses, params, opt_state, buffers, metric_outs
+
+        donate = (0, 2, 3) if flags.get_flag("donate_buffers") else ()
+        return jax.jit(loop, donate_argnums=donate)
+
     def _build_eval_step(self):
         def step(params, frozen, buffers, key, inputs, labels):
             with rng.key_guard(key), self._amp_context():
@@ -251,26 +493,30 @@ class Model:
         recompile guard and io.sequence bucketing bound)."""
         return len(self._shape_signatures)
 
-    def _guard_recompiles(self, inputs, labels) -> bool:
+    def _guard_recompiles(self, inputs, labels, kind: str = "step") -> bool:
         """Every distinct input shape recompiles the jitted step (XLA
         static shapes — SURVEY §7 hard parts). Track the signatures seen
         and warn once past FLAGS.recompile_warn_threshold, pointing at
         the padding/bucketing tools (io.sequence). Returns True when
         this batch introduces a NEW signature (= a compile is coming),
         which train_batch routes into the compile-time histogram.
-        Threshold 0 keeps its meaning as the full off switch (no
-        tracking, no warning — intentionally-dynamic workloads opt out
-        of the per-batch signature cost; compile metrics read 0), and
-        the signature set is capped so a long dynamic run can't grow
-        host memory without bound."""
+        ``kind`` separates the per-batch step from the fused K-step loop
+        ("loop"): a [K, b, ...] superbatch is its own program, one
+        signature per distinct superbatch shape, counted in the same
+        bounded set as K=1 signatures. Threshold 0 keeps its meaning as
+        the full off switch (no tracking, no warning — intentionally-
+        dynamic workloads opt out of the per-batch signature cost;
+        compile metrics read 0), and the signature set is capped so a
+        long dynamic run can't grow host memory without bound."""
         thresh = flags.get_flag("recompile_warn_threshold")
         if not thresh:
             return False
         seen = self._shape_signatures
         if len(seen) >= 4096:
             return False
-        sig = tuple((tuple(np.shape(a)), str(getattr(a, "dtype", type(a))))
-                    for a in (*inputs, *labels))
+        sig = (kind,) + tuple(
+            (tuple(np.shape(a)), str(getattr(a, "dtype", type(a))))
+            for a in (*inputs, *labels))
         if sig in seen:
             return False
         seen.add(sig)
@@ -326,20 +572,123 @@ class Model:
             raise FloatingPointError(
                 f"NaN/Inf loss at step {self._step_count}; "
                 f"non-finite tensors: {bad or ['(loss only)']}")
-        # keep the loss on device — no per-step host sync (the reference's
-        # dygraph adapter also returns without waiting; a float() here
-        # would serialize every step on the device stream). Callbacks /
-        # callers coerce with float() only when they actually display it.
+        # keep the loss AND metric outputs on device — no per-step host
+        # sync (the reference's dygraph adapter also returns without
+        # waiting; a float()/np.asarray here would serialize every step
+        # on the device stream). Metric outputs are buffered and drained
+        # into the host accumulators only when a callback/display
+        # actually coerces a value (log_freq/epoch boundaries).
         logs = {"loss": loss}
-        for m, mo in zip(self._metrics, metric_outs):
-            res = m.update(*_as_tuple(mo))
-            names = m.name() if isinstance(m.name(), list) else [m.name()]
-            vals = res if isinstance(res, list) else [res]
-            for n, v in zip(names, _as_tuple(vals)):
-                logs[n] = float(v)
+        self._buffer_metric_outs(metric_outs, 1)
+        self._attach_metric_logs(logs)
         return logs
 
+    def train_loop_batch(self, inputs, labels=None) -> List[Dict[str, Any]]:
+        """Run ONE fused slab of K optimizer steps (K = leading dim of
+        every input/label leaf, stacked [K, batch, ...] — see
+        ``DataLoader.superbatches``). Dispatches a single scanned XLA
+        program (``_build_train_loop``) and returns K per-step log
+        dicts whose losses/metrics are lazy device views; the loss
+        stream is bit-identical to K ``train_batch`` calls."""
+        self._sync_state_in()
+        if self._train_loop_fn is None:
+            self._train_loop_fn = self._build_train_loop()
+        inputs = _as_tuple(inputs)
+        labels = _as_tuple(labels) if labels is not None else ()
+        k = int(np.shape(inputs[0])[0])
+        fresh_shape = self._guard_recompiles(inputs, labels, kind="loop")
+        if self._obs is None:
+            self._obs = _train_metrics()
+        if self._obs_loop is None:
+            self._obs_loop = _loop_metrics()
+        batch_n = np.shape(inputs[0])[1] if np.ndim(inputs[0]) > 1 else 0
+        t0 = time.perf_counter()
+        if self._shard_superbatch is not None:
+            inputs = self._shard_superbatch(inputs)
+            labels = self._shard_superbatch(labels)
+        base_key = rng.get_global_stream()._key
+        losses, self._params, self._opt_state, self._buffers, metric_outs \
+            = self._train_loop_fn(
+                self._params, self._frozen, self._opt_state,
+                # plain dict: the per-step path may have left an
+                # OrderedDict here, and the scan carry's pytree type
+                # must match the body's output (a plain dict)
+                dict(self._buffers), self._step_count, base_key,
+                inputs, labels)
+        self._step_count += k
+        dt = time.perf_counter() - t0
+        self._obs_loop["dispatch"].observe(dt)
+        self._obs_loop["slab"].observe(k)
+        self._obs["step"].observe(dt / k)
+        if fresh_shape:
+            self._obs["compile_count"].inc()
+            self._obs["compile"].observe(dt)
+        if batch_n and dt > 0:
+            self._obs["eps"].observe(batch_n * k / dt)
+        self._obs["steps"].set(self._step_count)
+        if flags.get_flag("check_nan_inf") and not np.isfinite(
+                np.asarray(losses)).all():
+            from ..amp.debugging import find_nonfinite
+            bad = find_nonfinite({"param": self._params,
+                                  "buffer": self._buffers})
+            raise FloatingPointError(
+                f"NaN/Inf loss in slab ending at step {self._step_count}; "
+                f"non-finite tensors: {bad or ['(loss only)']}")
+        self._buffer_metric_outs(metric_outs, k)
+        out = []
+        for i in range(k):
+            logs: Dict[str, Any] = {"loss": _SlabScalar(losses, i)}
+            self._attach_metric_logs(logs)
+            out.append(logs)
+        return out
+
+    # deferred-metric backstop: if nothing displays for this many
+    # buffered entries (verbose=0 fit, long evaluate loops), drain
+    # anyway — bounds live device buffers held by the pending list
+    _PENDING_DRAIN_CAP = 64
+
+    # -- deferred metric coercion -------------------------------------------
+    def _buffer_metric_outs(self, metric_outs, nsteps: int) -> None:
+        if self._metrics:
+            if len(self._metric_pending) >= self._PENDING_DRAIN_CAP:
+                self._drain_metric_updates()
+            self._metric_pending.append((metric_outs, nsteps))
+
+    def _attach_metric_logs(self, logs: Dict[str, Any]) -> None:
+        for m in self._metrics:
+            names = m.name() if isinstance(m.name(), list) else [m.name()]
+            for j, n in enumerate(names):
+                logs[n] = _LazyMetricValue(self, m, j)
+
+    def _drain_metric_updates(self) -> None:
+        """Fold every buffered device metric output into the host-side
+        accumulators — ONE sync for all steps since the last drain
+        (log_freq/epoch boundaries), the deferral train_loop_drain_
+        seconds measures."""
+        if not self._metric_pending:
+            return
+        t0 = time.perf_counter()
+        pending, self._metric_pending = self._metric_pending, []
+        for outs, nsteps in pending:
+            for m, mo in zip(self._metrics, outs):
+                m.update_stacked(_as_tuple(mo), nsteps)
+        if self._obs_loop is None:
+            self._obs_loop = _loop_metrics()
+        self._obs_loop["drain"].observe(time.perf_counter() - t0)
+
+    def drain_metrics(self) -> None:
+        """Public flush for manual ``train_batch``/``eval_batch`` loops:
+        fold all deferred device metric outputs into the Metric
+        accumulators so ``metric.accumulate()`` reflects every step so
+        far. ``fit``/``evaluate`` and log-value reads call this
+        implicitly at display boundaries."""
+        self._drain_metric_updates()
+
     def eval_batch(self, inputs, labels=None) -> Dict[str, Any]:
+        """Single forward/metric step. Metric outputs are deferred like
+        the train path — manual loops call ``drain_metrics()`` (or read
+        a returned log value) before ``metric.accumulate()``;
+        ``evaluate`` does so automatically."""
         self._sync_state_in()
         if self._eval_step_fn is None:
             self._eval_step_fn = self._build_eval_step()
@@ -355,8 +704,9 @@ class Model:
         logs = {}
         if loss is not None:
             logs["loss"] = loss  # device value; coerced by the consumer
-        for m, mo in zip(self._metrics, metric_outs):
-            m.update(*_as_tuple(mo))
+        # buffered like the train path — evaluate()/accumulate drains
+        self._buffer_metric_outs(metric_outs, 1)
+        self._attach_metric_logs(logs)
         return logs
 
     def predict_batch(self, inputs):
@@ -379,13 +729,27 @@ class Model:
             epochs: int = 1, eval_freq: int = 1, log_freq: int = 10,
             save_dir: Optional[str] = None, save_freq: int = 1,
             verbose: int = 2, drop_last: bool = False, shuffle: bool = True,
-            num_workers: int = 0, callbacks=None) -> None:
-        """ref: hapi/model.py:1574."""
+            num_workers: int = 0, callbacks=None,
+            steps_per_loop: Optional[int] = None) -> None:
+        """ref: hapi/model.py:1574.
+
+        ``steps_per_loop`` (default ``FLAGS.steps_per_loop``) fuses K
+        optimizer steps into one scanned XLA dispatch fed by
+        double-buffered [K, ...] superbatches — losses are bit-identical
+        to K=1 (see ``_build_train_loop`` for the exactness scope) while
+        the per-step Python/dispatch overhead is paid once per slab. Callbacks still see per-step on_train_batch_begin/end
+        (driven from the slab's stacked, lazily-coerced logs)."""
         assert self._optimizer is not None and self._loss is not None, \
             "call prepare(optimizer, loss, ...) before fit()"
         loader = self._as_loader(train_data, batch_size, shuffle)
         eval_loader = self._as_loader(eval_data, batch_size, False) \
             if eval_data is not None else None
+        if steps_per_loop is None:
+            steps_per_loop = flags.get_flag("steps_per_loop")
+        k_loop = max(int(steps_per_loop), 1)
+        if k_loop > 1 and self._shard_batch is not None \
+                and self._shard_superbatch is None:
+            k_loop = 1  # no superbatch sharding hook wired: stay exact
         try:
             steps = len(loader)
         except TypeError:
@@ -402,6 +766,10 @@ class Model:
             if self.stop_training:
                 break
             cbks.on_epoch_begin(epoch)
+            # fold any still-buffered outputs BEFORE reset — the Metric
+            # objects then hold exactly what the immediate-update path
+            # held at every reset boundary
+            self._drain_metric_updates()
             for m in self._metrics:
                 m.reset()
             # model-perspective buckets for profiler.summary(): no-ops
@@ -412,27 +780,54 @@ class Model:
             from ..profiler import _events as _prof_events
             from ..profiler import RecordEvent as _Rec
             profiling = _prof_events.active
-            it = iter(loader)
+            rec = _Rec if profiling else contextlib.nullcontext
+            if k_loop > 1:
+                it = loader.superbatches(k_loop)
+            else:
+                it = iter(loader)
             step = 0
             while True:
-                if profiling:
-                    with _Rec("Dataloader"):
-                        batch = next(it, None)
-                else:
+                with rec("Dataloader"):
                     batch = next(it, None)
                 if batch is None:
                     break
-                cbks.on_train_batch_begin(step)
                 inputs, labels = self._split_batch(batch)
-                if profiling:
-                    with _Rec("TrainStep"):
-                        logs = self.train_batch(inputs, labels)
-                    with _Rec("Callbacks"):
-                        cbks.on_train_batch_end(step, logs)
+                if k_loop > 1:
+                    k = int(np.shape(
+                        jax.tree_util.tree_leaves(inputs)[0])[0])
+                    if k == k_loop:
+                        with rec("TrainStep"):
+                            step_logs = self.train_loop_batch(inputs,
+                                                              labels)
+                        with rec("Callbacks"):
+                            for logs in step_logs:
+                                cbks.on_train_batch_begin(step)
+                                cbks.on_train_batch_end(step, logs)
+                                step += 1
+                        continue
+                    # ragged tail slab (< K stacked steps): unstack and
+                    # run the per-step path — same math, one extra
+                    # signature at most (the K=1 program)
+                    sub_batches = [
+                        jax.tree_util.tree_map(lambda x: x[i],
+                                               (inputs, labels))
+                        for i in range(k)]
                 else:
-                    logs = self.train_batch(inputs, labels)
-                    cbks.on_train_batch_end(step, logs)
-                step += 1
+                    sub_batches = [(inputs, labels)]
+                for inp, lab in sub_batches:
+                    cbks.on_train_batch_begin(step)
+                    with rec("TrainStep"):
+                        logs = self.train_batch(inp, lab)
+                    with rec("Callbacks"):
+                        cbks.on_train_batch_end(step, logs)
+                    step += 1
+            # freeze the epoch's final train logs NOW (epoch boundary =
+            # display boundary): the eval pass below resets the shared
+            # metric accumulators, which would otherwise leak into the
+            # lazily-coerced train values at on_epoch_end
+            logs = {n: float(v) if isinstance(
+                v, (_LazyMetricValue, _SlabScalar)) else v
+                for n, v in logs.items()}
             if eval_loader is not None and epoch % eval_freq == 0:
                 if profiling:
                     with _Rec("Eval"):
@@ -455,6 +850,10 @@ class Model:
             callbacks, model=self, verbose=verbose,
             metrics=[m.name() for m in self._metrics])
         cbks.on_eval_begin()
+        # drain-then-reset: buffered train-step outputs fold in first,
+        # so Metric state at this boundary matches the pre-deferral
+        # immediate-update semantics
+        self._drain_metric_updates()
         for m in self._metrics:
             m.reset()
         losses = []
@@ -468,6 +867,7 @@ class Model:
         out: Dict[str, Any] = {}
         if losses:
             out["loss"] = float(np.mean(losses))
+        self._drain_metric_updates()
         for m in self._metrics:
             res = m.accumulate()
             names = m.name() if isinstance(m.name(), list) else [m.name()]

@@ -44,6 +44,20 @@ def _loader_metrics():
     }
 
 
+def _superbatch_metrics():
+    reg = _obs.default_registry()
+    return {
+        "wait": reg.histogram(
+            "train_loop_prefetch_wait_seconds",
+            "time the fused train loop blocked waiting for the next "
+            "[K, ...] slab (≈0 when the double-buffered prefetch "
+            "keeps up)"),
+        "batches": reg.counter(
+            "train_loop_slabs", "superbatch slabs handed to the fused "
+            "train loop"),
+    }
+
+
 class Dataset:
     """Map-style dataset (ref: fluid/dataloader/dataset.py Dataset)."""
 
@@ -295,13 +309,13 @@ class _PrefetchIterator:
     _SENTINEL = object()
 
     def __init__(self, produce: Callable[[], Iterator], buffer_size: int,
-                 to_device: bool):
+                 to_device: bool, instruments=None):
         self._q: "queue.Queue" = queue.Queue(maxsize=max(buffer_size, 1))
         self._to_device = to_device
         self._err: Optional[BaseException] = None
         self._produce = produce
         self._stop = threading.Event()
-        self._obs = _loader_metrics()
+        self._obs = instruments or _loader_metrics()
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
@@ -525,27 +539,74 @@ class DataLoader:
             for p in procs:  # reap — terminate alone leaks zombies
                 p.join(timeout=5.0)
 
-    def __iter__(self):
+    def _select_produce(self):
+        """Pick the host-batch producer for one pass (serial generator or
+        the fork-pool pipelines), resolving the per-epoch worker seed on
+        the CALLER thread (where paddle.seed's thread-local state lives —
+        the produce generator body runs on the prefetch thread)."""
         if self.num_workers > 0:
-            # resolve the seed HERE (caller thread, where paddle.seed's
-            # thread-local state lives — the produce generator body runs
-            # on the prefetch thread) and advance it per epoch so
-            # augmentations differ across epochs like the serial path
             self._epoch_count = getattr(self, "_epoch_count", -1) + 1
             seed = (int(rng_mod._tls.global_seed)
                     + self._epoch_count) % (2 ** 31)
             mp_produce = self._produce_multiprocess_iter if self._iterable \
                 else self._produce_multiprocess_map
-            produce = (lambda: mp_produce(seed))
-        else:
-            produce = self._produce
-        return _PrefetchIterator(produce, self.prefetch_factor,
-                                 self.to_device)
+            return lambda: mp_produce(seed)
+        return self._produce
+
+    def __iter__(self):
+        return _PrefetchIterator(self._select_produce(),
+                                 self.prefetch_factor, self.to_device)
+
+    def superbatches(self, steps_per_loop: int):
+        """Iterate ``[K, ...]``-stacked slabs for the fused train loop.
+
+        Stacks ``steps_per_loop`` consecutive host batches into one
+        superbatch (leading dim = per-slab optimizer steps) and ships it
+        with the same background-thread device prefetch as ``__iter__``:
+        the NEXT slab's jax.device_put overlaps the current slab's
+        compute (double buffering, one queue slot ahead per
+        ``prefetch_factor``). Batches whose leaf shapes differ from the
+        slab being built (the ragged tail of an epoch with
+        drop_last=False) flush the slab early, so every yielded slab is
+        rectangular; consumers route short slabs (leading dim < K)
+        through the per-step path. Prefetch wait/slab counts land in the
+        ``train_loop_*`` instruments rather than the per-batch
+        dataloader ones."""
+        k = max(int(steps_per_loop), 1)
+        produce = self._select_produce()
+
+        def gen():
+            buf: List[Any] = []
+            sig = None
+            for b in produce():
+                s = tuple(np.shape(x)
+                          for x in jax.tree_util.tree_leaves(b))
+                if buf and s != sig:
+                    yield stack_batches(buf)
+                    buf = []
+                buf.append(b)
+                sig = s
+                if len(buf) == k:
+                    yield stack_batches(buf)
+                    buf = []
+            if buf:
+                yield stack_batches(buf)
+
+        return _PrefetchIterator(gen, max(self.prefetch_factor, 1),
+                                 self.to_device,
+                                 instruments=_superbatch_metrics())
 
     def __len__(self):
         if self._iterable:
             raise TypeError("IterableDataset DataLoader has no len()")
         return len(self.batch_sampler)
+
+
+def stack_batches(batches: List[Any]):
+    """Stack same-structure host batches leaf-wise into one [K, ...]
+    superbatch (the fused train loop's unit of dispatch)."""
+    return jax.tree_util.tree_map(
+        lambda *xs: np.stack([np.asarray(x) for x in xs]), *batches)
 
 
 # variable-length sequence tools (XLA static-shape policy; SURVEY §7)

@@ -31,6 +31,23 @@ class Metric:
         """Optional pre-processing run inside the compiled step."""
         return pred, label
 
+    def update_stacked(self, outs, nsteps: int = 1):
+        """Fold buffered ``compute`` outputs into the accumulator.
+
+        ``outs`` is a tuple of device arrays; with ``nsteps > 1`` each
+        carries a leading per-step dimension (the fused train loop's
+        lax.scan stacks one row per optimizer step). Coercion to host
+        happens HERE — once for the whole stack — which is what lets
+        Model.train_batch defer the per-step host sync to log/display
+        boundaries. Per-step ``update`` calls keep accumulation
+        semantics identical to the unfused path."""
+        outs = tuple(np.asarray(o) for o in outs)
+        if nsteps == 1:
+            self.update(*outs)
+            return
+        for i in range(nsteps):
+            self.update(*(o[i] for o in outs))
+
 
 class Accuracy(Metric):
     """Top-k accuracy (ref: metrics.py:183)."""

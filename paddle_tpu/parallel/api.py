@@ -24,7 +24,8 @@ import numpy as np
 from ..nn.layer import Layer, split_state
 from .mesh import DeviceMesh, get_mesh, init_mesh, set_mesh
 from .sharding import (LogicalRules, named_sharding, replicate,
-                       shard_batch, shard_params, with_logical_constraint)
+                       shard_batch, shard_params, shard_superbatch,
+                       with_logical_constraint)
 from .strategy import DistributedStrategy
 
 _initialized = False
@@ -217,7 +218,11 @@ def distributed_model(model, strategy: Optional[DistributedStrategy] = None,
     def _shard_batch(tree):
         return shard_batch(tree, mesh)
 
+    def _shard_superbatch(tree):
+        return shard_superbatch(tree, mesh)
+
     model._shard_params = _shard_params
     model._shard_batch = _shard_batch
+    model._shard_superbatch = _shard_superbatch
     model._mesh = mesh
     return model

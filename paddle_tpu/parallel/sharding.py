@@ -142,6 +142,33 @@ def shard_batch(batch, mesh: Optional[DeviceMesh] = None):
     return jax.tree_util.tree_map(put, batch)
 
 
+def shard_superbatch(batch, mesh: Optional[DeviceMesh] = None):
+    """``shard_batch`` for the fused train loop's [K, batch, ...]
+    superbatches: dim 0 is the per-slab STEP axis (scanned sequentially
+    on every device — replicated), dim 1 is the batch axis split over
+    the data axes exactly like ``shard_batch`` splits dim 0."""
+    mesh = mesh or get_mesh()
+    spec = P(None, *mesh.batch_spec())
+    ndata = 1
+    for a in mesh.data_axes:
+        ndata *= mesh.axis_size(a)
+
+    def put(x):
+        if not hasattr(x, "shape"):
+            if not isinstance(x, (int, float, complex, bool)):
+                return x
+            x = jax.numpy.asarray(x)
+        if getattr(x, "ndim", 0) < 2 or (
+                ndata and x.shape[1] % ndata):
+            # scalar/per-step vector, or a partial batch whose dim 1
+            # doesn't divide the data axes: replicate (correct, just
+            # unsharded for that slab)
+            return jax.device_put(x, NamedSharding(mesh.mesh, P()))
+        return jax.device_put(x, NamedSharding(mesh.mesh, spec))
+
+    return jax.tree_util.tree_map(put, batch)
+
+
 def replicate(tree, mesh: Optional[DeviceMesh] = None):
     mesh = mesh or get_mesh()
     s = NamedSharding(mesh.mesh, P())

@@ -35,6 +35,12 @@ echo "== llm serving smoke (prefix cache + chunked ragged prefill)"
 # hits, cache-on == cache-off generations, and a clean shutdown
 python tools/llm_bench.py --ci
 
+echo "== fused train-loop parity smoke (K=1 vs K=4 bit-identical)"
+python tools/train_loop_smoke.py
+
+echo "== fused train-loop dispatch sweep (CPU)"
+PT_BENCH_FORCE_CPU=1 python bench.py --steps-per-loop 1,8
+
 echo "== bench smoke (CPU backend)"
 # PT_BENCH_FORCE_CPU: run the measuring child directly on CPU — the
 # default orchestrator mode would spend its TPU probe windows first
