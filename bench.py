@@ -206,6 +206,7 @@ def bench_steps_per_loop(ks=(1, 8, 32), cpu_smoke: bool = True):
     else:
         batch, seq, total_steps = 8, 1024, 32
         cfg_kw = {}
+    from paddle_tpu.observability import tracing
     rs = np.random.RandomState(0)
     rows = []
     for k in ks:
@@ -224,10 +225,16 @@ def bench_steps_per_loop(ks=(1, 8, 32), cpu_smoke: bool = True):
                                              weight_decay=0.01),
             loss=GPTFusedPretrainingCriterion(), amp_configs="O1")
         ids = rs.randint(0, cfg.vocab_size, (batch, seq))
+        # tracing ON for the timed region (span bookkeeping is a few
+        # host dict ops per DISPATCH — noise against the XLA step) so
+        # the row says where wall time went, not just the total
+        tracing.clear()
+        tracing.enable()
         if k == 1:
             feed = _device_feed(([ids], [ids]))
             logs = model.train_batch(*feed)          # warmup + compile
             float(np.asarray(logs["loss"]))
+            tracing.clear()                          # drop the warmup
             t0 = time.perf_counter()
             for _ in range(n):
                 logs = model.train_batch(*feed)
@@ -238,14 +245,20 @@ def bench_steps_per_loop(ks=(1, 8, 32), cpu_smoke: bool = True):
             feed = _device_feed(([slab], [slab]))
             logs = model.train_loop_batch(*feed)     # warmup + compile
             float(np.asarray(logs[-1]["loss"]))
+            tracing.clear()                          # drop the warmup
             t0 = time.perf_counter()
             for _ in range(n // k):
                 logs = model.train_loop_batch(*feed)
             float(np.asarray(logs[-1]["loss"]))      # true sync
             dt = time.perf_counter() - t0
+        rollup = {name: {"total_s": v["total_s"], "count": v["count"],
+                         "share_of_wall": round(v["total_s"] / dt, 4)}
+                  for name, v in tracing.rollup(prefix="train.").items()}
+        tracing.disable()
         rows.append({"steps_per_loop": k, "steps": n,
                      "per_step_ms": round(dt / n * 1e3, 3),
-                     "tokens_per_sec": round(batch * seq * n / dt, 1)})
+                     "tokens_per_sec": round(batch * seq * n / dt, 1),
+                     "span_rollup": rollup})
     base = next((r for r in rows if r["steps_per_loop"] == 1), None)
     if base:
         for r in rows:
@@ -326,6 +339,7 @@ def bench_llm_decode(n_requests: int = 16, max_seqs: int = 8,
     else:
         cfg = gpt_config(model_name, hidden_dropout=0.0,
                          attention_dropout=0.0)
+    from paddle_tpu.observability import tracing
     net = GPTForCausalLM(cfg)
     total = prompt_len + gen_len
     pages = -(-total // 16) * max_seqs + 8
@@ -338,10 +352,16 @@ def bench_llm_decode(n_requests: int = 16, max_seqs: int = 8,
                    lookahead=lookahead) as eng:
         # warmup compiles prefill + decode
         eng.generate([prompts[0]], max_new_tokens=2)
+        tracing.clear()
+        tracing.enable()           # per-phase rollup for the BENCH row
         t0 = time.perf_counter()
         futs = [eng.submit(p, max_new_tokens=gen_len) for p in prompts]
         outs = [f.result() for f in futs]
         dt = time.perf_counter() - t0
+    # phases tile llm.request, so excluding the root gives shares
+    # over where each request's wall time actually went
+    rollup = tracing.rollup(prefix="llm.", exclude=("llm.request",))
+    tracing.disable()
     gen_tokens = sum(len(o["output_ids"]) for o in outs)
     assert not any(o["truncated"] for o in outs)
     return {"metric": "llm_decode_tokens_per_sec",
@@ -353,6 +373,7 @@ def bench_llm_decode(n_requests: int = 16, max_seqs: int = 8,
                 [o["latency_s"] for o in outs])), 3),
             "mean_ttft_s": round(float(np.mean(
                 [o["ttft_s"] for o in outs])), 3),
+            "span_rollup": rollup,
             "mfu": None}
 
 

@@ -19,10 +19,15 @@ import numpy as np
 import paddle_tpu as pt
 from paddle_tpu.inference import LLMEngine, serve_llm
 from paddle_tpu.models.gpt import GPTForCausalLM, gpt_config
+from paddle_tpu.observability import server as debug
+from paddle_tpu.observability import tracing
 
 
 def main():
     pt.seed(0)
+    # request-scoped tracing + the live debug surface: scrape
+    # /metrics, inspect /statusz occupancy, read /tracez span trees
+    tracing.enable()
     cfg = gpt_config("gpt2-small", num_layers=4, hidden_size=256,
                      num_heads=4, vocab_size=1000,
                      max_position_embeddings=256,
@@ -34,6 +39,9 @@ def main():
         srv = serve_llm(engine)
         host, port = srv.server_address
         print(f"serving on http://{host}:{port}/generate")
+        dbg = debug.start_debug_server()
+        print(f"debug surface on {dbg.address}"
+              f" (/metrics /healthz /statusz /tracez)")
 
         rng = np.random.RandomState(0)
         # prompts generated BEFORE the threads start: RandomState is
@@ -72,6 +80,11 @@ def main():
         srv.shutdown()
         print(f"engine: {engine.n_steps} decode steps, "
               f"{engine.n_tokens} tokens")
+        phases = tracing.rollup(prefix="llm.", exclude=("llm.request",))
+        print("phase shares: " + ", ".join(
+            f"{k.split('.', 1)[1]}={v['share']:.1%}"
+            for k, v in phases.items()))
+        debug.stop_debug_server()
 
 
 if __name__ == "__main__":

@@ -168,6 +168,15 @@ class PreemptionGuard:
         if not self._triggered.is_set():
             return False
         stat_add("elastic.preempt_exit")
+        # flight-recorder hook: a preempted rank dumps its in-flight
+        # span window BEFORE checkpoint-and-exit, so "what was this
+        # rank doing when the platform evicted it" survives the VM
+        # (no-op unless observability.flight is installed)
+        try:
+            from ..observability.flight import dump_flight_record
+            dump_flight_record("preemption")
+        except Exception:  # noqa: BLE001 — never block the checkpoint
+            pass
         if save is not None:
             save()
         if exit:

@@ -20,6 +20,7 @@ from __future__ import annotations
 import contextlib
 import os
 import pickle
+import sys
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -33,6 +34,7 @@ from ..io import DataLoader, Dataset
 from ..metric import Metric
 from ..nn.layer import Layer, functional_call, split_state
 from ..observability import metrics as _obs
+from ..observability import tracing as _trace
 from ..optimizer.optimizer import Optimizer
 from .callbacks import config_callbacks
 
@@ -306,6 +308,32 @@ class Model:
         self._predict_fn = None
         self._metric_pending.clear()
         _enable_compilation_cache(flags.get_flag("compilation_cache_dir"))
+        self._register_status_provider()
+
+    def _register_status_provider(self) -> None:
+        """Expose train-loop state on the debug server's /statusz
+        (weakref closure — a collected Model drops out of the
+        listing). Idempotent per Model: prepare() re-registers under
+        the same name."""
+        import weakref
+        from ..observability import server as _dbgsrv
+        ref = weakref.ref(self)
+
+        def _status():
+            m = ref()
+            if m is None:
+                return None
+            return {
+                "step_count": m._step_count,
+                "compiled_shapes": m.compiled_shape_count,
+                "pending_metric_buffers": len(m._metric_pending),
+                "loop_compiled": m._train_loop_fn is not None,
+                "step_compiled": m._train_step_fn is not None,
+                "stop_training": m.stop_training,
+            }
+
+        _dbgsrv.register_status_provider(
+            f"train_model_{id(self):x}", _status)
 
     def _sync_state_in(self):
         """Pull state out of the stateful network into device trees.
@@ -544,21 +572,38 @@ class Model:
             self._obs = _train_metrics()
         batch_n = np.shape(inputs[0])[0] if inputs and np.ndim(
             inputs[0]) else 0
+        sp = _trace.start_span(
+            "train.step", attrs={"batch": batch_n,
+                                 "step": self._step_count}) \
+            if _trace.enabled() else None
         t0 = time.perf_counter()
-        if self._shard_batch is not None:
-            inputs = self._shard_batch(inputs)
-            labels = self._shard_batch(labels)
-        key = rng.split_for_step(self._step_count)
-        loss, self._params, self._opt_state, self._buffers, metric_outs = \
-            self._train_step_fn(self._params, self._frozen, self._opt_state,
-                                self._buffers, self._step_count, key,
-                                inputs, labels)
+        try:
+            if self._shard_batch is not None:
+                inputs = self._shard_batch(inputs)
+                labels = self._shard_batch(labels)
+            key = rng.split_for_step(self._step_count)
+            loss, self._params, self._opt_state, self._buffers, \
+                metric_outs = self._train_step_fn(
+                    self._params, self._frozen, self._opt_state,
+                    self._buffers, self._step_count, key, inputs, labels)
+        except BaseException:
+            # a caught-and-skipped bad batch must not leak a live span
+            # (the _live registry is uncapped, unlike the finished ring)
+            if sp is not None:
+                sp.set_status("error")
+                sp.end()
+            raise
         self._step_count += 1
         dt = time.perf_counter() - t0
         self._obs["step"].observe(dt)
         if fresh_shape:
             self._obs["compile_count"].inc()
             self._obs["compile"].observe(dt)
+        if sp is not None:
+            if fresh_shape:
+                sp.add_event("recompile", {"signature_count": len(
+                    self._shape_signatures)})
+            sp.end()
         if batch_n and dt > 0:
             self._obs["eps"].observe(batch_n / dt)
         self._obs["steps"].set(self._step_count)
@@ -602,19 +647,29 @@ class Model:
         if self._obs_loop is None:
             self._obs_loop = _loop_metrics()
         batch_n = np.shape(inputs[0])[1] if np.ndim(inputs[0]) > 1 else 0
+        sp = _trace.start_span(
+            "train.dispatch", attrs={"k": k, "batch": batch_n,
+                                     "step0": self._step_count}) \
+            if _trace.enabled() else None
         t0 = time.perf_counter()
-        if self._shard_superbatch is not None:
-            inputs = self._shard_superbatch(inputs)
-            labels = self._shard_superbatch(labels)
-        base_key = rng.get_global_stream()._key
-        losses, self._params, self._opt_state, self._buffers, metric_outs \
-            = self._train_loop_fn(
-                self._params, self._frozen, self._opt_state,
-                # plain dict: the per-step path may have left an
-                # OrderedDict here, and the scan carry's pytree type
-                # must match the body's output (a plain dict)
-                dict(self._buffers), self._step_count, base_key,
-                inputs, labels)
+        try:
+            if self._shard_superbatch is not None:
+                inputs = self._shard_superbatch(inputs)
+                labels = self._shard_superbatch(labels)
+            base_key = rng.get_global_stream()._key
+            losses, self._params, self._opt_state, self._buffers, \
+                metric_outs = self._train_loop_fn(
+                    self._params, self._frozen, self._opt_state,
+                    # plain dict: the per-step path may have left an
+                    # OrderedDict here, and the scan carry's pytree
+                    # type must match the body's output (a plain dict)
+                    dict(self._buffers), self._step_count, base_key,
+                    inputs, labels)
+        except BaseException:
+            if sp is not None:
+                sp.set_status("error")
+                sp.end()
+            raise
         self._step_count += k
         dt = time.perf_counter() - t0
         self._obs_loop["dispatch"].observe(dt)
@@ -623,6 +678,11 @@ class Model:
         if fresh_shape:
             self._obs["compile_count"].inc()
             self._obs["compile"].observe(dt)
+        if sp is not None:
+            if fresh_shape:
+                sp.add_event("recompile", {"signature_count": len(
+                    self._shape_signatures)})
+            sp.end()
         if batch_n and dt > 0:
             self._obs["eps"].observe(batch_n * k / dt)
         self._obs["steps"].set(self._step_count)
@@ -667,11 +727,19 @@ class Model:
         seconds measures."""
         if not self._metric_pending:
             return
+        sp = _trace.start_span(
+            "train.metric_drain",
+            attrs={"pending": len(self._metric_pending)}) \
+            if _trace.enabled() else None
         t0 = time.perf_counter()
-        pending, self._metric_pending = self._metric_pending, []
-        for outs, nsteps in pending:
-            for m, mo in zip(self._metrics, outs):
-                m.update_stacked(_as_tuple(mo), nsteps)
+        try:
+            pending, self._metric_pending = self._metric_pending, []
+            for outs, nsteps in pending:
+                for m, mo in zip(self._metrics, outs):
+                    m.update_stacked(_as_tuple(mo), nsteps)
+        finally:
+            if sp is not None:
+                sp.end()
         if self._obs_loop is None:
             self._obs_loop = _loop_metrics()
         self._obs_loop["drain"].observe(time.perf_counter() - t0)
@@ -766,78 +834,95 @@ class Model:
             if self.stop_training:
                 break
             cbks.on_epoch_begin(epoch)
-            # fold any still-buffered outputs BEFORE reset — the Metric
-            # objects then hold exactly what the immediate-update path
-            # held at every reset boundary
-            self._drain_metric_updates()
-            for m in self._metrics:
-                m.reset()
-            # model-perspective buckets for profiler.summary(): no-ops
-            # unless a Profiler is active (ref: profiler_statistic.py
-            # model perspective — Dataloader/Forward/.../Optimizer; the
-            # compiled step fuses fwd+bwd+opt, so the TPU-side split is
-            # Dataloader / TrainStep / Callbacks)
-            from ..profiler import _events as _prof_events
-            from ..profiler import RecordEvent as _Rec
-            profiling = _prof_events.active
-            rec = _Rec if profiling else contextlib.nullcontext
-            if k_loop > 1:
-                it = loader.superbatches(k_loop)
-            else:
-                it = iter(loader)
+            # epoch span: entered on the fit thread's stack so the
+            # dispatch/step/drain spans below parent under it. The
+            # finally closes it even when an exception unwinds (a
+            # caller catching a step failure and re-running fit must
+            # not inherit a stale epoch at the bottom of the
+            # thread-local stack); Span.__exit__ records the error.
+            ep_span = _trace.span(
+                "train.epoch", attrs={"epoch": epoch}).__enter__() \
+                if _trace.enabled() else None
             step = 0
-            while True:
-                with rec("Dataloader"):
-                    batch = next(it, None)
-                if batch is None:
-                    break
-                inputs, labels = self._split_batch(batch)
+            try:
+                # fold any still-buffered outputs BEFORE reset — the
+                # Metric objects then hold exactly what the
+                # immediate-update path held at every reset boundary
+                self._drain_metric_updates()
+                for m in self._metrics:
+                    m.reset()
+                # model-perspective buckets for profiler.summary():
+                # no-ops unless a Profiler is active (ref:
+                # profiler_statistic.py model perspective —
+                # Dataloader/Forward/.../Optimizer; the compiled step
+                # fuses fwd+bwd+opt, so the TPU-side split is
+                # Dataloader / TrainStep / Callbacks)
+                from ..profiler import _events as _prof_events
+                from ..profiler import RecordEvent as _Rec
+                profiling = _prof_events.active
+                rec = _Rec if profiling else contextlib.nullcontext
                 if k_loop > 1:
-                    k = int(np.shape(
-                        jax.tree_util.tree_leaves(inputs)[0])[0])
-                    if k == k_loop:
+                    it = loader.superbatches(k_loop)
+                else:
+                    it = iter(loader)
+                while True:
+                    with rec("Dataloader"):
+                        batch = next(it, None)
+                    if batch is None:
+                        break
+                    inputs, labels = self._split_batch(batch)
+                    if k_loop > 1:
+                        k = int(np.shape(
+                            jax.tree_util.tree_leaves(inputs)[0])[0])
+                        if k == k_loop:
+                            with rec("TrainStep"):
+                                step_logs = self.train_loop_batch(
+                                    inputs, labels)
+                            with rec("Callbacks"):
+                                for logs in step_logs:
+                                    cbks.on_train_batch_begin(step)
+                                    cbks.on_train_batch_end(step, logs)
+                                    step += 1
+                            continue
+                        # ragged tail slab (< K stacked steps): unstack
+                        # and run the per-step path — same math, one
+                        # extra signature at most (the K=1 program)
+                        sub_batches = [
+                            jax.tree_util.tree_map(lambda x: x[i],
+                                                   (inputs, labels))
+                            for i in range(k)]
+                    else:
+                        sub_batches = [(inputs, labels)]
+                    for inp, lab in sub_batches:
+                        cbks.on_train_batch_begin(step)
                         with rec("TrainStep"):
-                            step_logs = self.train_loop_batch(inputs,
-                                                              labels)
+                            logs = self.train_batch(inp, lab)
                         with rec("Callbacks"):
-                            for logs in step_logs:
-                                cbks.on_train_batch_begin(step)
-                                cbks.on_train_batch_end(step, logs)
-                                step += 1
-                        continue
-                    # ragged tail slab (< K stacked steps): unstack and
-                    # run the per-step path — same math, one extra
-                    # signature at most (the K=1 program)
-                    sub_batches = [
-                        jax.tree_util.tree_map(lambda x: x[i],
-                                               (inputs, labels))
-                        for i in range(k)]
-                else:
-                    sub_batches = [(inputs, labels)]
-                for inp, lab in sub_batches:
-                    cbks.on_train_batch_begin(step)
-                    with rec("TrainStep"):
-                        logs = self.train_batch(inp, lab)
-                    with rec("Callbacks"):
-                        cbks.on_train_batch_end(step, logs)
-                    step += 1
-            # freeze the epoch's final train logs NOW (epoch boundary =
-            # display boundary): the eval pass below resets the shared
-            # metric accumulators, which would otherwise leak into the
-            # lazily-coerced train values at on_epoch_end
-            logs = {n: float(v) if isinstance(
-                v, (_LazyMetricValue, _SlabScalar)) else v
-                for n, v in logs.items()}
-            if eval_loader is not None and epoch % eval_freq == 0:
-                if profiling:
-                    with _Rec("Eval"):
-                        eval_logs = self.evaluate(eval_loader, verbose=0,
-                                                  _callbacks=cbks)
-                else:
-                    eval_logs = self.evaluate(eval_loader, verbose=0,
-                                              _callbacks=cbks)
-                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
-            cbks.on_epoch_end(epoch, logs)
+                            cbks.on_train_batch_end(step, logs)
+                        step += 1
+                # freeze the epoch's final train logs NOW (epoch
+                # boundary = display boundary): the eval pass below
+                # resets the shared metric accumulators, which would
+                # otherwise leak into the lazily-coerced train values
+                # at on_epoch_end
+                logs = {n: float(v) if isinstance(
+                    v, (_LazyMetricValue, _SlabScalar)) else v
+                    for n, v in logs.items()}
+                if eval_loader is not None and epoch % eval_freq == 0:
+                    if profiling:
+                        with _Rec("Eval"):
+                            eval_logs = self.evaluate(
+                                eval_loader, verbose=0, _callbacks=cbks)
+                    else:
+                        eval_logs = self.evaluate(
+                            eval_loader, verbose=0, _callbacks=cbks)
+                    logs.update({f"eval_{k}": v
+                                 for k, v in eval_logs.items()})
+                cbks.on_epoch_end(epoch, logs)
+            finally:
+                if ep_span is not None:
+                    ep_span.set_attr("steps", step)
+                    ep_span.__exit__(*sys.exc_info())
         cbks.on_train_end(logs)
         self._sync_state_out()
 

@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import threading
 import time
+import weakref
 from collections import deque
 from concurrent.futures import Future
 from typing import List, Optional, Sequence
@@ -45,6 +46,8 @@ import numpy as np
 
 from ..nn.layer import Layer, functional_call, split_state
 from ..observability import metrics as _obs
+from ..observability import server as _dbgsrv
+from ..observability import tracing as _trace
 from ..ops.paged_attention import paged_attention, paged_attention_kernel
 
 
@@ -387,7 +390,7 @@ class _Request:
                  "tokens", "slot", "truncated", "t_submit", "t_first",
                  "t_done", "closing", "drain_after", "accepts_inflight",
                  "nonce", "prefill_pos", "prefill_done", "digests",
-                 "n_cached", "n_reg_pages")
+                 "n_cached", "n_reg_pages", "spans")
 
     def __init__(self, prompt, max_new_tokens, temperature):
         self.prompt = list(map(int, prompt))
@@ -419,6 +422,59 @@ class _Request:
         self.digests: List[bytes] = []
         self.n_cached = 0
         self.n_reg_pages = 0    # prompt pages promoted to shared so far
+        # tracing: {"root", "queue", "prefill", "first_token",
+        # "decode"} Span tree, or None when tracing is off (the only
+        # per-request tracing cost while disabled is this None)
+        self.spans = None
+
+
+def _engine_status_provider(ref):
+    """/statusz snapshot closure over a weakref'd engine: occupancy,
+    page pool, prefix-cache and tick state — the live-inspection view
+    of the aggregates the metric registry accumulates. Reads are
+    lock-free by design (python ints/lists; a debug snapshot may be a
+    tick stale)."""
+
+    def _status():
+        eng = ref()
+        if eng is None or eng._closed:
+            return None
+        live = sum(1 for s in eng._slots if s is not None)
+        usable = eng.num_pages - 1
+        out = {
+            "max_seqs": eng.max_seqs,
+            "live_slots": live,
+            "occupancy": round(live / eng.max_seqs, 4),
+            "free_pages": len(eng._free_pages),
+            "usable_pages": usable,
+            "kv_page_utilization": round(
+                (usable - len(eng._free_pages)) / usable, 4),
+            "inflight_steps": len(eng._inflight),
+            "prefill_queue_depth": len(eng._prefill_q),
+            "lookahead": eng.lookahead,
+            "n_steps": eng.n_steps,
+            "n_tokens": eng.n_tokens,
+            "prompt_tokens": eng.n_prompt_tokens,
+            "ticks": {"prefill": eng.n_prefill_ticks,
+                      "decode": eng.n_decode_ticks},
+        }
+        cache = eng._cache
+        if cache is not None:
+            out["prefix_cache"] = {
+                "shared_pages": cache.shared_page_count,
+                "evictable_pages": cache.evictable_count,
+                "hit_tokens": eng.n_cached_tokens,
+                "hit_rate": round(
+                    eng.n_cached_tokens / eng.n_prompt_tokens, 4)
+                if eng.n_prompt_tokens else 0.0,
+            }
+        if eng.spec_k:
+            out["speculative"] = {"spec_tokens": eng.spec_k,
+                                  "rounds": eng.n_spec_rounds,
+                                  "draft_steps": eng.n_draft_steps}
+        return out
+
+    return _status
 
 
 class LLMEngine:
@@ -652,6 +708,12 @@ class LLMEngine:
         self.tick_history: deque = deque(maxlen=512)
         self._m = _engine_metrics()
         self._last_fetch_t: Optional[float] = None
+        # live-debug surface: /statusz reports this engine while it's
+        # alive (weakref closure — a collected engine vanishes from
+        # the listing instead of raising)
+        self._status_name = f"llm_engine_{id(self):x}"
+        _dbgsrv.register_status_provider(
+            self._status_name, _engine_status_provider(weakref.ref(self)))
         self._worker = threading.Thread(target=self._loop, daemon=True)
         self._worker.start()
 
@@ -685,6 +747,22 @@ class LLMEngine:
             # timing) can never change a request's sampled stream
             req.nonce = self._nonce_seq
             self._nonce_seq += 1
+            if _trace.enabled():
+                # the request's span tree roots HERE (submitter
+                # thread, inside the lock so the tree exists before
+                # the engine loop can see the request); the loop
+                # parents every phase explicitly off the request
+                # object — thread-local propagation can't cross the
+                # submit/loop thread boundary
+                root = _trace.start_span(
+                    "llm.request", parent=None, attrs={
+                        "prompt_tokens": len(req.prompt),
+                        "max_new_tokens": req.max_new_tokens,
+                        "temperature": req.temperature,
+                        "nonce": req.nonce})
+                req.spans = {"root": root,
+                             "queue": _trace.start_span(
+                                 "llm.queue", parent=root, t0=root.t0)}
             self._pending.append(req)
         self._wake.set()
         return req.future
@@ -697,6 +775,7 @@ class LLMEngine:
         return [f.result() for f in futs]
 
     def close(self):
+        _dbgsrv.unregister_status_provider(self._status_name)
         with self._mu:
             self._closed = True
         self._wake.set()
@@ -769,6 +848,31 @@ class LLMEngine:
         self._slots[slot] = None
         self._update_kv_gauge()
 
+    def _end_request_spans(self, req: _Request, outcome: str,
+                           error=None) -> None:
+        """Close every open span in the request's tree at one shared
+        timestamp (idempotent — error paths and the normal finish may
+        both land here). The root records the outcome; children that
+        never opened (e.g. a request failed at admission) just don't
+        exist."""
+        sp = req.spans
+        if sp is None:
+            return
+        tp = time.perf_counter()
+        for key in ("queue", "prefill", "first_token", "decode"):
+            s = sp.get(key)
+            if s is not None and not s.ended:
+                if error is not None:
+                    s.set_status("error")
+                s.end(tp)
+        root = sp["root"]
+        root.set_attr("outcome", outcome)
+        root.set_attr("output_tokens", len(req.tokens))
+        if error is not None:
+            root.set_status("error").set_attr("error", str(error))
+        root.end(tp)
+        req.spans = None        # tree closed; drop the references
+
     def _finish(self, slot: int):
         """Resolve + reclaim. Only callable once the slot has no
         in-flight steps (enforced by the drain_after gate)."""
@@ -780,6 +884,8 @@ class LLMEngine:
             self._m["truncated"].inc()
         else:
             self._m["completed"].inc()
+        self._end_request_spans(
+            req, "truncated" if req.truncated else "completed")
         req.future.set_result({
             "prompt_ids": req.prompt,
             "output_ids": req.tokens,
@@ -876,6 +982,19 @@ class LLMEngine:
             self.n_cached_tokens / self.n_prompt_tokens)
         self._m["prefills"].inc()
         self._update_kv_gauge()
+        if req.spans is not None:
+            # queue ends / prefill begins at ONE timestamp: the phase
+            # spans tile submit→finish exactly (their sum IS the
+            # request's end-to-end latency)
+            tp = time.perf_counter()
+            req.spans["queue"].end(tp)
+            req.spans["prefill"] = _trace.start_span(
+                "llm.prefill", parent=req.spans["root"], t0=tp,
+                attrs={"slot": slot, "prompt_tokens": n,
+                       "cache_hit_tokens": req.n_cached})
+            req.spans["root"].add_event(
+                "admitted", {"slot": slot,
+                             "cache_hit_tokens": req.n_cached}, ts=tp)
         return "ok"
 
     def _admit_inline(self, req: _Request) -> str:
@@ -894,6 +1013,13 @@ class LLMEngine:
             active = any(s is not None for s in self._slots)
             return "retry" if active else "never"
         self._m["queue_wait"].observe(time.monotonic() - req.t_submit)
+        if req.spans is not None:
+            tp = time.perf_counter()
+            req.spans["queue"].end(tp)
+            req.spans["prefill"] = _trace.start_span(
+                "llm.prefill", parent=req.spans["root"], t0=tp,
+                attrs={"slot": slot, "prompt_tokens": n,
+                       "inline": True})
         for idx in range(need):
             self.block_tables[slot, idx] = self._alloc_page()
         bucket = self._bucket(n)
@@ -919,6 +1045,17 @@ class LLMEngine:
         req.t_first = time.monotonic()   # TTFT includes device time
         req.tokens.append(tok)
         req.prefill_done = True
+        if req.spans is not None:
+            # inline prefill blocks through the first token, so the
+            # tree skips the first_token phase: prefill ends at the
+            # fetch and decode starts there
+            tp = time.perf_counter()
+            req.spans["prefill"].end(tp)
+            req.spans["decode"] = _trace.start_span(
+                "llm.decode", parent=req.spans["root"], t0=tp)
+            req.spans["root"].add_event(
+                "first_token",
+                {"ttft_s": round(req.t_first - req.t_submit, 6)}, ts=tp)
         self._slots[slot] = req
         self.context_lens[slot] = n
         self._tokens_dev = self._tokens_dev.at[slot].set(req.tokens[-1])
@@ -979,6 +1116,9 @@ class LLMEngine:
             req.prefill_pos += take
             used += take
             touched.append(req)
+            if req.spans is not None:
+                req.spans["prefill"].add_event(
+                    "chunk", {"tokens": take, "pos": req.prefill_pos})
             if req.prefill_pos >= n:
                 self._prefill_q.popleft()
                 finishing.append(req)
@@ -1008,6 +1148,15 @@ class LLMEngine:
             for req in finishing:
                 req.prefill_done = True
                 self.context_lens[req.slot] = len(req.prompt)
+                if req.spans is not None:
+                    # the suffix is computed (last chunk issued); what
+                    # remains before the first token reaches the host
+                    # is the async drain — its own phase
+                    tp = time.perf_counter()
+                    req.spans["prefill"].end(tp)
+                    req.spans["first_token"] = _trace.start_span(
+                        "llm.first_token", parent=req.spans["root"],
+                        t0=tp)
         if self._cache is not None:
             for req in touched:
                 # promote freshly-written FULL prompt pages to shared
@@ -1074,6 +1223,9 @@ class LLMEngine:
                                 leftovers = self._pending
                                 self._pending = []
                             for req in leftovers:
+                                self._end_request_spans(
+                                    req, "failed",
+                                    error="engine closed")
                                 req.future.set_exception(
                                     RuntimeError("engine closed"))
                             return
@@ -1102,10 +1254,12 @@ class LLMEngine:
                     if s is not None:
                         self._free_slot(slot)
                         self._m["failed"].inc()
+                        self._end_request_spans(s, "failed", error=e)
                         s.future.set_exception(e)
                 for req in pending:
                     if not req.future.done():
                         self._m["failed"].inc()
+                        self._end_request_spans(req, "failed", error=e)
                         req.future.set_exception(e)
                 with self._mu:  # drop re-queued copies of failed reqs
                     self._pending = [r for r in self._pending
@@ -1122,13 +1276,18 @@ class LLMEngine:
         verdict = self._admit(req)
         if verdict == "never":
             self._m["failed"].inc()
-            req.future.set_exception(ValueError(
+            err = ValueError(
                 f"prompt of {len(req.prompt)} tokens cannot fit the "
                 f"KV page pool ({self.num_pages - 1} usable pages of "
                 f"{self.page_size} tokens, {self.pages_per_seq} "
-                f"pages/sequence)"))
+                f"pages/sequence)")
+            self._end_request_spans(req, "failed", error=err)
+            req.future.set_exception(err)
             return
         if verdict == "retry":
+            if req.spans is not None:
+                q = req.spans["queue"]
+                q.attrs["retries"] = q.attrs.get("retries", 0) + 1
             with self._mu:
                 self._pending.append(req)
             return
@@ -1210,6 +1369,23 @@ class LLMEngine:
                 # on the device; TTFT lands here, at the async fetch
                 req.t_first = time.monotonic()
                 self._m["ttft"].observe(req.t_first - req.t_submit)
+                if req.spans is not None:
+                    tp = time.perf_counter()
+                    ft = req.spans.get("first_token")
+                    if ft is not None:
+                        ft.end(tp)
+                    req.spans["decode"] = _trace.start_span(
+                        "llm.decode", parent=req.spans["root"], t0=tp)
+                    req.spans["root"].add_event(
+                        "first_token",
+                        {"ttft_s": round(req.t_first - req.t_submit,
+                                         6)}, ts=tp)
+            elif req.spans is not None and "decode" in req.spans:
+                # decode-tick annotation (bounded per span): which
+                # fetch delivered the request's n-th token
+                req.spans["decode"].add_event(
+                    "fetch", {"n_tokens": len(req.tokens),
+                              "issue_seq": seq})
             if self.eos_token_id is not None and \
                     req.tokens[-1] == self.eos_token_id:
                 req.accepts_inflight = False  # nothing after EOS

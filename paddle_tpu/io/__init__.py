@@ -31,6 +31,7 @@ import numpy as np
 
 from ..core import rng as rng_mod
 from ..observability import metrics as _obs
+from ..observability import tracing as _tracing
 
 
 def _loader_metrics():
@@ -345,8 +346,13 @@ class _PrefetchIterator:
             raise StopIteration
         # wait ≈ how starved the train loop is for input: near zero
         # when prefetch keeps up, ≈ batch production time when not
-        self._obs["wait"].observe(time.perf_counter() - t0)
+        t1 = time.perf_counter()
+        self._obs["wait"].observe(t1 - t0)
         self._obs["batches"].inc()
+        if _tracing.enabled():
+            # post-hoc span over the wait interval: the input-starved
+            # share shows up next to dispatch/drain in span rollups
+            _tracing.start_span("io.next_wait", t0=t0).end(t1)
         return item
 
     def close(self):

@@ -1,22 +1,30 @@
-"""paddle_tpu.observability — unified metrics + trace export.
+"""paddle_tpu.observability — metrics, tracing, debug server, flight recorder.
 
 The measurement layer the north star requires (ROADMAP: serve heavy
 traffic, run as fast as the hardware allows — neither is checkable
-without numbers). Two halves:
+without numbers). Four parts:
 
 - metrics: Counter / Gauge / Histogram families with labels, one
   process-wide ``MetricRegistry`` (the superset of the reference's
   platform/monitor.h StatRegistry, which ``core.monitor`` now fronts);
-- exporters: Prometheus text exposition, chrome://tracing JSON for the
-  profiler's host annotations (the ChromeTracingLogger analog), a
-  periodic JSONL file reporter, and jax device-memory gauges.
+- tracing: request/step-scoped ``Span`` trees (ids, parent links,
+  attributes, events) in a bounded process-wide table — the causal
+  view the aggregates can't give ("why was THIS request 40x p50");
+  off by default, near-zero overhead when disabled;
+- exporters: Prometheus text exposition, chrome://tracing JSON merging
+  spans + profiler host annotations onto one timeline, a periodic
+  JSONL file reporter (atexit-flushed), jax device-memory gauges;
+- server + flight: a live HTTP debug surface (``/metrics /healthz
+  /statusz /tracez`` + ``POST /profilez``) and a crash flight
+  recorder that dumps the recent-span ring to JSONL on unhandled
+  exceptions, SIGTERM, and elastic preemption.
 
-Hot paths ship instrumented: ``inference.llm`` (TTFT, tokens/sec,
-batch occupancy, KV-page utilization, queue wait), ``hapi.Model``
-(step time, examples/sec, compile count/time), ``io.checkpoint``
-(durations, bytes), ``distributed.elastic`` (restart/preemption
-counters), and the DataLoader prefetch path. Metric names are tabled
-in docs/OBSERVABILITY.md.
+Hot paths ship instrumented: ``inference.llm`` (metrics + a span tree
+per request: queue → prefill chunks → first token → decode),
+``hapi.Model`` (metrics + epoch/dispatch/metric-drain spans),
+``io.checkpoint``, ``distributed.elastic``, and the DataLoader
+prefetch path. Metric names and the span taxonomy are tabled in
+docs/OBSERVABILITY.md.
 """
 
 from .metrics import (BYTE_BUCKETS, DEFAULT_BUCKETS,  # noqa: F401
@@ -26,6 +34,18 @@ from .metrics import (BYTE_BUCKETS, DEFAULT_BUCKETS,  # noqa: F401
 from .exporters import (JSONLReporter, export_chrome_tracing,  # noqa: F401
                         prometheus_text, sample_device_memory,
                         write_prometheus)
+from . import tracing  # noqa: F401
+from .tracing import Span, SpanContext, start_span  # noqa: F401
+from .tracing import span as trace_span  # noqa: F401
+from .server import (DebugServer, get_debug_server,  # noqa: F401
+                     register_status_provider, start_debug_server,
+                     stop_debug_server, unregister_status_provider)
+from .flight import (FlightRecorder, dump_flight_record,  # noqa: F401
+                     get_flight_recorder, install_flight_recorder)
+
+enable_tracing = tracing.enable
+disable_tracing = tracing.disable
+tracing_enabled = tracing.enabled
 
 __all__ = [
     "BYTE_BUCKETS", "DEFAULT_BUCKETS", "RATE_BUCKETS", "RATIO_BUCKETS",
@@ -33,4 +53,11 @@ __all__ = [
     "MetricFamily", "MetricRegistry", "default_registry",
     "JSONLReporter", "export_chrome_tracing", "prometheus_text",
     "sample_device_memory", "write_prometheus",
+    "tracing", "Span", "SpanContext", "start_span", "trace_span",
+    "enable_tracing", "disable_tracing", "tracing_enabled",
+    "DebugServer", "start_debug_server", "get_debug_server",
+    "stop_debug_server", "register_status_provider",
+    "unregister_status_provider",
+    "FlightRecorder", "install_flight_recorder", "get_flight_recorder",
+    "dump_flight_record",
 ]

@@ -104,30 +104,100 @@ def write_prometheus(path: str,
 # ---------------------------------------------------------------------------
 
 
-def export_chrome_tracing(profiler=None, path: str = "trace.json") -> str:
-    """Dump the profiler facade's recorded host annotations as a
-    chrome://tracing-loadable JSON Array-Format file: one complete
-    ("ph": "X") event per RecordEvent begin/end pair, microsecond
-    timestamps, one row (tid) per recording thread.
+def _overlaps_window(t0: float, t1: float, windows) -> bool:
+    """Interval overlap, not point-in-window: a long-lived span (an
+    llm.request root, a train.epoch) that STARTED before a RECORD
+    window but runs through it must export, or its children would
+    carry dangling parent_ids."""
+    return any(t0 <= e and s <= t1 for s, e in windows)
 
-    ``profiler`` may be a Profiler instance or None — host events are
-    process-wide (worker threads land in the same table), so the
-    argument exists for API symmetry with the reference's
-    ``export_chrome_tracing(dir_name)`` on_trace_ready hook and for
-    future per-profiler filtering.
+
+def export_chrome_tracing(profiler=None, path: str = "trace.json",
+                          include_spans: bool = True) -> str:
+    """Dump the profiler facade's recorded host annotations AND the
+    tracing span table as ONE chrome://tracing-loadable JSON file:
+    complete ("ph": "X") events with microsecond timestamps, one row
+    (tid) per recording thread, ``process_name``/``thread_name``
+    metadata records (ph "M") so Perfetto labels rows instead of
+    showing bare tids, and span events as instants (ph "i").
+
+    ``profiler``: when a Profiler instance is passed, output is
+    filtered to that profiler's RECORD windows (``make_scheduler``
+    cycles: events from CLOSED/READY phases are dropped); ``None``
+    exports everything in the process-wide tables. Spans carry their
+    ids in ``args`` ({trace_id, span_id, parent_id, ...attributes}),
+    so parent links survive the export.
     """
     from ..profiler import _events
+    from . import tracing as _tracing
     with _events.lock:
         events = list(_events.trace)
+    spans = _tracing.finished_spans() if include_spans else []
+    windows = None
+    if profiler is not None and hasattr(profiler, "recording_windows"):
+        # a profiler that never reached a RECORD phase has no windows;
+        # fall back to exporting everything it recorded rather than
+        # silently producing an empty trace
+        windows = profiler.recording_windows() or None
+    if windows is not None:
+        events = [ev for ev in events
+                  if _overlaps_window(ev["ts"], ev["ts"] + ev["dur"],
+                                      windows)]
+        spans = [sp for sp in spans
+                 if _overlaps_window(sp["ts"],
+                                     sp["ts"] + (sp["dur"] or 0.0),
+                                     windows)]
+    pid = os.getpid()
     trace_events = [{
+        "name": "process_name", "ph": "M", "pid": pid,
+        "args": {"name": f"paddle_tpu[{pid}]"},
+    }]
+    tnames = {}
+    for ev in events:
+        tnames.setdefault(ev["tid"], ev.get("tname"))
+    for sp in spans:
+        tnames.setdefault(sp["tid"], sp.get("tname"))
+    for tid, tname in sorted(tnames.items(), key=lambda kv: kv[0] or 0):
+        trace_events.append({
+            "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+            "args": {"name": tname or f"thread-{tid}"},
+        })
+    trace_events += [{
         "name": ev["name"],
         "ph": "X",
         "cat": "host",
         "ts": round(ev["ts"] * 1e6, 3),       # seconds → microseconds
         "dur": round(ev["dur"] * 1e6, 3),
-        "pid": os.getpid(),
+        "pid": pid,
         "tid": ev["tid"],
     } for ev in events]
+    for sp in spans:
+        trace_events.append({
+            "name": sp["name"],
+            "ph": "X",
+            "cat": "span",
+            "ts": round(sp["ts"] * 1e6, 3),
+            "dur": round((sp["dur"] or 0.0) * 1e6, 3),
+            "pid": pid,
+            "tid": sp["tid"],
+            "args": {"trace_id": sp["trace_id"],
+                     "span_id": sp["span_id"],
+                     "parent_id": sp["parent_id"],
+                     "status": sp["status"],
+                     **sp["attrs"]},
+        })
+        for ev in sp["events"]:
+            trace_events.append({
+                "name": f"{sp['name']}:{ev['name']}",
+                "ph": "i",
+                "s": "t",                     # thread-scoped instant
+                "cat": "span_event",
+                "ts": round(ev["ts"] * 1e6, 3),
+                "pid": pid,
+                "tid": sp["tid"],
+                "args": {"span_id": sp["span_id"],
+                         **(ev.get("attrs") or {})},
+            })
     payload = {
         "traceEvents": trace_events,
         "displayTimeUnit": "ms",
@@ -153,10 +223,15 @@ class JSONLReporter:
     thread, writes ONE final snapshot so the last partial interval is
     never lost, joins the thread, and closes the file. Lines are
     flushed as written — a killed process keeps every completed line.
+    A reporter never explicitly stopped still flushes its final
+    snapshot at interpreter exit (atexit): short-lived jobs whose whole
+    life fits inside one interval don't lose everything, and a job
+    crashing through sys.exit keeps its last numbers.
     """
 
     def __init__(self, path: str, interval: float = 10.0,
                  registry: Optional[MetricRegistry] = None):
+        import atexit
         self.path = os.path.abspath(path)
         self.interval = float(interval)
         self.registry = registry or default_registry()
@@ -164,6 +239,8 @@ class JSONLReporter:
         self._f = open(self.path, "a")
         self._stop = threading.Event()
         self._mu = threading.Lock()   # file handle guard (stop vs tick)
+        self._atexit = atexit
+        atexit.register(self.stop)
         self._thread = threading.Thread(
             target=self._loop, name="jsonl-metrics-reporter", daemon=True)
         self._thread.start()
@@ -190,6 +267,10 @@ class JSONLReporter:
         if self._stop.is_set():
             return
         self._stop.set()
+        try:                       # registered at __init__; a stopped
+            self._atexit.unregister(self.stop)   # reporter must not
+        except Exception:          # re-flush at interpreter exit
+            pass
         self._thread.join(timeout=10)
         self._write_snapshot()      # final flush — never lose the tail
         with self._mu:

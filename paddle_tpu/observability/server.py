@@ -1,0 +1,296 @@
+"""Live debug server: scrape + inspect a running job over HTTP.
+
+The reference's PS-mode jobs were scraped ad hoc (monitor.h stats read
+out-of-band); serving/training jobs here get a first-class surface — a
+stdlib ``http.server`` on a daemon thread, safe to leave on in
+production (read-mostly; the one mutating endpoint arms a bounded
+profiler window):
+
+- ``GET /metrics``  — Prometheus text exposition 0.0.4 (the scrape).
+- ``GET /healthz``  — liveness: ``{"status": "ok", "uptime_s": ...}``.
+- ``GET /statusz``  — JSON job state: every registered status
+  provider (LLM engines report occupancy/prefix-cache/queue state,
+  ``hapi.Model`` reports train-loop state), plus device memory via
+  ``sample_device_memory()``.
+- ``GET /tracez``   — recent finished spans + currently-live spans
+  from the tracing table (``?limit=N``, newest first).
+- ``POST /profilez`` — arm an on-demand profiler window:
+  ``{"duration_s": 5, "log_dir": "/tmp/prof"}`` starts a
+  ``profiler.Profiler`` and stops it after the window; 409 while one
+  is already armed.
+
+Components self-register status providers (weakly — a dead engine
+disappears from /statusz instead of raising)::
+
+    from paddle_tpu.observability import server as debug
+    debug.register_status_provider("my_component", lambda: {...})
+    srv = debug.start_debug_server(port=0)   # ephemeral port
+    srv.port
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import os
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional
+from urllib.parse import parse_qs, urlparse
+
+from . import tracing
+from .exporters import prometheus_text, sample_device_memory
+from .metrics import MetricRegistry, default_registry
+
+# name → callable returning a JSON-able dict (or None to be skipped —
+# the convention weakref-closures use once their referent dies)
+_providers: Dict[str, Callable[[], Optional[dict]]] = {}
+_providers_mu = threading.Lock()
+
+_server: Optional["DebugServer"] = None
+_server_mu = threading.Lock()
+
+
+def register_status_provider(name: str,
+                             fn: Callable[[], Optional[dict]]) -> None:
+    with _providers_mu:
+        _providers[name] = fn
+
+
+def unregister_status_provider(name: str) -> None:
+    with _providers_mu:
+        _providers.pop(name, None)
+
+
+def _collect_status() -> Dict[str, dict]:
+    with _providers_mu:
+        items = list(_providers.items())
+    out: Dict[str, dict] = {}
+    dead = []
+    for name, fn in items:
+        try:
+            d = fn()
+        except Exception as e:  # noqa: BLE001 — one bad provider
+            out[name] = {"error": str(e)}   # must not kill /statusz
+            continue
+        if d is None:
+            dead.append(name)
+        else:
+            out[name] = d
+    for name in dead:
+        unregister_status_provider(name)
+    return out
+
+
+class _ProfilerArm:
+    """One on-demand profiler window at a time."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._active: Optional[dict] = None
+
+    def arm(self, duration_s: float, log_dir: str) -> Optional[dict]:
+        from .. import profiler as prof_mod
+        with self._mu:
+            if self._active is not None:
+                return None
+            if prof_mod._events.active:
+                # the job already has its own Profiler recording;
+                # starting another would CLEAR the process-wide event
+                # tables (Profiler.start) and then disable them on the
+                # timer's stop — silently emptying the user's trace
+                return None
+            prof = prof_mod.Profiler(log_dir=log_dir)
+            prof.start()
+            info = {"armed_at": time.time(),
+                    "duration_s": float(duration_s),
+                    "log_dir": os.path.abspath(log_dir)}
+            self._active = info
+
+            def _disarm():
+                try:
+                    prof.stop()
+                finally:
+                    with self._mu:
+                        self._active = None
+
+            t = threading.Timer(max(float(duration_s), 0.01), _disarm)
+            t.daemon = True
+            t.start()
+            return dict(info)
+
+    def status(self) -> Optional[dict]:
+        with self._mu:
+            return dict(self._active) if self._active else None
+
+
+class DebugServer:
+    """The HTTP front. ``port=0`` binds an ephemeral port (tests and
+    multi-job hosts); ``.port`` reads the bound one."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 registry: Optional[MetricRegistry] = None):
+        self.registry = registry or default_registry()
+        self.t_start = time.time()
+        self._arm = _ProfilerArm()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _reply(self, code: int, body: bytes,
+                       ctype: str = "application/json") -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _reply_json(self, code: int, payload) -> None:
+                self._reply(code, json.dumps(
+                    payload, default=str).encode())
+
+            def do_GET(self):
+                try:
+                    outer._get(self)
+                except BrokenPipeError:
+                    pass
+                except Exception as e:  # noqa: BLE001
+                    try:
+                        self._reply_json(500, {"error": str(e)})
+                    except Exception:  # noqa: BLE001
+                        pass
+
+            def do_POST(self):
+                try:
+                    outer._post(self)
+                except BrokenPipeError:
+                    pass
+                except Exception as e:  # noqa: BLE001
+                    try:
+                        self._reply_json(500, {"error": str(e)})
+                    except Exception:  # noqa: BLE001
+                        pass
+
+            def log_message(self, *a):   # debug surface: stay quiet
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    # -- endpoint logic (kept on the server object for testability) -----
+    def _get(self, h) -> None:
+        url = urlparse(h.path)
+        if url.path == "/metrics":
+            h._reply(200, prometheus_text(self.registry).encode(),
+                     ctype="text/plain; version=0.0.4; charset=utf-8")
+        elif url.path == "/healthz":
+            h._reply_json(200, {
+                "status": "ok",
+                "pid": os.getpid(),
+                "uptime_s": round(time.time() - self.t_start, 3)})
+        elif url.path == "/statusz":
+            try:
+                devmem = sample_device_memory(self.registry)
+            except Exception as e:  # noqa: BLE001 — no backend yet
+                devmem = {"error": str(e)}
+            h._reply_json(200, {
+                "pid": os.getpid(),
+                "uptime_s": round(time.time() - self.t_start, 3),
+                "tracing_enabled": tracing.enabled(),
+                "providers": _collect_status(),
+                "device_memory": devmem,
+                "profilez": self._arm.status()})
+        elif url.path == "/tracez":
+            q = parse_qs(url.query)
+            limit = int(q.get("limit", ["256"])[0])
+            fin = tracing.finished_spans()
+            h._reply_json(200, {
+                "enabled": tracing.enabled(),
+                "live": tracing.live_spans(),
+                "finished": list(reversed(fin))[:limit],
+                "finished_total": len(fin)})
+        elif url.path == "/profilez":
+            h._reply_json(200, {"armed": self._arm.status()})
+        else:
+            h._reply_json(404, {
+                "error": f"unknown path {url.path}",
+                "endpoints": ["/metrics", "/healthz", "/statusz",
+                              "/tracez", "POST /profilez"]})
+
+    def _post(self, h) -> None:
+        url = urlparse(h.path)
+        if url.path != "/profilez":
+            h._reply_json(404, {"error": f"unknown path {url.path}"})
+            return
+        n = int(h.headers.get("Content-Length", 0))
+        try:
+            body = json.loads(h.rfile.read(n) or b"{}")
+        except ValueError:
+            h._reply_json(400, {"error": "malformed JSON body"})
+            return
+        duration = float(body.get("duration_s", 5.0))
+        log_dir = body.get("log_dir") or os.path.join(
+            ".", "paddle_tpu_profile_ondemand")
+        info = self._arm.arm(duration, log_dir)
+        if info is None:
+            h._reply_json(409, {"error": "a profiler is already "
+                                "recording (on-demand window or the "
+                                "job's own Profiler)",
+                                "armed": self._arm.status()})
+        else:
+            h._reply_json(200, {"armed": info})
+
+    # -- lifecycle ------------------------------------------------------
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def address(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "DebugServer":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever,
+                name="pt-debug-server", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._thread is not None:
+            self._httpd.shutdown()
+            self._thread.join(timeout=10)
+            self._thread = None
+        self._httpd.server_close()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+def start_debug_server(host: str = "127.0.0.1", port: int = 0,
+                       registry: Optional[MetricRegistry] = None
+                       ) -> DebugServer:
+    """Process-wide singleton start (idempotent: returns the running
+    server if one exists)."""
+    global _server
+    with _server_mu:
+        if _server is None:
+            _server = DebugServer(host=host, port=port,
+                                  registry=registry).start()
+        return _server
+
+
+def get_debug_server() -> Optional[DebugServer]:
+    return _server
+
+
+def stop_debug_server() -> None:
+    global _server
+    with _server_mu:
+        if _server is not None:
+            _server.stop()
+            _server = None

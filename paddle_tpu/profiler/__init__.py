@@ -104,13 +104,28 @@ class _HostEvents:
         self.lock = threading.Lock()
 
     def record(self, name: str, t0: float, dt: float) -> None:
+        t = threading.current_thread()
         with self.lock:
             self.stats[name].append(dt)
             self.trace.append({"name": name, "ts": t0, "dur": dt,
-                               "tid": threading.get_ident()})
+                               "tid": t.ident, "tname": t.name})
+
+    def record_stat(self, name: str, dt: float) -> None:
+        """Aggregate-only record (no trace row): observability spans
+        feed summary() through this — their timeline rendering comes
+        from the span table, so a trace append here would render each
+        span twice in export_chrome_tracing."""
+        with self.lock:
+            self.stats[name].append(dt)
 
 
 _events = _HostEvents()
+
+# which Profiler instance last start()ed: stop() only deactivates the
+# shared event stream if it still owns it, so a stale stop (e.g. the
+# debug server's timed /profilez disarm racing a job profiler started
+# after it) can't silently kill the newer profiler's recording
+_active_owner: Optional["Profiler"] = None
 
 
 class RecordEvent:
@@ -171,6 +186,17 @@ class Profiler:
         self.step_num = 0
         self._state = ProfilerState.CLOSED
         self._tracing = False
+        # [start, end] perf_counter pairs, one per RECORD window (end
+        # None while the window is open) — export_chrome_tracing's
+        # per-profiler filter renders only events inside these
+        self._windows: list = []
+
+    def recording_windows(self):
+        """(start, end) perf_counter pairs of this profiler's RECORD
+        phases; an open window reads as end=+inf."""
+        import math
+        return [(s, e if e is not None else math.inf)
+                for s, e in self._windows]
 
     # -- device trace control -------------------------------------------
     def _start_trace(self):
@@ -178,11 +204,14 @@ class Profiler:
             os.makedirs(self.log_dir, exist_ok=True)
             jax.profiler.start_trace(self.log_dir)
             self._tracing = True
+            self._windows.append([time.perf_counter(), None])
 
     def _stop_trace(self):
         if self._tracing:
             jax.profiler.stop_trace()
             self._tracing = False
+            if self._windows and self._windows[-1][1] is None:
+                self._windows[-1][1] = time.perf_counter()
             if self.on_trace_ready:
                 self.on_trace_ready(self)
 
@@ -195,7 +224,10 @@ class Profiler:
         with _events.lock:
             _events.stats.clear()
             _events.trace.clear()
+        self._windows = []
         _events.active = True
+        global _active_owner
+        _active_owner = self
         self._transition(self.scheduler(self.step_num))
 
     def step(self):
@@ -203,9 +235,12 @@ class Profiler:
         self._transition(self.scheduler(self.step_num))
 
     def stop(self):
+        global _active_owner
         self._stop_trace()
         self._state = ProfilerState.CLOSED
-        _events.active = False
+        if _active_owner is self or _active_owner is None:
+            _events.active = False
+            _active_owner = None
 
     def _transition(self, new_state: ProfilerState):
         # RECORD_AND_RETURN marks a cycle boundary: the trace closes (and

@@ -239,12 +239,19 @@ def test_export_chrome_tracing_complete_events(tmp_path, monkeypatch):
     events = trace["traceEvents"]
     by_name = {}
     for ev in events:
+        if ev["ph"] == "M":           # row-label metadata (Perfetto)
+            assert ev["name"] in ("process_name", "thread_name")
+            assert ev["args"]["name"]
+            continue
         assert ev["ph"] == "X"
         assert ev["dur"] >= 0 and ev["ts"] > 0
         assert isinstance(ev["tid"], int) and isinstance(ev["pid"], int)
         by_name[ev["name"]] = by_name.get(ev["name"], 0) + 1
     assert by_name["step"] == 3       # one X event per annotation
     assert by_name["save"] == 1
+    # recording threads get labeled rows, not bare tids
+    assert any(ev["ph"] == "M" and ev["name"] == "thread_name"
+               for ev in events)
     # profiler module re-exports it (the old `= None` parity marker)
     assert profiler.export_chrome_tracing is export_chrome_tracing
 

@@ -27,7 +27,8 @@ python -c "import jax; jax.config.update('jax_platforms','cpu'); \
 jax.config.update('jax_num_cpu_devices', 8); \
 import __graft_entry__ as g; g.dryrun_multichip(4)"
 
-echo "== observability smoke (train loop -> prometheus + chrome trace + jsonl)"
+echo "== observability smoke (train loop -> prometheus + chrome trace"
+echo "   + jsonl + debug-server scrape + flight-recorder crash dump)"
 python tools/obs_smoke.py "$(mktemp -d)"
 
 echo "== llm serving smoke (prefix cache + chunked ragged prefill)"

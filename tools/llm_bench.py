@@ -54,12 +54,30 @@ def make_prompts(n_requests, prefix_len, tail_len, vocab, seed=0):
             for _ in range(n_requests)]
 
 
+def phase_rollup():
+    """Per-phase span rollup for the BENCH row: where each request's
+    wall time went (queue vs prefill vs first-token drain vs decode),
+    as totals + shares of the summed phase time. Excluding the
+    ``llm.request`` root keeps the shares over the phases that tile it
+    (they sum to 1). This is what lets the perf trajectory say WHERE a
+    TTFT regression lives, not just that totals moved."""
+    from paddle_tpu.observability import tracing
+    return tracing.rollup(prefix="llm.", exclude=("llm.request",))
+
+
 def run_mode(net, prompts, gen_len, prefix_cache, page_size=16,
              prefill_chunk=64, max_seqs=4):
     """One engine pass over the workload. The FIRST request runs alone
     (it populates the cache — and doubles as compile warmup), the rest
-    arrive as a concurrent burst, which is where prefix reuse pays."""
+    arrive as a concurrent burst, which is where prefix reuse pays.
+    Tracing is ON for the pass (span bookkeeping is host-side dict
+    ops, noise against a model forward) so the row carries the
+    per-phase breakdown."""
     from paddle_tpu.inference.llm import LLMEngine
+    from paddle_tpu.observability import tracing
+
+    tracing.clear()
+    tracing.enable()
 
     total = max(len(p) for p in prompts) + gen_len
     pages = -(-total // page_size) * max_seqs + 8
@@ -79,6 +97,8 @@ def run_mode(net, prompts, gen_len, prefix_cache, page_size=16,
         reused = eng.n_cached_tokens
         prompt_toks = eng.n_prompt_tokens
         ticks = (eng.n_prefill_ticks, eng.n_decode_ticks)
+    rollup = phase_rollup()
+    tracing.disable()
     gen_tokens = sum(len(o["output_ids"]) for o in outs[1:])
     ttfts = sorted(o["ttft_s"] for o in outs[1:])
 
@@ -95,6 +115,7 @@ def run_mode(net, prompts, gen_len, prefix_cache, page_size=16,
         "e2e_tokens_per_sec": round(gen_tokens / wall, 1),
         "prefill_ticks": ticks[0],
         "decode_ticks": ticks[1],
+        "span_rollup": rollup,
     }
 
 
@@ -147,6 +168,13 @@ def main(argv=None):
         assert on["tokens_reused"] > 0, \
             "prefix cache produced zero hits on a shared-prefix " \
             "workload"
+        for mode in (on, off):
+            r = mode["span_rollup"]
+            assert r.get("llm.prefill", {}).get("count", 0) > 0 and \
+                r.get("llm.decode", {}).get("count", 0) > 0, \
+                f"span rollup missing phases: {r}"
+            assert abs(sum(v["share"] for v in r.values()) - 1.0) \
+                < 0.01, r
         assert [o["output_ids"] for o in on_outs] == \
             [o["output_ids"] for o in off_outs], \
             "generations differ with prefix cache on vs off"

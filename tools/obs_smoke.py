@@ -1,16 +1,23 @@
 """Observability smoke gate (tools/ci.sh step): run a tiny instrumented
-train loop under the profiler, dump every exporter, and assert the
-artifacts parse — Prometheus text exposition, the chrome://tracing JSON
-(≥1 complete "X" event per recorded host annotation), and the JSONL
-reporter stream. Exits non-zero on any missing signal so a refactor
-that silently unhooks an instrument fails CI, not a 3am bench round.
+train loop under the profiler WITH TRACING ON, dump every exporter, and
+assert the artifacts parse — Prometheus text exposition, the
+chrome://tracing JSON (≥1 complete "X" event per recorded host
+annotation, plus span events with parent links and row-label metadata),
+and the JSONL reporter stream. Then exercise the live surfaces: start
+the debug server on an ephemeral port and scrape /metrics, /healthz,
+/statusz and /tracez; finally force-crash a subprocess with the flight
+recorder installed and assert the JSONL dump was written. Exits
+non-zero on any missing signal so a refactor that silently unhooks an
+instrument fails CI, not a 3am bench round.
 
 Run: python tools/obs_smoke.py [outdir]
 """
 
 import json
 import os
+import subprocess
 import sys
+import urllib.request
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -25,6 +32,8 @@ def main(outdir: str = "/tmp/pt_obs_smoke") -> int:
     import paddle_tpu as pt
     from paddle_tpu import nn, observability
     from paddle_tpu.io import TensorDataset
+    from paddle_tpu.observability import server as debug_server
+    from paddle_tpu.observability import tracing
     from paddle_tpu.profiler import Profiler, export_chrome_tracing
 
     os.makedirs(outdir, exist_ok=True)
@@ -39,24 +48,39 @@ def main(outdir: str = "/tmp/pt_obs_smoke") -> int:
 
     jsonl_path = os.path.join(outdir, "metrics.jsonl")
     prof = Profiler(log_dir=os.path.join(outdir, "xprof"))
+    tracing.enable()
     with observability.JSONLReporter(jsonl_path, interval=0.2):
         prof.start()
         model.fit(TensorDataset([x, y]), batch_size=16, epochs=2,
-                  verbose=0)
+                  verbose=0, steps_per_loop=2)
         prof.stop()
     observability.sample_device_memory()
 
-    # -- chrome trace: loads, and covers every recorded annotation ------
+    # -- chrome trace: loads, covers every annotation AND the spans -----
     trace_path = export_chrome_tracing(prof,
                                        os.path.join(outdir, "trace.json"))
     with open(trace_path) as f:
         trace = json.load(f)
     events = trace["traceEvents"]
     assert events, "empty chrome trace"
-    assert all(ev["ph"] == "X" and ev["dur"] >= 0 for ev in events)
-    names = {ev["name"] for ev in events}
+    xs = [ev for ev in events if ev["ph"] == "X"]
+    assert all(ev["dur"] >= 0 for ev in xs)
+    names = {ev["name"] for ev in xs}
     for bucket in ("Dataloader", "TrainStep", "Callbacks"):
         assert bucket in names, (bucket, names)
+    # spans merged onto the same timeline with parent links + metadata
+    span_evs = [ev for ev in xs if ev.get("cat") == "span"]
+    span_names = {ev["name"] for ev in span_evs}
+    for want in ("train.epoch", "train.dispatch"):
+        assert want in span_names, (want, span_names)
+    epoch_ids = {ev["args"]["span_id"] for ev in span_evs
+                 if ev["name"] == "train.epoch"}
+    step_parents = {ev["args"]["parent_id"] for ev in span_evs
+                    if ev["name"] == "train.dispatch"}
+    assert step_parents <= epoch_ids, \
+        "train.dispatch not parented to epoch"
+    meta = {ev["name"] for ev in events if ev["ph"] == "M"}
+    assert {"process_name", "thread_name"} <= meta, meta
 
     # -- prometheus text: parses line-by-line, has the train signals ----
     prom_path = observability.write_prometheus(
@@ -70,7 +94,8 @@ def main(outdir: str = "/tmp/pt_obs_smoke") -> int:
         float(value)            # every sample value is a number
         assert name_part[0].isalpha() or name_part[0] == "_", line
     assert "train_step_seconds_count" in text
-    assert "dataloader_batches" in text
+    assert "train_loop_slabs" in text     # fused-loop feed instrumented
+    assert "train_loop_dispatch_seconds" in text
 
     # -- jsonl stream: every line self-contained JSON with metrics ------
     with open(jsonl_path) as f:
@@ -79,9 +104,63 @@ def main(outdir: str = "/tmp/pt_obs_smoke") -> int:
     assert any(rec["metrics"].get("train_step_seconds_count", 0) > 0
                for rec in lines), "no step metrics reached the JSONL dump"
 
-    print(f"observability smoke OK: {len(events)} trace events, "
-          f"{len(text.splitlines())} prom lines, {len(lines)} jsonl rows "
-          f"-> {outdir}")
+    # -- debug server: live /metrics + /statusz + /tracez round-trip ----
+    srv = debug_server.DebugServer(port=0).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        with urllib.request.urlopen(base + "/healthz", timeout=30) as r:
+            assert json.loads(r.read())["status"] == "ok"
+        with urllib.request.urlopen(base + "/metrics", timeout=30) as r:
+            scraped = r.read().decode()
+            assert "version=0.0.4" in r.headers["Content-Type"]
+        for fam in ("train_step_seconds", "train_compile_count",
+                    "train_loop_slabs", "train_loop_dispatch_seconds"):
+            assert fam in scraped, f"{fam} missing from /metrics scrape"
+        for line in scraped.splitlines():     # scrape parses too
+            if not line or line.startswith("#"):
+                continue
+            float(line.rsplit(" ", 1)[1].replace("+Inf", "inf"))
+        with urllib.request.urlopen(base + "/statusz", timeout=30) as r:
+            st = json.loads(r.read())
+        assert any(k.startswith("train_model_") for k in st["providers"])
+        with urllib.request.urlopen(base + "/tracez?limit=8",
+                                    timeout=30) as r:
+            tz = json.loads(r.read())
+        assert tz["finished_total"] > 0
+    finally:
+        srv.stop()
+    tracing.disable()
+
+    # -- flight recorder: forced crash leaves a JSONL dump --------------
+    crash_dir = os.path.join(outdir, "flight")
+    crash_code = f"""
+import jax; jax.config.update("jax_platforms", "cpu")
+from paddle_tpu.observability import tracing, flight
+tracing.enable()
+flight.install_flight_recorder({crash_dir!r})
+tracing.start_span("doomed.work", attrs={{"step": 7}})
+raise RuntimeError("forced crash for the obs smoke gate")
+"""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.run([sys.executable, "-c", crash_code], env=env,
+                       capture_output=True, text=True, timeout=300,
+                       cwd=os.path.dirname(os.path.dirname(
+                           os.path.abspath(__file__))))
+    assert p.returncode != 0, "forced crash exited 0"
+    assert "forced crash" in p.stderr, p.stderr[-500:]
+    dumps = [f for f in os.listdir(crash_dir) if f.endswith(".jsonl")]
+    assert dumps, "flight recorder wrote no dump on unhandled exception"
+    rows = [json.loads(ln)
+            for ln in open(os.path.join(crash_dir, dumps[0]))]
+    assert rows[0]["kind"] == "header" and rows[0]["reason"] == "exception"
+    assert any(r.get("kind") == "span" and r.get("live") and
+               r["name"] == "doomed.work" for r in rows), \
+        "in-flight span missing from the crash dump"
+
+    print(f"observability smoke OK: {len(events)} trace events "
+          f"({len(span_evs)} spans), {len(text.splitlines())} prom "
+          f"lines, {len(lines)} jsonl rows, debug server scraped, "
+          f"crash dump {dumps[0]} -> {outdir}")
     return 0
 
 
