@@ -52,6 +52,7 @@ from . import device  # noqa: F401
 from . import distribution  # noqa: F401
 from . import distributed  # noqa: F401
 from . import observability  # noqa: F401
+from . import reliability  # noqa: F401
 from . import profiler  # noqa: F401
 from . import quant  # noqa: F401
 from . import cost_model  # noqa: F401

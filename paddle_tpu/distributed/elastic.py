@@ -39,6 +39,7 @@ import threading
 import time
 
 from ..core.monitor import stat_add
+from ..reliability.retry import backoff_delay
 from .launch import find_free_port, trainer_env
 from typing import Dict, List, Optional
 
@@ -201,7 +202,10 @@ class ElasticManager:
                  max_restarts: int = 0,
                  heartbeat_timeout: Optional[float] = None,
                  env_extra: Optional[Dict[str, str]] = None,
-                 poll_interval: float = 0.2):
+                 poll_interval: float = 0.2,
+                 restart_backoff: float = 0.5,
+                 restart_backoff_cap: float = 30.0,
+                 backoff_reset_s: float = 60.0):
         self.nproc = nproc
         self.script = training_script
         self.script_args = script_args
@@ -213,6 +217,17 @@ class ElasticManager:
         self.poll_interval = poll_interval
         self.restarts = 0      # failure-budget consumption only
         self.generation = 0    # every respawn (failures AND preemptions)
+        # restart-storm damping (reliability.retry backoff curve): a
+        # deterministic child crash used to hot-loop max_preemptions
+        # times in seconds; now consecutive short-lived generations
+        # back off exponentially (restart_backoff · 2^n, capped), and
+        # a generation that survives backoff_reset_s resets the curve.
+        # jitter=0: one launcher per job — reproducible pacing beats
+        # thundering-herd protection here.
+        self.restart_backoff = float(restart_backoff)
+        self.restart_backoff_cap = float(restart_backoff_cap)
+        self.backoff_reset_s = float(backoff_reset_s)
+        self._backoff_level = 0
 
     # -- one generation ------------------------------------------------
     def _spawn(self) -> None:
@@ -369,9 +384,40 @@ class ElasticManager:
                       f"{self.restarts}/{self.max_restarts} after "
                       f"{'stall' if code is None else f'exit {code}'}",
                       file=sys.stderr)
+            # restart-storm damping before the respawn; a CHECKPOINTED
+            # preemption exit is evidence of health, not of a crash
+            # loop — it restarts immediately and resets the curve
+            self._respawn_backoff(
+                healthy=(code == RESTART_EXIT_CODE))
             # fresh rendezvous for the new generation (the reference
             # re-registers under a new etcd index the same way)
             self.master = f"127.0.0.1:{find_free_port()}"
+
+    def _respawn_backoff(self, healthy: bool) -> float:
+        """Restart-storm damping (reliability.retry backoff curve):
+        consecutive short-lived generations wait restart_backoff · 2^n
+        (capped) before the respawn, so a deterministic child crash
+        can't hot-loop the budget away in seconds. Two signals reset
+        the curve: a generation that survived ``backoff_reset_s``, and
+        a ``healthy`` exit (graceful checkpointed preemption — the
+        platform's doing, not the trainer's; it respawns immediately).
+        Returns the delay slept."""
+        if healthy:
+            self._backoff_level = 0
+            return 0.0
+        if time.time() - self._gen_start >= self.backoff_reset_s:
+            self._backoff_level = 0
+        delay = backoff_delay(self._backoff_level,
+                              self.restart_backoff,
+                              cap=self.restart_backoff_cap)
+        self._backoff_level += 1
+        if delay > 0:
+            print(f"[elastic] backing off {delay:.1f}s before "
+                  f"respawn (consecutive restart "
+                  f"{self._backoff_level})", file=sys.stderr)
+            stat_add("elastic.backoff_seconds", delay)
+            time.sleep(delay)
+        return delay
 
     def install_signal_forwarding(self) -> None:
         """Launcher-level grace: when the LAUNCHER receives SIGTERM (the

@@ -18,10 +18,14 @@ Design:
   polls are absorbed by a 0.25 s read cache in the facade, so the
   wire carries only a few requests/sec/node and the
   persistent-connection bookkeeping a busier protocol would need
-  stays out. Retries transient failures, then raises
-  ``StoreUnavailable`` — the leader hosting the store is gone, which
-  on a platform-scheduled pod means the JOB is gone; the NodeAgent
-  maps it to its rendezvous-lost exit.
+  stays out. Transient failures are retried through the SHARED
+  ``reliability.retry`` policy (exponential backoff + jitter — a
+  leader restart no longer gets hammered by every follower on the
+  same fixed 0.3 s metronome), then raise ``StoreUnavailable`` — the
+  leader hosting the store is gone, which on a platform-scheduled pod
+  means the JOB is gone; the NodeAgent maps it to its rendezvous-lost
+  exit. The legacy ``retries``/``retry_delay`` constructor kwargs are
+  kept as aliases into the policy.
 - ``TCPRendezvous``: the FileRendezvous-compatible facade (same
   protocol surface: heartbeats, generation state, restart flags,
   done flags) over the store. The leader (node 0) hosts the server
@@ -40,6 +44,12 @@ import socketserver
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
+
+from ..reliability import faults as _faults
+from ..reliability.faults import FaultInjected
+from ..reliability.retry import (Deadline, DeadlineExceeded,
+                                 RetryExhausted, RetryPolicy,
+                                 as_deadline)
 
 
 class StoreUnavailable(RuntimeError):
@@ -107,33 +117,60 @@ class TCPStoreServer:
 
 
 class TCPStoreClient:
+    """``retries``/``retry_delay`` are kept as constructor aliases for
+    the shared policy's attempt budget / base delay (callers predate
+    ``reliability.retry``); pass ``policy=`` to override wholesale."""
+
     def __init__(self, endpoint: str, timeout: float = 5.0,
-                 retries: int = 3, retry_delay: float = 0.3):
+                 retries: int = 3, retry_delay: float = 0.3,
+                 policy: Optional[RetryPolicy] = None):
         host, port = endpoint.rsplit(":", 1)
         self.addr = (host, int(port))
         self.timeout = timeout
         self.retries = retries
         self.retry_delay = retry_delay
+        # ValueError is retryable here: a half-written response line
+        # (server died mid-reply) surfaces as a json decode error.
+        # FaultInjected too, so a default-exception chaos schedule at
+        # store.socket exercises the same retry path an OSError would
+        self.policy = policy or RetryPolicy(
+            max_attempts=retries, base_delay=retry_delay,
+            max_delay=max(8 * retry_delay, 2.0), jitter=0.5,
+            retry_on=(OSError, ValueError, FaultInjected),
+            scope="tcp_store")
 
-    def request(self, req: dict) -> dict:
-        last: Optional[Exception] = None
-        for _ in range(self.retries):
-            try:
-                with socket.create_connection(
-                        self.addr, timeout=self.timeout) as s:
-                    s.sendall(json.dumps(req).encode() + b"\n")
-                    f = s.makefile("rb")
-                    resp = json.loads(f.readline(1 << 20))
-                if not resp.get("ok"):
-                    raise StoreUnavailable(resp.get("error", "store error"))
-                return resp
-            except StoreUnavailable:
-                raise
-            except (OSError, ValueError) as e:
-                last = e
-                time.sleep(self.retry_delay)
-        raise StoreUnavailable(
-            f"rendezvous store at {self.addr} unreachable: {last!r}")
+    def _attempt(self, req: dict, deadline: Optional[Deadline]) -> dict:
+        if _faults.enabled():
+            _faults.check("store.socket")
+        timeout = self.timeout if deadline is None \
+            else max(deadline.clamp(self.timeout), 0.01)
+        with socket.create_connection(self.addr, timeout=timeout) as s:
+            s.sendall(json.dumps(req).encode() + b"\n")
+            f = s.makefile("rb")
+            resp = json.loads(f.readline(1 << 20))
+        if not resp.get("ok"):
+            # a protocol-level refusal is not a flaky socket: surface
+            # it without burning the retry budget
+            raise StoreUnavailable(resp.get("error", "store error"))
+        return resp
+
+    def request(self, req: dict, deadline=None) -> dict:
+        dl = as_deadline(deadline)
+        try:
+            return self.policy.call(self._attempt, req, dl, deadline=dl,
+                                    describe=f"store {req.get('op')}")
+        except RetryExhausted as e:
+            raise StoreUnavailable(
+                f"rendezvous store at {self.addr} unreachable: "
+                f"{e.last!r}") from e.last
+        except DeadlineExceeded as e:
+            # StoreUnavailable is THE documented failure contract —
+            # every consumer (heartbeat loop, NodeAgent rendezvous-
+            # lost mapping) catches exactly it; a caller deadline
+            # expiring mid-retry must not escape as a different type
+            raise StoreUnavailable(
+                f"rendezvous store at {self.addr} unreachable before "
+                f"deadline: {e}") from e
 
 
 AGENT_BEAT_INTERVAL = 0.5

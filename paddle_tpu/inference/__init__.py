@@ -544,7 +544,8 @@ class DynamicBatcher:
 def __getattr__(name):
     # lazy: the LLM engine pulls in model/ops modules that plain
     # CNN-artifact serving never needs
-    if name in ("LLMEngine", "serve_llm"):
+    if name in ("LLMEngine", "serve_llm", "AdmissionShed",
+                "AdmissionTimeout", "RequestCancelled"):
         from . import llm
         return getattr(llm, name)
     if name == "PrefixCache":

@@ -49,6 +49,33 @@ from ..observability import metrics as _obs
 from ..observability import server as _dbgsrv
 from ..observability import tracing as _trace
 from ..ops.paged_attention import paged_attention, paged_attention_kernel
+from ..reliability import faults as _faults
+from ..reliability.retry import Deadline, DeadlineExceeded, as_deadline
+
+
+class AdmissionShed(RuntimeError):
+    """Terminal admission verdict: the engine refused the request to
+    protect itself (bounded queue overflow, or a draining health
+    state). Distinct from ``"retry"`` (transient) and ``"never"`` (the
+    prompt can't fit the pool): a shed request was viable — the ENGINE
+    was not. Callers should back off and try another replica."""
+
+
+class AdmissionTimeout(TimeoutError):
+    """The admission retry budget ran out: the request waited in the
+    ``"retry"`` cycle past the engine's ``admit_timeout`` without slots
+    or pages freeing up."""
+
+
+class RequestCancelled(RuntimeError):
+    """The request was cancelled via :meth:`LLMEngine.cancel` before
+    it finished; its KV pages are reclaimed and its span tree closed."""
+
+
+# health state machine: consecutive device errors walk the engine
+# healthy → degraded → draining; any successful fetch resets to healthy
+# unless draining (sticky — operator recovers via reset_health()).
+_HEALTH_CODE = {"healthy": 0, "degraded": 1, "draining": 2}
 
 
 def _engine_metrics():
@@ -117,6 +144,37 @@ def _engine_metrics():
         "tick_ratio": reg.gauge(
             "llm_prefill_decode_tick_ratio",
             "prefill ticks / decode ticks since engine start"),
+        # hardened failure semantics (docs/RELIABILITY.md): these
+        # outcomes are terminal and disjoint from completed/truncated/
+        # failed — submitted = completed + truncated + failed + shed +
+        # deadline_exceeded + cancelled + admission_timeout
+        "shed": reg.counter(
+            "llm_shed_total",
+            "requests refused under load (bounded admission queue "
+            "overflow or a draining engine)"),
+        "deadline": reg.counter(
+            "llm_deadline_exceeded_total",
+            "requests resolved DeadlineExceeded at a queue/prefill/"
+            "decode boundary"),
+        "cancelled": reg.counter(
+            "llm_cancelled_total", "requests cancelled via cancel()"),
+        "admit_timeout": reg.counter(
+            "llm_admission_timeout_total",
+            "requests whose admission retry budget expired"),
+        "device_retries": reg.counter(
+            "llm_device_retries_total",
+            "per-request re-admissions after a device error"),
+        "device_errors": reg.counter(
+            "llm_device_errors_total",
+            "engine-loop device/compile errors caught"),
+        "health": reg.gauge(
+            "llm_health_state",
+            "engine health: 0 healthy, 1 degraded, 2 draining"),
+        "queue_depth": reg.gauge(
+            "llm_admission_queue_depth",
+            "submitted requests not yet admitted (new submissions "
+            "shed at max_pending; device-error re-admissions re-enter "
+            "above it, so the ceiling is max_pending + max_seqs)"),
     }
 
 
@@ -390,7 +448,9 @@ class _Request:
                  "tokens", "slot", "truncated", "t_submit", "t_first",
                  "t_done", "closing", "drain_after", "accepts_inflight",
                  "nonce", "prefill_pos", "prefill_done", "digests",
-                 "n_cached", "n_reg_pages", "spans")
+                 "n_cached", "n_reg_pages", "spans", "deadline",
+                 "priority", "req_id", "admit_attempts",
+                 "device_retries", "cancelled", "queued", "t_enqueued")
 
     def __init__(self, prompt, max_new_tokens, temperature):
         self.prompt = list(map(int, prompt))
@@ -426,6 +486,23 @@ class _Request:
         # "decode"} Span tree, or None when tracing is off (the only
         # per-request tracing cost while disabled is this None)
         self.spans = None
+        # hardened failure semantics: per-request deadline (composed
+        # Deadline or None), admission priority (higher admits first),
+        # public id (cancel() handle), and the two retry budgets'
+        # consumption counters
+        self.deadline = None
+        self.priority = 0
+        self.req_id = -1
+        self.admit_attempts = 0
+        self.device_retries = 0
+        self.cancelled = False
+        # True while the request occupies the bounded admission queue
+        # (submit → slot assignment); the _n_queued gauge mirrors the
+        # number of requests with this flag set. t_enqueued marks the
+        # start of the CURRENT admission cycle — device retries reset
+        # it, so admit_timeout bounds time-in-queue, not request age
+        self.queued = False
+        self.t_enqueued = self.t_submit
 
 
 def _engine_status_provider(ref):
@@ -451,6 +528,9 @@ def _engine_status_provider(ref):
                 (usable - len(eng._free_pages)) / usable, 4),
             "inflight_steps": len(eng._inflight),
             "prefill_queue_depth": len(eng._prefill_q),
+            "admission_queue_depth": eng._n_queued,
+            "health": eng.health,
+            "consecutive_device_errors": eng._consec_device_errors,
             "lookahead": eng.lookahead,
             "n_steps": eng.n_steps,
             "n_tokens": eng.n_tokens,
@@ -540,7 +620,12 @@ class LLMEngine:
                  lookahead: int = 0, attention_impl: str = "xla",
                  draft_net=None, spec_tokens: int = 4,
                  prefix_cache: bool = True,
-                 prefill_chunk: Optional[int] = None):
+                 prefill_chunk: Optional[int] = None,
+                 max_pending: int = 256,
+                 admit_timeout: Optional[float] = 300.0,
+                 device_retry_budget: int = 0,
+                 degraded_after: int = 1,
+                 drain_after: int = 8):
         cfg = net.cfg
         self.cfg = cfg
         self.max_seqs = max_seqs
@@ -696,6 +781,25 @@ class LLMEngine:
         self._pending: List[_Request] = []
         self._closed = False
         self._wake = threading.Event()
+        # hardened failure semantics (docs/RELIABILITY.md):
+        # - bounded admission queue; overflow verdict is "shed"
+        # - admission retry budget: a request stuck in the "retry"
+        #   cycle past admit_timeout resolves AdmissionTimeout instead
+        #   of spinning forever
+        # - per-request device-error retry budget: a device error
+        #   re-admits the request (same nonce → identical token
+        #   stream) up to this many times before failing its future;
+        #   0 keeps the historical fail-fast behavior
+        # - health state machine over consecutive device errors
+        self.max_pending = int(max_pending)
+        self.admit_timeout = admit_timeout
+        self.device_retry_budget = int(device_retry_budget)
+        self.degraded_after = int(degraded_after)
+        self.drain_after = int(drain_after)
+        self._n_queued = 0            # submitted, not yet admitted
+        self._by_id: dict = {}        # req_id → _Request (cancel handle)
+        self._consec_device_errors = 0
+        self._health = "healthy"
         # serving stats
         self.n_steps = 0
         self.n_tokens = 0
@@ -714,13 +818,49 @@ class LLMEngine:
         self._status_name = f"llm_engine_{id(self):x}"
         _dbgsrv.register_status_provider(
             self._status_name, _engine_status_provider(weakref.ref(self)))
+        ref = weakref.ref(self)
+        _dbgsrv.register_health_provider(
+            self._status_name,
+            lambda: (lambda e: None if e is None or e._closed
+                     else e.health)(ref()))
+        self._m["health"].set(0)
         self._worker = threading.Thread(target=self._loop, daemon=True)
         self._worker.start()
 
     # -- public API ---------------------------------------------------------
+    @property
+    def health(self) -> str:
+        """"healthy" | "degraded" | "draining" (docs/RELIABILITY.md).
+        Draining engines shed every new submission; degraded ones
+        serve but are one error streak from draining."""
+        return self._health
+
+    def reset_health(self) -> None:
+        """Operator escape hatch: clear the draining latch (e.g. after
+        the device recovered) and resume admitting."""
+        self._consec_device_errors = 0
+        self._health = "healthy"
+        self._m["health"].set(0)
+        self._wake.set()
+
+    def cancel(self, request_id: int) -> bool:
+        """Cancel a submitted request by the ``request_id`` attribute
+        of its future. Returns False if unknown or already resolved.
+        The engine loop resolves the future with
+        :class:`RequestCancelled`, frees the request's KV pages, and
+        closes its span tree at the next boundary."""
+        with self._mu:
+            req = self._by_id.get(request_id)
+        if req is None or req.future.done():
+            return False
+        req.cancelled = True
+        self._wake.set()
+        return True
+
     def submit(self, prompt_ids: Sequence[int],
                max_new_tokens: int = 32,
-               temperature: float = 0.0) -> Future:
+               temperature: float = 0.0,
+               deadline=None, priority: int = 0) -> Future:
         if len(prompt_ids) + max_new_tokens > self.max_len:
             raise ValueError(
                 f"prompt {len(prompt_ids)} + max_new_tokens "
@@ -739,14 +879,39 @@ class LLMEngine:
                 "speculative decoding is greedy-only (v1); use "
                 "temperature=0 or an engine without draft_net")
         req = _Request(prompt_ids, max_new_tokens, temperature)
+        req.deadline = as_deadline(deadline)
+        req.priority = int(priority)
         with self._mu:
             if self._closed:
                 raise RuntimeError("engine closed")
             # nonce = submission order: the sampling-key salt is fixed
             # HERE, so scheduler choices (cache hits, chunking, retry
             # timing) can never change a request's sampled stream
-            req.nonce = self._nonce_seq
+            req.nonce = req.req_id = self._nonce_seq
             self._nonce_seq += 1
+            # LOAD SHEDDING is a submit-time verdict: a full admission
+            # queue or a draining engine resolves the future right
+            # here with AdmissionShed — terminal, never queued, so an
+            # overloaded engine's queue cannot grow without bound
+            shed_why = None
+            if self._health == "draining":
+                shed_why = "engine is draining (health state machine)"
+            elif self._n_queued >= self.max_pending:
+                shed_why = (f"admission queue full "
+                            f"({self._n_queued}/{self.max_pending})")
+            if shed_why is not None:
+                self._m["shed"].inc()
+                err = AdmissionShed(shed_why)
+                if _trace.enabled():
+                    root = _trace.start_span(
+                        "llm.request", parent=None, attrs={
+                            "prompt_tokens": len(req.prompt),
+                            "nonce": req.nonce, "outcome": "shed",
+                            "error": shed_why})
+                    root.set_status("error").end()
+                req.future.set_exception(err)
+                req.future.request_id = req.req_id
+                return req.future
             if _trace.enabled():
                 # the request's span tree roots HERE (submitter
                 # thread, inside the lock so the tree exists before
@@ -764,18 +929,36 @@ class LLMEngine:
                              "queue": _trace.start_span(
                                  "llm.queue", parent=root, t0=root.t0)}
             self._pending.append(req)
+            self._by_id[req.req_id] = req
+            req.queued = True
+            self._n_queued += 1
         self._wake.set()
+        req.future.request_id = req.req_id
         return req.future
 
     def generate(self, prompts: Sequence[Sequence[int]],
                  max_new_tokens: int = 32,
                  temperature: float = 0.0) -> List[dict]:
-        futs = [self.submit(p, max_new_tokens, temperature)
-                for p in prompts]
-        return [f.result() for f in futs]
+        """Blocking batch convenience. Applies its own backpressure:
+        at most ``max_pending // 2`` submissions are outstanding at
+        once, so a batch wider than the bounded admission queue rides
+        through in windows instead of shedding its own tail."""
+        outs: List[Optional[dict]] = [None] * len(prompts)
+        window = max(1, self.max_pending // 2)
+        inflight: deque = deque()
+        for i, p in enumerate(prompts):
+            while len(inflight) >= window:
+                j, f = inflight.popleft()
+                outs[j] = f.result()
+            inflight.append((i, self.submit(p, max_new_tokens,
+                                            temperature)))
+        for j, f in inflight:
+            outs[j] = f.result()
+        return outs
 
     def close(self):
         _dbgsrv.unregister_status_provider(self._status_name)
+        _dbgsrv.unregister_health_provider(self._status_name)
         with self._mu:
             self._closed = True
         self._wake.set()
@@ -879,6 +1062,13 @@ class LLMEngine:
         req = self._slots[slot]
         req.t_done = time.monotonic()
         self._free_slot(slot)
+        with self._mu:
+            self._by_id.pop(req.req_id, None)
+        if req.future.done():
+            # cancelled / deadline-exceeded mid-flight: the future and
+            # span tree were resolved at the boundary that aborted it;
+            # this drain pass only had to reclaim the pages
+            return
         # disjoint outcomes: completed + truncated + failed = submitted
         if req.truncated:
             self._m["truncated"].inc()
@@ -911,6 +1101,67 @@ class LLMEngine:
                     and self._fetch_seq >= req.drain_after:
                 self._finish(slot)
 
+    def _typed_outcome(self, req: _Request):
+        """(outcome, counter, exc) the API already promised this
+        request, or None: an accepted cancel() beats an expired
+        deadline beats nothing — ONE place decides, so the admission
+        boundary, the per-tick police pass, and the device-error
+        handler can never drift apart."""
+        if req.cancelled:
+            return ("cancelled", self._m["cancelled"],
+                    RequestCancelled(
+                        f"request {req.req_id} cancelled after "
+                        f"{len(req.tokens)} token(s)"))
+        if req.deadline is not None and req.deadline.expired:
+            return ("deadline", self._m["deadline"],
+                    DeadlineExceeded(
+                        f"request {req.req_id} deadline expired after "
+                        f"{len(req.tokens)} token(s), "
+                        f"{req.admit_attempts} admission attempt(s)"))
+        return None
+
+    def _abort_slot(self, slot: int, outcome: str, exc: BaseException,
+                    counter) -> None:
+        """Terminal mid-flight resolution (cancel / deadline): resolve
+        the future NOW, close the span tree, stop issuing for the
+        slot. Pages stay held until the in-flight issue stream drains
+        past it (the _finish pass reclaims them and sees the future
+        already resolved)."""
+        req = self._slots[slot]
+        if req in self._prefill_q:
+            self._prefill_q = deque(
+                r for r in self._prefill_q if r is not req)
+        counter.inc()
+        self._end_request_spans(req, outcome, error=exc)
+        if not req.future.done():
+            req.future.set_exception(exc)
+        with self._mu:
+            self._by_id.pop(req.req_id, None)
+        self._begin_close(slot, accept_inflight=False)
+
+    def _police_slots(self):
+        """Per-tick failure-semantics boundary: cancellation and
+        deadline expiry for slotted requests. O(max_seqs) python-int
+        reads — control-plane noise next to a device step."""
+        for slot, req in enumerate(self._slots):
+            if req is None or req.closing:
+                continue
+            promised = self._typed_outcome(req)
+            if promised is not None:
+                outcome, counter, exc = promised
+                self._abort_slot(slot, outcome, exc, counter)
+
+    def _update_health(self) -> None:
+        if self._health != "draining":
+            n = self._consec_device_errors
+            if n >= self.drain_after:
+                self._health = "draining"
+            elif n >= self.degraded_after:
+                self._health = "degraded"
+            else:
+                self._health = "healthy"
+        self._m["health"].set(_HEALTH_CODE[self._health])
+
     def _bucket(self, n: int) -> int:
         for b in self.prefill_buckets:
             if n <= b:
@@ -919,7 +1170,9 @@ class LLMEngine:
 
     def _admit(self, req: _Request) -> str:
         """"ok" (admitted), "retry" (transiently out of slots/pages),
-        or "never" (the prompt cannot fit this pool at all).
+        "never" (the prompt cannot fit this pool at all), or "shed"
+        (the engine is protecting itself — terminal, resolve
+        AdmissionShed).
 
         Chunked path: admission only RESERVES — match the prefix
         cache, map shared pages read-only, allocate suffix pages, and
@@ -927,6 +1180,8 @@ class LLMEngine:
         suffix is computed by ``_prefill_tick`` chunks interleaved
         with decode, and the first token is harvested asynchronously
         in ``_drain_one`` like any decode token."""
+        if self._health == "draining":
+            return "shed"
         if self.spec_k:
             return self._admit_inline(req)
         n = len(req.prompt)
@@ -959,7 +1214,7 @@ class LLMEngine:
             return "retry" if active else "never"
         # admission decided: everything before this instant was queue
         # wait (slot/page availability), everything after is prefill
-        self._m["queue_wait"].observe(time.monotonic() - req.t_submit)
+        self._m["queue_wait"].observe(time.monotonic() - req.t_enqueued)
         for idx, page in enumerate(matched):
             self._cache.acquire(page)
             self.block_tables[slot, idx] = page
@@ -970,6 +1225,7 @@ class LLMEngine:
         req.prefill_pos = req.n_cached
         req.n_reg_pages = m
         self._slots[slot] = req
+        self._dequeue_accounting(req)
         self.temperatures[slot] = req.temperature
         self._nonces[slot] = req.nonce
         self._prefill_q.append(req)
@@ -1012,7 +1268,7 @@ class LLMEngine:
         if need > len(self._free_pages):
             active = any(s is not None for s in self._slots)
             return "retry" if active else "never"
-        self._m["queue_wait"].observe(time.monotonic() - req.t_submit)
+        self._m["queue_wait"].observe(time.monotonic() - req.t_enqueued)
         if req.spans is not None:
             tp = time.perf_counter()
             req.spans["queue"].end(tp)
@@ -1020,8 +1276,18 @@ class LLMEngine:
                 "llm.prefill", parent=req.spans["root"], t0=tp,
                 attrs={"slot": slot, "prompt_tokens": n,
                        "inline": True})
+        # the slot table owns the request BEFORE any page allocation
+        # or device call: if the blocking prefill below raises, the
+        # loop handler's slot scan reclaims the allocated pages and
+        # applies the device-retry budget (otherwise an inline prefill
+        # error would leak its pages and retry budget-free)
+        req.slot = slot
+        self._slots[slot] = req
+        self._dequeue_accounting(req)
         for idx in range(need):
             self.block_tables[slot, idx] = self._alloc_page()
+        if _faults.enabled():
+            _faults.check("device.dispatch")
         bucket = self._bucket(n)
         ids = np.zeros((1, bucket), np.int32)
         ids[0, :n] = req.prompt
@@ -1040,7 +1306,6 @@ class LLMEngine:
                 jnp.asarray(self.block_tables[slot]),
                 self.draft_k_pages, self.draft_v_pages,
                 jnp.float32(0.0), jnp.int32(req.nonce), self._key)
-        req.slot = slot
         tok = int(nxt)        # blocks until the prefill has executed —
         req.t_first = time.monotonic()   # TTFT includes device time
         req.tokens.append(tok)
@@ -1056,7 +1321,6 @@ class LLMEngine:
             req.spans["root"].add_event(
                 "first_token",
                 {"ttft_s": round(req.t_first - req.t_submit, 6)}, ts=tp)
-        self._slots[slot] = req
         self.context_lens[slot] = n
         self._tokens_dev = self._tokens_dev.at[slot].set(req.tokens[-1])
         self.temperatures[slot] = req.temperature
@@ -1126,6 +1390,8 @@ class LLMEngine:
                 sample_pos[req.slot] = n - 1
             else:
                 break   # chunk budget exhausted mid-prompt
+        if _faults.enabled():
+            _faults.check("device.dispatch")
         nxt, self.k_pages, self.v_pages = self._chunk_fn(
             self._params, self._buffers, jnp.asarray(tok),
             jnp.asarray(pos), jnp.asarray(lim), jnp.asarray(tbl),
@@ -1183,8 +1449,14 @@ class LLMEngine:
                     closed = self._closed
                     pending = self._pending
                     self._pending = []
+                # higher priority admits first; FIFO (by submission
+                # order) within a priority class — retries re-enter
+                # the next drain and re-sort with new arrivals
+                pending.sort(key=lambda r: (-r.priority, r.req_id))
                 for req in pending:
                     self._harvest_admit(req)
+                self._police_slots()
+                self._m["queue_depth"].set(self._n_queued)
                 busy = False
                 if self._prefill_q:
                     # ONE chunk of prefill, then (below) ONE decode
@@ -1234,11 +1506,16 @@ class LLMEngine:
             except Exception as e:  # noqa: BLE001
                 # a device/compile error (e.g. a transient PJRT tunnel
                 # failure) must not kill the scheduler with futures
-                # pending: fail the in-flight requests, reclaim their
-                # pages, and keep serving — fresh requests may succeed
+                # pending: fail OR re-admit the in-flight requests
+                # (per-request device_retry_budget), reclaim their
+                # pages, advance the health state machine, and keep
+                # serving — fresh requests may succeed
                 self._inflight.clear()
                 self._prefill_q.clear()
                 self._fetch_seq = self._issue_seq
+                self._consec_device_errors += 1
+                self._m["device_errors"].inc()
+                self._update_health()
                 # closers whose generation already completed (awaiting
                 # drain only) resolve successfully; ones still owed
                 # in-flight tokens resolve short with truncated=True —
@@ -1250,18 +1527,43 @@ class LLMEngine:
                                 len(s.tokens) < s.max_new_tokens:
                             s.truncated = True
                         self._finish(slot)
+                retried = set()
                 for slot, s in enumerate(self._slots):
-                    if s is not None:
-                        self._free_slot(slot)
-                        self._m["failed"].inc()
-                        self._end_request_spans(s, "failed", error=e)
-                        s.future.set_exception(e)
-                for req in pending:
-                    if not req.future.done():
-                        self._m["failed"].inc()
-                        self._end_request_spans(req, "failed", error=e)
-                        req.future.set_exception(e)
-                with self._mu:  # drop re-queued copies of failed reqs
+                    if s is None:
+                        continue
+                    self._free_slot(slot)
+                    if self._retry_after_device_error(s, e):
+                        # admitted THIS iteration? it is also in the
+                        # local `pending` list — the loop below must
+                        # not fail the copy we just requeued
+                        retried.add(id(s))
+                        continue
+                    # a request the API already promised a typed
+                    # outcome (cancel accepted; deadline expired)
+                    # resolves with THAT outcome — the device error
+                    # merely delivered it early
+                    outcome, counter, exc = self._typed_outcome(s) or \
+                        ("failed", self._m["failed"], e)
+                    counter.inc()
+                    self._end_request_spans(s, outcome, error=exc)
+                    if not s.future.done():
+                        s.future.set_exception(exc)
+                    with self._mu:
+                        self._by_id.pop(s.req_id, None)
+                # queued-but-never-admitted requests did NOT touch the
+                # device — the error is not theirs to absorb. Put any
+                # of this iteration's batch that is neither slotted
+                # (handled above), resolved, nor already re-queued
+                # back in the admission queue; their own deadline/
+                # admit_timeout budgets still bound them, and a
+                # draining health state sheds them, so nothing hangs
+                with self._mu:
+                    for req in pending:
+                        if id(req) in retried or req.future.done():
+                            continue
+                        if not any(r is req for r in self._pending):
+                            self._pending.append(req)
+                    # and drop queue copies of anything resolved above
                     self._pending = [r for r in self._pending
                                      if not r.future.done()]
                 if self._cache is not None:
@@ -1270,24 +1572,120 @@ class LLMEngine:
                     # may have left registered pages with garbage KV
                     self._free_pages.extend(self._cache.flush())
 
+    def _retry_after_device_error(self, req: _Request,
+                                  err: Exception) -> bool:
+        """Per-request device-error retry budget: a slotted request
+        whose step died re-enters the admission queue (its pages are
+        already reclaimed by the caller) instead of failing, up to
+        ``device_retry_budget`` times. The nonce is preserved, so the
+        regenerated token stream is IDENTICAL to what the failed
+        incarnation would have produced — a retry is invisible in the
+        output, it only costs latency."""
+        if req.device_retries >= self.device_retry_budget \
+                or req.cancelled or req.future.done() \
+                or (req.deadline is not None and req.deadline.expired):
+            return False
+        req.device_retries += 1
+        self._m["device_retries"].inc()
+        # reset generation state for a from-scratch re-admission; the
+        # prompt hashes (digests) are kept — a retry may still hit the
+        # prefix cache once it repopulates
+        req.tokens = []
+        req.slot = -1
+        req.truncated = False
+        req.t_first = None
+        req.t_enqueued = time.monotonic()   # fresh admission cycle
+        req.prefill_pos = 0
+        req.prefill_done = False
+        req.n_cached = 0
+        req.n_reg_pages = 0
+        req.closing = False
+        req.accepts_inflight = False
+        if req.spans is not None:
+            tp = time.perf_counter()
+            for key in ("queue", "prefill", "first_token", "decode"):
+                sp = req.spans.get(key)
+                if sp is not None and not sp.ended:
+                    sp.set_status("error").end(tp)
+            req.spans["root"].add_event(
+                "device_retry",
+                {"attempt": req.device_retries,
+                 "error": str(err)[:200]}, ts=tp)
+            req.spans["queue"] = _trace.start_span(
+                "llm.queue", parent=req.spans["root"], t0=tp)
+        with self._mu:
+            self._pending.append(req)
+            req.queued = True
+            self._n_queued += 1
+        return True
+
+    def _dequeue_accounting(self, req: _Request) -> None:
+        """The request left the admission queue (took a slot, or was
+        resolved without one); idempotent via the per-request flag."""
+        with self._mu:
+            if req.queued:
+                req.queued = False
+                self._n_queued -= 1
+
+    def _resolve_queued(self, req: _Request, outcome: str,
+                        exc: BaseException, counter) -> None:
+        """Terminal resolution for a request that never reached a
+        slot: count the outcome, close the span tree, resolve the
+        future, and release its admission-queue accounting."""
+        counter.inc()
+        self._end_request_spans(req, outcome, error=exc)
+        if not req.future.done():
+            req.future.set_exception(exc)
+        with self._mu:
+            self._by_id.pop(req.req_id, None)
+        self._dequeue_accounting(req)
+
     def _harvest_admit(self, req: _Request):
-        """Admit, re-queue, or fail; immediately-finished admissions
-        (e.g. max_new_tokens=1) resolve once drained."""
+        """Admit, re-queue, or resolve terminally. The admission
+        boundary enforces the request's deadline, the cancel flag, and
+        the engine-wide admission retry budget — a request can no
+        longer spin in the "retry" cycle forever when pages never
+        free. Immediately-finished admissions (e.g. max_new_tokens=1)
+        resolve once drained."""
+        promised = self._typed_outcome(req)
+        if promised is not None:
+            outcome, counter, exc = promised
+            self._resolve_queued(req, outcome, exc, counter)
+            return
+        if self.admit_timeout is not None and \
+                time.monotonic() - req.t_enqueued > self.admit_timeout:
+            self._resolve_queued(
+                req, "admission_timeout",
+                AdmissionTimeout(
+                    f"request {req.req_id} not admitted within "
+                    f"admit_timeout={self.admit_timeout}s "
+                    f"({req.admit_attempts} attempt(s); pages never "
+                    f"freed)"),
+                self._m["admit_timeout"])
+            return
         verdict = self._admit(req)
         if verdict == "never":
-            self._m["failed"].inc()
-            err = ValueError(
-                f"prompt of {len(req.prompt)} tokens cannot fit the "
-                f"KV page pool ({self.num_pages - 1} usable pages of "
-                f"{self.page_size} tokens, {self.pages_per_seq} "
-                f"pages/sequence)")
-            self._end_request_spans(req, "failed", error=err)
-            req.future.set_exception(err)
+            self._resolve_queued(
+                req, "failed",
+                ValueError(
+                    f"prompt of {len(req.prompt)} tokens cannot fit "
+                    f"the KV page pool ({self.num_pages - 1} usable "
+                    f"pages of {self.page_size} tokens, "
+                    f"{self.pages_per_seq} pages/sequence)"),
+                self._m["failed"])
+            return
+        if verdict == "shed":
+            self._resolve_queued(
+                req, "shed",
+                AdmissionShed("engine is draining (health state "
+                              "machine)"),
+                self._m["shed"])
             return
         if verdict == "retry":
+            req.admit_attempts += 1
             if req.spans is not None:
                 q = req.spans["queue"]
-                q.attrs["retries"] = q.attrs.get("retries", 0) + 1
+                q.attrs["retries"] = req.admit_attempts
             with self._mu:
                 self._pending.append(req)
             return
@@ -1327,6 +1725,8 @@ class LLMEngine:
         for slot in live:
             positions[slot] = self.context_lens[slot]
             lens[slot] = self.context_lens[slot] + 1
+        if _faults.enabled():
+            _faults.check("device.dispatch")
         tokens, self.k_pages, self.v_pages = self._decode_fn(
             self._params, self._buffers,
             self._tokens_dev, jnp.asarray(positions),
@@ -1348,9 +1748,16 @@ class LLMEngine:
     def _drain_one(self):
         """Fetch the oldest in-flight step's tokens and process them
         (emission, EOS/length, finalization of drained closers)."""
+        if _faults.enabled():
+            _faults.check("device.transfer")
         seq, slots_list, tokens, kind = self._inflight.popleft()
         host = np.asarray(tokens)          # the only blocking fetch
         self._fetch_seq = seq
+        if self._consec_device_errors:
+            # a successful fetch ends the error streak (draining is
+            # sticky until reset_health — see _update_health)
+            self._consec_device_errors = 0
+            self._update_health()
         if kind == "d":
             self.n_steps += 1
         emitted = 0
@@ -1446,6 +1853,8 @@ class LLMEngine:
             self._maybe_finalize()
             return
 
+        if _faults.enabled():
+            _faults.check("device.dispatch")
         base_arr = np.zeros((self.max_seqs,), np.int32)
         for slot in live:
             base_arr[slot] = self.context_lens[slot]
@@ -1529,11 +1938,24 @@ def serve_llm(engine: LLMEngine, host: str = "127.0.0.1",
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 body = json.loads(self.rfile.read(n) or b"{}")
+                dl = body.get("deadline_s")
                 fut = engine.submit(
                     body["prompt_ids"],
                     max_new_tokens=int(body.get("max_new_tokens", 32)),
-                    temperature=float(body.get("temperature", 0.0)))
+                    temperature=float(body.get("temperature", 0.0)),
+                    deadline=float(dl) if dl is not None else None,
+                    priority=int(body.get("priority", 0)))
                 out = fut.result(timeout=600)
+            except AdmissionShed as e:
+                # the load-shedding verdict maps to HTTP backpressure:
+                # the client should retry elsewhere / later
+                self.send_response(429)
+                payload = json.dumps({"error": str(e),
+                                      "outcome": "shed"}).encode()
+            except (DeadlineExceeded, AdmissionTimeout) as e:
+                self.send_response(504)
+                payload = json.dumps({"error": str(e),
+                                      "outcome": "deadline"}).encode()
             except Exception as e:  # noqa: BLE001 — report to client
                 self.send_response(400)
                 payload = json.dumps({"error": str(e)}).encode()

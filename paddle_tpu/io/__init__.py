@@ -32,6 +32,7 @@ import numpy as np
 from ..core import rng as rng_mod
 from ..observability import metrics as _obs
 from ..observability import tracing as _tracing
+from ..reliability import faults as _faults
 
 
 def _loader_metrics():
@@ -556,8 +557,23 @@ class DataLoader:
                     + self._epoch_count) % (2 ** 31)
             mp_produce = self._produce_multiprocess_iter if self._iterable \
                 else self._produce_multiprocess_map
-            return lambda: mp_produce(seed)
-        return self._produce
+            produce = lambda: mp_produce(seed)  # noqa: E731
+        else:
+            produce = self._produce
+        if not _faults.enabled():
+            # zero-overhead default: the injection wrapper only exists
+            # on passes started while chaos is armed
+            return produce
+
+        def produce_with_faults():
+            # injection site io.worker: one check per produced host
+            # batch — models a worker dying mid-epoch (OOM/segfault);
+            # the fault rides the prefetch queue to the training loop
+            for b in produce():
+                _faults.check("io.worker")
+                yield b
+
+        return produce_with_faults
 
     def __iter__(self):
         return _PrefetchIterator(self._select_produce(),

@@ -29,11 +29,26 @@ import numpy as np
 
 from ..core.monitor import stat_add
 from ..observability import metrics as _obs
+from ..reliability import faults as _faults
+from ..reliability.faults import FaultInjected
+from ..reliability.retry import RetryPolicy
 
 
 def _ocp():
     import orbax.checkpoint as ocp
     return ocp
+
+
+# shared save-dispatch retry (reliability.retry replaces the ad-hoc
+# loops this repo used to grow one per subsystem): a transient
+# filesystem error — or an injected ckpt.write fault — re-dispatches
+# the save; orbax's atomic commit makes a retried save safe (a failed
+# attempt leaves only an uncommitted tmp dir, which
+# cleanup_tmp_directories reclaims)
+_SAVE_RETRY = RetryPolicy(max_attempts=3, base_delay=0.05,
+                          max_delay=1.0, jitter=0.5,
+                          retry_on=(OSError, FaultInjected),
+                          scope="checkpoint")
 
 
 def _ckpt_metrics():
@@ -89,9 +104,11 @@ class CheckpointManager:
     """
 
     def __init__(self, directory: str, max_to_keep: int = 5,
-                 async_save: bool = True):
+                 async_save: bool = True,
+                 retry: Optional[RetryPolicy] = None):
         ocp = _ocp()
         self.directory = os.path.abspath(directory)
+        self.retry = retry or _SAVE_RETRY
         # cleanup_tmp_directories: a hard kill (preempted VM) mid-save
         # leaves an uncommitted tmp step dir; without cleanup the next
         # incarnation's save of that same step can collide with it
@@ -100,13 +117,33 @@ class CheckpointManager:
             cleanup_tmp_directories=True)
         self._mgr = ocp.CheckpointManager(self.directory, options=options)
 
-    def save(self, step: int, tree: Any, force: bool = False) -> bool:
+    def _dispatch_save(self, step: int, tree: Any, force: bool):
+        # injection site ckpt.write: fault BEFORE the orbax dispatch —
+        # a retried attempt never re-enters a half-dispatched save
+        if _faults.enabled():
+            _faults.check("ckpt.write")
         ocp = _ocp()
+        # time the attempt itself: failed attempts and retry backoff
+        # sleeps must not inflate the ckpt_save_seconds histogram
         t0 = time.perf_counter()
         saved = self._mgr.save(step, args=ocp.args.StandardSave(tree),
                                force=force)
+        return saved, time.perf_counter() - t0
+
+    def save(self, step: int, tree: Any, force: bool = False) -> bool:
+        saved, dt = self.retry.call(
+            self._dispatch_save, step, tree, force,
+            describe=f"checkpoint save step {step}")
+        # injection site ckpt.rename: the commit stage. A fault here
+        # propagates (the caller must treat the step as unsaved) but,
+        # like a real mid-commit kill, can never corrupt the directory:
+        # either orbax already committed the step atomically or the
+        # tmp dir is garbage the next manager cleans up — pinned by
+        # tests/test_checkpoint_crash.py and the chaos soak gate
+        if _faults.enabled():
+            _faults.check("ckpt.rename")
         if saved:
-            _record_save(time.perf_counter() - t0, tree)
+            _record_save(dt, tree)
         return saved
 
     def restore(self, step: Optional[int] = None, like: Any = None) -> Any:
@@ -157,10 +194,21 @@ def save_checkpoint(path: str, model, optimizer_state=None,
         tree["optimizer"] = optimizer_state
     tree.update(extra)
     ckptr = ocp.StandardCheckpointer()
-    t0 = time.perf_counter()
-    ckptr.save(os.path.abspath(path), tree, force=True)
+    box = {}
+
+    def _dispatch():
+        if _faults.enabled():
+            _faults.check("ckpt.write")
+        # successful-attempt clock: retries/backoff stay out of the
+        # recorded save duration
+        box["t0"] = time.perf_counter()
+        ckptr.save(os.path.abspath(path), tree, force=True)
+
+    _SAVE_RETRY.call(_dispatch, describe=f"save_checkpoint {path}")
     ckptr.wait_until_finished()
-    _record_save(time.perf_counter() - t0, tree)
+    if _faults.enabled():
+        _faults.check("ckpt.rename")
+    _record_save(time.perf_counter() - box["t0"], tree)
 
 
 def load_checkpoint(path: str, model=None, like: Any = None) -> Dict:

@@ -47,6 +47,14 @@ from .metrics import MetricRegistry, default_registry
 _providers: Dict[str, Callable[[], Optional[dict]]] = {}
 _providers_mu = threading.Lock()
 
+# name → callable returning a health STATE string ("healthy"/"ok",
+# "degraded", "draining") or None once the component is gone. /healthz
+# aggregates these: any draining component flips the endpoint to 503
+# so a load balancer stops routing to this process (the LLM engine's
+# health state machine registers here — docs/RELIABILITY.md).
+_health_providers: Dict[str, Callable[[], Optional[str]]] = {}
+_HEALTH_RANK = {"ok": 0, "healthy": 0, "degraded": 1, "draining": 2}
+
 _server: Optional["DebugServer"] = None
 _server_mu = threading.Lock()
 
@@ -60,6 +68,39 @@ def register_status_provider(name: str,
 def unregister_status_provider(name: str) -> None:
     with _providers_mu:
         _providers.pop(name, None)
+
+
+def register_health_provider(name: str,
+                             fn: Callable[[], Optional[str]]) -> None:
+    with _providers_mu:
+        _health_providers[name] = fn
+
+
+def unregister_health_provider(name: str) -> None:
+    with _providers_mu:
+        _health_providers.pop(name, None)
+
+
+def _collect_health() -> Dict[str, str]:
+    with _providers_mu:
+        items = list(_health_providers.items())
+    out: Dict[str, str] = {}
+    dead = []
+    for name, fn in items:
+        try:
+            st = fn()
+        except Exception as e:  # noqa: BLE001 — a broken provider is
+            out[name] = f"error: {e}"      # itself a degraded signal
+            continue
+        if st is None:
+            dead.append(name)
+        else:
+            out[name] = str(st)
+    if dead:
+        with _providers_mu:
+            for name in dead:
+                _health_providers.pop(name, None)
+    return out
 
 
 def _collect_status() -> Dict[str, dict]:
@@ -183,10 +224,22 @@ class DebugServer:
             h._reply(200, prometheus_text(self.registry).encode(),
                      ctype="text/plain; version=0.0.4; charset=utf-8")
         elif url.path == "/healthz":
-            h._reply_json(200, {
-                "status": "ok",
+            comp = _collect_health()
+            worst = 0
+            for st in comp.values():
+                # unknown strings (incl. provider errors) read as
+                # degraded: visibly unhealthy, still routable
+                worst = max(worst, _HEALTH_RANK.get(st, 1))
+            status = ("ok", "degraded", "draining")[worst]
+            body = {
+                "status": status,
                 "pid": os.getpid(),
-                "uptime_s": round(time.time() - self.t_start, 3)})
+                "uptime_s": round(time.time() - self.t_start, 3)}
+            if comp:
+                body["components"] = comp
+            # draining → 503: tells the balancer to pull this process
+            # out of rotation while in-flight work finishes
+            h._reply_json(503 if worst >= 2 else 200, body)
         elif url.path == "/statusz":
             try:
                 devmem = sample_device_memory(self.registry)

@@ -1,0 +1,29 @@
+"""Reliability layer: deterministic fault injection + shared failure
+semantics (deadlines, retry budgets, backoff).
+
+Reference context: PaddlePaddle's fleet/elastic stack treats failure
+handling as a first-class subsystem (SURVEY.md §L2/L8 — etcd-leased
+membership, restart budgets, auto-checkpoint resume). This package is
+that subsystem for the TPU-native stack, split into two stdlib-only
+modules any layer may import without cycles:
+
+- :mod:`~paddle_tpu.reliability.faults` — seeded, replayable fault
+  injection behind named sites threaded through the engine loop,
+  checkpoint commit, rendezvous store, and DataLoader (zero overhead
+  while disabled — same discipline as observability.tracing).
+- :mod:`~paddle_tpu.reliability.retry` — ONE exponential-backoff-with-
+  jitter policy (attempt budgets, per-attempt timeouts, composable
+  :class:`~paddle_tpu.reliability.retry.Deadline` objects) replacing
+  the divergent ad-hoc retry loops.
+
+The chaos gate (``tools/chaos_soak.py --ci``) drives the injected
+failure paths end to end and pins the invariants the multi-node work
+assumes: futures never hang, KV pages never leak, checkpoints stay
+restorable, span trees close on every exit.
+"""
+
+from . import faults  # noqa: F401
+from . import retry  # noqa: F401
+from .faults import FaultInjected  # noqa: F401
+from .retry import (Deadline, DeadlineExceeded, RetryExhausted,  # noqa: F401
+                    RetryPolicy, as_deadline, backoff_delay)

@@ -1,0 +1,373 @@
+"""Chaos soak gate: drive the stack under seeded fault schedules and
+assert the hardened failure semantics hold (tools/ci.sh step).
+
+What it proves (the invariants the multi-node work assumes,
+docs/RELIABILITY.md):
+
+1. ENGINE SOAK — an LLMEngine under injected ``device.dispatch`` /
+   ``device.transfer`` faults plus a deadline/priority/shed workload:
+   every submitted future RESOLVES (value, DeadlineExceeded,
+   AdmissionShed, RequestCancelled, or a typed error — never hangs),
+   per-request device-retry budgets re-admit faulted requests with
+   token-identical streams, and the injected-fault sequence matches
+   the pure seeded schedule exactly (same seed → same faults).
+2. CANCELLATION STORM — mass ``cancel()`` mid-generation: futures all
+   resolve RequestCancelled/result, KV pages are leak-free after
+   close, and (with tracing on) no ``llm.*`` span is left open.
+3. CRASH-CONSISTENT CHECKPOINTS — a subprocess worker is SIGKILLed
+   mid-``CheckpointManager.save``; the directory must still restore
+   its latest committed step AND accept new saves. Injected
+   ``ckpt.write`` faults are absorbed by the shared retry policy;
+   an injected ``ckpt.rename`` (commit-stage) fault fails the save
+   call but never corrupts the directory.
+4. FLIGHT-RECORDER ESCALATION — a chaos-injected ``io.worker`` fault
+   inside ``Model.fit`` escalates to a process crash; the PR-4 flight
+   recorder must leave a JSONL dump naming the injected fault.
+
+Determinism: every schedule is nth/probability-based with a fixed
+seed; ``faults.preview(site, N)`` recomputes the faulting call
+numbers purely, and the soak asserts the observed injection log
+equals that schedule.
+
+Run:  python tools/chaos_soak.py            # full soak (default seed)
+CI:   python tools/chaos_soak.py --ci       # fixed seeds, ~30s budget
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from concurrent.futures import wait as fut_wait
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FUTURE_TIMEOUT = 240.0   # "never hangs" ceiling (compile included)
+
+
+def _tiny_gpt():
+    import paddle_tpu as pt
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt_config
+    pt.seed(0)
+    cfg = gpt_config("gpt2-small", num_layers=2, hidden_size=64,
+                     num_heads=4, vocab_size=97,
+                     max_position_embeddings=96, hidden_dropout=0.0,
+                     attention_dropout=0.0)
+    return GPTForCausalLM(cfg)
+
+
+def _assert_schedule_matches(faults, sites):
+    """The determinism gate: the observed injection log must equal the
+    pure seeded schedule truncated to the calls each site actually
+    made."""
+    log = faults.injected_log()
+    assert faults.injected_log_dropped() == 0, (
+        "injection log overflowed its bound — raise _LOG_CAP or "
+        "shorten the soak; exact-schedule comparison would be "
+        "spuriously wrong")
+    for site in sites:
+        n = faults.call_count(site)
+        want = faults.preview(site, n)
+        got = [c for s, c in log if s == site]
+        assert got == want, (
+            f"injected-fault sequence for {site} diverged from the "
+            f"seeded schedule: got {got}, schedule {want} "
+            f"(over {n} calls)")
+
+
+def engine_soak(seed: int) -> dict:
+    """Scenarios 1 + 2 on one engine (one compile budget): fault soak
+    first, then — faults disarmed — a cancellation storm, then the
+    leak/span audit after close."""
+    from paddle_tpu.inference.llm import (AdmissionShed, AdmissionTimeout,
+                                          LLMEngine, RequestCancelled)
+    from paddle_tpu.observability import tracing
+    from paddle_tpu.reliability import faults
+    from paddle_tpu.reliability.retry import DeadlineExceeded
+
+    rng = np.random.RandomState(seed)
+    tracing.enable()
+    faults.reset()
+    faults.enable(seed=seed)
+    # schedule: nth/p rules only (pure → previewable). At most 4
+    # injections total (2 nth calls + 1 capped p + 1 transfer), so a
+    # device_retry_budget of 4 means no request may be LOST to chaos —
+    # every non-shed/deadline/cancel future must still produce tokens.
+    faults.inject("device.dispatch", nth=(5, 12))
+    faults.inject("device.dispatch", p=0.01, times=1)
+    faults.inject("device.transfer", nth=(9,))
+
+    net = _tiny_gpt()
+    eng = LLMEngine(net, max_seqs=4, page_size=4, num_pages=96,
+                    prefill_buckets=(16,), max_pending=8,
+                    admit_timeout=60.0, device_retry_budget=4,
+                    drain_after=64)
+    outcomes = {"ok": 0, "deadline": 0, "shed": 0, "cancelled": 0,
+                "admission_timeout": 0, "error": 0}
+
+    def tally(futs):
+        done, not_done = fut_wait(futs, timeout=FUTURE_TIMEOUT)
+        assert not not_done, (
+            f"{len(not_done)} futures never resolved — the engine "
+            f"hung under injected faults")
+        for f in futs:
+            exc = f.exception()
+            if exc is None:
+                assert f.result()["output_ids"] is not None
+                outcomes["ok"] += 1
+            elif isinstance(exc, DeadlineExceeded):
+                outcomes["deadline"] += 1
+            elif isinstance(exc, AdmissionShed):
+                outcomes["shed"] += 1
+            elif isinstance(exc, RequestCancelled):
+                outcomes["cancelled"] += 1
+            elif isinstance(exc, AdmissionTimeout):
+                outcomes["admission_timeout"] += 1
+            else:
+                outcomes["error"] += 1
+
+    try:
+        # phase 1: normal service while the fault schedule fires —
+        # the retry budget must make chaos invisible in the outcomes
+        tally([eng.submit(
+            rng.randint(0, 97, rng.randint(3, 12)).tolist(),
+            max_new_tokens=int(rng.randint(6, 12)),
+            priority=int(i % 3)) for i in range(6)])
+        assert outcomes["ok"] == 6, (
+            f"requests lost to budgeted chaos: {outcomes}")
+
+        # phase 2: hopeless deadlines resolve typed, never hang
+        tally([eng.submit(rng.randint(0, 97, 5).tolist(),
+                          max_new_tokens=8, deadline=-1.0)
+               for _ in range(3)])
+        assert outcomes["deadline"] == 3, outcomes
+
+        # phase 3: a burst wide enough to overflow max_pending=8 on 4
+        # slots — overflow sheds, the rest completes
+        tally([eng.submit(rng.randint(0, 97, 4).tolist(),
+                          max_new_tokens=16) for _ in range(16)])
+        assert outcomes["shed"] >= 1, outcomes
+        assert outcomes["error"] == 0, (
+            f"chaos leaked through the retry budget: {outcomes}")
+
+        _assert_schedule_matches(
+            faults, ("device.dispatch", "device.transfer"))
+        n_injected = len(faults.injected_log())
+        assert n_injected >= 3, (
+            f"schedule armed but only {n_injected} faults injected — "
+            f"the soak did not exercise the failure paths")
+
+        # phase 4: cancellation storm, faults off
+        faults.disable()
+        eng.reset_health()
+        storm = [eng.submit(rng.randint(0, 97, 6).tolist(),
+                            max_new_tokens=80) for _ in range(8)]
+        # half cancelled immediately (microseconds after submit — a
+        # cancel can only miss if the request fully generated first,
+        # impossible for 80 tokens), half after some reach decode
+        for f in storm[::2]:
+            eng.cancel(f.request_id)
+        time.sleep(0.2)
+        for f in storm[1::2]:
+            eng.cancel(f.request_id)
+        done, not_done = fut_wait(storm, timeout=FUTURE_TIMEOUT)
+        assert not not_done, "cancellation storm left futures pending"
+        n_cancelled = 0
+        for f in storm:
+            exc = f.exception()
+            assert exc is None or isinstance(exc, RequestCancelled), exc
+            n_cancelled += exc is not None
+        outcomes["cancelled"] += n_cancelled
+        assert n_cancelled >= 1, "storm cancelled nothing"
+    finally:
+        eng.close()
+        faults.reset()
+    # leak audit: every page back in the pool after close (the prefix
+    # cache was flushed; shared pages returned)
+    assert len(eng._free_pages) == eng.num_pages - 1, (
+        f"KV pages leaked: {len(eng._free_pages)} free of "
+        f"{eng.num_pages - 1} usable")
+    # span audit: no llm.* span left open anywhere
+    open_llm = [s for s in tracing.live_spans()
+                if s["name"].startswith("llm.")]
+    tracing.disable()
+    assert not open_llm, f"span trees left open: {open_llm}"
+    return outcomes
+
+
+def ckpt_crash(seed: int, workdir: str) -> dict:
+    """Scenario 3: SIGKILL a worker mid-save, then prove the directory
+    restores cleanly and still accepts saves; then the injected-fault
+    variants of the same invariant."""
+    from paddle_tpu.io.checkpoint import CheckpointManager
+    from paddle_tpu.reliability import faults
+    from paddle_tpu.reliability.faults import FaultInjected
+
+    rng = np.random.RandomState(seed)
+    ckpt_dir = os.path.join(workdir, "ckpt_kill")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    kill_at = int(rng.randint(2, 5))
+    p = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--ckpt-worker",
+         ckpt_dir, "12"],
+        env=env, cwd=REPO, stdout=subprocess.PIPE, text=True)
+    killed_during = None
+    for line in p.stdout:
+        if line.startswith("SAVING "):
+            k = int(line.split()[1])
+            if k >= kill_at:
+                # land the SIGKILL inside the save window (the worker
+                # announces, then saves); a seeded jitter moves the
+                # kill around within it across seeds
+                time.sleep(float(rng.uniform(0.0, 0.05)))
+                p.kill()
+                killed_during = k
+                break
+    p.wait(timeout=60)
+    assert killed_during is not None, "worker finished before the kill"
+
+    mgr = CheckpointManager(ckpt_dir, async_save=False)
+    latest = mgr.latest_step()
+    assert latest is not None and latest >= killed_during - 1, (
+        f"mid-save SIGKILL lost committed steps: latest={latest}, "
+        f"killed during save of {killed_during}")
+    tree = mgr.restore(latest)
+    np.testing.assert_array_equal(
+        tree["w"], np.arange(2048, dtype=np.int64) + latest)
+    # the survivor directory still accepts new saves (tmp-dir debris
+    # from the kill must not wedge the next incarnation)
+    assert mgr.save(latest + 1, {"w": np.arange(2048) + latest + 1,
+                                 "step": np.asarray(latest + 1)})
+    mgr.wait_until_finished()
+    mgr.close()
+
+    # injected ckpt.write faults: absorbed by the shared retry policy
+    faults.reset()
+    faults.enable(seed=seed)
+    faults.inject("ckpt.write", nth=(1,), times=1)
+    retry_dir = os.path.join(workdir, "ckpt_retry")
+    with CheckpointManager(retry_dir, async_save=False) as m2:
+        assert m2.save(0, {"w": np.arange(16)})
+        m2.wait_until_finished()
+        assert m2.latest_step() == 0
+    assert ("ckpt.write", 1) in faults.injected_log()
+
+    # injected ckpt.rename (commit-stage) fault: the save CALL fails,
+    # the directory stays restorable
+    faults.inject("ckpt.rename", nth=(faults.call_count("ckpt.rename")
+                                      + 1,), times=1)
+    with CheckpointManager(retry_dir, async_save=False) as m3:
+        try:
+            m3.save(1, {"w": np.arange(16) + 1})
+            raised = False
+        except FaultInjected:
+            raised = True
+        assert raised, "ckpt.rename fault did not surface"
+        m3.wait_until_finished()
+        latest = m3.latest_step()
+        assert latest is not None
+        np.testing.assert_array_equal(
+            m3.restore(latest)["w"], np.arange(16) + latest)
+    faults.reset()
+    return {"killed_during": killed_during, "latest": int(latest)}
+
+
+def flight_escalation(seed: int, workdir: str) -> dict:
+    """Scenario 4: an injected io.worker fault inside Model.fit goes
+    uncaught, the process dies, and the flight recorder dumps."""
+    crash_dir = os.path.join(workdir, "flight")
+    code = f"""
+import jax; jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.io import TensorDataset
+from paddle_tpu.observability import flight, tracing
+from paddle_tpu.reliability import faults
+tracing.enable()
+flight.install_flight_recorder({crash_dir!r})
+faults.enable(seed={seed})
+faults.inject("io.worker", nth=(3,))
+pt.seed(0)
+net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+model = pt.Model(net)
+model.prepare(optimizer=pt.optimizer.SGD(learning_rate=0.1,
+                                         parameters=net),
+              loss=nn.CrossEntropyLoss())
+x = np.zeros((64, 8), np.float32)
+y = np.zeros((64, 1), np.int64)
+model.fit(TensorDataset([x, y]), batch_size=8, epochs=2, verbose=0)
+raise SystemExit("unreachable: the injected fault must escalate")
+"""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    p = subprocess.run([sys.executable, "-c", code], env=env, cwd=REPO,
+                       capture_output=True, text=True, timeout=300)
+    assert p.returncode != 0, (
+        "chaos-injected io.worker fault did not crash the run:\n"
+        + p.stdout[-400:] + p.stderr[-400:])
+    assert "injected fault at io.worker" in p.stderr, p.stderr[-800:]
+    dumps = sorted(f for f in os.listdir(crash_dir)
+                   if f.endswith(".jsonl"))
+    assert dumps, "flight recorder wrote no dump for the chaos crash"
+    rows = [json.loads(ln)
+            for ln in open(os.path.join(crash_dir, dumps[0]))]
+    assert rows[0]["kind"] == "header", rows[0]
+    assert rows[0]["reason"] == "exception", rows[0]
+    return {"dump": dumps[0], "rows": len(rows)}
+
+
+def _ckpt_worker(directory: str, n_steps: int) -> int:
+    """Subprocess body for the SIGKILL scenario: announce, then save —
+    the parent kills inside an announced window."""
+    from paddle_tpu.io.checkpoint import CheckpointManager
+    mgr = CheckpointManager(directory, async_save=False, max_to_keep=4)
+    for step in range(n_steps):
+        print(f"SAVING {step}", flush=True)
+        mgr.save(step, {"w": np.arange(2048, dtype=np.int64) + step,
+                        "step": np.asarray(step)})
+        print(f"SAVED {step}", flush=True)
+    mgr.close()
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--ci", action="store_true",
+                    help="fixed seeds, one pass per scenario "
+                         "(~30s compute budget)")
+    ap.add_argument("--seed", type=int, default=1234)
+    ap.add_argument("--workdir", default=None)
+    ap.add_argument("--ckpt-worker", nargs=2, metavar=("DIR", "STEPS"),
+                    help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+    if args.ckpt_worker:
+        return _ckpt_worker(args.ckpt_worker[0],
+                            int(args.ckpt_worker[1]))
+    seed = 1234 if args.ci else args.seed
+    workdir = args.workdir or os.path.join(
+        "/tmp", f"pt_chaos_{os.getpid()}")
+    os.makedirs(workdir, exist_ok=True)
+
+    t0 = time.monotonic()
+    out = {"seed": seed}
+    out["engine"] = engine_soak(seed)
+    out["ckpt"] = ckpt_crash(seed, workdir)
+    out["flight"] = flight_escalation(seed, workdir)
+    out["wall_s"] = round(time.monotonic() - t0, 1)
+    print("chaos soak OK: " + json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -36,6 +36,13 @@ echo "== llm serving smoke (prefix cache + chunked ragged prefill)"
 # hits, cache-on == cache-off generations, and a clean shutdown
 python tools/llm_bench.py --ci
 
+echo "== chaos soak (seeded fault injection -> hardened semantics)"
+# engine under injected device faults + deadlines/shed/cancel storm,
+# SIGKILL mid-checkpoint-save, and an io.worker fault escalating to a
+# flight-recorder dump; fails on any hung future, leaked KV page,
+# unreplayable fault schedule, or unrestorable checkpoint
+python tools/chaos_soak.py --ci
+
 echo "== fused train-loop parity smoke (K=1 vs K=4 bit-identical)"
 python tools/train_loop_smoke.py
 
