@@ -38,6 +38,7 @@ connection. Ops: set k v | get k | ages prefix | list prefix.
 from __future__ import annotations
 
 import json
+import math
 import os
 import socket
 import socketserver
@@ -348,3 +349,69 @@ class TCPRendezvous:
             {"op": "list", "prefix": f"done.g{generation}.n"})["items"]
         return all(f"done.g{generation}.n{n}" in items
                    for n in range(self.nnodes))
+
+
+class TCPMembership:
+    """Elastic membership over the rendezvous store: a member PUBLISHES
+    a named info record (JSON) and re-SETs it on a heartbeat cadence;
+    observers read the roster with server-judged ages, so "alive" is
+    decided on the one clock the store already stamps. The serving
+    fleet (paddle_tpu/serving/) uses this for replica discovery: a
+    replica registers ``member.<name>`` → {endpoints...}, the router
+    lists members and treats entries older than ``stale_after`` as
+    departed — a SIGKILLed replica leaves the roster within one
+    timeout, a restarted one re-registers under the same name with its
+    new endpoints (last write wins)."""
+
+    PREFIX = "member."
+
+    def __init__(self, endpoint: str, name: str, info: dict,
+                 beat_interval: float = 0.5,
+                 client: Optional[TCPStoreClient] = None):
+        self.name = name
+        self.info = dict(info)
+        self.client = client or TCPStoreClient(endpoint)
+        self._beat_interval = beat_interval
+        self._stop = threading.Event()
+        self.announce()
+        self._thread = threading.Thread(target=self._beat_loop,
+                                        name=f"membership-{name}",
+                                        daemon=True)
+        self._thread.start()
+
+    def announce(self) -> None:
+        self.client.request({"op": "set", "k": self.PREFIX + self.name,
+                             "v": json.dumps(self.info)})
+
+    def _beat_loop(self) -> None:
+        while not self._stop.wait(self._beat_interval):
+            try:
+                self.announce()
+            except StoreUnavailable:
+                # the store (router) being gone is the OBSERVER's
+                # verdict to make; a member just keeps trying
+                pass
+
+    def stop(self) -> None:
+        """Stop heartbeating (the entry ages out at the observer's
+        ``stale_after``; there is no explicit deregistration — a
+        crashed member couldn't send one either, so one path serves
+        both)."""
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+    @classmethod
+    def list_members(cls, client: TCPStoreClient,
+                     stale_after: Optional[float] = None
+                     ) -> Dict[str, dict]:
+        """name → info for every member whose record is younger than
+        ``stale_after`` (None: everyone ever registered)."""
+        items = client.request(
+            {"op": "list", "prefix": cls.PREFIX})["items"]
+        if stale_after is not None:
+            ages = client.request(
+                {"op": "ages", "prefix": cls.PREFIX})["ages"]
+            items = {k: v for k, v in items.items()
+                     if ages.get(k, math.inf) <= stale_after}
+        return {k[len(cls.PREFIX):]: json.loads(v)
+                for k, v in items.items()}

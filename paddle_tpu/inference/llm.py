@@ -58,7 +58,17 @@ class AdmissionShed(RuntimeError):
     protect itself (bounded queue overflow, or a draining health
     state). Distinct from ``"retry"`` (transient) and ``"never"`` (the
     prompt can't fit the pool): a shed request was viable — the ENGINE
-    was not. Callers should back off and try another replica."""
+    was not. Callers should back off and try another replica.
+
+    ``reason`` distinguishes the two verdicts for routing layers:
+    ``"queue_full"`` (transient overload — retry elsewhere or later,
+    HTTP 429) vs ``"draining"`` (the engine is out of rotation until
+    an operator resets it — HTTP 503; the fleet router stops sending
+    new admissions entirely)."""
+
+    def __init__(self, msg: str, reason: str = "queue_full"):
+        super().__init__(msg)
+        self.reason = reason
 
 
 class AdmissionTimeout(TimeoutError):
@@ -70,6 +80,14 @@ class AdmissionTimeout(TimeoutError):
 class RequestCancelled(RuntimeError):
     """The request was cancelled via :meth:`LLMEngine.cancel` before
     it finished; its KV pages are reclaimed and its span tree closed."""
+
+
+class EngineClosed(RuntimeError):
+    """The engine is shut (or shutting) down. A routing layer treats
+    this like draining — rebalance to a sibling, never a client
+    error: a replica that is closing is out of rotation, and the
+    request it refused lost nothing (``serve_llm`` maps it to HTTP
+    503 for the same reason)."""
 
 
 # health state machine: consecutive device errors walk the engine
@@ -823,6 +841,12 @@ class LLMEngine:
             self._status_name,
             lambda: (lambda e: None if e is None or e._closed
                      else e.health)(ref()))
+        # POST /reset_health reaches the operator escape hatch without
+        # a Python shell (docs/RELIABILITY.md health states)
+        _dbgsrv.register_reset_handler(
+            self._status_name,
+            lambda: (lambda e: None if e is None or e._closed
+                     else e.reset_health())(ref()))
         self._m["health"].set(0)
         self._worker = threading.Thread(target=self._loop, daemon=True)
         self._worker.start()
@@ -860,7 +884,16 @@ class LLMEngine:
     def submit(self, prompt_ids: Sequence[int],
                max_new_tokens: int = 32,
                temperature: float = 0.0,
-               deadline=None, priority: int = 0) -> Future:
+               deadline=None, priority: int = 0,
+               nonce: Optional[int] = None) -> Future:
+        """``nonce``: pin the sampling-key salt instead of using this
+        engine's submission counter. Sampling keys depend only on
+        (nonce, position), so two identically-seeded engines given the
+        same prompt + nonce produce IDENTICAL token streams regardless
+        of what else either served — the property the fleet router's
+        cross-replica failover relies on (a request lost to a replica
+        crash is re-submitted to a sibling with the same nonce and the
+        client cannot tell). Must be in [0, 2**31)."""
         if len(prompt_ids) + max_new_tokens > self.max_len:
             raise ValueError(
                 f"prompt {len(prompt_ids)} + max_new_tokens "
@@ -878,30 +911,36 @@ class LLMEngine:
             raise ValueError(
                 "speculative decoding is greedy-only (v1); use "
                 "temperature=0 or an engine without draft_net")
+        if nonce is not None and not 0 <= int(nonce) < 2 ** 31:
+            raise ValueError(f"nonce {nonce} out of int32 range")
         req = _Request(prompt_ids, max_new_tokens, temperature)
         req.deadline = as_deadline(deadline)
         req.priority = int(priority)
         with self._mu:
             if self._closed:
-                raise RuntimeError("engine closed")
-            # nonce = submission order: the sampling-key salt is fixed
-            # HERE, so scheduler choices (cache hits, chunking, retry
-            # timing) can never change a request's sampled stream
-            req.nonce = req.req_id = self._nonce_seq
+                raise EngineClosed("engine closed")
+            # nonce = submission order (unless pinned by the caller):
+            # the sampling-key salt is fixed HERE, so scheduler
+            # choices (cache hits, chunking, retry timing) can never
+            # change a request's sampled stream
+            req.req_id = self._nonce_seq
+            req.nonce = req.req_id if nonce is None else int(nonce)
             self._nonce_seq += 1
             # LOAD SHEDDING is a submit-time verdict: a full admission
             # queue or a draining engine resolves the future right
             # here with AdmissionShed — terminal, never queued, so an
             # overloaded engine's queue cannot grow without bound
-            shed_why = None
+            shed_why = shed_reason = None
             if self._health == "draining":
                 shed_why = "engine is draining (health state machine)"
+                shed_reason = "draining"
             elif self._n_queued >= self.max_pending:
                 shed_why = (f"admission queue full "
                             f"({self._n_queued}/{self.max_pending})")
+                shed_reason = "queue_full"
             if shed_why is not None:
                 self._m["shed"].inc()
-                err = AdmissionShed(shed_why)
+                err = AdmissionShed(shed_why, reason=shed_reason)
                 if _trace.enabled():
                     root = _trace.start_span(
                         "llm.request", parent=None, attrs={
@@ -959,6 +998,7 @@ class LLMEngine:
     def close(self):
         _dbgsrv.unregister_status_provider(self._status_name)
         _dbgsrv.unregister_health_provider(self._status_name)
+        _dbgsrv.unregister_reset_handler(self._status_name)
         with self._mu:
             self._closed = True
         self._wake.set()
@@ -1499,7 +1539,7 @@ class LLMEngine:
                                     req, "failed",
                                     error="engine closed")
                                 req.future.set_exception(
-                                    RuntimeError("engine closed"))
+                                    EngineClosed("engine closed"))
                             return
                         self._wake.wait(timeout=0.05)
                         self._wake.clear()
@@ -1678,7 +1718,7 @@ class LLMEngine:
             self._resolve_queued(
                 req, "shed",
                 AdmissionShed("engine is draining (health state "
-                              "machine)"),
+                              "machine)", reason="draining"),
                 self._m["shed"])
             return
         if verdict == "retry":
@@ -1913,12 +1953,22 @@ class LLMEngine:
         self._maybe_finalize()
 
 
-def serve_llm(engine: LLMEngine, host: str = "127.0.0.1",
-              port: int = 0):
+def serve_llm(engine, host: str = "127.0.0.1", port: int = 0):
     """Minimal HTTP front for the engine (POST /generate with JSON
-    {"prompt_ids": [...], "max_new_tokens": N, "temperature": t}).
+    {"prompt_ids": [...], "max_new_tokens": N, "temperature": t,
+    "deadline_s": s, "priority": p, "nonce": n}; POST /cancel with
+    {"request_id": id}). ``engine`` is anything with the engine's
+    ``submit``/``cancel`` surface — the fleet router
+    (``paddle_tpu.serving.Router``) serves through this same front,
+    where bodies may also carry "tenant"/"slo".
     Returns the live ThreadingHTTPServer (serve_forever on a daemon
     thread); .server_address gives the bound (host, port).
+
+    Error mapping (the contract tests/test_inference_serving.py pins
+    and the fleet router routes on): shed → 429 (queue overflow;
+    retry elsewhere/later) or 503 (draining engine; out of rotation
+    until reset), DeadlineExceeded/AdmissionTimeout → 504,
+    RequestCancelled → 499 (client-abandoned, nginx convention).
 
     The native ``ptserve`` binary keeps serving static-shape artifacts
     (jit.save → StableHLO → C++ PJRT predictor); generation needs the
@@ -1931,37 +1981,68 @@ def serve_llm(engine: LLMEngine, host: str = "127.0.0.1",
                              ThreadingHTTPServer)
 
     class Handler(BaseHTTPRequestHandler):
+        def _generate(self, body: dict):
+            try:
+                dl = body.get("deadline_s")
+                kw = dict(
+                    max_new_tokens=int(body.get("max_new_tokens", 32)),
+                    temperature=float(body.get("temperature", 0.0)),
+                    deadline=float(dl) if dl is not None else None,
+                    priority=int(body.get("priority", 0)))
+                if body.get("nonce") is not None:
+                    kw["nonce"] = int(body["nonce"])
+                for k in ("tenant", "slo"):  # router-only fields
+                    if body.get(k) is not None:
+                        kw[k] = body[k]
+                fut = engine.submit(body["prompt_ids"], **kw)
+                out = fut.result(timeout=600)
+            except AdmissionShed as e:
+                # the load-shedding verdict maps to HTTP backpressure.
+                # 429: transient overload, retry elsewhere/later.
+                # 503: DRAINING — this engine is out of rotation until
+                # an operator resets it; a balancer/router must stop
+                # sending new admissions entirely.
+                code = 503 if getattr(e, "reason", "") == "draining" \
+                    else 429
+                return code, {"error": str(e), "outcome": "shed",
+                              "reason": getattr(e, "reason", "")}
+            except (DeadlineExceeded, AdmissionTimeout) as e:
+                return 504, {"error": str(e), "outcome": "deadline"}
+            except RequestCancelled as e:
+                return 499, {"error": str(e), "outcome": "cancelled"}
+            except EngineClosed as e:
+                # a closing replica is out of rotation, not a client
+                # error: 503 tells the router to rebalance budget-free
+                return 503, {"error": str(e), "outcome": "shed",
+                             "reason": "draining"}
+            except Exception as e:  # noqa: BLE001 — report to client
+                return 400, {"error": str(e)}
+            out["request_id"] = getattr(fut, "request_id", None)
+            return 200, out
+
+        def _cancel(self, body: dict):
+            try:
+                ok = engine.cancel(int(body["request_id"]))
+            except Exception as e:  # noqa: BLE001 — report to client
+                return 400, {"error": str(e)}
+            return 200, {"cancelled": bool(ok)}
+
         def do_POST(self):
-            if self.path != "/generate":
+            routes = {"/generate": self._generate,
+                      "/cancel": self._cancel}
+            fn = routes.get(self.path)
+            if fn is None:
                 self.send_error(404)
                 return
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 body = json.loads(self.rfile.read(n) or b"{}")
-                dl = body.get("deadline_s")
-                fut = engine.submit(
-                    body["prompt_ids"],
-                    max_new_tokens=int(body.get("max_new_tokens", 32)),
-                    temperature=float(body.get("temperature", 0.0)),
-                    deadline=float(dl) if dl is not None else None,
-                    priority=int(body.get("priority", 0)))
-                out = fut.result(timeout=600)
-            except AdmissionShed as e:
-                # the load-shedding verdict maps to HTTP backpressure:
-                # the client should retry elsewhere / later
-                self.send_response(429)
-                payload = json.dumps({"error": str(e),
-                                      "outcome": "shed"}).encode()
-            except (DeadlineExceeded, AdmissionTimeout) as e:
-                self.send_response(504)
-                payload = json.dumps({"error": str(e),
-                                      "outcome": "deadline"}).encode()
-            except Exception as e:  # noqa: BLE001 — report to client
-                self.send_response(400)
-                payload = json.dumps({"error": str(e)}).encode()
+            except ValueError:
+                code, out = 400, {"error": "malformed JSON body"}
             else:
-                self.send_response(200)
-                payload = json.dumps(out).encode()
+                code, out = fn(body)
+            payload = json.dumps(out).encode()
+            self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(payload)))
             self.end_headers()

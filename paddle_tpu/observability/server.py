@@ -18,6 +18,10 @@ profiler window):
   ``{"duration_s": 5, "log_dir": "/tmp/prof"}`` starts a
   ``profiler.Profiler`` and stops it after the window; 409 while one
   is already armed.
+- ``POST /reset_health`` — invoke registered reset handlers (an
+  engine's ``reset_health()``, the fleet router's breaker reset);
+  body ``{"name": ...}`` targets one, empty body resets all; 404
+  when no engine/router is registered in this process.
 
 Components self-register status providers (weakly — a dead engine
 disappears from /statusz instead of raising)::
@@ -55,6 +59,13 @@ _providers_mu = threading.Lock()
 _health_providers: Dict[str, Callable[[], Optional[str]]] = {}
 _HEALTH_RANK = {"ok": 0, "healthy": 0, "degraded": 1, "draining": 2}
 
+# name → zero-arg reset callable (LLMEngine.reset_health, the fleet
+# router's breaker reset). POST /reset_health invokes them — the
+# operator escape hatch reachable without a Python shell: a drained
+# engine (sticky health latch) or a stuck-open breaker is recovered
+# with one curl instead of an attach-and-poke.
+_reset_handlers: Dict[str, Callable[[], None]] = {}
+
 _server: Optional["DebugServer"] = None
 _server_mu = threading.Lock()
 
@@ -79,6 +90,17 @@ def register_health_provider(name: str,
 def unregister_health_provider(name: str) -> None:
     with _providers_mu:
         _health_providers.pop(name, None)
+
+
+def register_reset_handler(name: str,
+                           fn: Callable[[], None]) -> None:
+    with _providers_mu:
+        _reset_handlers[name] = fn
+
+
+def unregister_reset_handler(name: str) -> None:
+    with _providers_mu:
+        _reset_handlers.pop(name, None)
 
 
 def _collect_health() -> Dict[str, str]:
@@ -267,10 +289,14 @@ class DebugServer:
             h._reply_json(404, {
                 "error": f"unknown path {url.path}",
                 "endpoints": ["/metrics", "/healthz", "/statusz",
-                              "/tracez", "POST /profilez"]})
+                              "/tracez", "POST /profilez",
+                              "POST /reset_health"]})
 
     def _post(self, h) -> None:
         url = urlparse(h.path)
+        if url.path == "/reset_health":
+            self._post_reset_health(h)
+            return
         if url.path != "/profilez":
             h._reply_json(404, {"error": f"unknown path {url.path}"})
             return
@@ -291,6 +317,43 @@ class DebugServer:
                                 "armed": self._arm.status()})
         else:
             h._reply_json(200, {"armed": info})
+
+    def _post_reset_health(self, h) -> None:
+        """Operator escape hatch over HTTP: invoke the registered
+        reset handlers (engine ``reset_health``, router breaker
+        reset). Body ``{"name": ...}`` targets one handler; no body
+        (or ``{}``) resets all. 404 when nothing is registered — the
+        process has no engine/router to reset."""
+        n = int(h.headers.get("Content-Length", 0))
+        try:
+            body = json.loads(h.rfile.read(n) or b"{}")
+        except ValueError:
+            h._reply_json(400, {"error": "malformed JSON body"})
+            return
+        with _providers_mu:
+            handlers = dict(_reset_handlers)
+        if not handlers:
+            h._reply_json(404, {"error": "no engine registered"})
+            return
+        target = body.get("name")
+        if target is not None:
+            if target not in handlers:
+                h._reply_json(404, {
+                    "error": f"no reset handler named {target!r}",
+                    "registered": sorted(handlers)})
+                return
+            handlers = {target: handlers[target]}
+        done, errors = [], {}
+        for name, fn in handlers.items():
+            try:
+                fn()
+                done.append(name)
+            except Exception as e:  # noqa: BLE001 — report, don't die
+                errors[name] = str(e)
+        out = {"reset": done}
+        if errors:
+            out["errors"] = errors
+        h._reply_json(500 if errors and not done else 200, out)
 
     # -- lifecycle ------------------------------------------------------
     @property

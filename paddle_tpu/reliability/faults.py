@@ -31,6 +31,11 @@ Named sites (the catalog; see docs/RELIABILITY.md):
 ``ckpt.rename``           checkpoint commit/rename stage (post-write)
 ``store.socket``          one TCP rendezvous-store request attempt
 ``io.worker``             DataLoader host-batch production
+``router.dispatch``       fleet router: one request dispatch to a replica
+``router.healthz``        fleet router: one replica health poll
+``replica.crash``         serving replica process: hard-crash trigger
+                          (the replica main loop exits the process on
+                          injection — a SIGKILL the schedule controls)
 ========================  ==================================================
 
 Stdlib-only by design: any module may import this without cycles.
@@ -51,6 +56,9 @@ SITES = (
     "ckpt.rename",
     "store.socket",
     "io.worker",
+    "router.dispatch",
+    "router.healthz",
+    "replica.crash",
 )
 
 

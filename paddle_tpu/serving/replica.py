@@ -1,0 +1,339 @@
+"""Engine replicas: the router's uniform view of one serving engine.
+
+A replica is anything with the small ``submit/health/cancel`` surface
+below — the router neither knows nor cares whether the engine runs in
+this process or behind an HTTP endpoint three hosts away:
+
+- :class:`LocalReplica` — wraps an in-process :class:`LLMEngine`
+  (tests, benches, single-host multi-engine layouts).
+- :class:`HTTPReplica` — wraps a remote ``serve_llm`` endpoint plus
+  its debug server's ``/healthz``; maps the pinned HTTP error contract
+  (429/503/504/499) back to the typed exceptions, and maps transport
+  failures (connection refused/reset — the crashed-replica signature)
+  to :class:`ReplicaUnavailable`, the one error the router treats as
+  "fail over and charge the breaker".
+- :func:`spawn_replica` / ``python -m paddle_tpu.serving.replica`` —
+  a self-contained replica subprocess for the fleet chaos soak and
+  local scale-out: builds a model from a JSON spec, serves it, exposes
+  the debug surface, registers TCPStore membership, and honors an
+  injected ``replica.crash`` fault by dying hard (``os._exit``), the
+  way a SIGKILL would take it.
+
+All replicas in a fleet must be built from the same model weights and
+engine ``seed`` for failover to be token-identical (the router pins
+each request's sampling nonce; see ``LLMEngine.submit(nonce=)``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Optional
+
+from ..inference.llm import (AdmissionShed, AdmissionTimeout,
+                             RequestCancelled)
+from ..reliability.retry import DeadlineExceeded
+
+
+class ReplicaUnavailable(RuntimeError):
+    """The replica could not be reached or died mid-request
+    (connection refused/reset, empty response, unexpected 5xx). The
+    router's verdict for this error: charge the circuit breaker and
+    fail the request over to a sibling."""
+
+
+class LocalReplica:
+    """In-process replica over an ``LLMEngine`` (or anything with its
+    submit/cancel/health surface)."""
+
+    def __init__(self, engine):
+        self.engine = engine
+
+    def submit(self, prompt_ids, max_new_tokens=32, temperature=0.0,
+               deadline_s: Optional[float] = None, priority: int = 0,
+               nonce: Optional[int] = None) -> dict:
+        fut = self.engine.submit(
+            prompt_ids, max_new_tokens=max_new_tokens,
+            temperature=temperature, deadline=deadline_s,
+            priority=priority, nonce=nonce)
+        out = fut.result(timeout=600)
+        out["request_id"] = fut.request_id
+        return out
+
+    def health(self) -> Optional[str]:
+        if getattr(self.engine, "_closed", False):
+            return None
+        return self.engine.health
+
+    def cancel(self, request_id: int) -> bool:
+        return self.engine.cancel(request_id)
+
+    def close(self) -> None:
+        pass   # the engine's owner closes it
+
+
+class HTTPReplica:
+    """Remote replica behind ``serve_llm`` + debug-server endpoints.
+
+    ``generate_url`` is the ``serve_llm`` base (POST /generate,
+    POST /cancel); ``healthz_url`` the debug server's /healthz."""
+
+    def __init__(self, generate_url: str, healthz_url: str,
+                 timeout: float = 600.0):
+        self.generate_url = generate_url.rstrip("/")
+        self.healthz_url = healthz_url
+        self.timeout = float(timeout)
+
+    def _post(self, path: str, body: dict, timeout: float):
+        from urllib.error import HTTPError, URLError
+        from urllib.request import Request, urlopen
+        req = Request(self.generate_url + path,
+                      data=json.dumps(body).encode(),
+                      headers={"Content-Type": "application/json"})
+        try:
+            with urlopen(req, timeout=timeout) as r:
+                return r.status, json.loads(r.read() or b"{}")
+        except HTTPError as e:
+            try:
+                payload = json.loads(e.read() or b"{}")
+            except ValueError:
+                payload = {}
+            return e.code, payload
+        except (URLError, OSError, ValueError) as e:
+            # connection refused/reset, truncated response: the
+            # crashed-or-vanished replica signature
+            raise ReplicaUnavailable(
+                f"replica at {self.generate_url} unreachable: "
+                f"{e}") from e
+
+    def submit(self, prompt_ids, max_new_tokens=32, temperature=0.0,
+               deadline_s: Optional[float] = None, priority: int = 0,
+               nonce: Optional[int] = None) -> dict:
+        body = {"prompt_ids": list(map(int, prompt_ids)),
+                "max_new_tokens": int(max_new_tokens),
+                "temperature": float(temperature),
+                "priority": int(priority)}
+        if deadline_s is not None:
+            body["deadline_s"] = float(deadline_s)
+        if nonce is not None:
+            body["nonce"] = int(nonce)
+        # the HTTP wait must outlive the request's own deadline so the
+        # typed 504 arrives instead of a transport timeout
+        timeout = self.timeout if deadline_s is None \
+            else min(self.timeout, float(deadline_s) + 30.0)
+        code, out = self._post("/generate", body, max(timeout, 1.0))
+        if code == 200:
+            return out
+        err = out.get("error", f"HTTP {code}")
+        if code == 429:
+            raise AdmissionShed(err,
+                                reason=out.get("reason") or "queue_full")
+        if code == 503:
+            raise AdmissionShed(err, reason="draining")
+        if code == 504:
+            raise DeadlineExceeded(err)
+        if code == 499:
+            raise RequestCancelled(err)
+        if code == 400:
+            raise ValueError(err)
+        raise ReplicaUnavailable(
+            f"replica at {self.generate_url} returned HTTP {code}: "
+            f"{err}")
+
+    def health(self, timeout: float = 2.0) -> Optional[str]:
+        """"healthy"/"degraded"/"draining", or None when unreachable
+        (the caller decides what unreachable means — the router
+        charges the breaker)."""
+        from urllib.error import HTTPError, URLError
+        from urllib.request import urlopen
+        try:
+            with urlopen(self.healthz_url, timeout=timeout) as r:
+                body = json.loads(r.read() or b"{}")
+        except HTTPError as e:
+            if e.code == 503:   # draining flips /healthz to 503
+                try:
+                    body = json.loads(e.read() or b"{}")
+                except ValueError:
+                    body = {}
+                return body.get("status", "draining")
+            return None
+        except (URLError, OSError, ValueError):
+            return None
+        status = body.get("status", "healthy")
+        return "healthy" if status == "ok" else status
+
+    def cancel(self, request_id: int) -> bool:
+        try:
+            code, out = self._post("/cancel",
+                                   {"request_id": int(request_id)}, 10.0)
+        except ReplicaUnavailable:
+            return False
+        return bool(out.get("cancelled")) if code == 200 else False
+
+    def close(self) -> None:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# spawnable replica subprocess (fleet chaos soak / local scale-out)
+# ---------------------------------------------------------------------------
+
+READY_MARK = "REPLICA_READY "
+
+
+def build_net_from_spec(spec: dict):
+    """A small GPT from a JSON-able spec — the one model builder the
+    replica subprocess, the fleet soak parent, and the fleet bench
+    share, so "same weights on every replica" is true by construction
+    (same ``paddle_tpu.seed``)."""
+    import paddle_tpu as pt
+    from ..models.gpt import GPTForCausalLM, gpt_config
+    pt.seed(int(spec.get("model_seed", 0)))
+    cfg = gpt_config(
+        "gpt2-small",
+        num_layers=int(spec.get("layers", 2)),
+        hidden_size=int(spec.get("hidden", 64)),
+        num_heads=int(spec.get("heads", 4)),
+        vocab_size=int(spec.get("vocab", 97)),
+        max_position_embeddings=int(spec.get("max_pos", 96)),
+        hidden_dropout=0.0, attention_dropout=0.0)
+    return GPTForCausalLM(cfg)
+
+
+def make_engine_from_spec(spec: dict):
+    from ..inference.llm import LLMEngine
+    net = build_net_from_spec(spec)
+    ekw = dict(spec.get("engine", {}))
+    ekw.setdefault("max_seqs", 4)
+    ekw.setdefault("page_size", 4)
+    ekw.setdefault("num_pages", 96)
+    ekw.setdefault("prefill_buckets", (16,))
+    ekw.setdefault("seed", 0)
+    return LLMEngine(net, **ekw)
+
+
+def _arm_faults(spec: dict) -> None:
+    if not spec.get("faults"):
+        return
+    from ..reliability import faults
+    faults.reset()
+    faults.enable(seed=int(spec["faults"].get("seed", 0)))
+    for rule in spec["faults"].get("rules", ()):
+        faults.inject(rule["site"],
+                      nth=rule.get("nth"), p=rule.get("p"),
+                      times=rule.get("times"))
+
+
+def replica_main(spec: dict) -> int:
+    """Subprocess body: engine + serve_llm + debug server + optional
+    TCPStore membership, announced on stdout as one READY line."""
+    import jax
+    jax.config.update("jax_platforms", spec.get("platform", "cpu"))
+    if spec.get("cache_dir"):
+        # a fleet compiles K copies of the same tiny programs; the
+        # persistent cache makes replica N and every respawn hit
+        # replica 1's artifacts (PR 3's compilation_cache_dir wiring,
+        # applied fleet-wide)
+        jax.config.update("jax_compilation_cache_dir",
+                          spec["cache_dir"])
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.0)
+    from ..inference.llm import serve_llm
+    from ..observability import server as debug
+    from ..reliability import faults
+    from ..reliability.faults import FaultInjected
+
+    _arm_faults(spec)
+    eng = make_engine_from_spec(spec)
+    srv = serve_llm(eng)
+    host, port = srv.server_address[:2]
+    dbg = debug.start_debug_server()
+    name = spec.get("name", f"replica-{os.getpid()}")
+    info = {"name": name,
+            "generate": f"http://{host}:{port}",
+            "healthz": f"{dbg.address}/healthz",
+            "pid": os.getpid()}
+    member = None
+    if spec.get("store"):
+        from ..distributed.tcp_store import TCPMembership
+        member = TCPMembership(spec["store"], name, info,
+                               beat_interval=float(
+                                   spec.get("beat_interval", 0.2)))
+    print(READY_MARK + json.dumps(info), flush=True)
+    try:
+        while True:
+            time.sleep(0.05)
+            if faults.enabled():
+                try:
+                    faults.check("replica.crash")
+                except FaultInjected:
+                    # die the way a SIGKILL would: no cleanup, no
+                    # goodbye — the fleet must absorb exactly this
+                    os._exit(137)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if member is not None:
+            member.stop()
+        eng.close()
+        srv.shutdown()
+    return 0
+
+
+def spawn_replica(spec: dict, timeout: float = 120.0,
+                  env: Optional[dict] = None):
+    """Spawn ``python -m paddle_tpu.serving.replica`` and wait for its
+    READY line. Returns ``(Popen, info_dict)``; the caller owns the
+    process (SIGKILL it, wait() it)."""
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    child_env = dict(os.environ, JAX_PLATFORMS=spec.get(
+        "platform", "cpu"), PYTHONPATH=repo)
+    if env:
+        child_env.update(env)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.serving.replica",
+         json.dumps(spec)],
+        env=child_env, cwd=repo, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+
+    def _pump_stderr():
+        for _ in proc.stderr:
+            pass
+
+    threading.Thread(target=_pump_stderr, daemon=True).start()
+    # the READY wait must hold its deadline even while BLOCKED in
+    # readline (a child wedged mid-compile writes nothing): a daemon
+    # reader thread signals through an Event the caller waits on with
+    # the real budget
+    found = {}
+    ready = threading.Event()
+
+    def _read_stdout():
+        for line in proc.stdout:
+            if line.startswith(READY_MARK):
+                found["info"] = json.loads(line[len(READY_MARK):])
+                ready.set()
+                break
+        ready.set()          # EOF: child exited before READY
+        for _ in proc.stdout:
+            pass             # keep draining so the child never blocks
+
+    threading.Thread(target=_read_stdout, daemon=True).start()
+    if not ready.wait(timeout):
+        proc.kill()
+        raise TimeoutError(
+            f"replica {spec.get('name')} not READY in {timeout}s")
+    if "info" not in found:
+        raise ReplicaUnavailable(
+            f"replica {spec.get('name')} exited before READY "
+            f"(rc={proc.poll()})")
+    return proc, found["info"]
+
+
+if __name__ == "__main__":
+    sys.exit(replica_main(json.loads(sys.argv[1])))

@@ -1,0 +1,734 @@
+"""Fleet router: prefix-affinity load balancing over engine replicas
+with per-replica circuit breakers and in-budget failover.
+
+The horizontally-scaled serving tier (ROADMAP item 3): everything a
+single :class:`LLMEngine` learned in PRs 2 and 5 — prefix caching,
+deadlines, priorities, shed/cancel verdicts, the health state machine
+— composed ACROSS processes. One router fronts K replicas (in-process
+engines, spawned subprocesses, or attached multi-host endpoints;
+membership via the rendezvous TCPStore) and gives clients the same
+``submit(...) -> Future`` surface the engine has, with three fleet
+properties layered on top:
+
+PREFIX AFFINITY. Requests are routed by a rendezvous hash of the
+prompt's first KV-page digests (the same rolling BLAKE2b chain
+``prefix_cache.page_digests`` computes), so requests sharing a prefix
+land on the replica most likely to already hold those pages — PR 2's
+cache hit rate multiplies under scale-out instead of diluting by 1/K
+(``tools/llm_bench.py --fleet`` pins affinity ≥ 1.5× round-robin).
+Rendezvous hashing keeps the mapping stable under membership churn: a
+replica leaving only remaps ITS keys.
+
+HEALTH AS ROUTING INPUT. A background poll of each replica's
+``/healthz`` plus in-band error verdicts drive a per-replica
+:class:`CircuitBreaker` (closed → open → half-open): connection
+failures and crashes trip it OPEN (quiet time, no retry storm),
+half-open probes re-close it when the replica returns. A replica
+reporting DRAINING (its own sticky health latch, HTTP 503) receives no
+new admissions within one poll interval; its requests rebalance to
+siblings without consuming failover budget.
+
+FAILOVER INSIDE THE RETRY BUDGET. The router pins each request's
+sampling nonce at admission, so a request lost to a replica crash
+mid-decode is re-submitted to a sibling and — all replicas being
+identically seeded — regenerates the IDENTICAL token stream (PR 5's
+device-retry semantics, now across processes). The client sees
+latency, never an error, while ``failover_budget`` lasts.
+
+Per-tenant quotas and SLO classes map onto the engine's existing
+priority/deadline machinery: an :class:`SLOClass` is a named
+(deadline, priority) default, a :class:`TenantQuota` bounds a tenant's
+in-flight requests (overflow sheds at the ROUTER — the byte-lean
+control plane never even wakes a replica for it).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Dict, Optional
+
+from ..inference.llm import (AdmissionShed, EngineClosed,
+                             RequestCancelled)
+from ..inference.prefix_cache import page_digests
+from ..observability import metrics as _obs
+from ..observability import server as _dbgsrv
+from ..observability import tracing as _trace
+from ..reliability import faults as _faults
+from ..reliability.retry import DeadlineExceeded, as_deadline
+from .breaker import STATE_CODE, CircuitBreaker
+from .replica import HTTPReplica, ReplicaUnavailable
+
+_HEALTH_CODE = {"healthy": 0, "degraded": 1, "draining": 2,
+                "unreachable": 3, "unknown": 3}
+
+
+def affinity_key(prompt, page_size: int, affinity_pages: int) -> bytes:
+    """The routing key: the rolling digest of the prompt's first
+    ``min(affinity_pages, full pages)`` KV pages. Prompts sharing
+    their first ``affinity_pages`` pages co-locate (their tails,
+    wherever they diverge beyond that, don't matter); prompts shorter
+    than one page hash their tokens, so identical short prompts still
+    co-locate. Small ``affinity_pages`` = coarse families (better
+    sharing), large = finer spread."""
+    digs = page_digests(prompt, page_size)
+    if digs:
+        # digest i commits to the whole history through page i — one
+        # key per prefix family
+        return digs[:affinity_pages][-1]
+    return hashlib.blake2b(
+        ",".join(map(str, prompt)).encode(), digest_size=16).digest()
+
+
+def rendezvous_pick(key: bytes, names) -> Optional[str]:
+    """Highest-random-weight (rendezvous) hash: the max-scoring name
+    for ``key``. Stable under membership churn — removing a name only
+    remaps the keys that preferred it."""
+    best, best_score = None, -1
+    for n in names:
+        h = hashlib.blake2b(key + n.encode(), digest_size=8)
+        score = int.from_bytes(h.digest(), "big")
+        if score > best_score:
+            best, best_score = n, score
+    return best
+
+
+class SLOClass:
+    """A named latency tier: requests submitted under it inherit its
+    deadline/priority unless they bring their own."""
+
+    def __init__(self, name: str, deadline_s: Optional[float] = None,
+                 priority: int = 0):
+        self.name = name
+        self.deadline_s = deadline_s
+        self.priority = int(priority)
+
+
+class TenantQuota:
+    """Per-tenant admission bound: at most ``max_inflight`` of the
+    tenant's requests live in the fleet at once (None: unbounded);
+    ``slo`` names the tenant's default SLO class."""
+
+    def __init__(self, max_inflight: Optional[int] = None,
+                 slo: Optional[str] = None):
+        self.max_inflight = max_inflight
+        self.slo = slo
+
+
+def _router_metrics():
+    reg = _obs.default_registry()
+    return {
+        "dispatches": reg.counter(
+            "router_dispatches_total",
+            "request dispatch attempts per replica",
+            label_names=("replica",)),
+        "failovers": reg.counter(
+            "router_failover_total",
+            "re-dispatches after a replica became unavailable "
+            "mid-request (same nonce — token-identical resubmission)"),
+        "rebalanced": reg.counter(
+            "router_rebalanced_total",
+            "dispatches rerouted off a shedding/draining replica "
+            "(no failover budget consumed)"),
+        "shed": reg.counter(
+            "router_shed_total",
+            "requests shed at the router (tenant quota, or no "
+            "routable replica)"),
+        "affinity_routed": reg.counter(
+            "router_affinity_routed_total",
+            "dispatches that landed on the prefix-affinity-preferred "
+            "replica"),
+        "affinity_total": reg.counter(
+            "router_affinity_eligible_total",
+            "dispatches that had an affinity preference (denominator "
+            "of the hit rate)"),
+        "affinity_rate": reg.gauge(
+            "router_affinity_hit_rate",
+            "cumulative affinity-preferred / eligible dispatches"),
+        "breaker": reg.gauge(
+            "router_breaker_state",
+            "per-replica breaker: 0 closed, 1 half-open, 2 open",
+            label_names=("replica",)),
+        "inflight": reg.gauge(
+            "router_replica_inflight",
+            "requests currently dispatched to each replica (the "
+            "router-side queue depth)",
+            label_names=("replica",)),
+        "rhealth": reg.gauge(
+            "router_replica_health",
+            "last polled replica health: 0 healthy, 1 degraded, "
+            "2 draining, 3 unreachable",
+            label_names=("replica",)),
+        "latency": reg.histogram(
+            "router_request_seconds",
+            "router submit → resolution (failover latency included)"),
+    }
+
+
+class _ReplicaState:
+    __slots__ = ("name", "client", "breaker", "health", "inflight",
+                 "dispatched", "from_membership", "info")
+
+    def __init__(self, name, client, breaker):
+        self.name = name
+        self.client = client
+        self.breaker = breaker
+        self.health = "unknown"   # last poll verdict (or in-band 503)
+        self.inflight = 0
+        self.dispatched = 0
+        self.from_membership = False
+        self.info: dict = {}
+
+
+class _FleetRequest:
+    __slots__ = ("prompt", "max_new_tokens", "temperature", "deadline",
+                 "priority", "tenant", "nonce", "future", "cancelled",
+                 "span", "excluded", "t_submit", "failovers",
+                 "affinity_key", "quota_held", "rr_slot")
+
+    def __init__(self, prompt, max_new_tokens, temperature):
+        self.prompt = list(map(int, prompt))
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.deadline = None
+        self.priority = 0
+        self.tenant = None
+        self.nonce = 0
+        self.future: Future = Future()
+        self.cancelled = False
+        self.span = None
+        self.excluded = set()    # replicas that shed/died THIS request
+        self.t_submit = time.monotonic()
+        self.failovers = 0
+        self.affinity_key = b""
+        self.quota_held = False   # holds one tenant-inflight slot
+        self.rr_slot = 0          # round-robin seat, fixed at submit
+
+
+class Router:
+    """Load-balancing front over K engine replicas.
+
+    ``replicas``: mapping name → replica client (:class:`LocalReplica`
+    / :class:`HTTPReplica` / any object with their surface); more join
+    later via :meth:`attach` or TCPStore membership
+    (``store_endpoint=``, records published by
+    ``distributed.tcp_store.TCPMembership`` — replicas that re-register
+    under the same name keep their breaker history, so a restarted
+    replica must walk open → half-open → closed like any recovering
+    one).
+
+    ``policy``: ``"affinity"`` (prefix rendezvous, the default) or
+    ``"round_robin"`` (the baseline ``llm_bench --fleet`` compares
+    against). Both fall back to least-loaded when no preference
+    applies.
+    """
+
+    def __init__(self, replicas: Optional[Dict[str, object]] = None, *,
+                 page_size: int = 16, affinity_pages: int = 2,
+                 failover_budget: int = 2,
+                 health_poll_interval: float = 0.25,
+                 breaker_fail_threshold: int = 3,
+                 breaker_open_for: float = 1.0,
+                 breaker_half_open_probes: int = 1,
+                 slo_classes: Optional[Dict[str, SLOClass]] = None,
+                 tenants: Optional[Dict[str, TenantQuota]] = None,
+                 store_endpoint: Optional[str] = None,
+                 membership_stale_after: float = 2.0,
+                 policy: str = "affinity",
+                 max_workers: int = 32,
+                 name: str = "router"):
+        if policy not in ("affinity", "round_robin"):
+            raise ValueError(f"unknown routing policy {policy!r}")
+        self.page_size = int(page_size)
+        self.affinity_pages = int(affinity_pages)
+        self.failover_budget = int(failover_budget)
+        self.health_poll_interval = float(health_poll_interval)
+        self.policy = policy
+        self.name = name
+        self._breaker_kw = dict(
+            fail_threshold=breaker_fail_threshold,
+            open_for=breaker_open_for,
+            half_open_probes=breaker_half_open_probes)
+        self.slo_classes = dict(slo_classes or {})
+        self.tenants = dict(tenants or {})
+        self._mu = threading.Lock()
+        self._replicas: Dict[str, _ReplicaState] = {}
+        self._tenant_inflight: Dict[str, int] = {}
+        self._by_id: Dict[int, _FleetRequest] = {}
+        self._nonce_seq = itertools.count()
+        self._rr_seq = itertools.count()
+        self._closed = False
+        self._m = _router_metrics()
+        self.n_submitted = 0
+        self.n_failovers = 0
+        self.n_rebalanced = 0
+        self.n_shed = 0
+        for rname, client in (replicas or {}).items():
+            self.attach(rname, client)
+        # TCPStore membership: poll the roster alongside health
+        self._store_client = None
+        self._membership_stale_after = float(membership_stale_after)
+        if store_endpoint is not None:
+            from ..distributed.tcp_store import TCPStoreClient
+            self._store_client = TCPStoreClient(store_endpoint)
+        self._pool = ThreadPoolExecutor(
+            max_workers=max_workers,
+            thread_name_prefix=f"{name}-dispatch")
+        self._stop = threading.Event()
+        self._poller = threading.Thread(
+            target=self._poll_loop, name=f"{name}-health", daemon=True)
+        self._poller.start()
+        # live-debug surface: /statusz fleet view, /healthz aggregate,
+        # POST /reset_health → breaker reset (the router-side half of
+        # the operator escape hatch)
+        self._status_name = f"{name}_{id(self):x}"
+        _dbgsrv.register_status_provider(self._status_name,
+                                         self._status)
+        _dbgsrv.register_health_provider(self._status_name,
+                                         self._aggregate_health)
+        _dbgsrv.register_reset_handler(self._status_name,
+                                       self.reset_breakers)
+
+    # -- membership ---------------------------------------------------------
+    def attach(self, name: str, client) -> None:
+        """Add (or re-point) a replica. Re-attaching an existing name
+        keeps its breaker — a restarted replica re-earns trust through
+        half-open probes instead of resetting its history."""
+        with self._mu:
+            st = self._replicas.get(name)
+            if st is None:
+                st = _ReplicaState(name, client,
+                                   CircuitBreaker(**self._breaker_kw))
+                self._replicas[name] = st
+            else:
+                st.client = client
+
+    def detach(self, name: str) -> None:
+        with self._mu:
+            self._replicas.pop(name, None)
+
+    def replica_names(self):
+        with self._mu:
+            return sorted(self._replicas)
+
+    def _sync_membership(self) -> None:
+        from ..distributed.tcp_store import (StoreUnavailable,
+                                             TCPMembership)
+        try:
+            members = TCPMembership.list_members(
+                self._store_client,
+                stale_after=self._membership_stale_after)
+        except StoreUnavailable:
+            return
+        for mname, info in members.items():
+            with self._mu:
+                st = self._replicas.get(mname)
+                same = st is not None and st.info == info
+            if same:
+                continue
+            client = HTTPReplica(info["generate"], info["healthz"])
+            self.attach(mname, client)
+            with self._mu:
+                st = self._replicas[mname]
+                st.from_membership = True
+                st.info = dict(info)
+
+    # -- health / breaker maintenance ---------------------------------------
+    def _poll_once(self) -> None:
+        if self._store_client is not None:
+            self._sync_membership()
+        with self._mu:
+            states = list(self._replicas.values())
+        for st in states:
+            if st.breaker.state != "closed":
+                # open: skip (quiet time). half-open: a poll IS the
+                # probe — consume a probe slot so traffic and polls
+                # share one budget
+                if not st.breaker.allow():
+                    self._m["breaker"].labels(st.name).set(
+                        STATE_CODE[st.breaker.state])
+                    continue
+            h = None
+            try:
+                if _faults.enabled():
+                    _faults.check("router.healthz")
+                h = st.client.health()
+            except Exception:  # noqa: BLE001 — a poll failure is data
+                h = None
+            st.health = h if h is not None else "unreachable"
+            if h is None:
+                st.breaker.record_failure()
+            else:
+                # ANY answer settles as success — the breaker judges
+                # reachability only; a draining verdict keeps the
+                # replica out of rotation through the HEALTH filter,
+                # not by re-tripping the breaker every probe cycle
+                st.breaker.record_success()
+            self._m["breaker"].labels(st.name).set(
+                STATE_CODE[st.breaker.state])
+            self._m["rhealth"].labels(st.name).set(
+                _HEALTH_CODE.get(st.health, 3))
+
+    def _poll_loop(self) -> None:
+        while not self._stop.wait(self.health_poll_interval):
+            try:
+                self._poll_once()
+            except Exception:  # noqa: BLE001 — the poller must survive
+                pass
+
+    def reset_breakers(self) -> None:
+        """Operator escape hatch: force every breaker closed (e.g.
+        after a known-good fleet restart). Reachable over HTTP via
+        POST /reset_health."""
+        with self._mu:
+            states = list(self._replicas.values())
+        for st in states:
+            st.breaker.reset()
+            if st.health == "draining":
+                st.health = "unknown"   # re-polled next interval
+            self._m["breaker"].labels(st.name).set(0)
+
+    # -- routing ------------------------------------------------------------
+    _rendezvous = staticmethod(rendezvous_pick)
+
+    def _affinity_key(self, prompt) -> bytes:
+        return affinity_key(prompt, self.page_size,
+                            self.affinity_pages)
+
+    def _route(self, req: _FleetRequest):
+        """(state, affinity_hit) or (None, all_draining)."""
+        with self._mu:
+            states = dict(self._replicas)
+        eligible = {n: st for n, st in states.items()
+                    if n not in req.excluded
+                    and st.health != "draining"}
+        preferred_all = self._rendezvous(req.affinity_key, states) \
+            if self.policy == "affinity" else None
+        while eligible:
+            names = {n for n, st in eligible.items()
+                     if st.breaker.state != "open"}
+            if not names:
+                break
+            if self.policy == "affinity":
+                pick = self._rendezvous(req.affinity_key, names)
+            else:
+                # the seat was assigned at submit time, so placement
+                # is a function of ARRIVAL order, not of which pool
+                # thread won the race to dispatch
+                order = sorted(names)
+                pick = order[req.rr_slot % len(order)]
+            st = eligible[pick]
+            if st.breaker.allow():
+                return st, pick == preferred_all
+            eligible.pop(pick)   # half-open probe budget spent
+        all_draining = bool(states) and all(
+            st.health == "draining" for st in states.values())
+        return None, all_draining
+
+    # -- submission ---------------------------------------------------------
+    def submit(self, prompt_ids, max_new_tokens: int = 32,
+               temperature: float = 0.0, deadline=None,
+               priority: int = 0, tenant: Optional[str] = None,
+               slo: Optional[str] = None) -> Future:
+        if self._closed:
+            # typed like the engine's verdict: through serve_llm this
+            # is a 503 (out of rotation), never a client-error 400
+            raise EngineClosed("router closed")
+        if not prompt_ids:
+            raise ValueError("empty prompt")
+        req = _FleetRequest(prompt_ids, max_new_tokens, temperature)
+        req.tenant = tenant
+        quota = self.tenants.get(tenant) if tenant else None
+        if slo is None and quota is not None:
+            slo = quota.slo
+        cls = self.slo_classes.get(slo) if slo else None
+        if cls is not None:
+            if deadline is None:
+                deadline = cls.deadline_s
+            if priority == 0:
+                priority = cls.priority
+        req.deadline = as_deadline(deadline)
+        req.priority = int(priority)
+        req.nonce = next(self._nonce_seq) & 0x7FFFFFFF
+        req.future.request_id = req.nonce
+        req.affinity_key = self._affinity_key(req.prompt)
+        req.rr_slot = next(self._rr_seq)
+        self.n_submitted += 1
+        if _trace.enabled():
+            req.span = _trace.start_span(
+                "router.request", parent=None, attrs={
+                    "prompt_tokens": len(req.prompt),
+                    "nonce": req.nonce, "tenant": tenant or "",
+                    "slo": slo or ""})
+        # tenant quota: shed at the router — terminal, byte-lean (no
+        # replica is woken for a request its tenant can't run)
+        if quota is not None and quota.max_inflight is not None:
+            with self._mu:
+                cur = self._tenant_inflight.get(tenant, 0)
+                over = cur >= quota.max_inflight
+                if not over:
+                    self._tenant_inflight[tenant] = cur + 1
+                    req.quota_held = True
+            if over:
+                self._resolve_shed(
+                    req, f"tenant {tenant!r} quota exhausted "
+                    f"({cur}/{quota.max_inflight} in flight)",
+                    reason="queue_full")
+                return req.future
+        with self._mu:
+            self._by_id[req.nonce] = req
+        self._pool.submit(self._run, req)
+        return req.future
+
+    def generate(self, prompts, max_new_tokens: int = 32,
+                 temperature: float = 0.0, **kw):
+        """Blocking batch convenience (mirrors ``LLMEngine.generate``)."""
+        futs = [self.submit(p, max_new_tokens, temperature, **kw)
+                for p in prompts]
+        return [f.result() for f in futs]
+
+    def cancel(self, request_id: int) -> bool:
+        """Best-effort cancel: takes effect at the next routing
+        boundary (pre-dispatch, or between failover attempts). Work
+        already in flight on a replica runs to completion there; its
+        result is discarded and the client still sees
+        :class:`RequestCancelled`."""
+        with self._mu:
+            req = self._by_id.get(request_id)
+        if req is None or req.future.done():
+            return False
+        req.cancelled = True
+        return True
+
+    # -- the dispatch loop (runs on the pool) -------------------------------
+    def _resolve(self, req: _FleetRequest, result=None, exc=None,
+                 outcome: str = "ok") -> None:
+        with self._mu:
+            self._by_id.pop(req.nonce, None)
+            if req.quota_held:
+                req.quota_held = False
+                n = self._tenant_inflight.get(req.tenant, 1) - 1
+                if n <= 0:
+                    self._tenant_inflight.pop(req.tenant, None)
+                else:
+                    self._tenant_inflight[req.tenant] = n
+        self._m["latency"].observe(time.monotonic() - req.t_submit)
+        if req.span is not None:
+            req.span.set_attr("outcome", outcome)
+            req.span.set_attr("failovers", req.failovers)
+            if exc is not None:
+                req.span.set_status("error").set_attr("error", str(exc))
+            req.span.end()
+            req.span = None
+        if req.future.done():
+            return
+        if exc is not None:
+            req.future.set_exception(exc)
+        else:
+            req.future.set_result(result)
+
+    def _resolve_shed(self, req: _FleetRequest, why: str,
+                      reason: str) -> None:
+        self.n_shed += 1
+        self._m["shed"].inc()
+        self._resolve(req, exc=AdmissionShed(why, reason=reason),
+                      outcome="shed")
+
+    def _check_boundaries(self, req: _FleetRequest) -> bool:
+        """Typed early outs at every routing boundary; True = resolved."""
+        if req.cancelled:
+            self._resolve(req, exc=RequestCancelled(
+                f"request {req.nonce} cancelled at the router"),
+                outcome="cancelled")
+            return True
+        if req.deadline is not None and req.deadline.expired:
+            self._resolve(req, exc=DeadlineExceeded(
+                f"request {req.nonce} deadline expired after "
+                f"{req.failovers} failover(s)"), outcome="deadline")
+            return True
+        return False
+
+    def _run(self, req: _FleetRequest) -> None:
+        try:
+            self._run_inner(req)
+        except Exception as e:  # noqa: BLE001 — never lose a future
+            self._resolve(req, exc=e, outcome="error")
+
+    def _run_inner(self, req: _FleetRequest) -> None:
+        while True:
+            if self._check_boundaries(req):
+                return
+            st, flag = self._route(req)
+            if st is None:
+                self._resolve_shed(
+                    req, "no routable replica "
+                    f"(tried {sorted(req.excluded)}, "
+                    f"{len(self._replicas)} attached)",
+                    reason="draining" if flag else "queue_full")
+                return
+            dspan = None
+            if req.span is not None:
+                dspan = _trace.start_span(
+                    "router.dispatch", parent=req.span,
+                    attrs={"replica": st.name,
+                           "failovers": req.failovers})
+            if self.policy == "affinity":
+                self._m["affinity_total"].inc()
+                if flag:
+                    self._m["affinity_routed"].inc()
+                fam = self._m["affinity_total"]
+                self._m["affinity_rate"].set(
+                    self._m["affinity_routed"].value
+                    / max(1.0, fam.value))
+            self._m["dispatches"].labels(st.name).inc()
+            with self._mu:
+                st.dispatched += 1
+                st.inflight += 1
+            self._m["inflight"].labels(st.name).set(st.inflight)
+            try:
+                if _faults.enabled():
+                    _faults.check("router.dispatch")
+                out = st.client.submit(
+                    req.prompt, max_new_tokens=req.max_new_tokens,
+                    temperature=req.temperature,
+                    deadline_s=(req.deadline.remaining()
+                                if req.deadline is not None else None),
+                    priority=req.priority, nonce=req.nonce)
+            except (AdmissionShed, EngineClosed) as e:
+                # the replica refused — rebalance WITHOUT consuming
+                # failover budget (nothing was lost). 503/draining
+                # also updates the health view immediately instead of
+                # waiting out a poll interval. A refusal is still a
+                # RESPONSE: settle the breaker (a half-open probe that
+                # drew a shed must not wedge the breaker half-open —
+                # the breaker judges reachability, health judges load)
+                st.breaker.record_success()
+                if isinstance(e, EngineClosed) or \
+                        getattr(e, "reason", "") == "draining":
+                    st.health = "draining"
+                req.excluded.add(st.name)
+                self.n_rebalanced += 1
+                self._m["rebalanced"].inc()
+                if dspan is not None:
+                    dspan.set_attr("verdict", "shed")
+                    dspan.set_status("error").end()
+                continue
+            except (ReplicaUnavailable, _faults.FaultInjected) as e:
+                # the crash path: charge the breaker, fail over with
+                # the SAME nonce while budget remains
+                st.breaker.record_failure()
+                st.health = "unreachable"
+                req.excluded.add(st.name)
+                if dspan is not None:
+                    dspan.set_attr("verdict", "unavailable")
+                    dspan.set_status("error").end()
+                if req.failovers >= self.failover_budget:
+                    self._resolve(req, exc=ReplicaUnavailable(
+                        f"request {req.nonce} lost replica {st.name} "
+                        f"and exhausted its failover budget "
+                        f"({self.failover_budget})"),
+                        outcome="unavailable")
+                    return
+                req.failovers += 1
+                self.n_failovers += 1
+                self._m["failovers"].inc()
+                continue
+            except Exception as e:  # noqa: BLE001 — typed + terminal
+                # the replica answered (504/499/400 are verdicts, not
+                # crashes): settle the breaker like any response
+                st.breaker.record_success()
+                if dspan is not None:
+                    dspan.set_attr("verdict", type(e).__name__)
+                    dspan.set_status("error").end()
+                outcome = ("deadline"
+                           if isinstance(e, DeadlineExceeded)
+                           else "cancelled"
+                           if isinstance(e, RequestCancelled)
+                           else "error")
+                self._resolve(req, exc=e, outcome=outcome)
+                return
+            finally:
+                with self._mu:
+                    st.inflight -= 1
+                self._m["inflight"].labels(st.name).set(st.inflight)
+            st.breaker.record_success()
+            if dspan is not None:
+                dspan.set_attr("verdict", "ok").end()
+            if req.cancelled:
+                # cancelled while the replica was generating: the
+                # tokens are discarded, the promise is kept
+                self._resolve(req, exc=RequestCancelled(
+                    f"request {req.nonce} cancelled at the router"),
+                    outcome="cancelled")
+                return
+            out["replica"] = st.name
+            out["failovers"] = req.failovers
+            out["request_id"] = req.nonce
+            self._resolve(req, result=out)
+            return
+
+    # -- observability surfaces ---------------------------------------------
+    def _status(self) -> Optional[dict]:
+        if self._closed:
+            return None
+        with self._mu:
+            states = list(self._replicas.values())
+            tenants = dict(self._tenant_inflight)
+        return {
+            "policy": self.policy,
+            "submitted": self.n_submitted,
+            "failovers": self.n_failovers,
+            "rebalanced": self.n_rebalanced,
+            "shed": self.n_shed,
+            "tenant_inflight": tenants,
+            "replicas": {st.name: {
+                "health": st.health,
+                "breaker": st.breaker.state,
+                "breaker_opens": st.breaker.n_opens,
+                "inflight": st.inflight,
+                "dispatched": st.dispatched,
+                "from_membership": st.from_membership,
+            } for st in states},
+        }
+
+    def _aggregate_health(self) -> Optional[str]:
+        if self._closed:
+            return None
+        with self._mu:
+            states = list(self._replicas.values())
+        routable = [st for st in states
+                    if st.health != "draining"
+                    and st.breaker.state != "open"]
+        if not routable:
+            return "draining"
+        if len(routable) < len(states):
+            return "degraded"
+        return "healthy"
+
+    # -- lifecycle ----------------------------------------------------------
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        _dbgsrv.unregister_status_provider(self._status_name)
+        _dbgsrv.unregister_health_provider(self._status_name)
+        _dbgsrv.unregister_reset_handler(self._status_name)
+        self._stop.set()
+        self._poller.join(timeout=10)
+        # in-flight dispatches run to completion and resolve their
+        # futures; new submits are already refused
+        self._pool.shutdown(wait=True)
+        with self._mu:
+            leftovers = list(self._by_id.values())
+        for req in leftovers:
+            self._resolve(req, exc=EngineClosed("router closed"),
+                          outcome="closed")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -190,6 +190,129 @@ def test_batcher_threaded_clients_all_served():
     assert b.n_device_calls < 16  # coalescing actually happened
 
 
+# ---- serve_llm error-mapping contract over real HTTP (ISSUE 6)
+#
+# The fleet router routes on these exact status codes; pinning them
+# here keeps the engine front and the HTTPReplica client in lockstep:
+# shed/queue-full → 429, draining → 503, deadline → 504, cancel → 499.
+
+
+import json as _json
+from urllib.error import HTTPError
+from urllib.request import Request, urlopen
+
+
+@pytest.fixture(scope="module")
+def llm_http():
+    """One tiny engine behind serve_llm, shared by the mapping tests
+    (each test restores any engine state it pokes)."""
+    from paddle_tpu.inference.llm import serve_llm
+    from paddle_tpu.serving.replica import make_engine_from_spec
+    eng = make_engine_from_spec({"vocab": 97, "layers": 2,
+                                 "hidden": 64})
+    eng.submit([1, 2, 3], max_new_tokens=2).result(timeout=300)  # warm
+    srv = serve_llm(eng)
+    host, port = srv.server_address[:2]
+    yield eng, f"http://{host}:{port}"
+    srv.shutdown()
+    eng.close()
+
+
+def _post(base, path, body):
+    req = Request(base + path, data=_json.dumps(body).encode(),
+                  headers={"Content-Type": "application/json"})
+    try:
+        with urlopen(req, timeout=120) as r:
+            return r.status, _json.loads(r.read())
+    except HTTPError as e:
+        return e.code, _json.loads(e.read())
+
+
+def test_serve_llm_ok_carries_request_id(llm_http):
+    _, base = llm_http
+    code, out = _post(base, "/generate",
+                      {"prompt_ids": [4, 5, 6], "max_new_tokens": 3})
+    assert code == 200
+    assert len(out["output_ids"]) == 3
+    assert isinstance(out["request_id"], int)
+
+
+def test_serve_llm_shed_maps_to_429(llm_http):
+    eng, base = llm_http
+    saved = eng.max_pending
+    eng.max_pending = 0          # every submission is queue overflow
+    try:
+        code, out = _post(base, "/generate", {"prompt_ids": [1, 2]})
+    finally:
+        eng.max_pending = saved
+    assert code == 429, (code, out)
+    assert out["outcome"] == "shed" and out["reason"] == "queue_full"
+
+
+def test_serve_llm_draining_maps_to_503(llm_http):
+    eng, base = llm_http
+    eng._health = "draining"     # the sticky latch, forced
+    try:
+        code, out = _post(base, "/generate", {"prompt_ids": [1, 2]})
+    finally:
+        eng.reset_health()
+    assert code == 503, (code, out)
+    assert out["outcome"] == "shed" and out["reason"] == "draining"
+    assert eng.health == "healthy"
+
+
+def test_serve_llm_deadline_maps_to_504(llm_http):
+    _, base = llm_http
+    code, out = _post(base, "/generate",
+                      {"prompt_ids": [1, 2, 3], "deadline_s": -1.0})
+    assert code == 504, (code, out)
+    assert out["outcome"] == "deadline"
+
+
+def test_serve_llm_cancel_maps_to_499(llm_http):
+    eng, base = llm_http
+    res = {}
+
+    def client():
+        res["resp"] = _post(base, "/generate",
+                            {"prompt_ids": [7, 8, 9, 10],
+                             "max_new_tokens": 80})
+
+    t = threading.Thread(target=client)
+    t.start()
+    deadline = time.time() + 60
+    rid = None
+    while time.time() < deadline and rid is None:
+        ids = list(eng._by_id)
+        rid = ids[0] if ids else None
+        time.sleep(0.005)
+    assert rid is not None, "request never reached the engine"
+    code, out = _post(base, "/cancel", {"request_id": rid})
+    assert code == 200 and out["cancelled"] is True
+    t.join(timeout=120)
+    code, out = res["resp"]
+    assert code == 499, (code, out)
+    assert out["outcome"] == "cancelled"
+    # cancelling a resolved request reports False, not an error
+    code, out = _post(base, "/cancel", {"request_id": rid})
+    assert code == 200 and out["cancelled"] is False
+
+
+def test_serve_llm_nonce_passthrough_pins_stream(llm_http):
+    _, base = llm_http
+    body = {"prompt_ids": [11, 12, 13, 14], "max_new_tokens": 5,
+            "temperature": 0.9, "nonce": 4242}
+    _, out1 = _post(base, "/generate", body)
+    _, out2 = _post(base, "/generate", body)
+    assert out1["output_ids"] == out2["output_ids"]
+
+
+def test_serve_llm_bad_request_maps_to_400(llm_http):
+    _, base = llm_http
+    code, out = _post(base, "/generate", {"prompt_ids": []})
+    assert code == 400 and "error" in out
+
+
 # ---- real-plugin concurrency (skip-on-busy, like test_inference_native)
 
 

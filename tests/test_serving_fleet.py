@@ -1,0 +1,514 @@
+"""Serving fleet tests: circuit breaker, prefix-affinity routing,
+failover, quotas/SLO, TCPStore membership, and token-identical
+cross-replica retry (ISSUE 6 tentpole).
+
+Stub replicas cover the router's control plane without compiles; one
+real two-engine fleet at the end pins the exactness property the whole
+failover story rests on (same weights + seed + nonce → same stream)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.inference.llm import AdmissionShed, RequestCancelled
+from paddle_tpu.serving import (CircuitBreaker, LocalReplica,
+                                ReplicaUnavailable, Router, SLOClass,
+                                TenantQuota)
+from paddle_tpu.serving.router import affinity_key, rendezvous_pick
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker
+# ---------------------------------------------------------------------------
+
+
+def test_breaker_trips_after_threshold_and_recovers():
+    t = [0.0]
+    b = CircuitBreaker(fail_threshold=3, open_for=5.0,
+                       half_open_probes=1, clock=lambda: t[0])
+    assert b.state == "closed" and b.allow()
+    b.record_failure()
+    b.record_failure()
+    assert b.state == "closed"          # under threshold
+    b.record_failure()
+    assert b.state == "open" and not b.allow()
+    t[0] = 4.9
+    assert not b.allow()                # cooldown not over
+    t[0] = 5.0
+    assert b.state == "half_open"
+    assert b.allow()                    # the single probe
+    assert not b.allow()                # probe budget spent
+    b.record_success()
+    assert b.state == "closed" and b.allow()
+    assert b.n_opens == 1
+
+
+def test_breaker_failed_probe_reopens_with_fresh_cooldown():
+    t = [0.0]
+    b = CircuitBreaker(fail_threshold=1, open_for=2.0,
+                       clock=lambda: t[0])
+    b.record_failure()
+    t[0] = 2.5
+    assert b.allow()
+    b.record_failure()                  # probe failed
+    assert b.state == "open"
+    t[0] = 4.0                          # 1.5s into the NEW cooldown
+    assert not b.allow()
+    t[0] = 4.6
+    assert b.allow()
+    b.record_success()
+    assert b.state == "closed"
+    assert b.n_opens == 2
+
+
+def test_breaker_success_resets_failure_streak():
+    b = CircuitBreaker(fail_threshold=2)
+    b.record_failure()
+    b.record_success()
+    b.record_failure()
+    assert b.state == "closed"          # streak broken, never tripped
+
+
+def test_breaker_reset_forces_closed():
+    b = CircuitBreaker(fail_threshold=1, open_for=1e9)
+    b.record_failure()
+    assert b.state == "open"
+    b.reset()
+    assert b.state == "closed" and b.allow()
+
+
+# ---------------------------------------------------------------------------
+# affinity key + rendezvous hashing
+# ---------------------------------------------------------------------------
+
+
+def test_affinity_key_commits_to_prefix_not_tail():
+    prefix = list(range(32))            # 2 full pages at page_size 16
+    k1 = affinity_key(prefix + [1, 2, 3], 16, 2)
+    k2 = affinity_key(prefix + list(range(40, 90)), 16, 2)
+    assert k1 == k2                     # same first-2-pages family
+    k3 = affinity_key([7] + prefix[1:] + [1, 2, 3], 16, 2)
+    assert k3 != k1                     # different history → new family
+
+
+def test_affinity_key_short_prompt_hashes_tokens():
+    assert affinity_key([1, 2, 3], 16, 2) == \
+        affinity_key([1, 2, 3], 16, 2)
+    assert affinity_key([1, 2, 3], 16, 2) != \
+        affinity_key([1, 2, 4], 16, 2)
+
+
+def test_rendezvous_stability_under_membership_churn():
+    names = ["r0", "r1", "r2", "r3"]
+    rng = np.random.RandomState(0)
+    keys = [bytes(rng.bytes(16)) for _ in range(64)]
+    before = {k: rendezvous_pick(k, names) for k in keys}
+    gone = "r2"
+    after = {k: rendezvous_pick(k, [n for n in names if n != gone])
+             for k in keys}
+    moved = [k for k in keys if before[k] != after[k]]
+    # ONLY keys that preferred the removed name remap
+    assert all(before[k] == gone for k in moved)
+    assert any(before[k] == gone for k in keys)
+
+
+def test_rendezvous_spreads_keys():
+    names = ["r0", "r1", "r2"]
+    rng = np.random.RandomState(1)
+    picks = {rendezvous_pick(bytes(rng.bytes(16)), names)
+             for _ in range(64)}
+    assert picks == set(names)
+
+
+# ---------------------------------------------------------------------------
+# router over stub replicas (no compiles)
+# ---------------------------------------------------------------------------
+
+
+class StubReplica:
+    """Scriptable replica: fail the first ``fail_n`` submits, shed
+    while ``drain`` is set, else echo. Records every submit kwargs."""
+
+    def __init__(self, fail_n=0, drain=False, block=None,
+                 healthy=True):
+        self.fail_n = fail_n
+        self.drain = drain
+        self.block = block              # threading.Event to wait on
+        self.healthy = healthy
+        self.calls = []
+        self._mu = threading.Lock()
+
+    def submit(self, prompt_ids, **kw):
+        with self._mu:
+            self.calls.append(dict(kw, prompt_ids=list(prompt_ids)))
+            if self.fail_n > 0:
+                self.fail_n -= 1
+                raise ReplicaUnavailable("injected crash")
+        if self.drain:
+            raise AdmissionShed("draining", reason="draining")
+        if self.block is not None:
+            assert self.block.wait(timeout=30)
+        return {"output_ids": [1] * kw.get("max_new_tokens", 1),
+                "prompt_ids": list(prompt_ids)}
+
+    def health(self):
+        if not self.healthy:
+            return None
+        return "draining" if self.drain else "healthy"
+
+    def cancel(self, request_id):
+        return False
+
+    def close(self):
+        pass
+
+
+def mk_router(replicas, **kw):
+    kw.setdefault("health_poll_interval", 0.05)
+    kw.setdefault("breaker_open_for", 0.2)
+    return Router(replicas, **kw)
+
+
+def prompt_for(target, names, length=6, seed=0):
+    """A prompt whose affinity preference is ``target`` (rejection-
+    sampled, deterministic) — stub tests that script one replica's
+    behavior need traffic that actually prefers it."""
+    rng = np.random.RandomState(seed)
+    while True:
+        p = rng.randint(0, 97, length).tolist()
+        if rendezvous_pick(affinity_key(p, 16, 2), names) == target:
+            return p
+
+
+def test_router_routes_and_pins_unique_nonces():
+    stubs = {f"r{i}": StubReplica() for i in range(3)}
+    with mk_router(stubs) as r:
+        outs = [r.submit([i, 50 + i, 90 - i], max_new_tokens=3)
+                .result(timeout=30) for i in range(9)]
+    assert all(o["output_ids"] == [1, 1, 1] for o in outs)
+    assert all(o["replica"] in stubs and o["failovers"] == 0
+               for o in outs)
+    nonces = [kw["nonce"] for s in stubs.values() for kw in s.calls]
+    assert len(nonces) == 9 and len(set(nonces)) == 9
+
+
+def test_router_same_prefix_colocates():
+    stubs = {f"r{i}": StubReplica() for i in range(3)}
+    prefix = list(range(40))
+    with mk_router(stubs) as r:
+        outs = [r.submit(prefix + [100 + i], max_new_tokens=1)
+                .result(timeout=30) for i in range(6)]
+    assert len({o["replica"] for o in outs}) == 1
+
+
+def test_router_failover_within_budget_same_nonce():
+    flaky = StubReplica(fail_n=1)
+    backup = StubReplica()
+    with mk_router({"a": flaky, "b": backup},
+                   failover_budget=2) as r:
+        out = r.submit(prompt_for("a", ("a", "b")),
+                       max_new_tokens=2).result(timeout=30)
+        assert out["output_ids"] == [1, 1]
+        assert out["failovers"] == 1
+        assert r.n_failovers == 1
+    # the re-submission carried the SAME nonce — token identity's
+    # control-plane half
+    failed = flaky.calls[0]["nonce"]
+    assert any(kw["nonce"] == failed for kw in backup.calls)
+
+
+def test_router_failover_budget_exhaustion_is_typed():
+    stubs = {f"r{i}": StubReplica(fail_n=99) for i in range(3)}
+    with mk_router(stubs, failover_budget=1) as r:
+        fut = r.submit([1, 2, 3])
+        with pytest.raises(ReplicaUnavailable):
+            fut.result(timeout=30)
+
+
+def test_router_draining_rebalance_and_no_new_admissions():
+    draining = StubReplica(drain=True)
+    ok = StubReplica()
+    with mk_router({"a": draining, "b": ok}) as r:
+        outs = [r.submit([i, i, i]).result(timeout=30)
+                for i in range(4)]
+        assert all(o["replica"] == "b" for o in outs)
+        # draining never consumed failover budget
+        assert r.n_failovers == 0 and r.n_rebalanced >= 1
+        first_wave = len(draining.calls)
+        time.sleep(0.15)                # > one poll interval
+        for i in range(4):
+            r.submit([9, i, 9]).result(timeout=30)
+        assert len(draining.calls) == first_wave, (
+            "a draining replica received new admissions")
+
+
+def test_router_all_unroutable_sheds_typed():
+    with mk_router({"a": StubReplica(drain=True),
+                    "b": StubReplica(drain=True)}) as r:
+        fut = r.submit([1, 2])
+        with pytest.raises(AdmissionShed) as ei:
+            fut.result(timeout=30)
+    assert ei.value.reason in ("draining", "queue_full")
+
+
+def test_router_tenant_quota_and_slo_mapping():
+    gate = threading.Event()
+    stub = StubReplica(block=gate)
+    slos = {"interactive": SLOClass("interactive", deadline_s=30.0,
+                                    priority=5)}
+    tenants = {"acme": TenantQuota(max_inflight=1, slo="interactive")}
+    with mk_router({"a": stub}, slo_classes=slos,
+                   tenants=tenants) as r:
+        f1 = r.submit([1, 2, 3], tenant="acme")
+        # wait until the first request is ON the replica
+        deadline = time.time() + 10
+        while not stub.calls and time.time() < deadline:
+            time.sleep(0.01)
+        f2 = r.submit([4, 5, 6], tenant="acme")   # over quota
+        with pytest.raises(AdmissionShed):
+            f2.result(timeout=30)
+        gate.set()
+        assert f1.result(timeout=30)["output_ids"]
+        # SLO class mapped onto the engine's machinery
+        kw = stub.calls[0]
+        assert kw["priority"] == 5
+        assert kw["deadline_s"] is not None and kw["deadline_s"] <= 30.0
+        # quota slot released → next request admitted
+        assert r.submit([7, 8], tenant="acme").result(timeout=30)
+
+
+def test_router_cancel_between_attempts():
+    gate = threading.Event()
+    stub = StubReplica(block=gate)
+    with mk_router({"a": stub}, max_workers=1) as r:
+        f1 = r.submit([1, 2, 3])        # occupies the only worker
+        deadline = time.time() + 10
+        while not stub.calls and time.time() < deadline:
+            time.sleep(0.01)
+        f2 = r.submit([4, 5, 6])        # queued behind f1
+        assert r.cancel(f2.request_id)
+        gate.set()
+        assert f1.result(timeout=30)["output_ids"]
+        with pytest.raises(RequestCancelled):
+            f2.result(timeout=30)
+        assert not r.cancel(f2.request_id)   # already resolved
+
+
+def test_router_breaker_opens_then_health_probe_recloses():
+    stub = StubReplica(fail_n=99, healthy=False)
+    backup = StubReplica()
+    with mk_router({"a": stub, "b": backup}, failover_budget=2,
+                   breaker_fail_threshold=2,
+                   breaker_open_for=0.15) as r:
+        for i in range(3):
+            r.submit(prompt_for("a", ("a", "b"), seed=i)
+                     ).result(timeout=30)
+        st = r._status()["replicas"]["a"]
+        assert st["breaker"] == "open", st
+        # replica "recovers": health polls become the half-open probes
+        stub.healthy = True
+        stub.fail_n = 0
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if r._status()["replicas"]["a"]["breaker"] == "closed":
+                break
+            time.sleep(0.02)
+        assert r._status()["replicas"]["a"]["breaker"] == "closed"
+        assert r._aggregate_health() == "healthy"
+
+
+def test_router_half_open_probe_settles_on_shed_verdict():
+    """A half-open probe that draws a REFUSAL (shed) must settle the
+    breaker — a refusal proves the replica is reachable. Regression:
+    the probe slot leaked, wedging the breaker half-open forever (no
+    traffic, and polls skipped by the spent probe budget)."""
+    stub = StubReplica(fail_n=2, healthy=False)
+    backup = StubReplica()
+    # poll interval long enough that TRAFFIC, not the poller, consumes
+    # the half-open probe
+    with mk_router({"a": stub, "b": backup}, failover_budget=2,
+                   breaker_fail_threshold=2, breaker_open_for=0.1,
+                   health_poll_interval=30.0) as r:
+        for i in range(2):
+            r.submit(prompt_for("a", ("a", "b"), seed=i)
+                     ).result(timeout=30)
+        assert r._status()["replicas"]["a"]["breaker"] == "open"
+        time.sleep(0.15)                # cooldown → half-open
+        stub.fail_n = 0
+        stub.drain = True               # reachable, but refusing
+        r.submit(prompt_for("a", ("a", "b"), seed=7)
+                 ).result(timeout=30)   # rebalances to b
+        assert r._status()["replicas"]["a"]["breaker"] == "closed", (
+            "shed probe wedged the breaker: "
+            f"{r._status()['replicas']['a']}")
+
+
+def test_router_engine_closed_rebalances_budget_free():
+    """A replica whose engine is shutting down answers EngineClosed;
+    the router must treat it like draining (rebalance, no failover
+    budget, no client error)."""
+    from paddle_tpu.inference.llm import EngineClosed
+
+    class ClosingStub(StubReplica):
+        def submit(self, prompt_ids, **kw):
+            raise EngineClosed("engine closed")
+
+    with mk_router({"a": ClosingStub(), "b": StubReplica()},
+                   failover_budget=0) as r:
+        out = r.submit(prompt_for("a", ("a", "b"))).result(timeout=30)
+        assert out["replica"] == "b" and out["failovers"] == 0
+        assert r.n_rebalanced >= 1
+        assert r._status()["replicas"]["a"]["health"] == "draining"
+
+
+def test_router_reset_breakers_via_http():
+    import json
+    from urllib.request import Request, urlopen
+    from paddle_tpu.observability.server import DebugServer
+    stub = StubReplica(fail_n=99, healthy=False)
+    with mk_router({"a": stub}, breaker_fail_threshold=1,
+                   breaker_open_for=1e9) as r:
+        with pytest.raises(Exception):
+            r.submit([1]).result(timeout=30)
+        assert r._status()["replicas"]["a"]["breaker"] == "open"
+        # the replica "recovers" BEFORE the operator reset, so the
+        # health poller can't immediately re-trip the breaker
+        stub.healthy = True
+        stub.fail_n = 0
+        with DebugServer(port=0) as srv:
+            req = Request(f"http://127.0.0.1:{srv.port}/reset_health",
+                          data=b"{}")
+            with urlopen(req, timeout=10) as resp:
+                body = json.loads(resp.read())
+        assert any(n.startswith("router") for n in body["reset"])
+        assert r._status()["replicas"]["a"]["breaker"] == "closed"
+
+
+def test_reset_health_404_when_nothing_registered(monkeypatch):
+    from urllib.error import HTTPError
+    from urllib.request import Request, urlopen
+    from paddle_tpu.observability import server as dbgsrv
+    monkeypatch.setattr(dbgsrv, "_reset_handlers", {})
+    with dbgsrv.DebugServer(port=0) as srv:
+        req = Request(f"http://127.0.0.1:{srv.port}/reset_health",
+                      data=b"{}")
+        with pytest.raises(HTTPError) as ei:
+            urlopen(req, timeout=10)
+        assert ei.value.code == 404
+
+
+# ---------------------------------------------------------------------------
+# TCPStore membership
+# ---------------------------------------------------------------------------
+
+
+def test_membership_roster_and_staleness():
+    from paddle_tpu.distributed.tcp_store import (TCPMembership,
+                                                  TCPStoreClient,
+                                                  TCPStoreServer)
+    srv = TCPStoreServer("127.0.0.1", 0)
+    try:
+        endpoint = f"127.0.0.1:{srv.port}"
+        client = TCPStoreClient(endpoint)
+        m1 = TCPMembership(endpoint, "r0", {"generate": "u0"},
+                           beat_interval=0.05)
+        m2 = TCPMembership(endpoint, "r1", {"generate": "u1"},
+                           beat_interval=0.05)
+        roster = TCPMembership.list_members(client, stale_after=1.0)
+        assert set(roster) == {"r0", "r1"}
+        assert roster["r0"]["generate"] == "u0"
+        m2.stop()                       # stops heartbeating
+        time.sleep(0.4)
+        roster = TCPMembership.list_members(client, stale_after=0.2)
+        assert set(roster) == {"r0"}, roster
+        # re-registration under the same name replaces the info
+        m2b = TCPMembership(endpoint, "r1", {"generate": "u1-new"},
+                            beat_interval=0.05)
+        roster = TCPMembership.list_members(client, stale_after=1.0)
+        assert roster["r1"]["generate"] == "u1-new"
+        m1.stop()
+        m2b.stop()
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# real engines: the exactness property failover rests on
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def fleet_pair():
+    from paddle_tpu.serving.replica import make_engine_from_spec
+    spec = {"vocab": 97, "layers": 2, "hidden": 64}
+    engines = [make_engine_from_spec(spec) for _ in range(2)]
+    yield engines
+    for e in engines:
+        e.close()
+
+
+class FlakyOnce:
+    """LocalReplica that dies on its first submit — the in-process
+    stand-in for a replica crash mid-request."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.tripped = False
+
+    def submit(self, *a, **kw):
+        if not self.tripped:
+            self.tripped = True
+            raise ReplicaUnavailable("simulated crash")
+        return self.inner.submit(*a, **kw)
+
+    def health(self):
+        return self.inner.health()
+
+    def cancel(self, rid):
+        return self.inner.cancel(rid)
+
+    def close(self):
+        pass
+
+
+def test_failover_is_token_identical_across_real_replicas(fleet_pair):
+    engA, engB = fleet_pair
+    flaky = FlakyOnce(LocalReplica(engA))
+    prompt = prompt_for("a", ("a", "b"), length=15)
+    with mk_router({"a": flaky, "b": LocalReplica(engB)},
+                   failover_budget=2) as r:
+        # desynchronize replica B's internal nonce counter: identity
+        # must come from the PINNED nonce, not from matching counters
+        engB.submit([3, 1, 4], max_new_tokens=2,
+                    temperature=0.5).result(timeout=120)
+        out = r.submit(prompt, max_new_tokens=8,
+                       temperature=0.9).result(timeout=120)
+    assert out["failovers"] == 1
+    # the reference: what a healthy replica produces for this
+    # (prompt, nonce) — the failover'd stream must be identical
+    ref = engA.submit(prompt, max_new_tokens=8, temperature=0.9,
+                      nonce=out["request_id"]).result(timeout=120)
+    assert ref["output_ids"] == out["output_ids"]
+
+
+def test_engine_nonce_pinning_is_schedule_independent(fleet_pair):
+    engA, engB = fleet_pair
+    prompt = list(range(30, 42))
+    a = engA.submit(prompt, max_new_tokens=6, temperature=0.8,
+                    nonce=12345).result(timeout=120)
+    for i in range(3):                  # different scheduler history
+        engB.submit([i, i + 1], max_new_tokens=2,
+                    temperature=0.3).result(timeout=120)
+    b = engB.submit(prompt, max_new_tokens=6, temperature=0.8,
+                    nonce=12345).result(timeout=120)
+    assert a["output_ids"] == b["output_ids"]
+
+
+def test_engine_rejects_out_of_range_nonce(fleet_pair):
+    engA, _ = fleet_pair
+    with pytest.raises(ValueError):
+        engA.submit([1, 2], nonce=2 ** 31)
+    with pytest.raises(ValueError):
+        engA.submit([1, 2], nonce=-1)

@@ -23,6 +23,15 @@ docs/RELIABILITY.md):
 4. FLIGHT-RECORDER ESCALATION — a chaos-injected ``io.worker`` fault
    inside ``Model.fit`` escalates to a process crash; the PR-4 flight
    recorder must leave a JSONL dump naming the injected fault.
+5. FLEET SOAK (``--fleet``) — a router over K=3 spawned replica
+   subprocesses (TCPStore membership): an injected device-fault streak
+   drains one replica (router stops admitting to it within a poll
+   interval; POST /reset_health recovers it), a SIGKILL mid-decode
+   loses ZERO requests (failover re-submits with the same nonce —
+   token-identical streams, checked against a reference engine), the
+   killed replica's breaker walks open → half-open → closed across a
+   respawn, and an injected ``router.dispatch`` fault replays from its
+   seed like any other site.
 
 Determinism: every schedule is nth/probability-based with a fixed
 seed; ``faults.preview(site, N)`` recomputes the faulting call
@@ -31,6 +40,10 @@ equals that schedule.
 
 Run:  python tools/chaos_soak.py            # full soak (default seed)
 CI:   python tools/chaos_soak.py --ci       # fixed seeds, ~30s budget
+      python tools/chaos_soak.py --ci --fleet   # replica-kill soak,
+                                                # ≤45s budget
+Any assertion failure prints the fault seed and the one-line replay
+command, so a red CI run reproduces in one copy-paste.
 """
 
 from __future__ import annotations
@@ -41,6 +54,7 @@ import os
 import signal
 import subprocess
 import sys
+import threading
 import time
 from concurrent.futures import wait as fut_wait
 
@@ -327,6 +341,227 @@ raise SystemExit("unreachable: the injected fault must escalate")
     return {"dump": dumps[0], "rows": len(rows)}
 
 
+def _poll_until(fn, timeout: float, what: str):
+    """Poll ``fn`` (returns falsy to keep waiting) with a bounded
+    budget; returns its first truthy value."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = fn()
+        if v:
+            return v
+        time.sleep(0.05)
+    raise AssertionError(f"timed out ({timeout}s) waiting for {what}")
+
+
+def fleet_soak(seed: int, workdir: str) -> dict:
+    """Scenario 5: the serving fleet under replica-level chaos.
+    Asserts the ISSUE-6 acceptance invariants: zero lost requests
+    across a SIGKILL (token-identical failover within budget), breaker
+    open → half-open → closed across a respawn, draining replicas
+    receiving no new admissions within one health-poll interval, and
+    seed-replayable router fault sites."""
+    from paddle_tpu.distributed.tcp_store import TCPStoreServer
+    from paddle_tpu.reliability import faults
+    from paddle_tpu.serving import (LocalReplica, Router,
+                                    make_engine_from_spec,
+                                    spawn_replica)
+    from paddle_tpu.serving.router import affinity_key, rendezvous_pick
+
+    rng = np.random.RandomState(seed)
+    faults.reset()
+    store = TCPStoreServer("127.0.0.1", 0)
+    endpoint = f"127.0.0.1:{store.port}"
+    model = {"vocab": 97, "layers": 2, "hidden": 64, "heads": 4,
+             "max_pos": 96, "model_seed": 0}
+    engine_kw = {"device_retry_budget": 2, "drain_after": 2,
+                 "max_pending": 64, "seed": 0}
+    names = ("r0", "r1", "r2")
+    cache_dir = os.path.join(workdir, "xla_cache")
+    os.makedirs(cache_dir, exist_ok=True)
+    specs = {n: dict(model, name=n, store=endpoint,
+                     cache_dir=cache_dir,
+                     engine=dict(engine_kw)) for n in names}
+    # r2's schedule: dispatch calls 3 and 4 fault back-to-back — two
+    # CONSECUTIVE device errors at drain_after=2 latch it DRAINING
+    # while its first request is in flight (the request survives via
+    # the engine retry budget → draining shed → router rebalance)
+    specs["r2"]["faults"] = {"seed": seed, "rules": [
+        {"site": "device.dispatch", "nth": [3, 4]}]}
+
+    procs, infos = {}, {}
+
+    def _spawn(name):
+        procs[name], infos[name] = spawn_replica(specs[name],
+                                                 timeout=180)
+
+    # STAGGERED spawn: r0 comes up alone and serves one warm request,
+    # populating the shared persistent compile cache; r1/r2 (and the
+    # parent's reference engine) then hit its artifacts instead of
+    # compiling the same programs 3x on a contended host
+    _spawn("r0")
+    from paddle_tpu.serving import HTTPReplica
+    HTTPReplica(infos["r0"]["generate"],
+                infos["r0"]["healthz"]).submit([1, 2, 3],
+                                               max_new_tokens=2)
+    threads = [threading.Thread(target=_spawn, args=(n,))
+               for n in ("r1", "r2")]
+    for t in threads:
+        t.start()
+    # the reference engine (same weights/seed as every replica)
+    # replays failover'd requests to pin token identity; it reads the
+    # same compile cache
+    import jax
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      0.0)
+    ref = LocalReplica(make_engine_from_spec(dict(model,
+                                                  engine=engine_kw)))
+    ref_warm = threading.Thread(
+        target=lambda: ref.submit([1, 2, 3], max_new_tokens=1))
+    ref_warm.start()
+    for t in threads:
+        t.join(timeout=240)
+    assert set(infos) == set(names), f"replicas failed to spawn: " \
+        f"{sorted(set(names) - set(infos))}"
+
+    router = Router(store_endpoint=endpoint, page_size=16,
+                    affinity_pages=2, failover_budget=2,
+                    health_poll_interval=0.2,
+                    membership_stale_after=1.5,
+                    breaker_fail_threshold=3, breaker_open_for=1.0)
+    out = {"spawn_ok": True}
+    try:
+        _poll_until(lambda: set(router.replica_names()) == set(names),
+                    30, "membership convergence to 3 replicas")
+
+        def affine_prompt(target, length):
+            # rejection-sample a prompt whose rendezvous choice is
+            # `target` (deterministic from the run's RandomState)
+            while True:
+                p = rng.randint(0, 97, length).tolist()
+                key = affinity_key(p, router.page_size,
+                                   router.affinity_pages)
+                if rendezvous_pick(key, names) == target:
+                    return p
+
+        def status(name):
+            return router._status()["replicas"][name]
+
+        # -- phase A: injected faults drain r2; the router rebalances.
+        # One request per replica, concurrently: r0/r1 warm their
+        # compiles while r2's request trips its fault schedule
+        warm = [router.submit(affine_prompt(n, 12), max_new_tokens=8,
+                              temperature=0.9) for n in names]
+        for f in warm:
+            assert f.result(timeout=240)["output_ids"]
+        _poll_until(lambda: status("r2")["health"] == "draining", 10,
+                    "router marking r2 draining")
+        d2 = status("r2")["dispatched"]
+        time.sleep(2 * router.health_poll_interval)
+        futs = [router.submit(affine_prompt("r2", 12),
+                              max_new_tokens=8) for _ in range(2)]
+        for f in futs:
+            assert f.result(timeout=240)["output_ids"]
+        assert status("r2")["dispatched"] == d2, (
+            "a draining replica received new admissions: "
+            f"{status('r2')}")
+        out["drain"] = {"rebalanced": router.n_rebalanced}
+        assert router.n_rebalanced >= 1, router._status()
+
+        # -- phase A2: POST /reset_health recovers r2 over HTTP
+        from urllib.request import Request, urlopen
+        base = infos["r2"]["healthz"].rsplit("/healthz", 1)[0]
+        with urlopen(Request(base + "/reset_health", data=b"{}"),
+                     timeout=10) as resp:
+            assert resp.status == 200, resp.status
+        _poll_until(lambda: status("r2")["health"] == "healthy", 10,
+                    "r2 healthy after /reset_health")
+        f = router.submit(affine_prompt("r2", 12), max_new_tokens=8)
+        assert f.result(timeout=240)["output_ids"]
+        assert status("r2")["dispatched"] > d2, (
+            "recovered replica got no traffic back: "
+            f"{status('r2')}")
+
+        # -- phase B: SIGKILL r0 mid-decode — zero lost requests,
+        # token-identical failover, breaker opens
+        prompts = [affine_prompt("r0", 16) for _ in range(4)]
+        futs = [router.submit(p, max_new_tokens=32, temperature=0.9)
+                for p in prompts]
+        _poll_until(lambda: status("r0")["inflight"] > 0, 60,
+                    "r0 taking traffic before the kill")
+        os.kill(procs["r0"].pid, signal.SIGKILL)
+        procs["r0"].wait(timeout=30)
+        # respawn starts NOW, overlapped with the zero-loss and
+        # token-identity checks below (both take seconds — exactly the
+        # boot window)
+        respawned = {}
+
+        def _respawn():
+            respawned["proc"], respawned["info"] = spawn_replica(
+                specs["r0"], timeout=180)
+
+        respawn_t = threading.Thread(target=_respawn)
+        respawn_t.start()
+        # the breaker must trip well before the respawn can re-close
+        # it (health polls hit connection-refused within ~3 intervals)
+        _poll_until(lambda: status("r0")["breaker"] == "open", 15,
+                    "r0 breaker opening after the kill")
+        results = [f.result(timeout=240) for f in futs]
+        assert all(r["output_ids"] for r in results), results
+        flipped = [(p, r) for p, r in zip(prompts, results)
+                   if r["failovers"] > 0]
+        assert flipped, (
+            "SIGKILL mid-decode caused no failover — the kill missed "
+            f"the in-flight window: {[r['replica'] for r in results]}")
+        for p, r in flipped[:2]:
+            ref_out = ref.submit(p, max_new_tokens=32, temperature=0.9,
+                                 nonce=r["request_id"])
+            assert ref_out["output_ids"] == r["output_ids"], (
+                "failover was not token-identical: "
+                f"{ref_out['output_ids']} != {r['output_ids']}")
+        out["kill"] = {"failovers": router.n_failovers,
+                       "failover_requests": len(flipped)}
+
+        # -- phase B2: r0 respawned (same name, new endpoints) — the
+        # breaker must re-close through a half-open probe, and traffic
+        # must return
+        respawn_t.join(timeout=240)
+        assert "proc" in respawned, "r0 respawn failed"
+        procs["r0"], infos["r0"] = respawned["proc"], respawned["info"]
+        _poll_until(lambda: status("r0")["breaker"] == "closed", 30,
+                    "r0 breaker re-closing after respawn")
+        assert status("r0")["breaker_opens"] >= 1
+        d0 = status("r0")["dispatched"]
+        f = router.submit(affine_prompt("r0", 16), max_new_tokens=8)
+        assert f.result(timeout=240)["output_ids"]
+        assert status("r0")["dispatched"] > d0, status("r0")
+        assert router._aggregate_health() == "healthy", \
+            router._status()
+
+        # -- phase C: router-side fault sites replay from the seed
+        faults.enable(seed=seed)
+        faults.inject("router.dispatch", nth=(1,), times=1)
+        futs = [router.submit(affine_prompt("r1", 12),
+                              max_new_tokens=8) for _ in range(2)]
+        for f in futs:
+            assert f.result(timeout=240)["output_ids"]
+        assert ("router.dispatch", 1) in faults.injected_log(), \
+            faults.injected_log()
+        _assert_schedule_matches(faults, ("router.dispatch",))
+        faults.reset()
+        out["router_faults"] = {"injected": 1}
+    finally:
+        faults.reset()
+        router.close()
+        ref.engine.close()
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=30)
+        store.close()
+    return out
+
+
 def _ckpt_worker(directory: str, n_steps: int) -> int:
     """Subprocess body for the SIGKILL scenario: announce, then save —
     the parent kills inside an announced window."""
@@ -345,7 +580,10 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--ci", action="store_true",
                     help="fixed seeds, one pass per scenario "
-                         "(~30s compute budget)")
+                         "(~30s compute budget; ~45s with --fleet)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="run ONLY the fleet scenario (router + K=3 "
+                         "replica subprocesses, SIGKILL mid-decode)")
     ap.add_argument("--seed", type=int, default=1234)
     ap.add_argument("--workdir", default=None)
     ap.add_argument("--ckpt-worker", nargs=2, metavar=("DIR", "STEPS"),
@@ -361,9 +599,21 @@ def main(argv=None) -> int:
 
     t0 = time.monotonic()
     out = {"seed": seed}
-    out["engine"] = engine_soak(seed)
-    out["ckpt"] = ckpt_crash(seed, workdir)
-    out["flight"] = flight_escalation(seed, workdir)
+    try:
+        if args.fleet:
+            out["fleet"] = fleet_soak(seed, workdir)
+        else:
+            out["engine"] = engine_soak(seed)
+            out["ckpt"] = ckpt_crash(seed, workdir)
+            out["flight"] = flight_escalation(seed, workdir)
+    except AssertionError:
+        # make a red CI run reproducible in one copy-paste: the seed
+        # IS the fault schedule (docs/RELIABILITY.md determinism)
+        replay = (f"python tools/chaos_soak.py --seed {seed}"
+                  + (" --fleet" if args.fleet else ""))
+        print(f"CHAOS SOAK FAILED under fault seed {seed}\n"
+              f"replay: {replay}", file=sys.stderr, flush=True)
+        raise
     out["wall_s"] = round(time.monotonic() - t0, 1)
     print("chaos soak OK: " + json.dumps(out))
     return 0

@@ -43,6 +43,19 @@ echo "== chaos soak (seeded fault injection -> hardened semantics)"
 # unreplayable fault schedule, or unrestorable checkpoint
 python tools/chaos_soak.py --ci
 
+echo "== fleet chaos soak (K=3 replicas, SIGKILL mid-decode -> failover)"
+# router + 3 spawned replica subprocesses over TCPStore membership:
+# injected faults drain one replica (no new admissions within a poll
+# interval; POST /reset_health recovers it), SIGKILL mid-decode loses
+# zero requests (token-identical failover), the breaker walks
+# open -> half-open -> closed across a respawn
+python tools/chaos_soak.py --ci --fleet
+
+echo "== fleet serving bench (prefix-affinity vs round-robin at K=3)"
+# asserts aggregate prefix-cache hit rate with affinity routing is
+# >= 1.5x round-robin on the shared-prefix workload
+python tools/llm_bench.py --ci --fleet
+
 echo "== fused train-loop parity smoke (K=1 vs K=4 bit-identical)"
 python tools/train_loop_smoke.py
 

@@ -12,11 +12,21 @@ headline is the fraction of prompt-token recomputation eliminated.
 Everything runs on the CPU backend (recompute savings and cache hit
 rate are device-independent; tpu_sweep.py owns on-chip rounds).
 
+FLEET MODE (``--fleet``): the same shared-prefix observation at K=3
+engine replicas behind the serving router. Routing policy is the
+variable: PREFIX AFFINITY (rendezvous-hash the prompt's first KV-page
+digests to one replica per prefix family) vs ROUND-ROBIN (the naive
+balancer, which dilutes every replica's cache by 1/K). Reports the
+aggregate fleet prefix-cache hit rate per policy; the CI gate asserts
+affinity ≥ 1.5× round-robin (ISSUE 6 acceptance).
+
 Run:    python tools/llm_bench.py [--out BENCH_LLM.jsonl]
+        python tools/llm_bench.py --fleet [--out BENCH_LLM.jsonl]
 CI:     python tools/llm_bench.py --ci
         (tools/ci.sh gate: tiny model, 4 shared-prefix prompts;
         asserts nonzero cache hits, token-identical outputs with the
         cache on vs off, and a clean shutdown)
+        python tools/llm_bench.py --ci --fleet
 """
 
 import argparse
@@ -119,10 +129,138 @@ def run_mode(net, prompts, gen_len, prefix_cache, page_size=16,
     }
 
 
+def make_group_prompts(groups, per_group, prefix_len, tail_len, vocab,
+                       seed=0):
+    """``groups`` prefix families × ``per_group`` requests each: one
+    warm request per family first, then the rest SHUFFLED (seeded) —
+    interleaved arrival is the realistic case, and it also keeps a
+    round-robin balancer from accidentally achieving affinity when
+    the family cycle length divides the replica count."""
+    rng = np.random.RandomState(seed)
+    prefixes = [rng.randint(0, vocab, prefix_len).tolist()
+                for _ in range(groups)]
+    warm = [p + rng.randint(0, vocab, tail_len).tolist()
+            for p in prefixes]
+    burst = [p + rng.randint(0, vocab, tail_len).tolist()
+             for _ in range(per_group - 1) for p in prefixes]
+    rng.shuffle(burst)
+    return warm + burst
+
+
+def run_fleet_mode(net_fn, prompts, gen_len, policy, n_replicas=3,
+                   page_size=16, warm_first=None):
+    """One router pass over the workload at K replicas. The first
+    ``warm_first`` requests (one per prefix family) run to completion
+    before the burst — each family's pages are registered wherever its
+    warm request landed, which is exactly the state the two policies
+    then exploit differently.
+
+    ``net_fn`` builds one net PER replica (identically seeded →
+    identical weights): engines run concurrent traces, and
+    ``functional_call`` temporarily rebinds layer state, so replicas
+    must not share one Layer tree."""
+    from paddle_tpu.inference.llm import LLMEngine
+    from paddle_tpu.serving import LocalReplica, Router
+
+    total = max(len(p) for p in prompts) + gen_len
+    engines = [
+        LLMEngine(net_fn(), max_seqs=4, page_size=page_size,
+                  num_pages=-(-total // page_size) * 4 + 24,
+                  max_len=total,
+                  prefill_buckets=(max(len(p) for p in prompts),),
+                  prefill_chunk=64, prefix_cache=True)
+        for _ in range(n_replicas)]
+    router = Router({f"r{i}": LocalReplica(e)
+                     for i, e in enumerate(engines)},
+                    page_size=page_size, affinity_pages=2,
+                    policy=policy, health_poll_interval=0.1)
+    t0 = time.perf_counter()
+    try:
+        warm_first = warm_first or 0
+        warm, burst = prompts[:warm_first], prompts[warm_first:]
+        outs = [f.result(timeout=600) for f in
+                [router.submit(p, max_new_tokens=gen_len)
+                 for p in warm]]
+        futs = [router.submit(p, max_new_tokens=gen_len)
+                for p in burst]
+        outs += [f.result(timeout=600) for f in futs]
+        wall = time.perf_counter() - t0
+        reused = sum(e.n_cached_tokens for e in engines)
+        prompt_toks = sum(e.n_prompt_tokens for e in engines)
+        per_replica = {f"r{i}": {
+            "prompt_tokens": e.n_prompt_tokens,
+            "cache_hit_tokens": e.n_cached_tokens,
+        } for i, e in enumerate(engines)}
+    finally:
+        router.close()
+        for e in engines:
+            e.close()
+    return outs, {
+        "policy": policy,
+        "replicas": n_replicas,
+        "hit_rate": round(reused / max(1, prompt_toks), 4),
+        "tokens_reused": reused,
+        "prompt_tokens": prompt_toks,
+        "e2e_wall_s": round(wall, 2),
+        "per_replica": per_replica,
+    }
+
+
+def fleet_main(args):
+    if args.ci:
+        def net_fn():
+            return build_net(vocab=97, hidden=64, max_pos=256)
+        groups, per_group = 4, 4
+        prompts = make_group_prompts(groups, per_group, prefix_len=32,
+                                     tail_len=16, vocab=97)
+        gen_len = 8
+    else:
+        net_fn = build_net
+        groups, per_group = 4, 8
+        prompts = make_group_prompts(groups, per_group,
+                                     prefix_len=args.prefix_len,
+                                     tail_len=args.tail_len, vocab=211)
+        gen_len = args.gen_len
+
+    aff_outs, aff = run_fleet_mode(net_fn, prompts, gen_len,
+                                   "affinity", warm_first=groups)
+    rr_outs, rr = run_fleet_mode(net_fn, prompts, gen_len,
+                                 "round_robin", warm_first=groups)
+    ratio = aff["hit_rate"] / max(1e-9, rr["hit_rate"])
+    row = {
+        "metric": "llm_fleet_affinity_hit_ratio",
+        "value": round(ratio, 2),
+        "unit": "affinity_hit_rate_over_round_robin",
+        "device": "cpu",
+        "workload": {"groups": groups, "per_group": per_group,
+                     "prompt_len": len(prompts[0]),
+                     "gen_len": gen_len, "replicas": 3},
+        "affinity": aff,
+        "round_robin": rr,
+    }
+    print(json.dumps(row))
+    if args.out:
+        with open(args.out, "a") as f:
+            f.write(json.dumps(row) + "\n")
+    if args.ci:
+        assert [o["output_ids"] for o in aff_outs] == \
+            [o["output_ids"] for o in rr_outs], \
+            "generations differ across routing policies"
+        assert ratio >= 1.5, (
+            f"prefix-affinity routing must beat round-robin by >=1.5x "
+            f"on aggregate fleet cache hit rate; got "
+            f"{aff['hit_rate']} vs {rr['hit_rate']} ({ratio:.2f}x)")
+        print("LLM FLEET SMOKE OK")
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--ci", action="store_true",
                     help="fast smoke + assertions (tools/ci.sh gate)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="K=3 router benchmark: prefix-affinity vs "
+                         "round-robin aggregate cache hit rate")
     ap.add_argument("--out", default=None,
                     help="append the BENCH row to this JSONL file")
     ap.add_argument("--n-requests", type=int, default=8)
@@ -132,6 +270,9 @@ def main(argv=None):
     ap.add_argument("--tail-len", type=int, default=16)
     ap.add_argument("--gen-len", type=int, default=32)
     args = ap.parse_args(argv)
+
+    if args.fleet:
+        return fleet_main(args)
 
     if args.ci:
         net = build_net(vocab=97, hidden=64, max_pos=256)

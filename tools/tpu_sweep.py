@@ -66,10 +66,23 @@ RUNS = [
 ]
 
 
-def run_one(spec: dict) -> dict:
+def _connect_device():
     import jax
+    return jax.devices()[0]
+
+
+def run_one(spec: dict) -> dict:
     import bench
-    dev = jax.devices()[0]
+    from paddle_tpu.reliability.retry import RetryPolicy
+    # the tunnel connect is the flakiest step of a sweep row (BENCH
+    # r02–r05 all carry tpu_error): absorb transient socket failures
+    # through the SHARED retry policy instead of failing the row on
+    # the first OSError — a real compile/OOM error is not retryable
+    # and still propagates immediately
+    dev = RetryPolicy(max_attempts=4, base_delay=3.0, max_delay=20.0,
+                      jitter=0.25, retry_on=(OSError,),
+                      scope="tpu_tunnel").call(
+        _connect_device, describe="tpu tunnel connect")
     kind = spec["kind"]
     kw = {k: v for k, v in spec.items() if k not in ("tag", "kind")}
     if kind == "gpt":
@@ -105,10 +118,17 @@ def _metrics_snapshot() -> dict:
 
 
 def _transient(err: str) -> bool:
-    # retry only the tunnel's compile-helper 500s; a real OOM or crash
-    # must not hammer the chip (match the specific status token, not a
+    # retry only the tunnel's compile-helper 500s and connection-level
+    # socket failures that escaped the in-process retry; a real OOM or
+    # crash must not hammer the chip (match specific tokens, not a
     # bare "500" that could appear in byte counts or line numbers)
-    return "remote_compile" in err and "HTTP 500" in err
+    if "remote_compile" in err and "HTTP 500" in err:
+        return True
+    # the exception CLASS names socket code actually raises (a
+    # subclass traceback never contains the literal base-class name)
+    return any(tok in err for tok in (
+        "OSError", "ConnectionResetError", "ConnectionRefusedError",
+        "ConnectionAbortedError", "BrokenPipeError", "socket.timeout"))
 
 
 def main(out_path="PERF_SWEEP.jsonl", only=None):
