@@ -46,6 +46,7 @@ import numpy as np
 
 from ..nn.layer import Layer, functional_call, split_state
 from ..observability import metrics as _obs
+from ..observability import propagation as _propagation
 from ..observability import server as _dbgsrv
 from ..observability import tracing as _trace
 from ..ops.paged_attention import paged_attention, paged_attention_kernel
@@ -885,7 +886,8 @@ class LLMEngine:
                max_new_tokens: int = 32,
                temperature: float = 0.0,
                deadline=None, priority: int = 0,
-               nonce: Optional[int] = None) -> Future:
+               nonce: Optional[int] = None,
+               trace_context=None) -> Future:
         """``nonce``: pin the sampling-key salt instead of using this
         engine's submission counter. Sampling keys depend only on
         (nonce, position), so two identically-seeded engines given the
@@ -893,7 +895,16 @@ class LLMEngine:
         of what else either served — the property the fleet router's
         cross-replica failover relies on (a request lost to a replica
         crash is re-submitted to a sibling with the same nonce and the
-        client cannot tell). Must be in [0, 2**31)."""
+        client cannot tell). Must be in [0, 2**31).
+
+        ``trace_context``: a remote parent for this request's
+        ``llm.request`` span tree — a Span/SpanContext, a W3C
+        ``traceparent`` string, or a headers mapping (the fleet router
+        passes its ``router.dispatch`` span here, directly for
+        in-process replicas and via the HTTP header for remote ones,
+        so the whole fleet shares one trace_id per request).
+        Best-effort by contract: malformed context or disabled tracing
+        degrade to a locally-rooted (or no) tree, never an error."""
         if len(prompt_ids) + max_new_tokens > self.max_len:
             raise ValueError(
                 f"prompt {len(prompt_ids)} + max_new_tokens "
@@ -916,6 +927,11 @@ class LLMEngine:
         req = _Request(prompt_ids, max_new_tokens, temperature)
         req.deadline = as_deadline(deadline)
         req.priority = int(priority)
+        # resolved once, outside the lock: the remote parent (if any)
+        # for this request's span tree — cross-process propagation
+        remote_ctx = (_propagation.context_from(trace_context)
+                      if _trace.enabled() and trace_context is not None
+                      else None)
         with self._mu:
             if self._closed:
                 raise EngineClosed("engine closed")
@@ -943,7 +959,7 @@ class LLMEngine:
                 err = AdmissionShed(shed_why, reason=shed_reason)
                 if _trace.enabled():
                     root = _trace.start_span(
-                        "llm.request", parent=None, attrs={
+                        "llm.request", parent=remote_ctx, attrs={
                             "prompt_tokens": len(req.prompt),
                             "nonce": req.nonce, "outcome": "shed",
                             "error": shed_why})
@@ -959,11 +975,13 @@ class LLMEngine:
                 # object — thread-local propagation can't cross the
                 # submit/loop thread boundary
                 root = _trace.start_span(
-                    "llm.request", parent=None, attrs={
+                    "llm.request", parent=remote_ctx, attrs={
                         "prompt_tokens": len(req.prompt),
                         "max_new_tokens": req.max_new_tokens,
                         "temperature": req.temperature,
                         "nonce": req.nonce})
+                if remote_ctx is not None:
+                    root.set_attr("remote_parent", True)
                 req.spans = {"root": root,
                              "queue": _trace.start_span(
                                  "llm.queue", parent=root, t0=root.t0)}
@@ -1970,6 +1988,12 @@ def serve_llm(engine, host: str = "127.0.0.1", port: int = 0):
     until reset), DeadlineExceeded/AdmissionTimeout → 504,
     RequestCancelled → 499 (client-abandoned, nginx convention).
 
+    Both endpoints honor a W3C ``traceparent`` request header
+    (observability.propagation): the engine's span tree roots under
+    the remote caller's span, giving the fleet one trace_id per
+    request end to end. Absent/malformed headers degrade to a local
+    root — never an error.
+
     The native ``ptserve`` binary keeps serving static-shape artifacts
     (jit.save → StableHLO → C++ PJRT predictor); generation needs the
     engine's scheduler, which is host-side Python by design — the
@@ -1994,6 +2018,14 @@ def serve_llm(engine, host: str = "127.0.0.1", port: int = 0):
                 for k in ("tenant", "slo"):  # router-only fields
                     if body.get(k) is not None:
                         kw[k] = body[k]
+                # cross-process trace propagation: a traceparent
+                # header parents this request's span tree under the
+                # caller's (the fleet router's router.dispatch) span.
+                # Malformed values degrade to a local root inside
+                # submit — a bad header can never 400 a generation
+                tp = self.headers.get("traceparent")
+                if tp is not None:
+                    kw["trace_context"] = tp
                 fut = engine.submit(body["prompt_ids"], **kw)
                 out = fut.result(timeout=600)
             except AdmissionShed as e:
@@ -2021,10 +2053,26 @@ def serve_llm(engine, host: str = "127.0.0.1", port: int = 0):
             return 200, out
 
         def _cancel(self, body: dict):
+            # cancels propagate too: the cancel lands in the SAME
+            # trace as the request it kills, so a cross-process story
+            # ("the router cancelled this mid-decode") reads end to
+            # end on one timeline
+            cspan = None
+            if _trace.enabled():
+                ctx = _propagation.extract(
+                    self.headers.get("traceparent"))
+                cspan = _trace.start_span(
+                    "llm.cancel", parent=ctx,
+                    attrs={"request_id": body.get("request_id")})
             try:
                 ok = engine.cancel(int(body["request_id"]))
             except Exception as e:  # noqa: BLE001 — report to client
+                if cspan is not None:
+                    cspan.set_status("error")
+                    cspan.set_attr("error", str(e)).end()
                 return 400, {"error": str(e)}
+            if cspan is not None:
+                cspan.set_attr("cancelled", bool(ok)).end()
             return 200, {"cancelled": bool(ok)}
 
         def do_POST(self):

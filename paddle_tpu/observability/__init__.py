@@ -34,12 +34,16 @@ from .metrics import (BYTE_BUCKETS, DEFAULT_BUCKETS,  # noqa: F401
 from .exporters import (JSONLReporter, export_chrome_tracing,  # noqa: F401
                         prometheus_text, sample_device_memory,
                         write_prometheus)
+from . import propagation  # noqa: F401
 from . import tracing  # noqa: F401
 from .tracing import Span, SpanContext, start_span  # noqa: F401
 from .tracing import span as trace_span  # noqa: F401
+from .propagation import (TRACEPARENT_HEADER,  # noqa: F401
+                          format_traceparent, parse_traceparent)
 from .server import (DebugServer, get_debug_server,  # noqa: F401
                      register_status_provider, start_debug_server,
                      stop_debug_server, unregister_status_provider)
+from .slo import SLOTracker  # noqa: F401
 from .flight import (FlightRecorder, dump_flight_record,  # noqa: F401
                      get_flight_recorder, install_flight_recorder)
 
@@ -55,6 +59,8 @@ __all__ = [
     "sample_device_memory", "write_prometheus",
     "tracing", "Span", "SpanContext", "start_span", "trace_span",
     "enable_tracing", "disable_tracing", "tracing_enabled",
+    "propagation", "TRACEPARENT_HEADER", "format_traceparent",
+    "parse_traceparent", "SLOTracker",
     "DebugServer", "start_debug_server", "get_debug_server",
     "stop_debug_server", "register_status_provider",
     "unregister_status_provider",

@@ -13,7 +13,15 @@ profiler window):
   ``hapi.Model`` reports train-loop state), plus device memory via
   ``sample_device_memory()``.
 - ``GET /tracez``   — recent finished spans + currently-live spans
-  from the tracing table (``?limit=N``, newest first).
+  from the tracing table (``?limit=N`` newest first, 0 = uncapped;
+  ``?trace_id=`` filters to one request's spans — the cross-process
+  query the fleet trace merge and operators use). Spans carry
+  ``ts_wall`` so snapshots from different processes align.
+- ``GET /fleetz``   — fleet view (registered by a serving Router):
+  per-replica health/breaker/scrape digest + computed aggregates;
+  404 when this process fronts no fleet.
+- ``GET /sloz``     — SLO report (registered SLOTracker): per-class
+  burn rates, deadline hit ratios, breach latches; 404 when none.
 - ``POST /profilez`` — arm an on-demand profiler window:
   ``{"duration_s": 5, "log_dir": "/tmp/prof"}`` starts a
   ``profiler.Profiler`` and stops it after the window; 409 while one
@@ -66,6 +74,20 @@ _HEALTH_RANK = {"ok": 0, "healthy": 0, "degraded": 1, "draining": 2}
 # with one curl instead of an attach-and-poke.
 _reset_handlers: Dict[str, Callable[[], None]] = {}
 
+# name → callable returning extra Prometheus exposition text appended
+# to /metrics (or None once the component is gone). The fleet router's
+# FleetScraper re-exports replica series through this — federation
+# rides the same scrape operators already have pointed at /metrics.
+_scrape_providers: Dict[str, Callable[[], Optional[str]]] = {}
+
+# name → callable returning the /fleetz JSON payload (per-replica
+# state + aggregates); registered by a fleet router. 404 when empty —
+# this process fronts no fleet.
+_fleet_providers: Dict[str, Callable[[], Optional[dict]]] = {}
+
+# name → callable returning the /sloz JSON payload (SLOTracker.report)
+_slo_providers: Dict[str, Callable[[], Optional[dict]]] = {}
+
 _server: Optional["DebugServer"] = None
 _server_mu = threading.Lock()
 
@@ -101,6 +123,65 @@ def register_reset_handler(name: str,
 def unregister_reset_handler(name: str) -> None:
     with _providers_mu:
         _reset_handlers.pop(name, None)
+
+
+def register_scrape_provider(name: str,
+                             fn: Callable[[], Optional[str]]) -> None:
+    with _providers_mu:
+        _scrape_providers[name] = fn
+
+
+def unregister_scrape_provider(name: str) -> None:
+    with _providers_mu:
+        _scrape_providers.pop(name, None)
+
+
+def register_fleet_provider(name: str,
+                            fn: Callable[[], Optional[dict]]) -> None:
+    with _providers_mu:
+        _fleet_providers[name] = fn
+
+
+def unregister_fleet_provider(name: str) -> None:
+    with _providers_mu:
+        _fleet_providers.pop(name, None)
+
+
+def register_slo_provider(name: str,
+                          fn: Callable[[], Optional[dict]]) -> None:
+    with _providers_mu:
+        _slo_providers[name] = fn
+
+
+def unregister_slo_provider(name: str) -> None:
+    with _providers_mu:
+        _slo_providers.pop(name, None)
+
+
+def _collect_dict_providers(table: Dict[str, Callable[[], Optional[dict]]]
+                            ) -> Dict[str, dict]:
+    """Shared collection discipline for dict-returning provider
+    registries: a raising provider reports its error, a None return
+    self-unregisters (the weakref-closure convention)."""
+    with _providers_mu:
+        items = list(table.items())
+    out: Dict[str, dict] = {}
+    dead = []
+    for name, fn in items:
+        try:
+            d = fn()
+        except Exception as e:  # noqa: BLE001 — one bad provider
+            out[name] = {"error": str(e)}
+            continue
+        if d is None:
+            dead.append(name)
+        else:
+            out[name] = d
+    if dead:
+        with _providers_mu:
+            for name in dead:
+                table.pop(name, None)
+    return out
 
 
 def _collect_health() -> Dict[str, str]:
@@ -243,7 +324,24 @@ class DebugServer:
     def _get(self, h) -> None:
         url = urlparse(h.path)
         if url.path == "/metrics":
-            h._reply(200, prometheus_text(self.registry).encode(),
+            text = prometheus_text(self.registry)
+            # registered scrape providers (fleet federation) append
+            # their blocks; a broken provider must not kill the scrape
+            with _providers_mu:
+                extras = list(_scrape_providers.items())
+            dead = []
+            for name, fn in extras:
+                try:
+                    block = fn()
+                except Exception:  # noqa: BLE001
+                    continue
+                if block is None:
+                    dead.append(name)
+                elif block:
+                    text = text.rstrip("\n") + "\n" + block
+            for name in dead:
+                unregister_scrape_provider(name)
+            h._reply(200, text.encode(),
                      ctype="text/plain; version=0.0.4; charset=utf-8")
         elif url.path == "/healthz":
             comp = _collect_health()
@@ -275,22 +373,57 @@ class DebugServer:
                 "device_memory": devmem,
                 "profilez": self._arm.status()})
         elif url.path == "/tracez":
+            # ?limit=N caps the finished spans returned (0 = no cap);
+            # ?trace_id= pulls ONE request's spans out of a busy
+            # replica's 16384-span ring instead of shipping all of it.
+            # Spans gain ts_wall so tools/trace_merge.py can align
+            # snapshots from different processes on one timeline.
             q = parse_qs(url.query)
             limit = int(q.get("limit", ["256"])[0])
+            trace_id = q.get("trace_id", [None])[0]
+            live = tracing.live_spans()
             fin = tracing.finished_spans()
+            total = len(fin)
+            if trace_id:
+                live = [s for s in live if s["trace_id"] == trace_id]
+                fin = [s for s in fin if s["trace_id"] == trace_id]
+            matched = len(fin)
+            fin = list(reversed(fin))
+            if limit > 0:
+                fin = fin[:limit]
+            wall = tracing.perf_to_wall
             h._reply_json(200, {
                 "enabled": tracing.enabled(),
-                "live": tracing.live_spans(),
-                "finished": list(reversed(fin))[:limit],
-                "finished_total": len(fin)})
+                "trace_id": trace_id,
+                "live": [dict(s, ts_wall=wall(s["ts"])) for s in live],
+                "finished": [dict(s, ts_wall=wall(s["ts"]))
+                             for s in fin],
+                "finished_matched": matched,
+                "finished_total": total})
+        elif url.path == "/fleetz":
+            fleets = _collect_dict_providers(_fleet_providers)
+            if not fleets:
+                h._reply_json(404, {
+                    "error": "no fleet registered in this process "
+                             "(the router registers one)"})
+            else:
+                h._reply_json(200, {"fleets": fleets})
+        elif url.path == "/sloz":
+            slos = _collect_dict_providers(_slo_providers)
+            if not slos:
+                h._reply_json(404, {
+                    "error": "no SLO tracker registered in this "
+                             "process (the router registers one)"})
+            else:
+                h._reply_json(200, {"slo": slos})
         elif url.path == "/profilez":
             h._reply_json(200, {"armed": self._arm.status()})
         else:
             h._reply_json(404, {
                 "error": f"unknown path {url.path}",
                 "endpoints": ["/metrics", "/healthz", "/statusz",
-                              "/tracez", "POST /profilez",
-                              "POST /reset_health"]})
+                              "/tracez", "/fleetz", "/sloz",
+                              "POST /profilez", "POST /reset_health"]})
 
     def _post(self, h) -> None:
         url = urlparse(h.path)

@@ -34,6 +34,7 @@ without cycles, and enabling tracing never drags jax in.
 from __future__ import annotations
 
 import itertools
+import os
 import sys
 import threading
 import time
@@ -45,10 +46,22 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 # host memory without bound no matter how chatty the instrumentation
 DEFAULT_TABLE_CAP = 16384
 MAX_EVENTS_PER_SPAN = 128
+# per-span link cap (failover chains are short; a retry storm must
+# not grow one span without bound)
+MAX_LINKS_PER_SPAN = 32
 
 _enabled = False
 _lock = threading.Lock()
 _ids = itertools.count(1)
+# ids are W3C-sized and PROCESS-UNIQUE: a random per-process prefix
+# plus a counter. Before trace propagation this didn't matter — every
+# table was process-local — but a fleet merges span tables from K
+# replicas + a router onto one timeline (tools/trace_merge.py), where
+# counter-only ids from different processes would collide and cross-
+# link unrelated trees. 16-hex span ids / 32-hex trace ids are exactly
+# the W3C traceparent field widths, so inject/extract never pads.
+_SPAN_ID_PREFIX = os.urandom(4).hex()      # 8 hex + 8-hex counter
+_TRACE_ID_PREFIX = os.urandom(8).hex()     # 16 hex + the span id
 _table: deque = deque(maxlen=DEFAULT_TABLE_CAP)
 _live: Dict[str, "Span"] = {}
 _tls = threading.local()
@@ -84,7 +97,7 @@ class Span:
     construction)."""
 
     __slots__ = ("name", "trace_id", "span_id", "parent_id", "t0", "t1",
-                 "attrs", "events", "tid", "tname", "status",
+                 "attrs", "events", "links", "tid", "tname", "status",
                  "_dropped_events")
 
     def __init__(self, name: str, trace_id: str, span_id: str,
@@ -99,6 +112,7 @@ class Span:
         self.t1: Optional[float] = None
         self.attrs: Dict[str, Any] = dict(attrs) if attrs else {}
         self.events: List[Tuple[float, str, Optional[dict]]] = []
+        self.links: List[dict] = []
         t = threading.current_thread()
         self.tid = t.ident
         self.tname = t.name
@@ -131,6 +145,25 @@ class Span:
             return self
         self.events.append((time.perf_counter() if ts is None else ts,
                             name, attrs))
+        return self
+
+    def add_link(self, context, attrs: Optional[dict] = None) -> "Span":
+        """Record a causal association with another span that is NOT a
+        parent/child edge — the fleet router links a failover
+        re-dispatch back to the attempt it replaces, so a cross-replica
+        retry reads as one story instead of two disconnected trees.
+        ``context`` is any Span/SpanContext (possibly from another
+        process)."""
+        if len(self.links) >= MAX_LINKS_PER_SPAN:
+            return self
+        tid = getattr(context, "trace_id", "")
+        sid = getattr(context, "span_id", "")
+        if not sid:
+            return self          # a noop/disabled-side context: no-op
+        link = {"trace_id": tid, "span_id": sid}
+        if attrs:
+            link["attrs"] = dict(attrs)
+        self.links.append(link)
         return self
 
     def set_status(self, status: str) -> "Span":
@@ -177,11 +210,12 @@ class Span:
             try:
                 attrs = dict(self.attrs)
                 events = list(self.events)
+                links = list(self.links)
                 break
             except RuntimeError:
                 continue
         else:
-            attrs, events = {}, []
+            attrs, events, links = {}, [], []
         d = {
             "name": self.name,
             "trace_id": self.trace_id,
@@ -197,6 +231,8 @@ class Span:
                         **({"attrs": a} if a else {})}
                        for ts, n, a in events],
         }
+        if links:
+            d["links"] = links
         if self._dropped_events:
             d["dropped_events"] = self._dropped_events
         return d
@@ -229,6 +265,9 @@ class _NoopSpan:
         return self
 
     def add_event(self, name, attrs=None, ts=None):
+        return self
+
+    def add_link(self, context, attrs=None):
         return self
 
     def set_status(self, status):
@@ -294,7 +333,9 @@ def clear() -> None:
 
 
 def _new_id() -> str:
-    return f"{next(_ids):012x}"
+    """A 16-hex (W3C span-id width) process-unique id: random
+    per-process prefix + counter."""
+    return f"{_SPAN_ID_PREFIX}{next(_ids) & 0xFFFFFFFF:08x}"
 
 
 def _resolve_parent(parent) -> Tuple[Optional[str], Optional[str]]:
@@ -323,8 +364,10 @@ def start_span(name: str, parent=_USE_CURRENT,
         parent = current_span()
     trace_id, parent_id = _resolve_parent(parent)
     span_id = _new_id()
-    sp = Span(name, trace_id or span_id, span_id, parent_id,
-              attrs=attrs, t0=t0)
+    # a root span mints a 32-hex (W3C trace-id width) trace id so the
+    # identity can ride a traceparent header unmodified
+    sp = Span(name, trace_id or f"{_TRACE_ID_PREFIX}{span_id}",
+              span_id, parent_id, attrs=attrs, t0=t0)
     with _lock:
         _live[span_id] = sp
     return sp

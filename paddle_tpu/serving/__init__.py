@@ -16,6 +16,7 @@ nonce and all replicas share weights and seed.
 """
 
 from .breaker import CircuitBreaker
+from .fleet import FleetScraper, parse_prometheus_text
 from .replica import (HTTPReplica, LocalReplica, ReplicaUnavailable,
                       build_net_from_spec, make_engine_from_spec,
                       spawn_replica)
@@ -23,6 +24,8 @@ from .router import Router, SLOClass, TenantQuota
 
 __all__ = [
     "CircuitBreaker",
+    "FleetScraper",
+    "parse_prometheus_text",
     "HTTPReplica",
     "LocalReplica",
     "ReplicaUnavailable",

@@ -55,11 +55,12 @@ class LocalReplica:
 
     def submit(self, prompt_ids, max_new_tokens=32, temperature=0.0,
                deadline_s: Optional[float] = None, priority: int = 0,
-               nonce: Optional[int] = None) -> dict:
+               nonce: Optional[int] = None, trace_context=None) -> dict:
         fut = self.engine.submit(
             prompt_ids, max_new_tokens=max_new_tokens,
             temperature=temperature, deadline=deadline_s,
-            priority=priority, nonce=nonce)
+            priority=priority, nonce=nonce,
+            trace_context=trace_context)
         out = fut.result(timeout=600)
         out["request_id"] = fut.request_id
         return out
@@ -68,6 +69,16 @@ class LocalReplica:
         if getattr(self.engine, "_closed", False):
             return None
         return self.engine.health
+
+    # an in-process engine's metrics already live in this process's
+    # registry — federating them again would double every series in
+    # the same /metrics scrape, so local replicas OPT OUT of the
+    # FleetScraper (absent from federation, never marked down; they
+    # still appear in /fleetz via the router's own per-replica state)
+    metrics_opt_out = True
+
+    def metrics_text(self) -> Optional[str]:
+        return None
 
     def cancel(self, request_id: int) -> bool:
         return self.engine.cancel(request_id)
@@ -80,20 +91,35 @@ class HTTPReplica:
     """Remote replica behind ``serve_llm`` + debug-server endpoints.
 
     ``generate_url`` is the ``serve_llm`` base (POST /generate,
-    POST /cancel); ``healthz_url`` the debug server's /healthz."""
+    POST /cancel); ``healthz_url`` the debug server's /healthz;
+    ``metrics_url`` its /metrics (derived from ``healthz_url`` when
+    not given — both live on the same debug server)."""
 
     def __init__(self, generate_url: str, healthz_url: str,
-                 timeout: float = 600.0):
+                 timeout: float = 600.0,
+                 metrics_url: Optional[str] = None):
         self.generate_url = generate_url.rstrip("/")
         self.healthz_url = healthz_url
+        self.metrics_url = metrics_url or (
+            healthz_url.rsplit("/healthz", 1)[0] + "/metrics")
         self.timeout = float(timeout)
 
-    def _post(self, path: str, body: dict, timeout: float):
+    def _post(self, path: str, body: dict, timeout: float,
+              trace_context=None):
         from urllib.error import HTTPError, URLError
         from urllib.request import Request, urlopen
+        headers = {"Content-Type": "application/json"}
+        if trace_context is not None:
+            # cross-process propagation: the caller's span identity
+            # rides the W3C header; a disabled-tracing caller's noop
+            # context formats to None and no header is sent
+            from ..observability import propagation as _prop
+            tp = _prop.format_traceparent(trace_context)
+            if tp is not None:
+                headers[_prop.TRACEPARENT_HEADER] = tp
         req = Request(self.generate_url + path,
                       data=json.dumps(body).encode(),
-                      headers={"Content-Type": "application/json"})
+                      headers=headers)
         try:
             with urlopen(req, timeout=timeout) as r:
                 return r.status, json.loads(r.read() or b"{}")
@@ -112,7 +138,7 @@ class HTTPReplica:
 
     def submit(self, prompt_ids, max_new_tokens=32, temperature=0.0,
                deadline_s: Optional[float] = None, priority: int = 0,
-               nonce: Optional[int] = None) -> dict:
+               nonce: Optional[int] = None, trace_context=None) -> dict:
         body = {"prompt_ids": list(map(int, prompt_ids)),
                 "max_new_tokens": int(max_new_tokens),
                 "temperature": float(temperature),
@@ -125,7 +151,8 @@ class HTTPReplica:
         # typed 504 arrives instead of a transport timeout
         timeout = self.timeout if deadline_s is None \
             else min(self.timeout, float(deadline_s) + 30.0)
-        code, out = self._post("/generate", body, max(timeout, 1.0))
+        code, out = self._post("/generate", body, max(timeout, 1.0),
+                               trace_context=trace_context)
         if code == 200:
             return out
         err = out.get("error", f"HTTP {code}")
@@ -166,10 +193,23 @@ class HTTPReplica:
         status = body.get("status", "healthy")
         return "healthy" if status == "ok" else status
 
-    def cancel(self, request_id: int) -> bool:
+    def metrics_text(self, timeout: float = 2.0) -> Optional[str]:
+        """Scrape the replica's Prometheus text exposition, or None
+        when unreachable (the FleetScraper marks the replica down and
+        keeps its last-known series out of the federated view)."""
+        from urllib.error import HTTPError, URLError
+        from urllib.request import urlopen
+        try:
+            with urlopen(self.metrics_url, timeout=timeout) as r:
+                return r.read().decode("utf-8", "replace")
+        except (HTTPError, URLError, OSError, ValueError):
+            return None
+
+    def cancel(self, request_id: int, trace_context=None) -> bool:
         try:
             code, out = self._post("/cancel",
-                                   {"request_id": int(request_id)}, 10.0)
+                                   {"request_id": int(request_id)}, 10.0,
+                                   trace_context=trace_context)
         except ReplicaUnavailable:
             return False
         return bool(out.get("cancelled")) if code == 200 else False
@@ -230,7 +270,22 @@ def _arm_faults(spec: dict) -> None:
 
 def replica_main(spec: dict) -> int:
     """Subprocess body: engine + serve_llm + debug server + optional
-    TCPStore membership, announced on stdout as one READY line."""
+    TCPStore membership, announced on stdout as one READY line.
+
+    Observability knobs in the spec:
+
+    - ``tracing``: truthy → enable the span table (off by default,
+      same one-flag-check discipline as everywhere else) so the
+      router's traceparent headers land in a real tree and
+      ``/tracez?trace_id=`` answers cross-process queries.
+    - ``obs_dir``: base directory for this replica's observability
+      artifacts — the flight recorder dumps to
+      ``<obs_dir>/<name>/`` and a JSONL metrics reporter appends to
+      ``<obs_dir>/<name>/metrics.jsonl``. Without it, K spawned
+      replicas sharing a cwd scatter (and with unlucky pids, collide)
+      their dumps where no soak can collect them; with it, the fleet
+      chaos soak collects every replica's dumps from one tree.
+    """
     import jax
     jax.config.update("jax_platforms", spec.get("platform", "cpu"))
     if spec.get("cache_dir"):
@@ -244,18 +299,33 @@ def replica_main(spec: dict) -> int:
                           0.0)
     from ..inference.llm import serve_llm
     from ..observability import server as debug
+    from ..observability import tracing
     from ..reliability import faults
     from ..reliability.faults import FaultInjected
 
+    name = spec.get("name", f"replica-{os.getpid()}")
+    if spec.get("tracing"):
+        tracing.enable()
+    reporter = None
+    if spec.get("obs_dir"):
+        from ..observability import flight
+        from ..observability.exporters import JSONLReporter
+        my_dir = os.path.join(spec["obs_dir"], name)
+        os.makedirs(my_dir, exist_ok=True)
+        flight.install_flight_recorder(my_dir)
+        reporter = JSONLReporter(
+            os.path.join(my_dir, "metrics.jsonl"),
+            interval=float(spec.get("jsonl_interval", 2.0)))
     _arm_faults(spec)
     eng = make_engine_from_spec(spec)
     srv = serve_llm(eng)
     host, port = srv.server_address[:2]
     dbg = debug.start_debug_server()
-    name = spec.get("name", f"replica-{os.getpid()}")
     info = {"name": name,
             "generate": f"http://{host}:{port}",
             "healthz": f"{dbg.address}/healthz",
+            "metrics": f"{dbg.address}/metrics",
+            "tracez": f"{dbg.address}/tracez",
             "pid": os.getpid()}
     member = None
     if spec.get("store"):
@@ -279,6 +349,8 @@ def replica_main(spec: dict) -> int:
     finally:
         if member is not None:
             member.stop()
+        if reporter is not None:
+            reporter.stop()
         eng.close()
         srv.shutdown()
     return 0

@@ -55,11 +55,14 @@ from ..inference.llm import (AdmissionShed, EngineClosed,
                              RequestCancelled)
 from ..inference.prefix_cache import page_digests
 from ..observability import metrics as _obs
+from ..observability import propagation as _propagation
 from ..observability import server as _dbgsrv
 from ..observability import tracing as _trace
+from ..observability.slo import DEFAULT_WINDOWS, SLOTracker
 from ..reliability import faults as _faults
 from ..reliability.retry import DeadlineExceeded, as_deadline
 from .breaker import STATE_CODE, CircuitBreaker
+from .fleet import FleetScraper
 from .replica import HTTPReplica, ReplicaUnavailable
 
 _HEALTH_CODE = {"healthy": 0, "degraded": 1, "draining": 2,
@@ -98,13 +101,18 @@ def rendezvous_pick(key: bytes, names) -> Optional[str]:
 
 class SLOClass:
     """A named latency tier: requests submitted under it inherit its
-    deadline/priority unless they bring their own."""
+    deadline/priority unless they bring their own. ``target`` is the
+    class's SLO success objective (fed to the router's
+    :class:`~paddle_tpu.observability.slo.SLOTracker`; None uses the
+    tracker's default)."""
 
     def __init__(self, name: str, deadline_s: Optional[float] = None,
-                 priority: int = 0):
+                 priority: int = 0,
+                 target: Optional[float] = None):
         self.name = name
         self.deadline_s = deadline_s
         self.priority = int(priority)
+        self.target = target
 
 
 class TenantQuota:
@@ -187,7 +195,8 @@ class _FleetRequest:
     __slots__ = ("prompt", "max_new_tokens", "temperature", "deadline",
                  "priority", "tenant", "nonce", "future", "cancelled",
                  "span", "excluded", "t_submit", "failovers",
-                 "affinity_key", "quota_held", "rr_slot")
+                 "affinity_key", "quota_held", "rr_slot", "slo_name",
+                 "had_deadline", "last_dispatch")
 
     def __init__(self, prompt, max_new_tokens, temperature):
         self.prompt = list(map(int, prompt))
@@ -206,6 +215,12 @@ class _FleetRequest:
         self.affinity_key = b""
         self.quota_held = False   # holds one tenant-inflight slot
         self.rr_slot = 0          # round-robin seat, fixed at submit
+        self.slo_name = None      # SLO class for burn-rate accounting
+        self.had_deadline = False
+        # (SpanContext, replica) of the previous dispatch attempt —
+        # the next attempt links back to it so a failover reads as
+        # one story on the merged timeline
+        self.last_dispatch = None
 
 
 class Router:
@@ -239,6 +254,12 @@ class Router:
                  membership_stale_after: float = 2.0,
                  policy: str = "affinity",
                  max_workers: int = 32,
+                 scrape_metrics: bool = True,
+                 federate_prefixes=("llm_",),
+                 slo_windows=DEFAULT_WINDOWS,
+                 slo_default_target: float = 0.99,
+                 slo_breach_threshold: float = 10.0,
+                 slo_min_samples: int = 10,
                  name: str = "router"):
         if policy not in ("affinity", "round_robin"):
             raise ValueError(f"unknown routing policy {policy!r}")
@@ -274,6 +295,20 @@ class Router:
         if store_endpoint is not None:
             from ..distributed.tcp_store import TCPStoreClient
             self._store_client = TCPStoreClient(store_endpoint)
+        # fleet observability: the FleetScraper federates replica
+        # /metrics on the health-poll cadence; the SLOTracker turns
+        # request outcomes into burn-rate gauges. Both are wired into
+        # the debug surface below.
+        self.scraper = FleetScraper(
+            federate_prefixes=tuple(federate_prefixes)) \
+            if scrape_metrics else None
+        self.slo = SLOTracker(
+            targets={n: c.target for n, c in self.slo_classes.items()
+                     if c.target is not None},
+            default_target=slo_default_target,
+            windows=tuple(slo_windows),
+            breach_threshold=slo_breach_threshold,
+            min_samples=slo_min_samples)
         self._pool = ThreadPoolExecutor(
             max_workers=max_workers,
             thread_name_prefix=f"{name}-dispatch")
@@ -281,16 +316,26 @@ class Router:
         self._poller = threading.Thread(
             target=self._poll_loop, name=f"{name}-health", daemon=True)
         self._poller.start()
-        # live-debug surface: /statusz fleet view, /healthz aggregate,
-        # POST /reset_health → breaker reset (the router-side half of
-        # the operator escape hatch)
+        # live-debug surface: /statusz fleet view, /fleetz federation,
+        # /sloz burn rates, /healthz aggregate (+ SLO breach latch),
+        # POST /reset_health → breaker + breach-latch reset (the
+        # router-side half of the operator escape hatch)
         self._status_name = f"{name}_{id(self):x}"
         _dbgsrv.register_status_provider(self._status_name,
                                          self._status)
         _dbgsrv.register_health_provider(self._status_name,
                                          self._aggregate_health)
         _dbgsrv.register_reset_handler(self._status_name,
-                                       self.reset_breakers)
+                                       self._reset_all)
+        _dbgsrv.register_fleet_provider(self._status_name,
+                                        self._fleetz)
+        _dbgsrv.register_slo_provider(self._status_name,
+                                      self._sloz)
+        _dbgsrv.register_health_provider(self._status_name + "_slo",
+                                         self._slo_health)
+        if self.scraper is not None:
+            _dbgsrv.register_scrape_provider(
+                self._status_name, self._render_federated)
 
     # -- membership ---------------------------------------------------------
     def attach(self, name: str, client) -> None:
@@ -309,6 +354,8 @@ class Router:
     def detach(self, name: str) -> None:
         with self._mu:
             self._replicas.pop(name, None)
+        if self.scraper is not None:
+            self.scraper.forget(name)
 
     def replica_names(self):
         with self._mu:
@@ -329,7 +376,8 @@ class Router:
                 same = st is not None and st.info == info
             if same:
                 continue
-            client = HTTPReplica(info["generate"], info["healthz"])
+            client = HTTPReplica(info["generate"], info["healthz"],
+                                 metrics_url=info.get("metrics"))
             self.attach(mname, client)
             with self._mu:
                 st = self._replicas[mname]
@@ -350,6 +398,9 @@ class Router:
                 if not st.breaker.allow():
                     self._m["breaker"].labels(st.name).set(
                         STATE_CODE[st.breaker.state])
+                    if self.scraper is not None:   # open = down
+                        self.scraper.mark_unreachable(st.name,
+                                                      st.client)
                     continue
             h = None
             try:
@@ -371,11 +422,23 @@ class Router:
                 STATE_CODE[st.breaker.state])
             self._m["rhealth"].labels(st.name).set(
                 _HEALTH_CODE.get(st.health, 3))
+            # metrics federation rides the SAME cycle: one poll, one
+            # health verdict, one scrape — an unreachable replica is
+            # recorded down without a second timeout
+            if self.scraper is not None:
+                if h is None:
+                    self.scraper.mark_unreachable(st.name, st.client)
+                else:
+                    self.scraper.scrape(st.name, st.client)
 
     def _poll_loop(self) -> None:
         while not self._stop.wait(self.health_poll_interval):
             try:
                 self._poll_once()
+                # windowed SLO gauges decay on the same cadence —
+                # burn rates on /metrics must fall back to 0 when a
+                # storm ends, not freeze at their last recorded value
+                self.slo.refresh()
             except Exception:  # noqa: BLE001 — the poller must survive
                 pass
 
@@ -390,6 +453,13 @@ class Router:
             if st.health == "draining":
                 st.health = "unknown"   # re-polled next interval
             self._m["breaker"].labels(st.name).set(0)
+
+    def _reset_all(self) -> None:
+        """POST /reset_health verb for the router: breakers closed AND
+        SLO breach latches acknowledged — one curl recovers the whole
+        router-side sticky state."""
+        self.reset_breakers()
+        self.slo.reset_breach()
 
     # -- routing ------------------------------------------------------------
     _rendezvous = staticmethod(rendezvous_pick)
@@ -432,7 +502,8 @@ class Router:
     def submit(self, prompt_ids, max_new_tokens: int = 32,
                temperature: float = 0.0, deadline=None,
                priority: int = 0, tenant: Optional[str] = None,
-               slo: Optional[str] = None) -> Future:
+               slo: Optional[str] = None,
+               trace_context=None) -> Future:
         if self._closed:
             # typed like the engine's verdict: through serve_llm this
             # is a 503 (out of rotation), never a client-error 400
@@ -452,14 +523,21 @@ class Router:
                 priority = cls.priority
         req.deadline = as_deadline(deadline)
         req.priority = int(priority)
+        req.had_deadline = req.deadline is not None
+        req.slo_name = slo
         req.nonce = next(self._nonce_seq) & 0x7FFFFFFF
         req.future.request_id = req.nonce
         req.affinity_key = self._affinity_key(req.prompt)
         req.rr_slot = next(self._rr_seq)
         self.n_submitted += 1
         if _trace.enabled():
+            # router.request roots here — or under a REMOTE parent
+            # when the client itself propagated a traceparent (a
+            # router fronted by serve_llm extends the caller's trace)
             req.span = _trace.start_span(
-                "router.request", parent=None, attrs={
+                "router.request",
+                parent=_propagation.context_from(trace_context),
+                attrs={
                     "prompt_tokens": len(req.prompt),
                     "nonce": req.nonce, "tenant": tenant or "",
                     "slo": slo or ""})
@@ -515,7 +593,13 @@ class Router:
                     self._tenant_inflight.pop(req.tenant, None)
                 else:
                     self._tenant_inflight[req.tenant] = n
-        self._m["latency"].observe(time.monotonic() - req.t_submit)
+        latency = time.monotonic() - req.t_submit
+        self._m["latency"].observe(latency)
+        # SLO accounting: every resolution is a burn-rate sample for
+        # its class (cancelled requests are a client choice and burn
+        # no budget — slo.py owns that policy)
+        self.slo.record(req.slo_name, req.tenant, latency, outcome,
+                        had_deadline=req.had_deadline)
         if req.span is not None:
             req.span.set_attr("outcome", outcome)
             req.span.set_attr("failovers", req.failovers)
@@ -575,6 +659,15 @@ class Router:
                     "router.dispatch", parent=req.span,
                     attrs={"replica": st.name,
                            "failovers": req.failovers})
+                if req.last_dispatch is not None:
+                    # a re-dispatch (failover or rebalance) links back
+                    # to the attempt it replaces: the cross-replica
+                    # retry reads as one story on a merged timeline
+                    prev_ctx, prev_name = req.last_dispatch
+                    dspan.add_link(prev_ctx, {
+                        "relation": "retry_of",
+                        "replica": prev_name})
+                req.last_dispatch = (dspan.context, st.name)
             if self.policy == "affinity":
                 self._m["affinity_total"].inc()
                 if flag:
@@ -596,7 +689,12 @@ class Router:
                     temperature=req.temperature,
                     deadline_s=(req.deadline.remaining()
                                 if req.deadline is not None else None),
-                    priority=req.priority, nonce=req.nonce)
+                    priority=req.priority, nonce=req.nonce,
+                    # the dispatch span rides to the replica (HTTP
+                    # header / direct SpanContext) so its llm.request
+                    # tree shares this request's trace_id end to end
+                    trace_context=(dspan.context
+                                   if dspan is not None else None))
             except (AdmissionShed, EngineClosed) as e:
                 # the replica refused — rebalance WITHOUT consuming
                 # failover budget (nothing was lost). 503/draining
@@ -667,6 +765,11 @@ class Router:
             out["replica"] = st.name
             out["failovers"] = req.failovers
             out["request_id"] = req.nonce
+            if req.span is not None:
+                # hand the client its trace id: one GET
+                # /tracez?trace_id= on any fleet process pulls this
+                # request's spans
+                out["trace_id"] = req.span.trace_id
             self._resolve(req, result=out)
             return
 
@@ -708,6 +811,60 @@ class Router:
             return "degraded"
         return "healthy"
 
+    def _slo_health(self) -> Optional[str]:
+        """The /healthz breach-latch component: a latched SLO breach
+        shows as degraded until an operator acknowledges it."""
+        if self._closed:
+            return None
+        return self.slo.health()
+
+    def _sloz(self) -> Optional[dict]:
+        if self._closed:
+            return None
+        return self.slo.report()
+
+    def _render_federated(self) -> Optional[str]:
+        if self._closed or self.scraper is None:
+            return None
+        return self.scraper.render_prometheus()
+
+    def _fleetz(self) -> Optional[dict]:
+        """The /fleetz payload: the router's per-replica view (health,
+        breaker, dispatch counts) joined with the scraper's per-replica
+        metrics digest, plus the computed fleet aggregates."""
+        if self._closed:
+            return None
+        with self._mu:
+            states = list(self._replicas.values())
+        scraped = self.scraper.replica_report() \
+            if self.scraper is not None else {}
+        replicas = {}
+        for st in states:
+            entry = {
+                "health": st.health,
+                "breaker": st.breaker.state,
+                "breaker_opens": st.breaker.n_opens,
+                "inflight": st.inflight,
+                "dispatched": st.dispatched,
+                "from_membership": st.from_membership,
+            }
+            entry["metrics"] = scraped.pop(st.name, None)
+            replicas[st.name] = entry
+        # scrapes for since-detached replicas, if any, still show
+        for name, digest in scraped.items():
+            replicas[name] = {"health": "detached", "metrics": digest}
+        out = {
+            "policy": self.policy,
+            "replicas": replicas,
+            "submitted": self.n_submitted,
+            "failovers": self.n_failovers,
+            "rebalanced": self.n_rebalanced,
+            "shed": self.n_shed,
+        }
+        if self.scraper is not None:
+            out["aggregates"] = self.scraper.aggregates()
+        return out
+
     # -- lifecycle ----------------------------------------------------------
     def close(self) -> None:
         if self._closed:
@@ -715,7 +872,11 @@ class Router:
         self._closed = True
         _dbgsrv.unregister_status_provider(self._status_name)
         _dbgsrv.unregister_health_provider(self._status_name)
+        _dbgsrv.unregister_health_provider(self._status_name + "_slo")
         _dbgsrv.unregister_reset_handler(self._status_name)
+        _dbgsrv.unregister_fleet_provider(self._status_name)
+        _dbgsrv.unregister_slo_provider(self._status_name)
+        _dbgsrv.unregister_scrape_provider(self._status_name)
         self._stop.set()
         self._poller.join(timeout=10)
         # in-flight dispatches run to completion and resolve their

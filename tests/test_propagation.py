@@ -1,0 +1,358 @@
+"""Cross-process trace propagation (ISSUE 7 tentpole 1).
+
+Pins: the W3C traceparent wire format round-trips; malformed/absent
+headers degrade to a root span and NEVER reject a request; tracing
+disabled on either side produces no orphan parents; and — the
+acceptance criterion — a request through the Router to a replica over
+REAL HTTP yields ONE trace: ``router.request`` → ``router.dispatch``
+→ the replica's ``llm.request`` tree share a trace_id, with failover
+re-dispatches recorded as span links.
+"""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from paddle_tpu.observability import propagation, tracing
+from paddle_tpu.observability.propagation import (format_traceparent,
+                                                  parse_traceparent)
+from paddle_tpu.observability.tracing import SpanContext
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracing():
+    tracing.clear()
+    tracing.enable()
+    yield
+    tracing.disable()
+    tracing.clear()
+
+
+# ---------------------------------------------------------------------------
+# wire format
+# ---------------------------------------------------------------------------
+
+def test_native_ids_are_w3c_sized_and_round_trip():
+    root = tracing.start_span("req", parent=None)
+    assert len(root.trace_id) == 32 and len(root.span_id) == 16
+    header = format_traceparent(root.context)
+    assert header == f"00-{root.trace_id}-{root.span_id}-01"
+    ctx = parse_traceparent(header)
+    assert ctx.trace_id == root.trace_id
+    assert ctx.span_id == root.span_id
+    root.end()
+
+
+def test_foreign_short_ids_pad_on_inject():
+    header = format_traceparent(SpanContext("abc123", "9f"))
+    assert header == f"00-{'abc123'.zfill(32)}-{'9f'.zfill(16)}-01"
+
+
+@pytest.mark.parametrize("bad", [
+    None, 42, "", "junk", "00", "00-xyz-abc-01",
+    "00-" + "0" * 32 + "-" + "1" * 16 + "-01",       # all-zero trace
+    "00-" + "1" * 32 + "-" + "0" * 16 + "-01",       # all-zero span
+    "ff-" + "1" * 32 + "-" + "2" * 16 + "-01",       # forbidden version
+    "00-" + "1" * 31 + "-" + "2" * 16 + "-01",       # short trace
+    "00-" + "1" * 32 + "-" + "2" * 15 + "-01",       # short span
+    "00-" + "g" * 32 + "-" + "2" * 16 + "-01",       # non-hex
+])
+def test_malformed_traceparent_parses_to_none(bad):
+    assert parse_traceparent(bad) is None
+
+
+def test_future_version_with_extra_fields_accepted():
+    v = "cc-" + "a" * 32 + "-" + "b" * 16 + "-01-what-ever"
+    ctx = parse_traceparent(v)
+    assert ctx is not None and ctx.trace_id == "a" * 32
+
+
+def test_disabled_tracing_injects_nothing():
+    tracing.disable()
+    sp = tracing.start_span("ghost")
+    assert format_traceparent(sp.context) is None
+    carrier = propagation.inject({}, context=sp)
+    assert carrier == {}
+
+
+def test_extract_is_header_case_insensitive():
+    root = tracing.start_span("req", parent=None)
+    hdr = format_traceparent(root)
+    for key in ("traceparent", "Traceparent", "TRACEPARENT"):
+        ctx = propagation.extract({key: hdr})
+        assert ctx is not None and ctx.trace_id == root.trace_id
+    root.end()
+
+
+def test_context_from_coercions():
+    root = tracing.start_span("req", parent=None)
+    hdr = format_traceparent(root)
+    for obj in (root, root.context, hdr, {"traceparent": hdr}):
+        ctx = propagation.context_from(obj)
+        assert ctx.trace_id == root.trace_id, obj
+    assert propagation.context_from(None) is None
+    assert propagation.context_from("garbage") is None
+    assert propagation.context_from(tracing.NOOP_SPAN) is None
+    root.end()
+
+
+def test_remote_parent_links_child_into_remote_trace():
+    remote = SpanContext("a" * 32, "b" * 16)
+    child = tracing.start_span("phase", parent=remote)
+    assert child.trace_id == "a" * 32
+    assert child.parent_id == "b" * 16
+    child.end()
+
+
+def test_span_links_survive_to_dict():
+    a = tracing.start_span("attempt0", parent=None)
+    b = tracing.start_span("attempt1", parent=None)
+    b.add_link(a.context, {"relation": "retry_of"})
+    b.add_link(tracing.NOOP_SPAN, {"relation": "nope"})   # no-op
+    a.end()
+    b.end()
+    d = [s for s in tracing.finished_spans()
+         if s["name"] == "attempt1"][0]
+    assert d["links"] == [{"trace_id": a.trace_id,
+                           "span_id": a.span_id,
+                           "attrs": {"relation": "retry_of"}}]
+
+
+# ---------------------------------------------------------------------------
+# serve_llm header handling (fake engine: no compiles)
+# ---------------------------------------------------------------------------
+
+class _FakeEngine:
+    """Records submit kwargs; resolves immediately."""
+
+    def __init__(self):
+        self.calls = []
+        self.cancels = []
+
+    def submit(self, prompt_ids, **kw):
+        from concurrent.futures import Future
+        self.calls.append(dict(kw, prompt_ids=list(prompt_ids)))
+        f = Future()
+        f.request_id = 7
+        f.set_result({"output_ids": [1, 2], "prompt_ids": prompt_ids})
+        return f
+
+    def cancel(self, request_id):
+        self.cancels.append(request_id)
+        return True
+
+
+@pytest.fixture()
+def fake_http():
+    from paddle_tpu.inference.llm import serve_llm
+    eng = _FakeEngine()
+    srv = serve_llm(eng)
+    host, port = srv.server_address[:2]
+    yield eng, f"http://{host}:{port}"
+    srv.shutdown()
+
+
+def _post(url, body, headers=None):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_serve_llm_forwards_traceparent(fake_http):
+    eng, base = fake_http
+    root = tracing.start_span("client", parent=None)
+    hdr = format_traceparent(root)
+    code, _out = _post(base + "/generate", {"prompt_ids": [1, 2]},
+                       {"traceparent": hdr})
+    assert code == 200
+    assert eng.calls[-1]["trace_context"] == hdr
+    root.end()
+
+
+def test_serve_llm_absent_header_passes_no_context(fake_http):
+    eng, base = fake_http
+    code, _out = _post(base + "/generate", {"prompt_ids": [1, 2]})
+    assert code == 200
+    assert "trace_context" not in eng.calls[-1]
+
+
+def test_serve_llm_cancel_span_joins_remote_trace(fake_http):
+    eng, base = fake_http
+    remote = SpanContext("c" * 32, "d" * 16)
+    code, out = _post(base + "/cancel", {"request_id": 7},
+                      {"traceparent": format_traceparent(remote)})
+    assert code == 200 and out["cancelled"] is True
+    assert eng.cancels == [7]
+    cancels = [s for s in tracing.finished_spans()
+               if s["name"] == "llm.cancel"]
+    assert cancels and cancels[-1]["trace_id"] == "c" * 32
+    assert cancels[-1]["parent_id"] == "d" * 16
+    assert cancels[-1]["attrs"]["cancelled"] is True
+
+
+# ---------------------------------------------------------------------------
+# the real thing: engine behind serve_llm, router in front, real HTTP
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def llm_http_fleet():
+    """One tiny real engine behind serve_llm; an HTTPReplica-backed
+    Router in front. The traceparent genuinely crosses an HTTP
+    boundary (same process, so both tables are inspectable)."""
+    from paddle_tpu.inference.llm import serve_llm
+    from paddle_tpu.serving import HTTPReplica, Router
+    from paddle_tpu.serving.replica import make_engine_from_spec
+    eng = make_engine_from_spec({"vocab": 97, "layers": 2,
+                                 "hidden": 64})
+    srv = serve_llm(eng)
+    host, port = srv.server_address[:2]
+    replica = HTTPReplica(f"http://{host}:{port}",
+                          "http://127.0.0.1:1/healthz")
+    router = Router({"r0": replica}, health_poll_interval=5.0,
+                    page_size=4)
+    yield eng, router, f"http://{host}:{port}"
+    router.close()
+    eng.close()
+    srv.shutdown()
+
+
+def test_one_trace_across_router_http_replica(llm_http_fleet):
+    """THE acceptance pin: one trace_id end to end over real HTTP."""
+    eng, router, _base = llm_http_fleet
+    out = router.submit([5, 6, 7, 8, 9], max_new_tokens=3) \
+        .result(timeout=120)
+    tid = out["trace_id"]
+    assert tid and len(tid) == 32
+    spans = [s for s in tracing.finished_spans()
+             if s["trace_id"] == tid]
+    by_name = {}
+    for s in spans:
+        by_name.setdefault(s["name"], []).append(s)
+    for want in ("router.request", "router.dispatch", "llm.request",
+                 "llm.queue", "llm.decode"):
+        assert want in by_name, (want, sorted(by_name))
+    root = by_name["router.request"][0]
+    dispatch = by_name["router.dispatch"][0]
+    llm_req = by_name["llm.request"][0]
+    assert root["parent_id"] is None
+    assert dispatch["parent_id"] == root["span_id"]
+    # the HTTP hop preserved the parent link exactly
+    assert llm_req["parent_id"] == dispatch["span_id"]
+    assert llm_req["attrs"].get("remote_parent") is True
+    # the replica-side phases stay inside the same trace
+    for s in by_name["llm.queue"] + by_name["llm.decode"]:
+        assert s["trace_id"] == tid
+
+
+def test_malformed_traceparent_never_rejects_over_real_http(
+        llm_http_fleet):
+    _eng, _router, base = llm_http_fleet
+    code, out = _post(base + "/generate",
+                      {"prompt_ids": [1, 2, 3], "max_new_tokens": 2},
+                      {"traceparent": "00-born-bad-ff"})
+    assert code == 200 and out["output_ids"]
+    roots = [s for s in tracing.finished_spans()
+             if s["name"] == "llm.request"
+             and s["attrs"].get("prompt_tokens") == 3]
+    assert roots and roots[-1]["parent_id"] is None
+
+
+def test_absent_traceparent_roots_locally_over_real_http(
+        llm_http_fleet):
+    _eng, _router, base = llm_http_fleet
+    code, out = _post(base + "/generate",
+                      {"prompt_ids": [9, 9, 9, 9], "max_new_tokens": 2})
+    assert code == 200 and out["output_ids"]
+    roots = [s for s in tracing.finished_spans()
+             if s["name"] == "llm.request"
+             and s["attrs"].get("prompt_tokens") == 4]
+    assert roots and roots[-1]["parent_id"] is None
+    assert "remote_parent" not in roots[-1]["attrs"]
+
+
+def test_tracing_disabled_side_produces_no_orphans(llm_http_fleet):
+    """Receiver disabled: a context arrives, nothing records, nothing
+    breaks; re-enabled, a disabled SENDER (no header) roots locally —
+    no span anywhere claims a parent that does not exist."""
+    eng, _router, base = llm_http_fleet
+    tracing.disable()
+    tracing.clear()
+    remote = SpanContext("e" * 32, "f" * 16)
+    hdr = format_traceparent(remote)
+    code, out = _post(base + "/generate",
+                      {"prompt_ids": [1, 2], "max_new_tokens": 2},
+                      {"traceparent": hdr})
+    assert code == 200 and out["output_ids"]
+    assert tracing.finished_spans() == []
+    assert tracing.live_spans() == []
+    # direct engine submit with a context while disabled: same story
+    eng.submit([3, 4], max_new_tokens=2,
+               trace_context=remote).result(timeout=120)
+    assert tracing.finished_spans() == []
+    tracing.enable()
+    code, out = _post(base + "/generate",
+                      {"prompt_ids": [1, 2, 3, 4, 5, 6],
+                       "max_new_tokens": 2})
+    assert code == 200
+    spans = tracing.finished_spans()
+    ids = {s["span_id"] for s in spans}
+    for s in spans:
+        assert s["parent_id"] is None or s["parent_id"] in ids, s
+
+
+def test_failover_redispatch_records_span_link():
+    """A failover re-dispatch links back to the attempt it replaces."""
+    from paddle_tpu.serving import Router
+    from paddle_tpu.serving.replica import ReplicaUnavailable
+
+    class Flaky:
+        def __init__(self, fail_n):
+            self.fail_n = fail_n
+            self.lock = threading.Lock()
+
+        def submit(self, prompt_ids, **kw):
+            with self.lock:
+                if self.fail_n > 0:
+                    self.fail_n -= 1
+                    raise ReplicaUnavailable("boom")
+            return {"output_ids": [1], "prompt_ids": list(prompt_ids)}
+
+        def health(self):
+            return "healthy"
+
+        def cancel(self, request_id):
+            return False
+
+        def close(self):
+            pass
+
+    from paddle_tpu.serving.router import affinity_key, rendezvous_pick
+    prompt, n = None, 0
+    while prompt is None:     # a prompt whose affinity prefers "a"
+        cand = [n, n + 1, n + 2]
+        if rendezvous_pick(affinity_key(cand, 16, 2),
+                           ("a", "b")) == "a":
+            prompt = cand
+        n += 1
+    with Router({"a": Flaky(fail_n=1), "b": Flaky(fail_n=0)},
+                failover_budget=2, health_poll_interval=5.0,
+                scrape_metrics=False) as r:
+        out = r.submit(prompt, max_new_tokens=1).result(timeout=60)
+    assert out["failovers"] == 1
+    tid = out["trace_id"]
+    dispatches = sorted(
+        (s for s in tracing.finished_spans()
+         if s["trace_id"] == tid and s["name"] == "router.dispatch"),
+        key=lambda s: s["ts"])
+    assert len(dispatches) == 2
+    first, second = dispatches
+    assert first["status"] == "error"
+    assert "links" not in first
+    assert second["links"] == [{
+        "trace_id": tid, "span_id": first["span_id"],
+        "attrs": {"relation": "retry_of",
+                  "replica": first["attrs"]["replica"]}}]

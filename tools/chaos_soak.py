@@ -31,7 +31,14 @@ docs/RELIABILITY.md):
    token-identical streams, checked against a reference engine), the
    killed replica's breaker walks open → half-open → closed across a
    respawn, and an injected ``router.dispatch`` fault replays from its
-   seed like any other site.
+   seed like any other site. Fleet observability rides the same soak:
+   ``GET /fleetz`` must aggregate the replicas with per-replica data,
+   and an injected DEADLINE-MISS STORM against one SLO class must move
+   its multi-window burn-rate gauges on ``GET /sloz`` and latch the
+   breach (visible on /healthz, cleared by the reset). On ANY fleet
+   assertion failure the report attaches a MERGED cross-process trace
+   (router + replicas via tools/trace_merge) next to the fault seed
+   and replay command.
 
 Determinism: every schedule is nth/probability-based with a fixed
 seed; ``faults.preview(site, N)`` recomputes the faulting call
@@ -353,26 +360,74 @@ def _poll_until(fn, timeout: float, what: str):
     raise AssertionError(f"timed out ({timeout}s) waiting for {what}")
 
 
+def _attach_fleet_trace(workdir: str, infos: dict):
+    """Best-effort failure attachment: merge the router's span table,
+    every reachable replica's /tracez, and any flight dumps under the
+    soak's obs_dir into one chrome trace. Never raises — the original
+    assertion is the story; this is the supporting evidence."""
+    try:
+        from paddle_tpu.observability import tracing
+        from tools.trace_merge import load_source, merge_chrome_trace
+        wall = tracing.perf_to_wall
+        sources = {"router": (
+            [dict(s, ts_wall=wall(s["ts"]), live=False)
+             for s in tracing.finished_spans()]
+            + [dict(s, ts_wall=wall(s["ts"]), live=True)
+               for s in tracing.live_spans()])}
+        for n, info in infos.items():
+            url = info.get("tracez")
+            if not url:
+                continue
+            try:
+                sources[n] = load_source(url, timeout=5)
+            except Exception:  # noqa: BLE001 — a dead replica's live
+                pass           # table is gone; its flight dump below
+        obs_dir = os.path.join(workdir, "obs")
+        if os.path.isdir(obs_dir):
+            for root, _dirs, files in os.walk(obs_dir):
+                for fn in files:
+                    if fn.startswith("flight_") and \
+                            fn.endswith(".jsonl"):
+                        tag = f"{os.path.basename(root)}:{fn}"
+                        try:
+                            sources[tag] = load_source(
+                                os.path.join(root, fn))
+                        except Exception:  # noqa: BLE001
+                            pass
+        path = os.path.join(workdir, "fleet_failure_trace.json")
+        return path, merge_chrome_trace(sources, path)
+    except Exception:  # noqa: BLE001 — never mask the real failure
+        return None, None
+
+
 def fleet_soak(seed: int, workdir: str) -> dict:
     """Scenario 5: the serving fleet under replica-level chaos.
     Asserts the ISSUE-6 acceptance invariants: zero lost requests
     across a SIGKILL (token-identical failover within budget), breaker
     open → half-open → closed across a respawn, draining replicas
     receiving no new admissions within one health-poll interval, and
-    seed-replayable router fault sites."""
+    seed-replayable router fault sites — plus the ISSUE-7 fleet
+    observability invariants (/fleetz aggregation, /sloz burn rates
+    moving under a deadline-miss storm, cross-process traces)."""
     from paddle_tpu.distributed.tcp_store import TCPStoreServer
+    from paddle_tpu.observability import tracing
     from paddle_tpu.reliability import faults
-    from paddle_tpu.serving import (LocalReplica, Router,
+    from paddle_tpu.serving import (LocalReplica, Router, SLOClass,
                                     make_engine_from_spec,
                                     spawn_replica)
     from paddle_tpu.serving.router import affinity_key, rendezvous_pick
 
     rng = np.random.RandomState(seed)
     faults.reset()
+    tracing.enable()   # router-side spans: the failure report's raw data
     store = TCPStoreServer("127.0.0.1", 0)
     endpoint = f"127.0.0.1:{store.port}"
+    obs_dir = os.path.join(workdir, "obs")
     model = {"vocab": 97, "layers": 2, "hidden": 64, "heads": 4,
-             "max_pos": 96, "model_seed": 0}
+             "max_pos": 96, "model_seed": 0,
+             # every replica traces and collects its dumps under ONE
+             # tree the soak can merge on failure
+             "tracing": True, "obs_dir": obs_dir}
     engine_kw = {"device_retry_budget": 2, "drain_after": 2,
                  "max_pending": 64, "seed": 0}
     names = ("r0", "r1", "r2")
@@ -428,7 +483,13 @@ def fleet_soak(seed: int, workdir: str) -> dict:
                     affinity_pages=2, failover_budget=2,
                     health_poll_interval=0.2,
                     membership_stale_after=1.5,
-                    breaker_fail_threshold=3, breaker_open_for=1.0)
+                    breaker_fail_threshold=3, breaker_open_for=1.0,
+                    # the SLO class the phase-D deadline-miss storm
+                    # burns: tight windows so a ~45s soak spans them
+                    slo_classes={"gold": SLOClass(
+                        "gold", deadline_s=60.0, target=0.99)},
+                    slo_windows=(2.0, 8.0), slo_min_samples=5,
+                    slo_breach_threshold=5.0)
     out = {"spawn_ok": True}
     try:
         _poll_until(lambda: set(router.replica_names()) == set(names),
@@ -550,8 +611,86 @@ def fleet_soak(seed: int, workdir: str) -> dict:
         _assert_schedule_matches(faults, ("router.dispatch",))
         faults.reset()
         out["router_faults"] = {"injected": 1}
+
+        # -- phase D: fleet observability. /fleetz must aggregate all
+        # three replicas with per-replica data; a deadline-miss storm
+        # against the "gold" SLO class must move its burn-rate gauges
+        # on /sloz and latch the breach (cleared by /reset_health)
+        from urllib.request import Request, urlopen
+
+        from paddle_tpu.observability.server import DebugServer
+        from paddle_tpu.reliability.retry import DeadlineExceeded
+        dbg = DebugServer(port=0).start()
+        base = f"http://127.0.0.1:{dbg.port}"
+
+        def get_json(path):
+            with urlopen(base + path, timeout=10) as r:
+                return json.loads(r.read())
+
+        try:
+            def fleetz_all_up():
+                fz = next(iter(get_json("/fleetz")["fleets"].values()))
+                reps = fz["replicas"]
+                ok = all(n in reps and (reps[n].get("metrics") or {})
+                         .get("up") for n in names) \
+                    and fz["aggregates"]["tokens_generated"] > 0
+                return fz if ok else None
+
+            fz = _poll_until(fleetz_all_up, 15,
+                             "/fleetz aggregating all 3 replicas")
+            assert fz["aggregates"]["replicas_scraped"] == 3, fz
+            sz = next(iter(get_json("/sloz")["slo"].values()))
+            burn0 = sz["classes"].get("gold", {}).get(
+                "windows", {}).get("short", {}).get("burn_rate", 0.0)
+            assert burn0 == 0.0, f"gold budget burning before the " \
+                f"storm: {sz}"
+            storm = [router.submit(affine_prompt("r1", 8),
+                                   max_new_tokens=4, slo="gold",
+                                   deadline=0.001) for _ in range(8)]
+            n_missed = 0
+            for f in storm:
+                try:
+                    f.result(timeout=120)
+                except DeadlineExceeded:
+                    n_missed += 1
+            assert n_missed == 8, f"storm deadlines not hopeless " \
+                f"enough: {n_missed}/8 missed"
+            sz = next(iter(get_json("/sloz")["slo"].values()))
+            gold = sz["classes"]["gold"]
+            assert gold["windows"]["short"]["burn_rate"] > 5.0, gold
+            assert gold["windows"]["long"]["burn_rate"] > 5.0, gold
+            assert "gold" in sz["breached"], sz
+            hz = get_json("/healthz")
+            slo_comp = [v for k, v in hz.get("components", {}).items()
+                        if k.endswith("_slo")]
+            assert slo_comp == ["degraded"], hz
+            # operator acknowledgment clears the latch over HTTP
+            with urlopen(Request(base + "/reset_health", data=b"{}"),
+                         timeout=10) as resp:
+                assert resp.status == 200, resp.status
+            sz = next(iter(get_json("/sloz")["slo"].values()))
+            assert sz["breached"] == [], sz
+            out["slo"] = {"missed": n_missed,
+                          "burn_short": gold["windows"]["short"]
+                          ["burn_rate"]}
+        finally:
+            dbg.stop()
+    except AssertionError:
+        # the failure report attaches the merged cross-process trace:
+        # every span table in the fleet (router + replica /tracez +
+        # any flight dumps under obs_dir) on one ts_wall-aligned
+        # timeline — the "which process ate the latency / dropped the
+        # request" question answered next to the replay command
+        path, summary = _attach_fleet_trace(workdir, infos)
+        if path is not None:
+            print(f"merged cross-process trace attached: {path} "
+                  f"({summary['spans']} spans from "
+                  f"{summary['processes']} processes)",
+                  file=sys.stderr, flush=True)
+        raise
     finally:
         faults.reset()
+        tracing.disable()
         router.close()
         ref.engine.close()
         for p in procs.values():
@@ -580,7 +719,7 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--ci", action="store_true",
                     help="fixed seeds, one pass per scenario "
-                         "(~30s compute budget; ~45s with --fleet)")
+                         "(~30s compute budget; ~50s with --fleet)")
     ap.add_argument("--fleet", action="store_true",
                     help="run ONLY the fleet scenario (router + K=3 "
                          "replica subprocesses, SIGKILL mid-decode)")

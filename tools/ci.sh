@@ -31,6 +31,15 @@ echo "== observability smoke (train loop -> prometheus + chrome trace"
 echo "   + jsonl + debug-server scrape + flight-recorder crash dump)"
 python tools/obs_smoke.py "$(mktemp -d)"
 
+echo "== fleet observability smoke (K=2 replicas -> /fleetz federation"
+echo "   + one cross-process trace + disabled-tracing flag-check bound)"
+# router + 2 spawned replicas: /fleetz aggregates replica-labeled
+# series, a request's router.dispatch -> llm.request spans share ONE
+# trace_id over real HTTP (fetched back via /tracez?trace_id=),
+# trace_merge joins the tables, and disabled tracing still costs one
+# flag check (time-bounded)
+python tools/obs_smoke.py "$(mktemp -d)" --fleet
+
 echo "== llm serving smoke (prefix cache + chunked ragged prefill)"
 # 4 shared-prefix prompts through the engine: asserts nonzero cache
 # hits, cache-on == cache-off generations, and a clean shutdown
@@ -48,7 +57,9 @@ echo "== fleet chaos soak (K=3 replicas, SIGKILL mid-decode -> failover)"
 # injected faults drain one replica (no new admissions within a poll
 # interval; POST /reset_health recovers it), SIGKILL mid-decode loses
 # zero requests (token-identical failover), the breaker walks
-# open -> half-open -> closed across a respawn
+# open -> half-open -> closed across a respawn; /fleetz aggregates the
+# fleet and a deadline-miss storm moves /sloz burn rates + latches the
+# breach; failures attach a merged cross-process trace
 python tools/chaos_soak.py --ci --fleet
 
 echo "== fleet serving bench (prefix-affinity vs round-robin at K=3)"

@@ -10,7 +10,18 @@ recorder installed and assert the JSONL dump was written. Exits
 non-zero on any missing signal so a refactor that silently unhooks an
 instrument fails CI, not a 3am bench round.
 
-Run: python tools/obs_smoke.py [outdir]
+FLEET MODE (``--fleet``): spawn K=2 replica subprocesses behind a
+Router and assert the fleet-wide observability holds — ``GET /fleetz``
+aggregates both replicas with per-replica data, the router's
+``/metrics`` re-exports replica-labeled ``fleet_llm_*`` series, a
+request's spans form ONE cross-process trace (router.request →
+router.dispatch here, llm.request in the replica, fetched back via
+``/tracez?trace_id=``), ``tools/trace_merge.py`` joins the tables onto
+one timeline, and — the PR-4 regression criterion — DISABLED tracing
+still costs one flag check (start_span returns the shared noop, time-
+bounded).
+
+Run: python tools/obs_smoke.py [outdir] [--fleet]
 """
 
 import json
@@ -164,5 +175,178 @@ raise RuntimeError("forced crash for the obs smoke gate")
     return 0
 
 
+def _get_json(url: str, timeout: float = 30.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def fleet_main(outdir: str = "/tmp/pt_obs_fleet_smoke") -> int:
+    import time
+
+    from paddle_tpu.observability import server as debug_server
+    from paddle_tpu.observability import tracing
+    from paddle_tpu.serving import HTTPReplica, Router, spawn_replica
+    from tools.trace_merge import load_source, merge_chrome_trace
+
+    os.makedirs(outdir, exist_ok=True)
+    obs_dir = os.path.join(outdir, "obs")
+    cache_dir = os.path.join(outdir, "xla_cache")
+    os.makedirs(cache_dir, exist_ok=True)
+    model = {"vocab": 97, "layers": 2, "hidden": 64, "heads": 4,
+             "max_pos": 96, "model_seed": 0, "tracing": True,
+             "obs_dir": obs_dir, "cache_dir": cache_dir,
+             "engine": {"seed": 0, "max_pending": 64}}
+    names = ("r0", "r1")
+    tracing.enable()
+    # setup happens INSIDE the try: a spawn/warm-up failure must
+    # still kill whatever replica subprocesses already exist
+    procs, infos = {}, {}
+    router, srv = None, None
+    try:
+        # staggered spawn: r0 warms the shared compile cache for r1
+        procs["r0"], infos["r0"] = spawn_replica(
+            dict(model, name="r0"), timeout=240)
+        HTTPReplica(infos["r0"]["generate"],
+                    infos["r0"]["healthz"]).submit([1, 2, 3],
+                                                   max_new_tokens=2)
+        procs["r1"], infos["r1"] = spawn_replica(
+            dict(model, name="r1"), timeout=240)
+        router = Router(
+            {n: HTTPReplica(infos[n]["generate"], infos[n]["healthz"],
+                            metrics_url=infos[n]["metrics"])
+             for n in names},
+            health_poll_interval=0.2, page_size=4, affinity_pages=2)
+        srv = debug_server.DebugServer(port=0).start()
+        base = f"http://127.0.0.1:{srv.port}"
+        from paddle_tpu.serving.router import (affinity_key,
+                                               rendezvous_pick)
+        import numpy as np
+
+        def prompt_for(target, length=12, seed=0):
+            # rejection-sample a prompt whose affinity preference is
+            # `target` — BOTH replicas must serve traffic for the
+            # per-replica federation assertions to mean anything
+            rng = np.random.RandomState(seed)
+            while True:
+                p = rng.randint(0, 97, length).tolist()
+                key = affinity_key(p, router.page_size,
+                                   router.affinity_pages)
+                if rendezvous_pick(key, names) == target:
+                    return p
+
+        outs = [router.submit(prompt_for(n, seed=i), max_new_tokens=4)
+                .result(timeout=240)
+                for i, n in enumerate(names * 2)]
+        assert all(o["output_ids"] for o in outs)
+        assert {o["replica"] for o in outs} == set(names), outs
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            code, fz = _get_json(base + "/fleetz")
+            fleet = next(iter(fz["fleets"].values()))
+            reps = fleet["replicas"]
+            # wait for a scrape taken AFTER the traffic: EACH
+            # replica's own completed work must be visible (an "up"
+            # verdict can come from a pre-traffic scrape cycle)
+            if all(n in reps and (reps[n].get("metrics") or {})
+                   .get("requests_completed") for n in names):
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError(f"/fleetz never aggregated both "
+                                 f"replicas' traffic: {fz}")
+        # -- /fleetz: per-replica data + computed aggregates ------------
+        agg = fleet["aggregates"]
+        assert agg["replicas_scraped"] == 2, agg
+        assert any((reps[n]["metrics"] or {}).get("requests_completed")
+                   for n in names), reps
+        # -- /metrics: replica-labeled federated series -----------------
+        with urllib.request.urlopen(base + "/metrics", timeout=30) as r:
+            scraped = r.read().decode()
+        for n in names:
+            assert f'fleet_llm_requests_completed{{replica="{n}"}}' \
+                in scraped, f"federated series for {n} missing"
+        assert "fleet_prefix_cache_hit_rate" in scraped
+        assert "router_dispatches_total" in scraped
+        # -- ONE cross-process trace ------------------------------------
+        out = outs[0]
+        tid = out["trace_id"]
+        assert tid and len(tid) == 32, out
+        local = [s for s in tracing.finished_spans()
+                 if s["trace_id"] == tid]
+        lnames = {s["name"] for s in local}
+        assert {"router.request", "router.dispatch"} <= lnames, lnames
+        dispatch = [s for s in local if s["name"] == "router.dispatch"]
+        replica = out["replica"]
+        code, tz = _get_json(
+            infos[replica]["tracez"] + f"?trace_id={tid}")
+        rspans = {s["name"]: s for s in tz["finished"]}
+        assert "llm.request" in rspans, (
+            f"replica {replica} has no llm.request for trace {tid}: "
+            f"{sorted(rspans)}")
+        req_span = rspans["llm.request"]
+        assert req_span["trace_id"] == tid
+        assert req_span["parent_id"] in {d["span_id"] for d in dispatch}
+        assert req_span["attrs"].get("remote_parent") is True
+        # the replica-side phases share the trace too
+        assert any(n.startswith("llm.") and n != "llm.request"
+                   for n in rspans), sorted(rspans)
+        # -- merged timeline via trace_merge ----------------------------
+        sources = {"router": load_source(base + "/tracez"),
+                   **{n: load_source(infos[n]["tracez"])
+                      for n in names}}
+        merged = merge_chrome_trace(
+            sources, os.path.join(outdir, "merged.json"), trace_id=tid)
+        assert merged["spans"] >= 3, merged
+        assert merged["trace_ids"] == 1, merged
+        with open(merged["path"]) as f:
+            chrome = json.load(f)
+        pnames = {e["args"]["name"] for e in chrome["traceEvents"]
+                  if e["name"] == "process_name"}
+        assert {"router", "r0", "r1"} <= pnames, pnames
+        # -- /sloz answers (burn-rate movement is chaos-soak-asserted) --
+        code, sz = _get_json(base + "/sloz")
+        assert code == 200
+        classes = next(iter(sz["slo"].values()))["classes"]
+        assert "default" in classes, classes
+        assert classes["default"]["windows"]["short"]["requests"] > 0
+        # -- flight/JSONL artifacts landed under the obs_dir knob -------
+        for n in names:
+            jl = os.path.join(obs_dir, n, "metrics.jsonl")
+            assert os.path.exists(jl), f"{n} JSONL reporter wrote nothing"
+        # -- PR-4 regression criterion: disabled tracing = one flag
+        # check. Structural half: the shared noop comes back (no Span,
+        # no table write). Timing half: a generous per-call bound that
+        # still catches accidentally creating real spans.
+        tracing.disable()
+        sp = tracing.start_span("ghost")
+        assert sp is tracing.NOOP_SPAN
+        n_calls = 200_000
+        t0 = time.perf_counter()
+        for _ in range(n_calls):
+            tracing.start_span("ghost")
+        per_call = (time.perf_counter() - t0) / n_calls
+        assert per_call < 5e-6, (
+            f"disabled start_span costs {per_call * 1e6:.2f}us/call — "
+            f"more than a flag check")
+    finally:
+        tracing.disable()
+        if router is not None:
+            router.close()
+        if srv is not None:
+            srv.stop()
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=30)
+    print(f"fleet observability smoke OK: 2 replicas federated, "
+          f"cross-process trace {tid} merged "
+          f"({merged['spans']} spans), disabled tracing "
+          f"{per_call * 1e9:.0f}ns/call -> {outdir}")
+    return 0
+
+
 if __name__ == "__main__":
-    sys.exit(main(*sys.argv[1:]))
+    argv = sys.argv[1:]
+    fleet = "--fleet" in argv
+    argv = [a for a in argv if a != "--fleet"]
+    sys.exit(fleet_main(*argv) if fleet else main(*argv))

@@ -1,0 +1,205 @@
+"""Merge span tables from router + replicas onto ONE chrome-trace
+timeline.
+
+A fleet request now shares one trace_id across processes
+(observability.propagation), but the evidence still lives in K+1
+separate tables: each process's /tracez ring and, after a crash, its
+flight-recorder dump. This tool joins them: every source becomes a
+chrome://tracing PROCESS (a ``process_name`` metadata row labeled with
+the replica/router name), spans land at their wall-clock time
+(``ts_wall``, which both /tracez and flight dumps carry exactly so
+independently-booted processes line up), and parent/link ids ride in
+``args`` — so "the router dispatched at t, the replica prefilled at
+t+2ms, the failover re-dispatch linked back at t+40ms" reads as one
+story in Perfetto.
+
+Sources (``name=target``), auto-detected by shape:
+
+- a live debug server:  ``r0=http://127.0.0.1:8080/tracez``
+  (``?trace_id=`` and ``?limit=`` pass through if you add them;
+  ``limit=0`` is appended by default so the whole ring ships);
+- a saved /tracez snapshot: ``r0=r0_tracez.json``;
+- a flight-recorder dump:   ``r0=flight_123_sigterm.jsonl``.
+
+Run::
+
+    python tools/trace_merge.py -o merged.json \
+        router=http://127.0.0.1:8080/tracez \
+        r0=obs/r0/flight_4242_exception.jsonl r1=r1_tracez.json \
+        [--trace-id <32-hex id>]
+
+The fleet chaos soak calls :func:`merge_chrome_trace` directly to
+attach a merged timeline to its failure reports.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional
+
+# span dicts flow through as produced by observability.tracing, plus
+# "ts_wall" (required for alignment) and "live" (still-open spans)
+
+
+def _spans_from_tracez(payload: dict) -> List[dict]:
+    out = []
+    for sp in payload.get("finished", []):
+        out.append(dict(sp, live=False))
+    for sp in payload.get("live", []):
+        out.append(dict(sp, live=True))
+    return out
+
+
+def _spans_from_flight(lines) -> List[dict]:
+    out = []
+    for ln in lines:
+        ln = ln.strip()
+        if not ln:
+            continue
+        try:
+            row = json.loads(ln)
+        except ValueError:
+            continue        # a torn tail line in a crash dump is fine
+        if row.get("kind") == "span":
+            out.append(row)
+    return out
+
+
+def load_source(target: str, timeout: float = 10.0) -> List[dict]:
+    """Load spans from a /tracez URL, a /tracez JSON snapshot file, or
+    a flight-recorder JSONL dump."""
+    if target.startswith(("http://", "https://")):
+        from urllib.request import urlopen
+        url = target
+        if "limit=" not in url:
+            url += ("&" if "?" in url else "?") + "limit=0"
+        with urlopen(url, timeout=timeout) as r:
+            return _spans_from_tracez(json.loads(r.read()))
+    with open(target) as f:
+        if target.endswith(".jsonl"):
+            return _spans_from_flight(f)
+        payload = json.load(f)
+    if isinstance(payload, dict) and (
+            "finished" in payload or "live" in payload):
+        return _spans_from_tracez(payload)
+    raise ValueError(f"unrecognized source shape: {target}")
+
+
+def merge_chrome_trace(sources: Dict[str, List[dict]], path: str,
+                       trace_id: Optional[str] = None) -> dict:
+    """Write one chrome-trace JSON from ``{process_name: spans}``.
+    Timestamps are ``ts_wall``-aligned: the earliest span across ALL
+    sources becomes t=0, so cross-process ordering is real ordering
+    (clock skew bounded by the hosts' wall clocks — exact on the
+    single-host fleets the soak spawns). Returns a summary dict."""
+    t0 = None
+    for spans in sources.values():
+        for sp in spans:
+            w = sp.get("ts_wall")
+            if w is not None and (t0 is None or w < t0):
+                t0 = w
+    t0 = t0 or 0.0
+    events, n_spans, n_links = [], 0, 0
+    trace_ids = set()
+    for pid, (pname, spans) in enumerate(sorted(sources.items())):
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "args": {"name": pname}})
+        tnames = {}
+        for sp in spans:
+            if trace_id is not None and sp.get("trace_id") != trace_id:
+                continue
+            if sp.get("ts_wall") is None:
+                continue        # can't place it on the shared axis
+            tnames.setdefault(sp.get("tid"), sp.get("tname"))
+        for tid, tname in sorted(tnames.items(),
+                                 key=lambda kv: kv[0] or 0):
+            events.append({"name": "thread_name", "ph": "M",
+                           "pid": pid, "tid": tid,
+                           "args": {"name": tname or f"thread-{tid}"}})
+        for sp in spans:
+            if trace_id is not None and sp.get("trace_id") != trace_id:
+                continue
+            wall = sp.get("ts_wall")
+            if wall is None:
+                continue
+            trace_ids.add(sp.get("trace_id"))
+            n_spans += 1
+            args = {"trace_id": sp.get("trace_id"),
+                    "span_id": sp.get("span_id"),
+                    "parent_id": sp.get("parent_id"),
+                    "status": sp.get("status"),
+                    **(sp.get("attrs") or {})}
+            links = sp.get("links") or []
+            if links:
+                n_links += len(links)
+                args["links"] = links
+            if sp.get("live"):
+                args["live"] = True
+            events.append({
+                "name": sp["name"], "ph": "X", "cat": "span",
+                "ts": round((wall - t0) * 1e6, 3),
+                "dur": round((sp.get("dur") or 0.0) * 1e6, 3),
+                "pid": pid, "tid": sp.get("tid"),
+                "args": args,
+            })
+            # span events ride as thread-scoped instants; their perf
+            # timestamps convert through THIS span's wall offset
+            offset = wall - sp["ts"] if sp.get("ts") is not None \
+                else None
+            for ev in sp.get("events", []):
+                if offset is None or ev.get("ts") is None:
+                    continue
+                events.append({
+                    "name": f"{sp['name']}:{ev['name']}",
+                    "ph": "i", "s": "t", "cat": "span_event",
+                    "ts": round((ev["ts"] + offset - t0) * 1e6, 3),
+                    "pid": pid, "tid": sp.get("tid"),
+                    "args": {"span_id": sp.get("span_id"),
+                             **(ev.get("attrs") or {})},
+                })
+    payload = {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "producer": "paddle_tpu tools/trace_merge.py",
+            "t0_wall": t0,
+            "trace_id_filter": trace_id,
+        },
+    }
+    with open(path, "w") as f:
+        json.dump(payload, f)
+    return {"path": path, "processes": len(sources), "spans": n_spans,
+            "links": n_links, "trace_ids": len(trace_ids)}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        epilog="sources: name=path-or-url (flight .jsonl, /tracez "
+               ".json snapshot, or live /tracez URL)")
+    ap.add_argument("-o", "--out", default="merged_trace.json")
+    ap.add_argument("--trace-id", default=None,
+                    help="keep only this trace's spans")
+    ap.add_argument("sources", nargs="+", metavar="NAME=TARGET")
+    args = ap.parse_args(argv)
+    sources: Dict[str, List[dict]] = {}
+    for item in args.sources:
+        name, _, target = item.partition("=")
+        if not target:
+            ap.error(f"source {item!r} is not NAME=TARGET")
+        try:
+            sources[name] = load_source(target)
+        except Exception as e:  # noqa: BLE001 — partial fleets merge
+            print(f"warning: source {name} ({target}) skipped: {e}",
+                  file=sys.stderr)
+            sources[name] = []
+    summary = merge_chrome_trace(sources, args.out,
+                                 trace_id=args.trace_id)
+    print("merged: " + json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
