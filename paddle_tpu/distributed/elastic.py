@@ -45,6 +45,12 @@ from typing import Dict, List, Optional
 
 HB_DIR_ENV = "PADDLE_ELASTIC_HB_DIR"
 RESTART_COUNT_ENV = "PADDLE_ELASTIC_RESTART_COUNT"
+# Newest VERIFIED checkpoint step, threaded into each respawned
+# generation's env when the manager knows the checkpoint directory —
+# Model.fit(resume="auto") reads it, so a respawned rank picks up the
+# right step with no script changes (and falls back to the newest
+# verified step if the pinned one has rotted since).
+RESUME_STEP_ENV = "PADDLE_ELASTIC_RESUME_STEP"
 
 # A rank exiting with this code means "I was preempted, my state is
 # checkpointed, restart me" — the launcher restarts WITHOUT burning the
@@ -205,7 +211,8 @@ class ElasticManager:
                  poll_interval: float = 0.2,
                  restart_backoff: float = 0.5,
                  restart_backoff_cap: float = 30.0,
-                 backoff_reset_s: float = 60.0):
+                 backoff_reset_s: float = 60.0,
+                 checkpoint_dir: Optional[str] = None):
         self.nproc = nproc
         self.script = training_script
         self.script_args = script_args
@@ -217,6 +224,16 @@ class ElasticManager:
         self.poll_interval = poll_interval
         self.restarts = 0      # failure-budget consumption only
         self.generation = 0    # every respawn (failures AND preemptions)
+        # elastic auto-resume: when the manager knows where checkpoints
+        # live, every generation gets $PADDLE_ELASTIC_RESUME_STEP (the
+        # newest verified step) and the respawn path watches whether
+        # that step ADVANCES between generations — a crash loop that
+        # never moves the checkpoint (e.g. the newest checkpoint keeps
+        # failing verification on restore) damps like any other
+        # restart storm instead of hot-looping into the same corruption
+        self.checkpoint_dir = checkpoint_dir
+        self._spawn_resume_step: Optional[int] = None
+        self._resume_stalls = 0
         # restart-storm damping (reliability.retry backoff curve): a
         # deterministic child crash used to hot-loop max_preemptions
         # times in seconds; now consecutive short-lived generations
@@ -251,11 +268,14 @@ class ElasticManager:
                     os.unlink(os.path.join(self._hb_dir, f))
                 except OSError:
                     pass
+        resume_step = self._spawn_resume_step = self._latest_verified()
         for rank in range(self.nproc):
             env = dict(os.environ)
             env.update(self.env_extra)
             env.update(trainer_env(rank, self.nproc, self.master))
             env[RESTART_COUNT_ENV] = str(self.generation)
+            if resume_step is not None:
+                env[RESUME_STEP_ENV] = str(resume_step)
             if self.heartbeat_timeout is not None:
                 env[HB_DIR_ENV] = self._hb_dir
             stdout = None
@@ -269,6 +289,31 @@ class ElasticManager:
                 [sys.executable, self.script, *self.script_args],
                 env=env, stdout=stdout,
                 stderr=subprocess.STDOUT if stdout else None))
+
+    def _latest_verified(self) -> Optional[int]:
+        """Newest verified (manifested) checkpoint step, or None —
+        orbax-free manifest scan, cheap enough for every respawn."""
+        if self.checkpoint_dir is None:
+            return None
+        from ..io.checkpoint import latest_manifest_step
+        return latest_manifest_step(self.checkpoint_dir)
+
+    def _note_resume_progress(self) -> bool:
+        """After a generation dies: did the resumable step advance past
+        what that generation was HANDED at spawn? Returns True when the
+        restart is STALLED on the same checkpoint — the signal that
+        feeds the respawn backoff, so a newest checkpoint that keeps
+        failing verification on restore can't drive a hot-loop of
+        doomed respawns into the same corruption."""
+        if self.checkpoint_dir is None:
+            return False
+        stalled = self._latest_verified() == self._spawn_resume_step
+        if stalled:
+            self._resume_stalls += 1
+            stat_add("elastic.resume_stalls")
+        else:
+            self._resume_stalls = 0
+        return stalled
 
     def _teardown(self) -> None:
         for p in self._procs:
@@ -386,9 +431,18 @@ class ElasticManager:
                       file=sys.stderr)
             # restart-storm damping before the respawn; a CHECKPOINTED
             # preemption exit is evidence of health, not of a crash
-            # loop — it restarts immediately and resets the curve
+            # loop — it restarts immediately and resets the curve.
+            # Unless the checkpoint is STALLED: a "graceful" exit that
+            # never advances the verified step (emergency flush timing
+            # out every time, or resume dying into a corrupt newest
+            # checkpoint) is a crash loop wearing a 67 — damp it, and
+            # let consecutive stalls escalate the curve.
+            stalled = self._note_resume_progress()
+            if stalled and self._resume_stalls > 1:
+                self._backoff_level = max(self._backoff_level,
+                                          self._resume_stalls - 1)
             self._respawn_backoff(
-                healthy=(code == RESTART_EXIT_CODE))
+                healthy=(code == RESTART_EXIT_CODE and not stalled))
             # fresh rendezvous for the new generation (the reference
             # re-registers under a new etcd index the same way)
             self.master = f"127.0.0.1:{find_free_port()}"

@@ -17,6 +17,7 @@ step compiles to an SPMD program.
 
 from __future__ import annotations
 
+import base64
 import contextlib
 import os
 import pickle
@@ -785,6 +786,120 @@ class Model:
         return self._predict_fn(self._params, self._frozen, self._buffers,
                                 inputs)
 
+    # -- preemption-safe training state (ISSUE 8) ---------------------------
+    def _save_training_state(self, mgr, loader, epoch: int,
+                             boundary: bool = False,
+                             force: bool = False) -> None:
+        """Checkpoint the COMPLETE training state: params/opt-state/
+        buffers as the array tree, plus a small manifest ``state``
+        bundle — global step, epoch, DataLoader cursor, the RNG base
+        key, and pickled metric accumulators. With an async manager
+        the call stalls only for the device→host snapshot; the commit
+        overlaps the next train steps. Pending device metric buffers
+        are drained FIRST, so the snapshot never loses in-flight
+        metric state.
+
+        ``boundary=True`` means the epoch (and its pass over the
+        loader) is COMPLETE: the state records the NEXT epoch at batch
+        0 — resuming from an exhausted cursor would replay the
+        finished epoch's on_epoch_begin/eval/on_epoch_end over an
+        empty train pass."""
+        if self._params is None:
+            self._sync_state_in()
+        self._drain_metric_updates()
+        tree = {"params": self._params, "opt": self._opt_state}
+        if self._frozen:
+            tree["frozen"] = self._frozen
+        if self._buffers:
+            tree["buffers"] = self._buffers
+        key_data = np.asarray(
+            jax.random.key_data(rng.get_global_stream()._key))
+        cursor = loader.state_dict()
+        if boundary:
+            cursor = {"pass": int(cursor["pass"]) + 1, "batch": 0}
+            epoch = epoch + 1
+        state = {
+            "step": int(self._step_count),
+            "epoch": int(epoch),
+            "loader": cursor,
+            "rng": {"seed": int(rng._tls.global_seed),
+                    "key_data": key_data.tolist(),
+                    "key_dtype": str(key_data.dtype)},
+            "metrics": base64.b64encode(pickle.dumps(
+                [m.__dict__ for m in self._metrics],
+                protocol=4)).decode("ascii"),
+        }
+        mgr.save(self._step_count, tree, state=state, force=force)
+
+    def _restore_training_state(self, mgr, resume, loader):
+        """Resume from ``mgr``: newest verified step for
+        ``resume="auto"`` (or the step pinned by
+        ``$PADDLE_ELASTIC_RESUME_STEP`` — an elastic respawn's hint —
+        falling back to auto if that step is gone or corrupt), an
+        explicit int otherwise. Returns the manifest state bundle, or
+        None when the directory has no checkpoint (fresh start)."""
+        from ..io.checkpoint import CheckpointCorrupt
+        # identity/string checks, NOT `resume in (True, "auto")`:
+        # 1 == True in Python, and resume=1 must mean STEP 1
+        auto = resume == "auto" or resume is True
+        step = None
+        if not auto:
+            step = int(resume)
+        else:
+            env = os.environ.get("PADDLE_ELASTIC_RESUME_STEP")
+            if env:
+                step = int(env)
+        try:
+            try:
+                tree, state = mgr.restore_with_state(step)
+            except (CheckpointCorrupt, FileNotFoundError):
+                if not auto or step is None:
+                    raise
+                # the env-pinned step is gone or rotted: auto falls
+                # back to the newest verifying step
+                tree, state = mgr.restore_with_state(None)
+        except FileNotFoundError:
+            # only auto treats an empty directory as a fresh start; an
+            # explicit resume=<step> that is missing (GC'd, mistyped)
+            # must not silently retrain from step 0
+            if not auto:
+                raise
+            return None
+        # jnp.array(copy=True), NOT asarray: on CPU backends asarray
+        # can zero-copy ALIAS the restored numpy buffers, and the
+        # fused train loop then DONATES them — freeing the numpy tree
+        # turns the live params into use-after-free garbage (same
+        # hazard as the save-side snapshot, mirrored)
+        put = lambda t: jax.tree_util.tree_map(  # noqa: E731
+            lambda x: jnp.array(x, copy=True), t)
+        self._params = put(tree["params"])
+        self._frozen = put(tree.get("frozen") or {})
+        self._buffers = put(tree.get("buffers") or {})
+        self._opt_state = put(tree["opt"])
+        state = dict(state or {})
+        self._step_count = int(state.get("step", mgr.latest_step() or 0))
+        rng_state = state.get("rng")
+        if rng_state:
+            # the base key, not just the seed: next_key() calls before
+            # fit() advance the stream past from_seed(seed)
+            rng._tls.global_seed = int(rng_state["seed"])
+            key = jax.random.wrap_key_data(jnp.asarray(np.asarray(
+                rng_state["key_data"],
+                dtype=rng_state.get("key_dtype", "uint32"))))
+            rng._tls.stack = [rng.KeyStream(key)]
+        blob = state.get("metrics")
+        if blob:
+            for m, st in zip(self._metrics,
+                             pickle.loads(base64.b64decode(blob))):
+                m.__dict__.update(st)
+        cursor = state.get("loader")
+        if cursor:
+            loader.load_state_dict(cursor)
+        # rebind network attributes so save()/state_dict() see the
+        # restored values (same invalidation contract as Model.load)
+        self._sync_state_out()
+        return state
+
     # -- fit/evaluate/predict loops -----------------------------------------
     def _as_loader(self, data, batch_size, shuffle) -> DataLoader:
         if isinstance(data, DataLoader):
@@ -798,7 +913,13 @@ class Model:
             save_dir: Optional[str] = None, save_freq: int = 1,
             verbose: int = 2, drop_last: bool = False, shuffle: bool = True,
             num_workers: int = 0, callbacks=None,
-            steps_per_loop: Optional[int] = None) -> None:
+            steps_per_loop: Optional[int] = None,
+            checkpoint_dir: Optional[str] = None,
+            checkpoint_freq: Optional[int] = None,
+            resume=None, keep_checkpoints: int = 5,
+            async_checkpoint: bool = True,
+            preemption_guard=None,
+            preemption_flush_budget: float = 30.0) -> None:
         """ref: hapi/model.py:1574.
 
         ``steps_per_loop`` (default ``FLAGS.steps_per_loop``) fuses K
@@ -806,7 +927,25 @@ class Model:
         double-buffered [K, ...] superbatches — losses are bit-identical
         to K=1 (see ``_build_train_loop`` for the exactness scope) while
         the per-step Python/dispatch overhead is paid once per slab. Callbacks still see per-step on_train_batch_begin/end
-        (driven from the slab's stacked, lazily-coerced logs)."""
+        (driven from the slab's stacked, lazily-coerced logs).
+
+        Preemption-safe training (docs/RELIABILITY.md):
+
+        - ``checkpoint_dir`` arms full-state checkpointing through
+          ``io.checkpoint.CheckpointManager`` — every ``checkpoint_freq``
+          optimizer steps (or each epoch when None), async by default:
+          the loop stalls only for the device→host snapshot.
+        - ``resume="auto"`` (or an explicit step) restores the newest
+          VERIFIED checkpoint — params, optimizer state, RNG base key,
+          DataLoader cursor (mid-epoch, mid-superbatch), and metric
+          accumulators — and continues with a loss stream bit-identical
+          to the uninterrupted run at any ``steps_per_loop``. An
+          elastic respawn pins the step via
+          ``$PADDLE_ELASTIC_RESUME_STEP``; no script change needed.
+        - ``preemption_guard`` (an ``elastic.PreemptionGuard``) is
+          polled at step boundaries: on SIGTERM the loop snapshots the
+          current state, flushes it under ``preemption_flush_budget``
+          seconds, and exits ``RESTART_EXIT_CODE``."""
         assert self._optimizer is not None and self._loss is not None, \
             "call prepare(optimizer, loss, ...) before fit()"
         loader = self._as_loader(train_data, batch_size, shuffle)
@@ -818,6 +957,76 @@ class Model:
         if k_loop > 1 and self._shard_batch is not None \
                 and self._shard_superbatch is None:
             k_loop = 1  # no superbatch sharding hook wired: stay exact
+        train_ckpt = None
+        start_epoch = 0
+        resume_step_in_epoch = 0
+        if checkpoint_dir is not None:
+            from ..io.checkpoint import CheckpointManager
+            train_ckpt = CheckpointManager(
+                checkpoint_dir, max_to_keep=keep_checkpoints,
+                async_save=async_checkpoint)
+            # not a truthiness gate: resume=0 means "restore STEP 0",
+            # only None/False mean "don't resume"
+            if resume is not None and resume is not False:
+                st = self._restore_training_state(train_ckpt, resume,
+                                                  loader)
+                if st is not None:
+                    start_epoch = int(st.get("epoch", 0))
+                    resume_step_in_epoch = int(
+                        (st.get("loader") or {}).get("batch", 0))
+        last_ckpt_step = self._step_count
+        last_ckpt_boundary = True  # restored/fresh state never replays
+
+        def ckpt_tick(epoch: int, force: bool = False,
+                      boundary: bool = False) -> None:
+            """Step-boundary checkpoint cadence + preemption poll."""
+            nonlocal last_ckpt_step, last_ckpt_boundary
+            if train_ckpt is not None:
+                stale = self._step_count != last_ckpt_step
+                # an epoch-end tick UPGRADES a same-step mid-loop save:
+                # that save recorded (epoch, exhausted cursor), which
+                # would replay the finished epoch's callbacks/eval over
+                # an empty train pass on resume
+                upgrade = boundary and not stale and not last_ckpt_boundary
+                if (stale and (force or (checkpoint_freq and
+                                         self._step_count - last_ckpt_step
+                                         >= checkpoint_freq))) or upgrade:
+                    self._save_training_state(train_ckpt, loader, epoch,
+                                              boundary=boundary,
+                                              force=upgrade)
+                    last_ckpt_step = self._step_count
+                    last_ckpt_boundary = boundary
+            if preemption_guard is not None and preemption_guard.triggered:
+                def _flush():
+                    if train_ckpt is None:
+                        return
+                    from ..reliability.retry import Deadline
+                    dl = Deadline.after(preemption_flush_budget)
+                    outcome = None
+                    if self._step_count != last_ckpt_step:
+                        # drain queued commits FIRST: save()'s bounded
+                        # queue blocks (no deadline) while a snapshot
+                        # is queued behind a slow commit — snapshotting
+                        # into a backed-up writer could eat the whole
+                        # grace budget before flush() ever ran
+                        drained = train_ckpt.flush(dl)
+                        if drained in ("committed", "noop"):
+                            # fresh snapshot of the CURRENT step
+                            # (stalls only for the device→host copy)
+                            self._save_training_state(
+                                train_ckpt, loader, epoch,
+                                boundary=boundary)
+                        else:
+                            outcome = drained  # timeout/error: the
+                            # previous manifested step stands
+                    if outcome is None:
+                        outcome = train_ckpt.flush(dl)
+                    print(f"[preemption] emergency checkpoint flush: "
+                          f"{outcome} (step {self._step_count})",
+                          file=sys.stderr)
+                # runs _flush then exits RESTART_EXIT_CODE
+                preemption_guard.check(save=_flush)
+
         try:
             steps = len(loader)
         except TypeError:
@@ -830,101 +1039,136 @@ class Model:
         self.stop_training = False
         cbks.on_train_begin()
         logs: Dict[str, Any] = {}
-        for epoch in range(epochs):
-            if self.stop_training:
-                break
-            cbks.on_epoch_begin(epoch)
-            # epoch span: entered on the fit thread's stack so the
-            # dispatch/step/drain spans below parent under it. The
-            # finally closes it even when an exception unwinds (a
-            # caller catching a step failure and re-running fit must
-            # not inherit a stale epoch at the bottom of the
-            # thread-local stack); Span.__exit__ records the error.
-            ep_span = _trace.span(
-                "train.epoch", attrs={"epoch": epoch}).__enter__() \
-                if _trace.enabled() else None
-            step = 0
-            try:
-                # fold any still-buffered outputs BEFORE reset — the
-                # Metric objects then hold exactly what the
-                # immediate-update path held at every reset boundary
-                self._drain_metric_updates()
-                for m in self._metrics:
-                    m.reset()
-                # model-perspective buckets for profiler.summary():
-                # no-ops unless a Profiler is active (ref:
-                # profiler_statistic.py model perspective —
-                # Dataloader/Forward/.../Optimizer; the compiled step
-                # fuses fwd+bwd+opt, so the TPU-side split is
-                # Dataloader / TrainStep / Callbacks)
-                from ..profiler import _events as _prof_events
-                from ..profiler import RecordEvent as _Rec
-                profiling = _prof_events.active
-                rec = _Rec if profiling else contextlib.nullcontext
-                if k_loop > 1:
-                    it = loader.superbatches(k_loop)
-                else:
-                    it = iter(loader)
-                while True:
-                    with rec("Dataloader"):
-                        batch = next(it, None)
-                    if batch is None:
-                        break
-                    inputs, labels = self._split_batch(batch)
+        epoch_done = start_epoch - 1  # last fully completed epoch
+        try:
+            for epoch in range(start_epoch, epochs):
+                if self.stop_training:
+                    break
+                cbks.on_epoch_begin(epoch)
+                # epoch span: entered on the fit thread's stack so the
+                # dispatch/step/drain spans below parent under it. The
+                # finally closes it even when an exception unwinds (a
+                # caller catching a step failure and re-running fit must
+                # not inherit a stale epoch at the bottom of the
+                # thread-local stack); Span.__exit__ records the error.
+                ep_span = _trace.span(
+                    "train.epoch", attrs={"epoch": epoch}).__enter__() \
+                    if _trace.enabled() else None
+                step = resume_step_in_epoch if epoch == start_epoch else 0
+                try:
+                    # fold any still-buffered outputs BEFORE reset — the
+                    # Metric objects then hold exactly what the
+                    # immediate-update path held at every reset boundary.
+                    # A mid-epoch RESUME (step > 0) skips the reset: the
+                    # restored accumulators ARE this epoch's state so far.
+                    if step == 0:
+                        self._drain_metric_updates()
+                        for m in self._metrics:
+                            m.reset()
+                    # model-perspective buckets for profiler.summary():
+                    # no-ops unless a Profiler is active (ref:
+                    # profiler_statistic.py model perspective —
+                    # Dataloader/Forward/.../Optimizer; the compiled step
+                    # fuses fwd+bwd+opt, so the TPU-side split is
+                    # Dataloader / TrainStep / Callbacks)
+                    from ..profiler import _events as _prof_events
+                    from ..profiler import RecordEvent as _Rec
+                    profiling = _prof_events.active
+                    rec = _Rec if profiling else contextlib.nullcontext
                     if k_loop > 1:
-                        k = int(np.shape(
-                            jax.tree_util.tree_leaves(inputs)[0])[0])
-                        if k == k_loop:
-                            with rec("TrainStep"):
-                                step_logs = self.train_loop_batch(
-                                    inputs, labels)
-                            with rec("Callbacks"):
-                                for logs in step_logs:
-                                    cbks.on_train_batch_begin(step)
-                                    cbks.on_train_batch_end(step, logs)
-                                    step += 1
-                            continue
-                        # ragged tail slab (< K stacked steps): unstack
-                        # and run the per-step path — same math, one
-                        # extra signature at most (the K=1 program)
-                        sub_batches = [
-                            jax.tree_util.tree_map(lambda x: x[i],
-                                                   (inputs, labels))
-                            for i in range(k)]
+                        it = loader.superbatches(k_loop)
                     else:
-                        sub_batches = [(inputs, labels)]
-                    for inp, lab in sub_batches:
-                        cbks.on_train_batch_begin(step)
-                        with rec("TrainStep"):
-                            logs = self.train_batch(inp, lab)
-                        with rec("Callbacks"):
-                            cbks.on_train_batch_end(step, logs)
-                        step += 1
-                # freeze the epoch's final train logs NOW (epoch
-                # boundary = display boundary): the eval pass below
-                # resets the shared metric accumulators, which would
-                # otherwise leak into the lazily-coerced train values
-                # at on_epoch_end
-                logs = {n: float(v) if isinstance(
-                    v, (_LazyMetricValue, _SlabScalar)) else v
-                    for n, v in logs.items()}
-                if eval_loader is not None and epoch % eval_freq == 0:
-                    if profiling:
-                        with _Rec("Eval"):
+                        it = iter(loader)
+                    while True:
+                        with rec("Dataloader"):
+                            batch = next(it, None)
+                        if batch is None:
+                            break
+                        inputs, labels = self._split_batch(batch)
+                        if k_loop > 1:
+                            k = int(np.shape(
+                                jax.tree_util.tree_leaves(inputs)[0])[0])
+                            if k == k_loop:
+                                with rec("TrainStep"):
+                                    step_logs = self.train_loop_batch(
+                                        inputs, labels)
+                                with rec("Callbacks"):
+                                    for logs in step_logs:
+                                        cbks.on_train_batch_begin(step)
+                                        cbks.on_train_batch_end(step, logs)
+                                        step += 1
+                                ckpt_tick(epoch)
+                                continue
+                            # ragged tail slab (< K stacked steps): unstack
+                            # and run the per-step path — same math, one
+                            # extra signature at most (the K=1 program)
+                            sub_batches = [
+                                jax.tree_util.tree_map(lambda x: x[i],
+                                                       (inputs, labels))
+                                for i in range(k)]
+                        else:
+                            sub_batches = [(inputs, labels)]
+                        for inp, lab in sub_batches:
+                            cbks.on_train_batch_begin(step)
+                            with rec("TrainStep"):
+                                logs = self.train_batch(inp, lab)
+                            with rec("Callbacks"):
+                                cbks.on_train_batch_end(step, logs)
+                            step += 1
+                        ckpt_tick(epoch)
+                    # freeze the epoch's final train logs NOW (epoch
+                    # boundary = display boundary): the eval pass below
+                    # resets the shared metric accumulators, which would
+                    # otherwise leak into the lazily-coerced train values
+                    # at on_epoch_end
+                    logs = {n: float(v) if isinstance(
+                        v, (_LazyMetricValue, _SlabScalar)) else v
+                        for n, v in logs.items()}
+                    if eval_loader is not None and epoch % eval_freq == 0:
+                        if profiling:
+                            with _Rec("Eval"):
+                                eval_logs = self.evaluate(
+                                    eval_loader, verbose=0, _callbacks=cbks)
+                        else:
                             eval_logs = self.evaluate(
                                 eval_loader, verbose=0, _callbacks=cbks)
-                    else:
-                        eval_logs = self.evaluate(
-                            eval_loader, verbose=0, _callbacks=cbks)
-                    logs.update({f"eval_{k}": v
-                                 for k, v in eval_logs.items()})
-                cbks.on_epoch_end(epoch, logs)
-            finally:
-                if ep_span is not None:
-                    ep_span.set_attr("steps", step)
-                    ep_span.__exit__(*sys.exc_info())
-        cbks.on_train_end(logs)
-        self._sync_state_out()
+                        logs.update({f"eval_{k}": v
+                                     for k, v in eval_logs.items()})
+                    cbks.on_epoch_end(epoch, logs)
+                    # epoch-granular checkpoint default (checkpoint_freq
+                    # None): one full-state save per completed epoch
+                    ckpt_tick(epoch, force=checkpoint_freq is None,
+                              boundary=True)
+                    epoch_done = epoch
+                finally:
+                    if ep_span is not None:
+                        ep_span.set_attr("steps", step)
+                        ep_span.__exit__(*sys.exc_info())
+            cbks.on_train_end(logs)
+            self._sync_state_out()
+            if train_ckpt is not None:
+                # final full-state save (no-op if the last step is already
+                # boundary-checkpointed): the fit-exit barrier in the
+                # finally below makes every queued async commit durable
+                # before fit returns. Keyed to the last COMPLETED epoch —
+                # a stop_training break leaves `epoch` naming an epoch
+                # that never ran, and a boundary save against it would
+                # resume PAST it
+                ckpt_tick(epoch_done, force=True, boundary=True)
+        finally:
+            if train_ckpt is not None:
+                # fit-exit barrier, exception path included: wait out
+                # in-flight async commits and stop the writer thread.
+                # A close/commit failure must not mask an exception
+                # already unwinding through fit — but on a clean exit
+                # it IS fit's failure (the final save never committed)
+                unwinding = sys.exc_info()[0] is not None
+                try:
+                    train_ckpt.close()
+                except BaseException:
+                    if not unwinding:
+                        raise
+
 
     def evaluate(self, eval_data, batch_size: int = 1, log_freq: int = 10,
                  verbose: int = 2, num_workers: int = 0, callbacks=None,

@@ -24,7 +24,8 @@ import math
 import queue
 import threading
 import time
-from typing import Any, Callable, Iterable, Iterator, List, Optional, Sequence
+from typing import (Any, Callable, Iterable, Iterator, List, Optional,
+                    Sequence, Tuple)
 
 import jax
 import numpy as np
@@ -259,6 +260,11 @@ class DistributedBatchSampler(BatchSampler):
         return (self.num_samples + self.batch_size - 1) // self.batch_size
 
     def set_epoch(self, epoch: int):
+        # a user-driven epoch pin (the torch/paddle sampler contract):
+        # once called, the DataLoader's pass-index sync backs off and
+        # shuffle order is the caller's responsibility (including on
+        # resume)
+        self._epoch_set_by_user = True
         self.epoch = epoch
 
 
@@ -311,13 +317,17 @@ class _PrefetchIterator:
     _SENTINEL = object()
 
     def __init__(self, produce: Callable[[], Iterator], buffer_size: int,
-                 to_device: bool, instruments=None):
+                 to_device: bool, instruments=None, on_item=None):
         self._q: "queue.Queue" = queue.Queue(maxsize=max(buffer_size, 1))
         self._to_device = to_device
         self._err: Optional[BaseException] = None
         self._produce = produce
         self._stop = threading.Event()
         self._obs = instruments or _loader_metrics()
+        # consumption hook (DataLoader cursor tracking): fires on the
+        # CONSUMER thread as each item is handed out — prefetched-but-
+        # unconsumed batches never advance the resume cursor
+        self._on_item = on_item
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
@@ -354,6 +364,8 @@ class _PrefetchIterator:
             # post-hoc span over the wait interval: the input-starved
             # share shows up next to dispatch/drain in span rollups
             _tracing.start_span("io.next_wait", t0=t0).end(t1)
+        if self._on_item is not None:
+            self._on_item(item)
         return item
 
     def close(self):
@@ -444,25 +456,38 @@ class DataLoader:
             self.batch_sampler = BatchSampler(
                 dataset, sampler=sampler, shuffle=shuffle,
                 batch_size=batch_size or 1, drop_last=drop_last)
+        # resume cursor (preemption-safe training, ISSUE 8): which pass
+        # (epoch) is running and how many host batches the CONSUMER has
+        # taken from it — see state_dict()/load_state_dict()
+        self._pass_index = 0      # passes started (next pass's index)
+        self._current_pass = 0
+        self._batch_cursor = 0
+        self._resume_cursor: Optional[Tuple[int, int]] = None
 
-    def _produce(self):
+    def _produce(self, skip: int = 0):
         if self._iterable:
             it = iter(self.dataset)
             if self.batch_size is None:
-                yield from it
+                yield from itertools.islice(it, skip, None)
                 return
+            n = 0
             while True:
                 batch = list(itertools.islice(it, self.batch_size))
                 if not batch:
                     return
                 if len(batch) < self.batch_size and self.drop_last:
                     return
-                yield self.collate_fn(batch)
+                n += 1
+                if n > skip:  # iterables can't seek: consume and drop
+                    yield self.collate_fn(batch)
         else:
-            for batch_idx in self.batch_sampler:
+            # map-style skip happens at the INDEX level — skipped
+            # batches cost no __getitem__/collate work on resume
+            for batch_idx in itertools.islice(
+                    iter(self.batch_sampler), skip, None):
                 yield self.collate_fn([self.dataset[i] for i in batch_idx])
 
-    def _produce_multiprocess_map(self, seed):
+    def _produce_multiprocess_map(self, seed, skip: int = 0):
         """Ordered pipelined map over batch indices on a fork pool —
         up to num_workers*prefetch_factor batches in flight."""
         import collections
@@ -485,7 +510,7 @@ class DataLoader:
         try:
             pending: "collections.deque" = collections.deque()
             depth = self.num_workers * max(self.prefetch_factor, 1)
-            it = iter(self.batch_sampler)
+            it = itertools.islice(iter(self.batch_sampler), skip, None)
             for batch_idx in it:
                 pending.append(pool.submit(_map_worker_collate, batch_idx))
                 if len(pending) >= depth:
@@ -495,11 +520,13 @@ class DataLoader:
         finally:
             pool.shutdown(wait=False, cancel_futures=True)
 
-    def _produce_multiprocess_iter(self, seed):
+    def _produce_multiprocess_iter(self, seed, skip: int = 0):
         """IterableDataset workers: each process iterates its own copy
         with worker_info set (datasets shard via get_worker_info, ref
         contract); parent round-robins worker queues for a deterministic
-        order."""
+        order (which is also what makes the resume ``skip`` exact: the
+        parent drops the first ``skip`` batches of the SAME deterministic
+        round-robin stream the interrupted run consumed)."""
         import multiprocessing as mp
 
         ctx = mp.get_context("fork")
@@ -539,6 +566,9 @@ class DataLoader:
                     if kind == "done":
                         alive[w] = False
                         continue
+                    if skip > 0:
+                        skip -= 1
+                        continue
                     yield payload
         finally:
             for p in procs:
@@ -546,20 +576,64 @@ class DataLoader:
             for p in procs:  # reap — terminate alone leaks zombies
                 p.join(timeout=5.0)
 
-    def _select_produce(self):
+    def _begin_pass(self) -> Tuple[int, int]:
+        """Start one pass over the data: resolve which pass index it is
+        (a pending resume cursor wins), how many batches to skip, and
+        sync every epoch-seeded sampler to that index — so pass ``e``
+        of a resumed run shuffles EXACTLY like pass ``e`` of an
+        uninterrupted one."""
+        if self._resume_cursor is not None:
+            pass_idx, skip = self._resume_cursor
+            self._resume_cursor = None
+        else:
+            pass_idx, skip = self._pass_index, 0
+        self._pass_index = pass_idx + 1
+        self._current_pass = pass_idx
+        self._batch_cursor = skip
+        self._sync_shuffle_epoch(pass_idx)
+        return pass_idx, skip
+
+    def _sync_shuffle_epoch(self, epoch: int) -> None:
+        for obj in (self.batch_sampler,
+                    getattr(self.batch_sampler, "sampler", None)):
+            if obj is None:
+                continue
+            if getattr(obj, "_epoch_set_by_user", False):
+                # the user drives this sampler's epoch (set_epoch
+                # contract) — never overwrite their pin with the
+                # loader's private pass counter
+                continue
+            if hasattr(obj, "set_epoch"):
+                obj.set_epoch(epoch)
+                # a loader-managed sync must stay distinguishable from
+                # a user call: un-latch the flag set_epoch just set
+                try:
+                    obj._epoch_set_by_user = False
+                except AttributeError:
+                    pass
+            elif hasattr(obj, "_epoch"):
+                obj._epoch = epoch
+
+    def _note_consumed(self, n: int) -> None:
+        self._batch_cursor += n
+
+    def _select_produce(self, pass_idx: int = None, skip: int = 0):
         """Pick the host-batch producer for one pass (serial generator or
         the fork-pool pipelines), resolving the per-epoch worker seed on
         the CALLER thread (where paddle.seed's thread-local state lives —
         the produce generator body runs on the prefetch thread)."""
+        if pass_idx is None:
+            pass_idx, skip = self._begin_pass()
         if self.num_workers > 0:
-            self._epoch_count = getattr(self, "_epoch_count", -1) + 1
-            seed = (int(rng_mod._tls.global_seed)
-                    + self._epoch_count) % (2 ** 31)
+            # worker seed keyed by the PASS INDEX (not a private
+            # counter): a resumed run's pass e re-derives the exact
+            # per-worker seeds the interrupted run used
+            seed = (int(rng_mod._tls.global_seed) + pass_idx) % (2 ** 31)
             mp_produce = self._produce_multiprocess_iter if self._iterable \
                 else self._produce_multiprocess_map
-            produce = lambda: mp_produce(seed)  # noqa: E731
+            produce = lambda: mp_produce(seed, skip)  # noqa: E731
         else:
-            produce = self._produce
+            produce = lambda: self._produce(skip)  # noqa: E731
         if not _faults.enabled():
             # zero-overhead default: the injection wrapper only exists
             # on passes started while chaos is armed
@@ -576,8 +650,42 @@ class DataLoader:
         return produce_with_faults
 
     def __iter__(self):
-        return _PrefetchIterator(self._select_produce(),
-                                 self.prefetch_factor, self.to_device)
+        pass_idx, skip = self._begin_pass()
+        return _PrefetchIterator(self._select_produce(pass_idx, skip),
+                                 self.prefetch_factor, self.to_device,
+                                 on_item=lambda _b: self._note_consumed(1))
+
+    # -- resume cursor (preemption-safe training) ---------------------------
+    def state_dict(self) -> dict:
+        """The exact-resume cursor: the pass (epoch) currently being
+        consumed and how many host batches the consumer has taken from
+        it. Batches sitting in the prefetch queue (produced, never
+        consumed) are NOT counted — they re-produce on resume, so the
+        training loop sees each batch exactly once. Safe with
+        multiprocess workers: worker seeds and the round-robin order
+        derive from the pass index alone."""
+        if _faults.enabled():
+            _faults.check("loader.state")
+        return {"pass": int(self._current_pass),
+                "batch": int(self._batch_cursor)}
+
+    def load_state_dict(self, state: dict) -> None:
+        """Arm the NEXT iteration pass to resume at ``state``: it runs
+        as pass ``state["pass"]`` (same shuffle permutation, same
+        worker seeds) and skips the first ``state["batch"]`` batches —
+        map-style datasets skip at the index level (no __getitem__
+        cost), IterableDatasets consume-and-drop. A mid-superbatch
+        cursor (batch not a multiple of steps_per_loop) is fine:
+        ``superbatches`` restacks slabs from the resume point and the
+        fused loop's per-step keys depend only on the global step."""
+        if _faults.enabled():
+            _faults.check("loader.state")
+        pass_idx = int(state["pass"])
+        skip = int(state["batch"])
+        self._resume_cursor = (pass_idx, skip)
+        self._current_pass = pass_idx
+        self._batch_cursor = skip
+        self._pass_index = pass_idx
 
     def superbatches(self, steps_per_loop: int):
         """Iterate ``[K, ...]``-stacked slabs for the fused train loop.
@@ -593,9 +701,14 @@ class DataLoader:
         rectangular; consumers route short slabs (leading dim < K)
         through the per-step path. Prefetch wait/slab counts land in the
         ``train_loop_*`` instruments rather than the per-batch
-        dataloader ones."""
+        dataloader ones. The resume cursor counts the BATCHES inside
+        each consumed slab (leading dim), so a checkpoint taken between
+        slabs — or at a ragged tail — resumes mid-superbatch: the
+        restarted stream restacks slabs from the skipped batch onward
+        (slab boundaries may shift; per-step contents don't)."""
         k = max(int(steps_per_loop), 1)
-        produce = self._select_produce()
+        pass_idx, skip = self._begin_pass()
+        produce = self._select_produce(pass_idx, skip)
 
         def gen():
             buf: List[Any] = []
@@ -614,9 +727,14 @@ class DataLoader:
             if buf:
                 yield stack_batches(buf)
 
+        def consumed(slab):
+            self._note_consumed(
+                int(jax.tree_util.tree_leaves(slab)[0].shape[0]))
+
         return _PrefetchIterator(gen, max(self.prefetch_factor, 1),
                                  self.to_device,
-                                 instruments=_superbatch_metrics())
+                                 instruments=_superbatch_metrics(),
+                                 on_item=consumed)
 
     def __len__(self):
         if self._iterable:

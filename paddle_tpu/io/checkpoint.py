@@ -10,19 +10,42 @@ Reference being replaced (SURVEY.md §5 checkpoint/resume):
   (fluid/incubate/checkpoint/auto_checkpoint.py:71 AutoCheckpointChecker,
   :267 TrainEpochRange).
 
-TPU-native design: orbax handles the hard parts the reference hand-rolls
-— per-shard parallel writes (each host writes only the array shards it
-owns), async save (training continues while the previous step persists),
-atomic commit, and reshard-on-restore (restoring into a different mesh
-topology replaces the reference's converter.py). This facade gives it a
-Paddle-shaped API and wires it to hapi Model and callbacks.
+TPU-native design: orbax handles per-shard parallel writes, atomic
+commit, and reshard-on-restore (restoring into a different mesh topology
+replaces the reference's converter.py). On top of that this module owns
+the PREEMPTION-SAFE lifecycle (ISSUE 8):
+
+- **async save** — ``save(step, tree, async_=True)`` snapshots the tree
+  to host buffers (the caller stalls only for the device→host copy),
+  then a bounded background writer thread commits it through the same
+  atomic-commit + RetryPolicy path; a second async save barriers on the
+  first (≤ 2 snapshots alive), and ``wait_until_finished``/``flush``
+  are the explicit barriers (fit-exit / SIGTERM emergency flush).
+- **integrity manifests** — every committed step gets an atomically
+  renamed ``manifest-<step>.json`` sidecar with per-array blake2b
+  digests plus a small JSON ``state`` blob (RNG key, DataLoader cursor,
+  metric state — the exact-resume bundle). ``latest_step`` only
+  surfaces manifested steps, so a kill between data-commit and
+  manifest-write costs exactly that step, never corruption.
+- **verified restore** — ``restore`` recomputes digests; a mismatch
+  raises :class:`CheckpointCorrupt` (explicit step) or quarantines the
+  step and falls back to the newest step that verifies (auto), dumping
+  a flight record with the digest diff.
+- **GC** — keep-last-N operates on VERIFIED manifests and never deletes
+  the newest verified step; debris (data dirs without a manifest, from
+  kills mid-commit) is swept at open and before re-saving a step.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
+import queue
+import shutil
+import threading
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -31,7 +54,7 @@ from ..core.monitor import stat_add
 from ..observability import metrics as _obs
 from ..reliability import faults as _faults
 from ..reliability.faults import FaultInjected
-from ..reliability.retry import RetryPolicy
+from ..reliability.retry import RetryPolicy, as_deadline
 
 
 def _ocp():
@@ -56,12 +79,26 @@ def _ckpt_metrics():
     return {
         "save": reg.histogram(
             "checkpoint_save_seconds",
-            "checkpoint save wall time (dispatch only when async)"),
+            "checkpoint commit wall time (write + atomic rename)"),
         "restore": reg.histogram(
             "checkpoint_restore_seconds", "checkpoint restore wall time"),
         "bytes": reg.counter(
             "checkpoint_bytes_written",
             "array bytes handed to checkpoint saves"),
+        "snapshot": reg.histogram(
+            "ckpt_snapshot_seconds",
+            "device→host snapshot wall time — the ONLY part of an "
+            "async save the train loop stalls on"),
+        "queue": reg.gauge(
+            "ckpt_commit_queue_depth",
+            "async checkpoint snapshots enqueued or committing"),
+        "verify_fail": reg.counter(
+            "ckpt_verify_failures_total",
+            "restores whose recomputed digests mismatched the manifest"),
+        "flush": reg.counter(
+            "ckpt_emergency_flush_total",
+            "emergency (deadline-budgeted) checkpoint flushes",
+            label_names=("outcome",)),
     }
 
 
@@ -94,86 +131,574 @@ def _record_restore(dt: float) -> None:
     stat_add("checkpoint.restore_wall_seconds", dt)
 
 
-class CheckpointManager:
-    """Managed step checkpoints: rotation, async save, latest/restore.
+class CheckpointCorrupt(RuntimeError):
+    """A restored checkpoint's bytes do not match the digests recorded
+    in its manifest at save time. ``step`` names the bad step; ``diff``
+    maps leaf paths to {expected, actual} digest pairs (``actual`` is
+    None for leaves missing from the restored tree)."""
 
-    save(step, tree) → async by default; restore(step=None) → latest.
-    Trees may contain sharded jax.Arrays — each process writes its own
-    shards; restore honors the target sharding passed via ``like`` (or
-    returns host numpy when ``like`` is None).
+    def __init__(self, step: int, diff: Dict[str, Dict[str, Any]]):
+        bad = ", ".join(sorted(diff)[:4])
+        more = f" (+{len(diff) - 4} more)" if len(diff) > 4 else ""
+        super().__init__(
+            f"checkpoint step {step} failed integrity verification: "
+            f"digest mismatch at {bad}{more}")
+        self.step = step
+        self.diff = diff
+
+
+# -- manifest sidecars -------------------------------------------------------
+#
+# manifest-<step>.json is written (atomic tmp+rename) AFTER the data
+# commit, so its presence certifies a complete checkpoint; a quarantined
+# (corrupt) step keeps its data dir for forensics under
+# manifest-<step>.json.corrupt and stops being surfaced by latest_step.
+
+_MANIFEST_FMT = "manifest-{step}.json"
+_CORRUPT_SUFFIX = ".corrupt"
+
+
+def _manifest_path(directory: str, step: int) -> str:
+    return os.path.join(directory, _MANIFEST_FMT.format(step=int(step)))
+
+
+def _scan_manifest_steps(directory: str) -> List[int]:
+    """Sorted steps with a committed (non-quarantined) manifest.
+    Stdlib-only — the elastic launcher calls this without orbax."""
+    steps = []
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    for name in names:
+        if name.startswith("manifest-") and name.endswith(".json"):
+            try:
+                steps.append(int(name[len("manifest-"):-len(".json")]))
+            except ValueError:
+                continue
+    return sorted(steps)
+
+
+def latest_manifest_step(directory: str) -> Optional[int]:
+    """Newest step with a committed manifest (None when the directory
+    has none). This is what an elastic launcher threads into the
+    respawn env (``PADDLE_ELASTIC_RESUME_STEP``) — cheap, orbax-free,
+    and never names a partially committed or quarantined step."""
+    steps = _scan_manifest_steps(directory)
+    return steps[-1] if steps else None
+
+
+def _leaf_digest(arr: Any) -> str:
+    a = np.ascontiguousarray(np.asarray(arr))
+    h = hashlib.blake2b(digest_size=16)
+    h.update(str(a.dtype).encode())
+    h.update(str(a.shape).encode())
+    h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def digest_tree(tree: Any) -> Optional[Dict[str, str]]:
+    """Per-leaf blake2b digests keyed by jax key-path. Returns None for
+    trees holding non-fully-addressable (multi-host sharded) arrays —
+    no single process can see those bytes, so such saves are recorded
+    unverified rather than wrongly verified."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    for _path, leaf in flat:
+        if not getattr(leaf, "is_fully_addressable", True):
+            return None
+    return {jax.tree_util.keystr(path): _leaf_digest(leaf)
+            for path, leaf in flat}
+
+
+def _digest_diff(expected: Dict[str, str],
+                 tree: Any) -> Dict[str, Dict[str, Any]]:
+    actual = digest_tree(tree)
+    if actual is None:  # can't see the bytes: nothing to compare
+        return {}
+    diff: Dict[str, Dict[str, Any]] = {}
+    for key, want in expected.items():
+        got = actual.get(key)
+        if got != want:
+            diff[key] = {"expected": want, "actual": got}
+    for key in actual:
+        if key not in expected:
+            diff[key] = {"expected": None, "actual": actual[key]}
+    return diff
+
+
+class CheckpointManager:
+    """Managed step checkpoints: rotation, async save, verified
+    latest/restore.
+
+    ``save(step, tree)`` → async by default: the call stalls only for
+    the device→host snapshot, then a background writer commits through
+    the atomic-commit + RetryPolicy path and writes the integrity
+    manifest. ``restore(step=None)`` → newest VERIFIED step (digest
+    mismatches quarantine the step and fall back). Trees may contain
+    sharded jax.Arrays; restore honors the target sharding passed via
+    ``like`` (or returns host numpy when ``like`` is None).
     """
+
+    _CLOSE = object()
 
     def __init__(self, directory: str, max_to_keep: int = 5,
                  async_save: bool = True,
                  retry: Optional[RetryPolicy] = None):
         ocp = _ocp()
         self.directory = os.path.abspath(directory)
+        self.max_to_keep = max_to_keep
+        self.async_save = bool(async_save)
         self.retry = retry or _SAVE_RETRY
-        # cleanup_tmp_directories: a hard kill (preempted VM) mid-save
-        # leaves an uncommitted tmp step dir; without cleanup the next
-        # incarnation's save of that same step can collide with it
-        options = ocp.CheckpointManagerOptions(
-            max_to_keep=max_to_keep, enable_async_checkpointing=async_save,
-            cleanup_tmp_directories=True)
-        self._mgr = ocp.CheckpointManager(self.directory, options=options)
+        self._ckptr = ocp.StandardCheckpointer()
+        # async writer plumbing: one queued snapshot max — a third
+        # concurrent save barriers on the oldest (bounded memory: at
+        # most two host snapshots alive)
+        self._q: "queue.Queue" = queue.Queue(maxsize=1)
+        self._writer: Optional[threading.Thread] = None
+        self._cv = threading.Condition()
+        self._pending = 0
+        self._writer_err: Optional[BaseException] = None
+        self._flush_timed_out = False
+        self._sweep_debris()
 
-    def _dispatch_save(self, step: int, tree: Any, force: bool):
+    # -- directory scanning -------------------------------------------------
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, str(int(step)))
+
+    def _disk_steps(self) -> List[int]:
+        """Committed (finalized, digit-named) step data dirs."""
+        steps = []
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return []
+        for name in names:
+            if name.isdigit() and os.path.isdir(
+                    os.path.join(self.directory, name)):
+                steps.append(int(name))
+        return sorted(steps)
+
+    def _manifest_steps(self) -> List[int]:
+        """Verified-at-save steps: manifest present AND data committed."""
+        disk = set(self._disk_steps())
+        return [s for s in _scan_manifest_steps(self.directory)
+                if s in disk]
+
+    def _legacy_steps(self) -> List[int]:
+        """Pre-manifest-era checkpoints: data dirs OLDER than the
+        oldest manifest (or all of them when no manifest exists).
+        Steps are monotonic, so debris from a crashed manifest-era
+        commit is always newer than some manifest — anything older can
+        only predate manifests. Quarantined steps are excluded."""
+        manifested = _scan_manifest_steps(self.directory)
+        disk = self._disk_steps()
+        if manifested:
+            disk = [s for s in disk if s < manifested[0]]
+        return [s for s in disk if not os.path.exists(
+            _manifest_path(self.directory, s) + _CORRUPT_SUFFIX)]
+
+    def _sweep_debris(self) -> None:
+        """Open-time hygiene: uncommitted orbax tmp dirs from a hard
+        kill mid-write, and (when this is a manifested directory)
+        committed data dirs that never got their manifest — a kill
+        between data-commit and manifest-write. Legacy steps (older
+        than the oldest manifest, i.e. pre-manifest-era rollback
+        points) are left untouched until GC rotates them out."""
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return
+        for name in names:
+            if "orbax-checkpoint-tmp" in name:
+                shutil.rmtree(os.path.join(self.directory, name),
+                              ignore_errors=True)
+        manifested = set(_scan_manifest_steps(self.directory))
+        if not manifested:
+            return
+        oldest_manifested = min(manifested)
+        for s in self._disk_steps():
+            if s >= oldest_manifested and s not in manifested \
+                    and not os.path.exists(
+                        _manifest_path(self.directory, s)
+                        + _CORRUPT_SUFFIX):
+                shutil.rmtree(self._step_dir(s), ignore_errors=True)
+
+    def _read_manifest(self, step: int) -> Optional[Dict[str, Any]]:
+        try:
+            with open(_manifest_path(self.directory, step)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def _write_manifest(self, step: int, digests: Optional[Dict[str, str]],
+                        state: Optional[Dict[str, Any]]) -> None:
+        os.makedirs(self.directory, exist_ok=True)
+        path = _manifest_path(self.directory, step)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"format": 1, "step": int(step),
+                       "ts": time.time(), "digests": digests,
+                       "state": state}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def _quarantine(self, step: int) -> None:
+        path = _manifest_path(self.directory, step)
+        try:
+            os.replace(path, path + _CORRUPT_SUFFIX)
+        except OSError:
+            pass
+
+    def _delete_step(self, step: int) -> None:
+        # manifest first: a kill mid-deletion must leave the step
+        # UNLISTED (manifest gone) rather than listed-but-partial
+        for path in (_manifest_path(self.directory, step),
+                     _manifest_path(self.directory, step)
+                     + _CORRUPT_SUFFIX):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        shutil.rmtree(self._step_dir(step), ignore_errors=True)
+
+    def _clear_debris(self, step: int) -> None:
+        """Before (re)saving ``step``: drop any unmanifested or
+        quarantined data dir squatting on its name (a crashed commit,
+        or a corrupt step being re-trained past after fallback)."""
+        if self._read_manifest(step) is not None:
+            return  # a live manifested step is not debris
+        if os.path.exists(self._step_dir(step)):
+            self._delete_step(step)
+
+    def _gc(self) -> None:
+        """Keep the newest ``max_to_keep`` restorable steps — VERIFIED
+        (manifested) ones plus any legacy pre-manifest steps still
+        counting as rollback points at the migration boundary. The
+        newest verified step is by construction in the keep set — GC
+        can never delete it; quarantined/corrupt steps don't count
+        toward the budget and older ones are swept with the rest."""
+        if not self.max_to_keep or self.max_to_keep <= 0:
+            return
+        restorable = sorted(set(self._manifest_steps())
+                            | set(self._legacy_steps()))
+        cut = restorable[-self.max_to_keep:]
+        if not cut:
+            return
+        oldest_kept = cut[0]
+        for s in self._disk_steps():
+            if s < oldest_kept:
+                self._delete_step(s)
+
+    # -- save ---------------------------------------------------------------
+    def _dispatch_save(self, step: int, tree: Any) -> float:
         # injection site ckpt.write: fault BEFORE the orbax dispatch —
         # a retried attempt never re-enters a half-dispatched save
         if _faults.enabled():
             _faults.check("ckpt.write")
-        ocp = _ocp()
         # time the attempt itself: failed attempts and retry backoff
-        # sleeps must not inflate the ckpt_save_seconds histogram
+        # sleeps must not inflate the checkpoint_save_seconds histogram
         t0 = time.perf_counter()
-        saved = self._mgr.save(step, args=ocp.args.StandardSave(tree),
-                               force=force)
-        return saved, time.perf_counter() - t0
+        self._ckptr.save(self._step_dir(step), tree, force=True)
+        # StandardCheckpointer is an AsyncCheckpointer: block until the
+        # atomic commit lands — the manifest written after this call
+        # must certify COMMITTED data (async-ness comes from our own
+        # writer thread, which already overlaps the train loop)
+        self._ckptr.wait_until_finished()
+        return time.perf_counter() - t0
 
-    def save(self, step: int, tree: Any, force: bool = False) -> bool:
-        saved, dt = self.retry.call(
-            self._dispatch_save, step, tree, force,
+    def _commit(self, step: int, tree: Any, force: bool,
+                state: Optional[Dict[str, Any]]) -> bool:
+        if self._read_manifest(step) is not None and not force:
+            # skip, don't raise — the old orbax-backed save returned
+            # False here, and AutoCheckpoint's multi-rank agreed-older-
+            # step resume re-commits a step some ranks already hold
+            # (same content: training replayed from the agreed step)
+            return False
+        self._clear_debris(step)
+        if force:
+            self._delete_step(step)
+        dt = self.retry.call(
+            self._dispatch_save, step, tree,
             describe=f"checkpoint save step {step}")
         # injection site ckpt.rename: the commit stage. A fault here
-        # propagates (the caller must treat the step as unsaved) but,
-        # like a real mid-commit kill, can never corrupt the directory:
-        # either orbax already committed the step atomically or the
-        # tmp dir is garbage the next manager cleans up — pinned by
+        # propagates (the caller must treat the step as unsaved) and —
+        # like a real mid-commit kill — never corrupts the directory:
+        # the data dir is committed but the MANIFEST was not written,
+        # so latest_step() never surfaces the step and the debris is
+        # swept at the next open/save — pinned by
         # tests/test_checkpoint_crash.py and the chaos soak gate
         if _faults.enabled():
             _faults.check("ckpt.rename")
-        if saved:
-            _record_save(dt, tree)
-        return saved
+        self._write_manifest(step, digest_tree(tree), state)
+        self._gc()
+        _record_save(dt, tree)
+        return True
 
-    def restore(self, step: Optional[int] = None, like: Any = None) -> Any:
-        ocp = _ocp()
-        step = self.latest_step() if step is None else step
-        if step is None:
-            raise FileNotFoundError(
-                f"no checkpoints under {self.directory}")
+    def _writer_loop(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is self._CLOSE:
+                return
+            step, host_tree, force, state = item
+            try:
+                # injection site ckpt.async_commit: the queued commit
+                # about to run on the writer thread
+                if _faults.enabled():
+                    _faults.check("ckpt.async_commit")
+                self._commit(step, host_tree, force, state)
+            except BaseException as e:  # noqa: BLE001 — surfaced at
+                with self._cv:          # the next save/barrier
+                    self._writer_err = e
+            finally:
+                with self._cv:
+                    self._pending -= 1
+                    _ckpt_metrics()["queue"].set(self._pending)
+                    self._cv.notify_all()
+
+    def _ensure_writer(self) -> None:
+        if self._writer is None or not self._writer.is_alive():
+            self._writer = threading.Thread(
+                target=self._writer_loop, daemon=True,
+                name="ckpt-writer")
+            self._writer.start()
+
+    def _raise_writer_err(self) -> None:
+        with self._cv:
+            err, self._writer_err = self._writer_err, None
+        if err is not None:
+            raise err
+
+    def save(self, step: int, tree: Any, force: bool = False,
+             async_: Optional[bool] = None,
+             state: Optional[Dict[str, Any]] = None) -> bool:
+        """Checkpoint ``tree`` as ``step``. ``state`` (JSON-serializable)
+        rides the manifest — the exact-resume bundle readable without
+        restoring the arrays. ``async_`` defaults to the manager's
+        ``async_save``; a failed background commit surfaces at the
+        next save / ``wait_until_finished``."""
+        async_ = self.async_save if async_ is None else bool(async_)
+        self._raise_writer_err()
+        if async_ and not all(
+                getattr(x, "is_fully_addressable", True)
+                for x in jax.tree_util.tree_leaves(tree)):
+            # multi-host sharded leaves: no single process can see
+            # those bytes, so a host snapshot would raise — fall back
+            # to the sync path, where orbax keeps the per-shard
+            # parallel write (these saves are recorded unverified,
+            # same as digest_tree's contract)
+            async_ = False
+        if not async_:
+            # sync: barrier any in-flight async commit (one writer at
+            # a time), then hand the tree to orbax as-is — sharded
+            # device arrays keep their per-shard write path
+            self.wait_until_finished()
+            return self._commit(step, tree, force, state)
+        # injection site ckpt.snapshot: the only phase of an async save
+        # the train loop waits on
+        if _faults.enabled():
+            _faults.check("ckpt.snapshot")
         t0 = time.perf_counter()
-        # always pass StandardRestore: a manager REOPENED over an
-        # existing directory (the restart path) has no handler
-        # registered for the saved item and a bare restore(step)
-        # KeyErrors on current orbax
-        tree = self._mgr.restore(
-            step, args=ocp.args.StandardRestore(like))
-        _record_restore(time.perf_counter() - t0)
-        return tree
+        # np.array(copy=True), NOT np.asarray: on CPU backends asarray
+        # can ALIAS the device buffer, and a donating train step then
+        # rewrites it under the queued snapshot — the commit would
+        # persist (and digest-certify) torn state
+        host_tree = jax.tree_util.tree_map(
+            lambda x: np.array(x, copy=True), tree)
+        _ckpt_metrics()["snapshot"].observe(time.perf_counter() - t0)
+        self._ensure_writer()
+        with self._cv:
+            self._pending += 1
+            _ckpt_metrics()["queue"].set(self._pending)
+        # maxsize=1: blocks while another snapshot is still QUEUED —
+        # the "barrier at the next save" that bounds host memory
+        self._q.put((step, host_tree, force, state))
+        return True
 
+    # -- restore ------------------------------------------------------------
+    def restore(self, step: Optional[int] = None, like: Any = None,
+                verify: bool = True) -> Any:
+        return self.restore_with_state(step, like=like, verify=verify)[0]
+
+    def restore_with_state(self, step: Optional[int] = None,
+                           like: Any = None, verify: bool = True
+                           ) -> Tuple[Any, Optional[Dict[str, Any]]]:
+        """Restore a tree plus its manifest ``state`` bundle.
+
+        ``step=None`` walks manifested steps newest→oldest and returns
+        the first that passes digest verification; a mismatch
+        quarantines the step (``manifest-N.json.corrupt`` — it stops
+        being ``latest_step``), bumps ``ckpt_verify_failures_total``,
+        and dumps a flight record carrying the digest diff. An explicit
+        ``step`` raises :class:`CheckpointCorrupt` instead of falling
+        back. Legacy directories (no manifests) restore unverified."""
+        self.wait_until_finished()  # never race the async writer
+        explicit = step is not None
+        if explicit:
+            candidates = [int(step)]
+        else:
+            candidates = list(reversed(self._manifest_steps()))
+            if not candidates:
+                legacy = self._disk_steps()  # pre-manifest directory
+                candidates = list(reversed(legacy))
+        last_corrupt: Optional[CheckpointCorrupt] = None
+        for s in candidates:
+            manifest = self._read_manifest(s)
+            if manifest is None and os.path.exists(
+                    _manifest_path(self.directory, s) + _CORRUPT_SUFFIX):
+                # quarantined: the data dir is forensics, not a legacy
+                # (pre-manifest) step — an explicit restore must raise,
+                # not hand back known-corrupt arrays unverified
+                err = CheckpointCorrupt(s, {"<manifest>": {
+                    "expected": "committed manifest",
+                    "actual": "quarantined (" + _MANIFEST_FMT.format(
+                        step=s) + _CORRUPT_SUFFIX + ")"}})
+                if explicit:
+                    raise err
+                last_corrupt = err
+                continue
+            t0 = time.perf_counter()
+            try:
+                if like is not None:
+                    tree = self._ckptr.restore(self._step_dir(s), like)
+                else:
+                    tree = self._ckptr.restore(self._step_dir(s))
+            except FileNotFoundError:
+                raise
+            except Exception as e:  # noqa: BLE001
+                if manifest is None:
+                    raise  # legacy dir: no verification contract
+                # corruption severe enough that orbax/tensorstore can't
+                # even read the step (CRC failures, truncated files):
+                # same verdict as a digest mismatch
+                err = CheckpointCorrupt(
+                    s, {"<restore>": {"expected":
+                                      "readable checkpoint data",
+                                      "actual": repr(e)}})
+                self._on_verify_failure(s, err.diff)
+                if explicit:
+                    raise err from e
+                last_corrupt = err
+                continue
+            dt = time.perf_counter() - t0
+            digests = (manifest or {}).get("digests")
+            if verify and digests is not None:
+                diff = _digest_diff(digests, tree)
+                if diff:
+                    err = CheckpointCorrupt(s, diff)
+                    self._on_verify_failure(s, diff)
+                    if explicit:
+                        raise err
+                    last_corrupt = err
+                    continue
+            _record_restore(dt)
+            return tree, (manifest or {}).get("state")
+        if last_corrupt is not None:
+            raise last_corrupt
+        raise FileNotFoundError(
+            f"no checkpoints under {self.directory}")
+
+    def _on_verify_failure(self, step: int,
+                           diff: Dict[str, Dict[str, Any]]) -> None:
+        _ckpt_metrics()["verify_fail"].inc()
+        stat_add("checkpoint.verify_failures")
+        self._quarantine(step)
+        # flight-recorder dump with the digest diff attached: "which
+        # arrays rotted, expected vs actual" survives next to the spans
+        # of whatever was running (no-op unless a recorder is installed)
+        try:
+            from ..observability.flight import dump_flight_record
+            dump_flight_record(
+                f"ckpt_verify_step{step}",
+                extra={"what": "checkpoint_verify_failure",
+                       "directory": self.directory, "step": int(step),
+                       "digest_diff": dict(
+                           sorted(diff.items())[:16])})
+        except Exception:  # noqa: BLE001 — never mask the corruption
+            pass
+
+    # -- introspection / lifecycle ------------------------------------------
     def latest_step(self) -> Optional[int]:
-        return self._mgr.latest_step()
+        """Newest step safe to resume from: manifested (commit
+        completed) and not quarantined. Falls back to raw committed
+        dirs only for legacy (pre-manifest) directories."""
+        steps = self._manifest_steps()
+        if steps:
+            return steps[-1]
+        legacy = self._legacy_steps()  # never a quarantined dir
+        return legacy[-1] if legacy else None
 
     def all_steps(self):
-        return list(self._mgr.all_steps())
+        return self._disk_steps()
+
+    def read_state(self, step: int) -> Optional[Dict[str, Any]]:
+        """The manifest ``state`` bundle without restoring arrays."""
+        manifest = self._read_manifest(step)
+        return None if manifest is None else manifest.get("state")
 
     def wait_until_finished(self) -> None:
-        """Block until in-flight async saves are committed."""
-        self._mgr.wait_until_finished()
+        """Barrier: block until in-flight async commits finish; raises
+        any background commit failure."""
+        with self._cv:
+            while self._pending:
+                self._cv.wait()
+            # a drained queue un-abandons the manager: a survived
+            # flush timeout must not make close() skip its barrier
+            self._flush_timed_out = False
+        self._raise_writer_err()
+
+    def flush(self, deadline=None) -> str:
+        """Deadline-budgeted barrier for the preemption path: wait for
+        in-flight async commits only as long as the grace budget
+        allows. Returns the outcome — ``"committed"`` (everything
+        durable), ``"timeout"`` (budget ran out first; the previous
+        manifested step stands), ``"noop"`` (nothing in flight), or
+        ``"error"`` (a background commit failed) — and counts it in
+        ``ckpt_emergency_flush_total{outcome=}``."""
+        dl = as_deadline(deadline)
+        outcome = "committed"
+        with self._cv:
+            if not self._pending:
+                outcome = "noop" if self._writer_err is None else "error"
+            while self._pending:
+                remaining = None if dl is None else dl.remaining()
+                if remaining is not None and remaining <= 0:
+                    outcome = "timeout"
+                    # the grace budget is SPENT: the teardown that
+                    # follows (fit's finally → close()) must not
+                    # re-block on the same stuck commit — the platform
+                    # would SIGKILL us mid-wait and exit 67 would never
+                    # reach the elastic launcher
+                    self._flush_timed_out = True
+                    break
+                self._cv.wait(timeout=remaining)
+            if outcome == "committed" and self._writer_err is not None:
+                outcome = "error"
+        _ckpt_metrics()["flush"].labels(outcome).inc()
+        stat_add(f"checkpoint.flush_{outcome}")
+        return outcome
 
     def close(self) -> None:
-        self._mgr.close()
+        if self._flush_timed_out:
+            # best-effort teardown after a timed-out emergency flush:
+            # never wait on the in-flight commit again (a half-written
+            # commit is unmanifested debris, swept at the next open)
+            try:
+                self._q.put_nowait(self._CLOSE)
+            except queue.Full:
+                pass
+            # not even self._ckptr.close(): orbax joins its own pool,
+            # which is busy with the very write we gave up on — the
+            # process is exiting, the daemon writer dies with it
+            return
+        if self._writer is not None and self._writer.is_alive():
+            with self._cv:
+                while self._pending:
+                    self._cv.wait()
+            self._q.put(self._CLOSE)
+            self._writer.join(timeout=30.0)
+        self._ckptr.close()
+        self._raise_writer_err()
 
     def __enter__(self):
         return self

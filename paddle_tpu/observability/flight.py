@@ -47,7 +47,11 @@ from .metrics import MetricRegistry, default_registry
 _EVENT_TAIL = 256
 
 _installed: Optional["FlightRecorder"] = None
-_mu = threading.Lock()
+# RLock: install_flight_recorder holds it across its check-then-install
+# (two concurrent callers must not both observe "none installed" and
+# stack hooks twice) while FlightRecorder.install() re-acquires it to
+# register itself as the process-wide recorder
+_mu = threading.RLock()
 
 
 class FlightRecorder:
@@ -68,22 +72,27 @@ class FlightRecorder:
         self._dump_mu = threading.Lock()
 
     # -- the dump -------------------------------------------------------
-    def dump(self, reason: str, dedupe: bool = False) -> Optional[str]:
+    def dump(self, reason: str, dedupe: bool = False,
+             extra: Optional[dict] = None) -> Optional[str]:
         """Write ``flight_<pid>_<reason>.jsonl``; returns the path.
         Never raises — a recorder failure must not mask the original
         crash. ``dedupe=True`` (the hook paths) writes at most one dump
         per reason: a SIGTERM handler racing an excepthook must not
-        interleave."""
+        interleave. ``extra`` (a JSON-serializable dict) lands as one
+        ``kind="extra"`` row right after the header — how a failed
+        checkpoint-restore verification attaches its manifest digest
+        diff."""
         try:
             with self._dump_mu:
                 if dedupe and reason in self._dumped:
                     return None
                 self._dumped.add(reason)
-                return self._dump_locked(reason)
+                return self._dump_locked(reason, extra=extra)
         except Exception:  # noqa: BLE001 — never mask the real death
             return None
 
-    def _dump_locked(self, reason: str) -> str:
+    def _dump_locked(self, reason: str,
+                     extra: Optional[dict] = None) -> str:
         os.makedirs(self.directory, exist_ok=True)
         path = os.path.join(self.directory,
                             f"flight_{os.getpid()}_{reason}.jsonl")
@@ -105,6 +114,9 @@ class FlightRecorder:
                 "live_spans": len(live), "finished_spans": len(finished),
                 "metrics": metrics,
             }, default=str) + "\n")
+            if extra is not None:
+                f.write(json.dumps({"kind": "extra", **extra},
+                                   default=str) + "\n")
             for sp in live:
                 sp = dict(sp, live=True, kind="span",
                           ts_wall=tracing.perf_to_wall(sp["ts"]))
@@ -136,6 +148,13 @@ class FlightRecorder:
                 # not the main thread / unsupported signal: the
                 # exception hooks still cover us
                 pass
+        # the most recently installed recorder IS the process-wide one
+        # (mirrors uninstall(), which already clears this slot):
+        # dump_flight_record() callers — e.g. checkpoint verify
+        # failures — must reach a recorder installed either way
+        global _installed
+        with _mu:
+            _installed = self
         return self
 
     def uninstall(self) -> None:
@@ -203,28 +222,25 @@ def install_flight_recorder(directory: str = "./flight_recorder",
     """Create + install the process-wide recorder (idempotent per
     process: a second call re-points the existing recorder's
     directory rather than stacking hooks)."""
-    global _installed
-    with _mu:
-        if _installed is not None:
+    with _mu:  # held across check+install: concurrent first callers
+        if _installed is not None:  # must not both stack hooks
             _installed.directory = os.path.abspath(directory)
             if registry is not None:
                 _installed.registry = registry
             return _installed
-        rec = FlightRecorder(directory, registry=registry,
-                             signals=signals)
-        rec.install()
-        _installed = rec
-        return rec
+        return FlightRecorder(directory, registry=registry,
+                              signals=signals).install()
 
 
 def get_flight_recorder() -> Optional[FlightRecorder]:
     return _installed
 
 
-def dump_flight_record(reason: str) -> Optional[str]:
+def dump_flight_record(reason: str,
+                       extra: Optional[dict] = None) -> Optional[str]:
     """Dump through the installed recorder; harmless no-op when none
     is installed (the elastic hook calls this unconditionally)."""
     rec = _installed
     if rec is None:
         return None
-    return rec.dump(reason)
+    return rec.dump(reason, extra=extra)

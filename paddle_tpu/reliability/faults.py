@@ -29,6 +29,12 @@ Named sites (the catalog; see docs/RELIABILITY.md):
 ``device.transfer``       device→host fetch of sampled tokens
 ``ckpt.write``            checkpoint save dispatch (pre-write)
 ``ckpt.rename``           checkpoint commit/rename stage (post-write)
+``ckpt.snapshot``         device→host state snapshot (the only part of
+                          an async save the train loop waits on)
+``ckpt.async_commit``     background writer thread, one queued commit
+                          (write+manifest) about to run
+``loader.state``          DataLoader cursor capture/restore
+                          (state_dict / load_state_dict)
 ``store.socket``          one TCP rendezvous-store request attempt
 ``io.worker``             DataLoader host-batch production
 ``router.dispatch``       fleet router: one request dispatch to a replica
@@ -54,6 +60,9 @@ SITES = (
     "device.transfer",
     "ckpt.write",
     "ckpt.rename",
+    "ckpt.snapshot",
+    "ckpt.async_commit",
+    "loader.state",
     "store.socket",
     "io.worker",
     "router.dispatch",

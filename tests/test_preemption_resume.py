@@ -1,0 +1,805 @@
+"""Preemption-safe training (ISSUE 8): async checkpoints, exact
+resume, integrity-verified restore.
+
+In-process counterpart of the kill-anywhere chaos gate
+(``tools/chaos_soak.py --ci --train``): CheckpointManager async/
+manifest/verify/GC semantics, the DataLoader resume cursor, the new
+fault sites' seeded determinism, flight-recorder dumps on verify
+failure, ``Model.fit(resume=...)`` bit-identity, and the
+ElasticManager resume-step threading + stall damping.
+"""
+
+import glob
+import json
+import os
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.io import DataLoader, TensorDataset
+from paddle_tpu.io.checkpoint import (CheckpointCorrupt, CheckpointManager,
+                                      digest_tree, latest_manifest_step)
+from paddle_tpu.reliability import faults
+from paddle_tpu.reliability.faults import FaultInjected
+from paddle_tpu.reliability.retry import Deadline
+
+
+def _tree(v=0.0):
+    return {"w": np.full((32, 8), v, np.float32),
+            "b": np.arange(8, dtype=np.float32) + v}
+
+
+def _tamper_manifest(directory, step):
+    """Rewrite one digest in the step's manifest: restore then succeeds
+    at the byte level but fails integrity verification."""
+    path = os.path.join(directory, f"manifest-{step}.json")
+    man = json.load(open(path))
+    key = sorted(man["digests"])[0]
+    man["digests"][key] = "0" * 32
+    json.dump(man, open(path, "w"))
+    return key
+
+
+# -- manifests, latest_step, GC ---------------------------------------------
+
+def test_manifest_state_rides_the_checkpoint(tmp_path):
+    with CheckpointManager(str(tmp_path), async_save=False) as mgr:
+        mgr.save(3, _tree(1.0), state={"step": 3, "loader": {"pass": 0,
+                                                             "batch": 7}})
+        tree, state = mgr.restore_with_state()
+        assert state == {"step": 3, "loader": {"pass": 0, "batch": 7}}
+        assert mgr.read_state(3) == state
+        np.testing.assert_array_equal(np.asarray(tree["w"]),
+                                      _tree(1.0)["w"])
+
+
+def test_latest_step_never_surfaces_unmanifested_data(tmp_path):
+    """A committed data dir whose manifest never landed (kill between
+    data-commit and manifest-write) is invisible and swept."""
+    with CheckpointManager(str(tmp_path), async_save=False) as mgr:
+        mgr.save(1, _tree())
+        mgr.save(2, _tree())
+    os.unlink(str(tmp_path / "manifest-2.json"))  # "killed mid-commit"
+    mgr = CheckpointManager(str(tmp_path), async_save=False)
+    assert mgr.latest_step() == 1
+    assert latest_manifest_step(str(tmp_path)) == 1
+    assert not os.path.exists(str(tmp_path / "2")), \
+        "unmanifested debris should be swept at open"
+    mgr.save(2, _tree(2.0))  # the name is reusable after the sweep
+    assert mgr.latest_step() == 2
+    mgr.close()
+
+
+def test_gc_keeps_newest_verified_and_skips_quarantined(tmp_path):
+    with CheckpointManager(str(tmp_path), max_to_keep=2,
+                           async_save=False) as mgr:
+        for s in (1, 2, 3):
+            mgr.save(s, _tree(float(s)), state={"step": s})
+        _tamper_manifest(str(tmp_path), 3)
+        _t, state = mgr.restore_with_state()   # quarantines 3, falls back
+        assert state["step"] == 2
+        assert mgr.latest_step() == 2
+        # GC budget counts VERIFIED steps only; the newest verified
+        # step is always in the keep set
+        for s in (4, 5):
+            mgr.save(s, _tree(float(s)), state={"step": s})
+        steps = mgr.all_steps()
+        assert 4 in steps and 5 in steps
+        assert 1 not in steps
+        assert mgr.latest_step() == 5
+
+
+def test_explicit_step_restore_raises_checkpoint_corrupt(tmp_path):
+    with CheckpointManager(str(tmp_path), async_save=False) as mgr:
+        mgr.save(1, _tree(1.0))
+        mgr.save(2, _tree(2.0))
+        key = _tamper_manifest(str(tmp_path), 2)
+        with pytest.raises(CheckpointCorrupt) as ei:
+            mgr.restore(2)
+        assert ei.value.step == 2
+        assert key in ei.value.diff
+        assert ei.value.diff[key]["expected"] == "0" * 32
+        # auto falls back instead of raising
+        tree = mgr.restore()
+        np.testing.assert_array_equal(np.asarray(tree["w"]),
+                                      _tree(1.0)["w"])
+
+
+def test_byte_rot_unreadable_step_falls_back(tmp_path):
+    """Corruption severe enough that orbax can't read the step gets the
+    same quarantine+fallback verdict as a digest mismatch."""
+    with CheckpointManager(str(tmp_path), async_save=False) as mgr:
+        mgr.save(1, _tree(1.0), state={"step": 1})
+        mgr.save(2, _tree(2.0), state={"step": 2})
+        for f in glob.glob(str(tmp_path / "2" / "**"), recursive=True):
+            if os.path.isfile(f):
+                blob = bytearray(open(f, "rb").read())
+                for i in range(0, len(blob), 32):
+                    blob[i] ^= 0xFF
+                open(f, "wb").write(bytes(blob))
+        _t, state = mgr.restore_with_state()
+        assert state["step"] == 1
+        assert mgr.latest_step() == 1
+        assert os.path.exists(str(tmp_path / "manifest-2.json.corrupt"))
+
+
+def test_digest_tree_keys_and_determinism():
+    t = {"a": np.arange(4.0), "b": {"c": np.ones((2, 2))}}
+    d1, d2 = digest_tree(t), digest_tree(t)
+    assert d1 == d2 and len(d1) == 2
+    t["b"]["c"][0, 0] = 5.0
+    assert digest_tree(t) != d1
+
+
+# -- async save path --------------------------------------------------------
+
+def test_async_save_stall_bounded_by_snapshot(tmp_path):
+    """save() returns in device→host snapshot time; the (slowed)
+    commit overlaps and is barriered by wait_until_finished."""
+    mgr = CheckpointManager(str(tmp_path), async_save=True)
+    orig = mgr._commit
+    mgr._commit = lambda *a, **kw: (time.sleep(0.3), orig(*a, **kw))[-1]
+    t0 = time.perf_counter()
+    mgr.save(1, _tree(), state={"step": 1})
+    stall = time.perf_counter() - t0
+    mgr.wait_until_finished()
+    assert stall < 0.15, f"async save stalled {stall:.3f}s"
+    assert mgr.latest_step() == 1
+    mgr._commit = orig
+    mgr.close()
+
+
+def test_async_commit_failure_surfaces_at_next_barrier(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), async_save=True)
+    orig = mgr._commit
+    calls = {"n": 0}
+
+    def flaky(*a, **kw):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise OSError("disk gone")
+        return orig(*a, **kw)
+
+    mgr._commit = flaky
+    mgr.save(1, _tree())
+    with pytest.raises(OSError):
+        mgr.wait_until_finished()
+    # the failure is consumed: the manager keeps working
+    mgr.save(2, _tree())
+    mgr.wait_until_finished()
+    assert mgr.latest_step() == 2
+    mgr._commit = orig
+    mgr.close()
+
+
+def test_flush_outcomes(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), async_save=True)
+    assert mgr.flush() == "noop"
+    mgr.save(1, _tree(), state={"step": 1})
+    assert mgr.flush(Deadline.after(30.0)) == "committed"
+    # a commit slower than the grace budget → timeout, previous
+    # manifested step stands
+    orig = mgr._commit
+    release = threading.Event()
+    mgr._commit = lambda *a, **kw: (release.wait(5.0), orig(*a, **kw))[-1]
+    mgr.save(2, _tree())
+    assert mgr.flush(Deadline.after(0.05)) == "timeout"
+    assert mgr.latest_step() == 1
+    release.set()
+    mgr.wait_until_finished()
+    assert mgr.latest_step() == 2
+    mgr._commit = orig
+    mgr.close()
+
+
+def test_sync_save_barriers_inflight_async(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), async_save=True)
+    mgr.save(1, _tree(1.0), state={"step": 1})
+    mgr.save(2, _tree(2.0), async_=False, state={"step": 2})
+    # the sync save implies the async one is committed
+    assert sorted(mgr.all_steps()) == [1, 2]
+    assert mgr.latest_step() == 2
+    mgr.close()
+
+
+# -- fault sites (satellite 1) ----------------------------------------------
+
+def test_new_fault_sites_preview_determinism():
+    for site in ("ckpt.snapshot", "ckpt.async_commit", "loader.state"):
+        faults.reset()
+        faults.enable(seed=77)
+        faults.inject(site, p=0.3)
+        want = faults.preview(site, 40)
+        assert want == faults.preview(site, 40), site
+        assert want, f"p=0.3 over 40 calls injected nothing at {site}"
+        assert faults.preview(site, 40, seed=78) != want, site
+        # live checks fire exactly on the previewed schedule
+        hits = []
+        for n in range(1, 41):
+            try:
+                faults.check(site)
+            except FaultInjected:
+                hits.append(n)
+        assert hits == want, site
+    faults.reset()
+
+
+def test_loader_state_site_guards_capture_and_restore():
+    faults.reset()
+    faults.enable(seed=5)
+    faults.inject("loader.state", nth=(1,), times=1)
+    loader = DataLoader(TensorDataset([np.arange(8.0)[:, None]]),
+                        batch_size=2)
+    try:
+        with pytest.raises(FaultInjected):
+            loader.state_dict()
+        loader.state_dict()  # budget consumed
+    finally:
+        faults.reset()
+
+
+def test_verify_failure_dumps_flight_record_with_digest_diff(tmp_path):
+    from paddle_tpu.observability import flight
+    rec = flight.FlightRecorder(str(tmp_path / "dumps")).install()
+    try:
+        with CheckpointManager(str(tmp_path / "ck"),
+                               async_save=False) as mgr:
+            mgr.save(1, _tree(1.0))
+            mgr.save(2, _tree(2.0))
+            key = _tamper_manifest(str(tmp_path / "ck"), 2)
+            mgr.restore()  # quarantines 2, falls back to 1
+        dumps = glob.glob(str(tmp_path / "dumps" / "*ckpt_verify*"))
+        assert len(dumps) == 1
+        rows = [json.loads(l) for l in open(dumps[0])]
+        extra = [r for r in rows if r.get("kind") == "extra"]
+        assert extra and extra[0]["what"] == "checkpoint_verify_failure"
+        assert extra[0]["step"] == 2
+        assert key in extra[0]["digest_diff"]
+        assert extra[0]["digest_diff"][key]["expected"] == "0" * 32
+    finally:
+        rec.uninstall()
+
+
+# -- DataLoader resume cursor (satellite 3) ---------------------------------
+
+def _batches(it, n=None):
+    out = []
+    for b in it:
+        out.append(np.asarray(b[0]).copy())
+        if n is not None and len(out) >= n:
+            break
+    return out
+
+
+def _loader(n=24, batch_size=4, shuffle=True, **kw):
+    x = np.arange(n, dtype=np.float32)[:, None]
+    return DataLoader(TensorDataset([x]), batch_size=batch_size,
+                      shuffle=shuffle, **kw)
+
+
+def test_cursor_resumes_mid_epoch_exactly():
+    pt.seed(11)
+    ref = _batches(iter(_loader()))          # pass 0, uninterrupted
+    pt.seed(11)
+    a = _loader()
+    it = iter(a)
+    head = _batches(it, 3)
+    st = a.state_dict()
+    assert st == {"pass": 0, "batch": 3}
+    it.close()
+    pt.seed(11)
+    b = _loader()
+    b.load_state_dict(st)
+    tail = _batches(iter(b))
+    got = head + tail
+    assert len(got) == len(ref)
+    for r, g in zip(ref, got):
+        np.testing.assert_array_equal(r, g)
+
+
+def test_cursor_counts_consumed_not_prefetched():
+    """Prefetched-but-unconsumed batches must re-produce on resume."""
+    a = _loader(shuffle=False, prefetch_factor=4)
+    it = iter(a)
+    next(it)
+    time.sleep(0.2)  # let the prefetch thread run far ahead
+    assert a.state_dict()["batch"] == 1
+    it.close()
+
+
+def test_shuffle_reproducibility_across_passes():
+    """Pass e of a resumed run shuffles exactly like pass e of an
+    uninterrupted one — including passes AFTER the resumed one."""
+    pt.seed(13)
+    a = _loader()
+    ref = [_batches(iter(a)) for _ in range(3)]      # passes 0,1,2
+    assert not np.array_equal(ref[0][0], ref[1][0]), \
+        "shuffle should differ across passes"
+    pt.seed(13)
+    b = _loader()
+    it = iter(b)          # pass 0
+    _batches(it, 5)
+    st = b.state_dict()
+    it.close()
+    pt.seed(13)
+    c = _loader()
+    c.load_state_dict(st)
+    tail0 = _batches(iter(c))                        # rest of pass 0
+    for r, g in zip(ref[0][5:], tail0):
+        np.testing.assert_array_equal(r, g)
+    for e in (1, 2):                                 # subsequent passes
+        for r, g in zip(ref[e], _batches(iter(c))):
+            np.testing.assert_array_equal(r, g)
+
+
+def test_cursor_resumes_mid_superbatch():
+    """A cursor not aligned to steps_per_loop restacks slabs from the
+    resume point: slab boundaries shift, per-step contents don't."""
+    pt.seed(17)
+    a = _loader(n=32)
+    ref = []
+    for slab in a.superbatches(4):                   # pass 0: 2 slabs
+        ref.extend(np.asarray(slab[0]))
+    pt.seed(17)
+    b = _loader(n=32)
+    it = b.superbatches(4)
+    first = next(it)
+    got = list(np.asarray(first[0]))
+    st = b.state_dict()
+    assert st["batch"] == 4
+    it.close()
+    # checkpoint "mid-superbatch": pretend only 2 of the slab's 4
+    # steps were retained (the manifest cursor can say so)
+    st = {"pass": st["pass"], "batch": 2}
+    got = got[:2]
+    pt.seed(17)
+    c = _loader(n=32)
+    c.load_state_dict(st)
+    for slab in c.superbatches(4):
+        got.extend(np.asarray(slab[0]))
+    assert len(got) == len(ref)
+    for r, g in zip(ref, got):
+        np.testing.assert_array_equal(r, g)
+
+
+def test_cursor_with_multiprocess_workers():
+    """Worker seeds derive from the pass index, so a resumed pass
+    re-produces the interrupted run's exact stream over mp workers."""
+    pt.seed(19)
+    a = _loader(n=32, num_workers=2)
+    ref = _batches(iter(a))
+    pt.seed(19)
+    b = _loader(n=32, num_workers=2)
+    it = iter(b)
+    head = _batches(it, 3)
+    st = b.state_dict()
+    it.close()
+    pt.seed(19)
+    c = _loader(n=32, num_workers=2)
+    c.load_state_dict(st)
+    tail = _batches(iter(c))
+    got = head + tail
+    assert len(got) == len(ref)
+    for r, g in zip(ref, got):
+        np.testing.assert_array_equal(r, g)
+
+
+def test_cursor_across_ragged_tail_flush():
+    """drop_last=False ragged tails flush short slabs; the batch-level
+    cursor stays exact across the shape change."""
+    pt.seed(23)
+    a = _loader(n=26, shuffle=False)     # 6 full batches + tail of 2
+    ref = []
+    for slab in a.superbatches(4):
+        ref.extend(np.asarray(slab[0]))
+    assert len(ref) == 7
+    pt.seed(23)
+    b = _loader(n=26, shuffle=False)
+    it = b.superbatches(4)
+    next(it)                              # consume slab 1 (4 batches)
+    st = b.state_dict()
+    assert st["batch"] == 4
+    it.close()
+    pt.seed(23)
+    c = _loader(n=26, shuffle=False)
+    c.load_state_dict(st)
+    got = ref[:4]
+    for slab in c.superbatches(4):
+        got.extend(np.asarray(slab[0]))
+    assert len(got) == len(ref)
+    for r, g in zip(ref, got):
+        np.testing.assert_array_equal(r, g)
+
+
+# -- Model.fit resume (tentpole, in-process) --------------------------------
+
+class _LossTap(pt.callbacks.Callback):
+    def __init__(self, epoch_steps):
+        self.losses = {}
+        self._epoch_steps = epoch_steps
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+
+    def on_train_batch_end(self, step, logs=None):
+        g = self._epoch * self._epoch_steps + step
+        self.losses[g] = float(logs["loss"]).hex()
+
+
+def _fit_model(tap, ckpt_dir=None, epochs=2, resume=None, k=1,
+               stop_after=None, freq=3):
+    pt.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 4))
+    model = pt.Model(net)
+    model.prepare(
+        optimizer=pt.optimizer.AdamW(learning_rate=1e-2, parameters=net),
+        loss=nn.CrossEntropyLoss(), metrics=pt.metric.Accuracy())
+    rng = np.random.RandomState(3)
+    x = rng.randn(32, 8).astype(np.float32)
+    y = rng.randint(0, 4, (32, 1))
+    cbs = [tap]
+    if stop_after is not None:
+        class _Die(pt.callbacks.Callback):
+            def on_train_batch_end(self, step, logs=None):
+                if len(tap.losses) >= stop_after:
+                    raise RuntimeError("synthetic preemption")
+        cbs.append(_Die())
+    kw = {}
+    if ckpt_dir is not None:
+        kw = dict(checkpoint_dir=ckpt_dir, checkpoint_freq=freq,
+                  resume=resume, keep_checkpoints=3)
+    model.fit(TensorDataset([x, y]), batch_size=4, epochs=epochs,
+              shuffle=True, verbose=0, steps_per_loop=k,
+              callbacks=cbs, **kw)
+    return model
+
+
+@pytest.mark.parametrize("k", [1, 4])
+def test_fit_resume_bit_identical(tmp_path, k):
+    """A fit interrupted mid-epoch-0 and resumed (fresh Model, fresh
+    process-equivalent state) replays a loss stream bit-identical to
+    the uninterrupted run at any steps_per_loop."""
+    base = _LossTap(8)
+    _fit_model(base, epochs=2, k=k)
+    assert sorted(base.losses) == list(range(16))
+
+    tap = _LossTap(8)
+    d = str(tmp_path / f"ck{k}")
+    with pytest.raises(RuntimeError, match="synthetic preemption"):
+        _fit_model(tap, ckpt_dir=d, epochs=2, k=k, stop_after=5)
+    resumed = _LossTap(8)
+    _fit_model(resumed, ckpt_dir=d, epochs=2, resume="auto", k=k)
+    combined = dict(tap.losses)
+    combined.update(resumed.losses)
+    assert sorted(combined) == list(range(16))
+    for s, h in base.losses.items():
+        assert combined[s] == h, f"step {s}: {combined[s]} != {h}"
+        if s in resumed.losses:
+            assert resumed.losses[s] == h
+
+
+def test_fit_resume_restores_metric_accumulators(tmp_path):
+    """Resume mid-epoch keeps the epoch's metric state: the resumed
+    epoch's final accuracy equals the uninterrupted run's."""
+    base = _LossTap(8)
+    m1 = _fit_model(base, epochs=1)
+    acc_ref = float(m1._metrics[0].accumulate())
+
+    tap = _LossTap(8)
+    d = str(tmp_path / "ck")
+    with pytest.raises(RuntimeError):
+        _fit_model(tap, ckpt_dir=d, epochs=1, stop_after=5)
+    m2 = _fit_model(_LossTap(8), ckpt_dir=d, epochs=1, resume="auto")
+    assert float(m2._metrics[0].accumulate()) == acc_ref
+
+
+def test_fit_resume_env_pin_falls_back_when_corrupt(tmp_path, monkeypatch):
+    """$PADDLE_ELASTIC_RESUME_STEP names the step an elastic respawn
+    was handed; if that step has rotted, resume="auto" falls back to
+    the newest verified step instead of dying."""
+    tap = _LossTap(8)
+    d = str(tmp_path / "ck")
+    with pytest.raises(RuntimeError):
+        _fit_model(tap, ckpt_dir=d, epochs=2, stop_after=7, freq=3)
+    steps = sorted(int(s) for s in
+                   CheckpointManager(d, async_save=False).all_steps())
+    assert len(steps) >= 2
+    _tamper_manifest(d, steps[-1])
+    monkeypatch.setenv("PADDLE_ELASTIC_RESUME_STEP", str(steps[-1]))
+    resumed = _LossTap(8)
+    _fit_model(resumed, ckpt_dir=d, epochs=2, resume="auto")
+    base = _LossTap(8)
+    _fit_model(base, epochs=2)
+    for s, h in resumed.losses.items():
+        assert base.losses[s] == h, f"step {s} diverged after fallback"
+
+
+# -- ElasticManager resume threading (satellite 2) --------------------------
+
+def test_elastic_threads_resume_step_and_detects_stalls(tmp_path):
+    from paddle_tpu.distributed.elastic import ElasticManager
+
+    def manifest(step):
+        json.dump({"format": 1, "step": step, "digests": {}},
+                  open(str(tmp_path / f"manifest-{step}.json"), "w"))
+
+    mgr = ElasticManager(nproc=1, training_script="x.py",
+                         script_args=[], checkpoint_dir=str(tmp_path))
+    assert mgr._latest_verified() is None
+    manifest(5)
+    assert mgr._latest_verified() == 5
+    manifest(9)
+    assert mgr._latest_verified() == 9
+
+    # spawn handed step 9; death without progress is a stall
+    mgr._spawn_resume_step = 9
+    assert mgr._note_resume_progress() is True
+    assert mgr._resume_stalls == 1
+    assert mgr._note_resume_progress() is True
+    assert mgr._resume_stalls == 2
+    manifest(12)   # checkpoint advanced: stall streak resets
+    assert mgr._note_resume_progress() is False
+    assert mgr._resume_stalls == 0
+
+
+_ELASTIC_TRAIN = """
+import json, os, sys
+work = sys.argv[1]
+resume = os.environ.get("PADDLE_ELASTIC_RESUME_STEP")
+incarnation = int(os.environ.get("PADDLE_ELASTIC_RESTART_COUNT", 0))
+with open(os.path.join(work, "log.txt"), "a") as f:
+    f.write(json.dumps({"inc": incarnation, "resume": resume}) + "\\n")
+start = 0 if resume is None else int(resume)
+for step in range(start + 1, start + 4):
+    man = os.path.join(work, "ckpt", f"manifest-{step}.json")
+    json.dump({"format": 1, "step": step, "digests": {}},
+              open(man + ".tmp", "w"))
+    os.replace(man + ".tmp", man)
+if incarnation == 0:
+    os._exit(17)   # crash after committing 3 steps
+"""
+
+
+def test_elastic_restart_resumes_from_newest_verified(tmp_path):
+    """Regression (satellite 2): a respawned generation is handed the
+    newest verified step via $PADDLE_ELASTIC_RESUME_STEP — no script
+    changes — and a crash that DID advance the checkpoint does not
+    count as a resume stall."""
+    from paddle_tpu.distributed.elastic import ElasticManager
+    script = tmp_path / "train.py"
+    script.write_text(_ELASTIC_TRAIN)
+    (tmp_path / "ckpt").mkdir()
+    mgr = ElasticManager(
+        nproc=1, training_script=str(script),
+        script_args=[str(tmp_path)], max_restarts=2,
+        poll_interval=0.05, restart_backoff=0.05,
+        checkpoint_dir=str(tmp_path / "ckpt"))
+    assert mgr.run() == 0
+    log = [json.loads(l)
+           for l in (tmp_path / "log.txt").read_text().splitlines()]
+    assert log[0] == {"inc": 0, "resume": None}
+    # incarnation 0 committed manifests 1..3 then crashed: the respawn
+    # is pinned to the newest verified step
+    assert log[1] == {"inc": 1, "resume": "3"}
+    assert latest_manifest_step(str(tmp_path / "ckpt")) == 6
+    assert mgr._resume_stalls == 0
+
+
+def test_elastic_damps_respawns_into_stalled_checkpoint(tmp_path):
+    """A 'graceful' exit-67 loop that never advances the verified step
+    (resume dying into a corrupt newest checkpoint) must damp like a
+    crash loop instead of hot-looping respawns."""
+    from paddle_tpu.distributed.elastic import (ElasticManager,
+                                                RESTART_EXIT_CODE)
+    script = tmp_path / "train.py"
+    script.write_text(
+        "import os, sys\n"
+        "path = os.path.join(sys.argv[1], 'runs.txt')\n"
+        "n = os.path.getsize(path) if os.path.exists(path) else 0\n"
+        "open(path, 'a').write('x')\n"
+        f"os._exit(0 if n >= 3 else {RESTART_EXIT_CODE})\n")
+    (tmp_path / "ckpt").mkdir()
+    json.dump({"format": 1, "step": 4, "digests": {}},
+              open(str(tmp_path / "ckpt" / "manifest-4.json"), "w"))
+    mgr = ElasticManager(
+        nproc=1, training_script=str(script),
+        script_args=[str(tmp_path)], max_restarts=0,
+        poll_interval=0.02, restart_backoff=0.2,
+        restart_backoff_cap=0.4,
+        checkpoint_dir=str(tmp_path / "ckpt"))
+    t0 = time.perf_counter()
+    assert mgr.run() == 0
+    elapsed = time.perf_counter() - t0
+    # 3 preemption exits, all stalled on manifest-4: stalls 2 and 3
+    # must pay escalating backoff (2 sleeps from the damping curve)
+    assert mgr._resume_stalls == 3
+    assert elapsed >= 0.4, (
+        f"stalled exit-67 loop respawned in {elapsed:.2f}s — "
+        f"restart-storm damping did not engage")
+
+
+# -- review-pass regressions ------------------------------------------------
+
+def test_fit_resume_explicit_int_one_is_not_auto(tmp_path):
+    """resume=1 means STEP 1. 1 == True in Python, so a containment
+    gate like ``resume in (True, "auto")`` silently turns it into
+    "auto" and restores the newest step instead."""
+    tap = _LossTap(8)
+    d = str(tmp_path / "ck")
+    with pytest.raises(RuntimeError, match="synthetic preemption"):
+        _fit_model(tap, ckpt_dir=d, epochs=1, stop_after=5, freq=1)
+    with CheckpointManager(d, async_save=False) as mgr:
+        steps = [s for s in mgr.all_steps() if mgr.read_state(s)]
+    assert 1 not in steps and 2 in steps
+    # no step-1 checkpoint exists: an explicit resume=1 must raise,
+    # not silently auto-resume from the newest step
+    with pytest.raises(FileNotFoundError):
+        _fit_model(_LossTap(8), ckpt_dir=d, epochs=1, resume=1)
+    # and an explicit step that DOES exist restores that step
+    resumed = _LossTap(8)
+    _fit_model(resumed, ckpt_dir=d, epochs=1, resume=2)
+    assert min(resumed.losses) == 2, (
+        f"resume=2 restored step {min(resumed.losses)}")
+    base = _LossTap(8)
+    _fit_model(base, epochs=1)
+    for s, h in resumed.losses.items():
+        assert base.losses[s] == h
+
+
+def test_fit_resume_explicit_zero_is_not_skipped(tmp_path):
+    """resume=0 is an EXPLICIT step, not falsy "don't resume": when no
+    step-0 checkpoint exists it must raise, never silently retrain
+    from scratch."""
+    d = str(tmp_path / "ck")
+    with pytest.raises(RuntimeError, match="synthetic preemption"):
+        _fit_model(_LossTap(8), ckpt_dir=d, epochs=1, stop_after=5)
+    with pytest.raises(FileNotFoundError):
+        _fit_model(_LossTap(8), ckpt_dir=d, epochs=1, resume=0)
+
+
+class _FakeRemoteShard(np.ndarray):
+    """Stands in for a multi-host sharded jax.Array: bytes not visible
+    to this process."""
+    @property
+    def is_fully_addressable(self):
+        return False
+
+
+def test_async_save_falls_back_to_sync_for_non_addressable(tmp_path):
+    """A tree with non-fully-addressable leaves can't be host-
+    snapshotted by one process — save(async_=True) must take the sync
+    per-shard path instead of raising, and the step restores
+    (unverified, per digest_tree's contract)."""
+    leaf = np.arange(8, dtype=np.float32).view(_FakeRemoteShard)
+    assert not leaf.is_fully_addressable
+    with CheckpointManager(str(tmp_path), async_save=True) as mgr:
+        mgr.save(1, {"w": leaf})
+        assert mgr._writer is None, "async writer ran on a remote shard"
+        assert mgr.latest_step() == 1
+        tree, _state = mgr.restore_with_state()
+        np.testing.assert_array_equal(np.asarray(tree["w"]),
+                                      np.arange(8, dtype=np.float32))
+
+
+def test_quarantined_step_never_restores_as_legacy(tmp_path):
+    """Quarantine renames the manifest, which must not demote the step
+    to a 'legacy unverified' directory: explicit restore raises, auto
+    raises when nothing else verifies, latest_step surfaces nothing."""
+    with CheckpointManager(str(tmp_path), async_save=False) as mgr:
+        mgr.save(1, _tree(1.0))
+        mgr.save(2, _tree(2.0))
+        _tamper_manifest(str(tmp_path), 2)
+        mgr.restore()                      # quarantines 2, falls back
+        with pytest.raises(CheckpointCorrupt):
+            mgr.restore(2)                 # not "legacy", still corrupt
+    d2 = str(tmp_path / "all_corrupt")
+    with CheckpointManager(d2, async_save=False) as mgr:
+        mgr.save(1, _tree(1.0))
+        _tamper_manifest(d2, 1)
+        with pytest.raises(CheckpointCorrupt):
+            mgr.restore()                  # quarantines the only step
+        assert mgr.latest_step() is None
+        with pytest.raises(CheckpointCorrupt):
+            mgr.restore()                  # and STAYS corrupt reopened
+
+
+def test_gc_and_sweep_keep_legacy_steps_at_migration_boundary(tmp_path):
+    """Pre-manifest checkpoints are rollback points, not debris: the
+    first manifested save must rotate them through the keep-last-N
+    budget, and reopening must not sweep them."""
+    d = str(tmp_path)
+    with CheckpointManager(d, async_save=False) as mgr:
+        for s in (1, 2, 3):
+            mgr.save(s, _tree(float(s)))
+    for s in (1, 2, 3):
+        os.unlink(os.path.join(d, f"manifest-{s}.json"))  # legacy era
+    with CheckpointManager(d, max_to_keep=3, async_save=False) as mgr:
+        assert mgr.latest_step() == 3      # legacy fallback
+        mgr.save(5, _tree(5.0))
+        steps = mgr.all_steps()
+        assert steps == [2, 3, 5], (
+            f"migration-boundary GC kept {steps}, wanted newest 3 "
+            f"counting legacy rollback points")
+    with CheckpointManager(d, max_to_keep=3, async_save=False) as mgr:
+        assert mgr.all_steps() == [2, 3, 5], "reopen swept legacy steps"
+        assert mgr.latest_step() == 5
+
+
+def test_duplicate_step_save_skips_like_legacy(tmp_path):
+    """Re-saving an already-manifested step is a silent skip (the old
+    orbax-backed behavior), not an error — AutoCheckpoint's multi-rank
+    agreed-older-step resume re-commits a step some ranks already
+    hold. force=True still overwrites."""
+    with CheckpointManager(str(tmp_path), async_save=False) as mgr:
+        assert mgr.save(1, _tree(1.0)) is True
+        assert mgr.save(1, _tree(9.0)) is False      # skipped, no raise
+        tree = mgr.restore(1)
+        np.testing.assert_array_equal(np.asarray(tree["w"]),
+                                      _tree(1.0)["w"])
+        assert mgr.save(1, _tree(9.0), force=True) is True
+        tree = mgr.restore(1)
+        np.testing.assert_array_equal(np.asarray(tree["w"]),
+                                      _tree(9.0)["w"])
+
+
+def test_close_does_not_reblock_after_flush_timeout(tmp_path):
+    """Once a deadline-budgeted flush has timed out, the grace budget
+    is SPENT: close() (fit's finally on the preemption exit path) must
+    return immediately instead of waiting out the stuck commit."""
+    release = threading.Event()
+    mgr = CheckpointManager(str(tmp_path), async_save=True)
+    orig = mgr._dispatch_save
+
+    def slow_dispatch(step, tree):
+        release.wait(timeout=30.0)
+        return orig(step, tree)
+
+    mgr._dispatch_save = slow_dispatch
+    try:
+        mgr.save(1, _tree(1.0))
+        assert mgr.flush(Deadline.after(0.2)) == "timeout"
+        t0 = time.perf_counter()
+        mgr.close()
+        assert time.perf_counter() - t0 < 2.0, (
+            "close() re-blocked on the commit the flush gave up on")
+    finally:
+        release.set()
+
+
+def test_loader_does_not_clobber_user_set_epoch():
+    """DistributedBatchSampler.set_epoch is the USER's contract: once
+    called, the loader's pass-index sync must not overwrite it; without
+    a user call, the loader keys shuffle to the pass index (exact
+    resume)."""
+    from paddle_tpu.io import DistributedBatchSampler
+
+    def order(sampler):
+        return [i for batch in sampler for i in batch]
+
+    data = list(range(16))
+    ds = TensorDataset([np.arange(16, dtype=np.float32)[:, None]])
+    # loader-managed: shuffle varies by pass, pass e reproduces pass e
+    s1 = DistributedBatchSampler(data, batch_size=4, num_replicas=1,
+                                 rank=0, shuffle=True)
+    dl = DataLoader(ds, batch_sampler=s1, to_device=False)
+    p0 = [int(np.asarray(b[0])[0, 0]) for b in dl]
+    assert s1.epoch == 0
+    p1 = [int(np.asarray(b[0])[0, 0]) for b in dl]
+    assert s1.epoch == 1 and p0 != p1
+    dl.load_state_dict({"pass": 0, "batch": 0})
+    assert [int(np.asarray(b[0])[0, 0]) for b in dl] == p0
+    # user-managed: the pin survives loader passes
+    s2 = DistributedBatchSampler(data, batch_size=4, num_replicas=1,
+                                 rank=0, shuffle=True)
+    s2.set_epoch(7)
+    ref = order(s2)
+    dl2 = DataLoader(ds, batch_sampler=s2, to_device=False)
+    for _ in dl2:
+        pass
+    assert s2.epoch == 7, "loader clobbered the user's set_epoch"
+    assert order(s2) == ref

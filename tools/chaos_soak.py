@@ -45,10 +45,29 @@ seed; ``faults.preview(site, N)`` recomputes the faulting call
 numbers purely, and the soak asserts the observed injection log
 equals that schedule.
 
+6. TRAIN SOAK (``--train``) — the kill-anywhere/resume-exactly gate
+   (ISSUE 8): a training worker runs ``Model.fit`` with async
+   full-state checkpointing (``checkpoint_dir`` + ``resume="auto"`` +
+   ``PreemptionGuard``), announcing phase markers (STEP / SNAPSHOT /
+   COMMIT / GC). The parent SIGKILLs it at seeded random points —
+   mid-step, mid-snapshot, mid-async-commit, mid-GC — or SIGTERMs it
+   (graceful preemption: deadline-budgeted emergency flush, exit 67),
+   relaunches until completion, and asserts the combined loss stream
+   is BIT-IDENTICAL (float hex) to an uninterrupted baseline at
+   ``steps_per_loop`` ∈ {1, 4}, including every re-run overlap step.
+   Also: a byte-corrupted newest checkpoint is quarantined on restore
+   (falls back to the newest verified step and never surfaces through
+   ``latest_step()`` again), ``ckpt.snapshot``/``ckpt.async_commit``
+   faults replay from their seed, and an async save's measured
+   train-loop stall stays bounded by the device→host snapshot time
+   while a (slowed) commit runs in the background.
+
 Run:  python tools/chaos_soak.py            # full soak (default seed)
 CI:   python tools/chaos_soak.py --ci       # fixed seeds, ~30s budget
       python tools/chaos_soak.py --ci --fleet   # replica-kill soak,
                                                 # ≤45s budget
+      python tools/chaos_soak.py --ci --train   # kill-anywhere train
+                                                # soak, ≤45s budget
 Any assertion failure prints the fault seed and the one-line replay
 command, so a red CI run reproduces in one copy-paste.
 """
@@ -701,6 +720,343 @@ def fleet_soak(seed: int, workdir: str) -> dict:
     return out
 
 
+TRAIN_STEPS = 16          # 2 epochs × 8 steps (32 samples / batch 4)
+TRAIN_EPOCH_STEPS = TRAIN_STEPS // 2
+TRAIN_CKPT_FREQ = 5
+
+
+def train_soak(seed: int, workdir: str) -> dict:
+    """Scenario 6: kill-anywhere / resume-exactly. For steps_per_loop
+    ∈ {1, 4}: an uninterrupted baseline, then seeded kills (SIGKILL in
+    the STEP/SNAPSHOT/COMMIT/GC windows, SIGTERM for the graceful
+    emergency-flush path), then relaunch-to-completion — the combined
+    loss stream must be bit-identical to the baseline at every step,
+    including steps re-run after resuming from an older checkpoint.
+    Plus in-process: corrupt-checkpoint quarantine + fallback, seeded
+    replay of the ckpt.snapshot/ckpt.async_commit fault sites, and the
+    async-save stall bound (snapshot time, not commit time)."""
+    rng = np.random.RandomState(seed)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+
+    def launch(run_dir, k):
+        os.makedirs(run_dir, exist_ok=True)
+        return subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--train-worker",
+             run_dir, str(k), str(TRAIN_CKPT_FREQ)],
+            env=env, cwd=REPO, stdout=subprocess.PIPE, text=True)
+
+    def read_losses(run_dir):
+        out = {}
+        path = os.path.join(run_dir, "losses.txt")
+        if os.path.exists(path):
+            for ln in open(path):
+                s, h = ln.split()
+                out.setdefault(int(s), []).append(h)
+        return out
+
+    def run_complete(run_dir, k):
+        p = launch(run_dir, k)
+        out_text, _ = p.communicate(timeout=300)
+        assert p.returncode == 0, out_text[-800:]
+        assert "DONE" in out_text, out_text[-400:]
+
+    def run_and_kill(run_dir, k, kind, occurrence, jitter):
+        """Kill the worker at the chosen marker occurrence (seeded
+        jitter inside the window). kind="TERM" sends SIGTERM at a STEP
+        marker instead — the graceful-preemption path — and asserts
+        the deadline-budgeted flush exits RESTART_EXIT_CODE. Returns
+        the window the worker died in, or None if it finished first."""
+        from paddle_tpu.distributed.elastic import RESTART_EXIT_CODE
+        p = launch(run_dir, k)
+        target = "STEP" if kind == "TERM" else kind
+        seen = 0
+        died_in = None
+        for line in p.stdout:
+            parts = line.split()
+            if not parts:
+                continue
+            if parts[0] == "DONE":
+                break
+            if parts[0] == target:
+                seen += 1
+                if seen >= occurrence:
+                    time.sleep(jitter)
+                    if kind == "TERM":
+                        p.send_signal(signal.SIGTERM)
+                    else:
+                        p.kill()
+                    died_in = kind
+                    break
+        p.wait(timeout=180)
+        if died_in == "TERM":
+            assert p.returncode == RESTART_EXIT_CODE, (
+                f"SIGTERM mid-training exited {p.returncode}, not "
+                f"{RESTART_EXIT_CODE} — the PreemptionGuard emergency "
+                f"flush path is broken")
+        return died_in
+
+    # pre-draw every seeded choice, then run the two independent
+    # steps_per_loop lanes CONCURRENTLY (each is mostly subprocess
+    # startup + pipe waits): determinism stays a pure function of the
+    # seed while the wall clock halves toward the CI budget
+    kinds = ["SNAPSHOT", "COMMIT", "GC", "TERM", "STEP"]
+    order = [kinds[int(i)] for i in rng.permutation(len(kinds))]
+    plans = []
+    for ki, k in enumerate((1, 4)):
+        lane = []
+        for kind in order[2 * ki: 2 * ki + 2]:
+            occurrence = int(rng.randint(2, 14)
+                             if kind in ("STEP", "TERM")
+                             else rng.randint(1, 3))
+            lane.append((kind, occurrence,
+                         float(rng.uniform(0.0, 0.02))))
+        plans.append((k, lane))
+    out = {"kills": []}
+
+    # both uninterrupted baselines ride ONE subprocess (one jax
+    # import, shared warm caches) before the kill lanes fan out
+    base1 = os.path.join(workdir, "train_base_k1")
+    base4 = os.path.join(workdir, "train_base_k4")
+    os.makedirs(base1, exist_ok=True)
+    os.makedirs(base4, exist_ok=True)
+    p = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--train-baseline",
+         base1, base4, str(TRAIN_CKPT_FREQ)],
+        env=env, cwd=REPO, stdout=subprocess.PIPE, text=True,
+        timeout=300)
+    assert p.returncode == 0 and p.stdout.count("DONE") == 2, (
+        f"baseline run failed rc={p.returncode}: {p.stdout[-800:]}")
+
+    def lane_run(k, lane):
+        baseline = read_losses(os.path.join(workdir,
+                                            f"train_base_k{k}"))
+        assert sorted(baseline) == list(range(TRAIN_STEPS)), (
+            f"k={k} baseline incomplete: {sorted(baseline)}")
+        ref = {s: v[0] for s, v in baseline.items()}
+
+        run_dir = os.path.join(workdir, f"train_kill_k{k}")
+        kills = []
+        for kind, occurrence, jitter in lane:
+            died_in = run_and_kill(run_dir, k, kind, occurrence, jitter)
+            kills.append({"k": k, "kind": kind,
+                          "occurrence": occurrence,
+                          "landed": bool(died_in)})
+        run_complete(run_dir, k)  # final incarnation finishes the range
+        got = read_losses(run_dir)
+        assert sorted(got) == list(range(TRAIN_STEPS)), (
+            f"k={k}: killed/resumed run lost steps: {sorted(got)}")
+        for s in range(TRAIN_STEPS):
+            for h in got[s]:
+                assert h == ref[s], (
+                    f"k={k} step {s}: resumed loss {h} != baseline "
+                    f"{ref[s]} — resume is not bit-identical")
+        return kills, sum(len(v) for v in got.values())
+
+    lane_res: dict = {}
+
+    def lane_thread(k, lane):
+        try:
+            lane_res[k] = lane_run(k, lane)
+        except BaseException as e:  # noqa: BLE001 — re-raised below
+            lane_res[k] = e
+
+    threads = [threading.Thread(target=lane_thread, args=(k, lane))
+               for k, lane in plans]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for k, _lane in plans:
+        res = lane_res.get(k)
+        if isinstance(res, BaseException):
+            raise res
+        kills, loss_lines = res
+        out["kills"].extend(kills)
+        out[f"k{k}"] = {"loss_lines": loss_lines}
+    landed = sum(1 for kl in out["kills"] if kl["landed"])
+    assert landed >= 2, (
+        f"only {landed}/4 seeded kills landed inside the run — the "
+        f"soak under-exercised the kill windows: {out['kills']}")
+    out.update(_train_soak_inprocess(seed, workdir))
+    return out
+
+
+def _train_soak_inprocess(seed: int, workdir: str) -> dict:
+    """Train-soak invariants that don't need a subprocess."""
+    import glob
+
+    from paddle_tpu.io.checkpoint import (CheckpointManager,
+                                          latest_manifest_step)
+    from paddle_tpu.reliability import faults
+    from paddle_tpu.reliability.faults import FaultInjected
+
+    out = {}
+    # -- async stall bound: slow the commit path 0.4s; save() must
+    # return in snapshot time while the barrier sees the full commit
+    d = os.path.join(workdir, "stall_ck")
+    mgr = CheckpointManager(d, async_save=True)
+    orig_commit = mgr._commit
+    mgr._commit = lambda *a, **kw: (time.sleep(0.4),
+                                    orig_commit(*a, **kw))[-1]
+    t0 = time.perf_counter()
+    mgr.save(1, {"w": np.zeros((128, 128), np.float32)},
+             state={"step": 1})
+    stall = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    mgr.wait_until_finished()
+    commit_wall = time.perf_counter() - t0
+    assert stall < 0.2 and commit_wall >= 0.3, (
+        f"async save stalled the train loop {stall:.3f}s against a "
+        f"{commit_wall:.3f}s commit — the stall must be bounded by "
+        f"the device→host snapshot, not the write")
+    mgr._commit = orig_commit
+    mgr.close()
+    out["stall"] = {"save_call_s": round(stall, 4),
+                    "commit_s": round(commit_wall, 3)}
+
+    # -- corrupt newest checkpoint: quarantined on restore, falls back
+    # to the newest VERIFIED step, never surfaces via latest_step again
+    ckdir = os.path.join(workdir, "train_base_k1", "ckpt")
+    mgr = CheckpointManager(ckdir, async_save=False)
+    newest = mgr.latest_step()
+    # flip a byte every 32 across EVERY file of the step: a single
+    # mid-file flip can (correctly) be invisible when it lands in
+    # ocdbt btree dead space a restore never reads — rot THIS thorough
+    # must either corrupt restored values (digest mismatch) or break
+    # the read outright (also quarantined)
+    corrupted = 0
+    for f in glob.glob(os.path.join(ckdir, str(newest), "**"),
+                       recursive=True):
+        if not os.path.isfile(f):
+            continue
+        blob = bytearray(open(f, "rb").read())
+        for i in range(0, len(blob), 32):
+            blob[i] ^= 0xFF
+        open(f, "wb").write(bytes(blob))
+        corrupted += 1
+    assert corrupted, f"no payload files found under step {newest}"
+    _tree, state = mgr.restore_with_state()
+    fallback = mgr.latest_step()
+    assert fallback is not None and fallback < newest, (
+        f"corrupt step {newest} still surfaced: latest={fallback}")
+    assert int(state["step"]) == fallback, state
+    assert latest_manifest_step(ckdir) == fallback, (
+        "quarantined step still visible to the elastic launcher")
+    mgr.close()
+    out["corrupt"] = {"newest": int(newest), "fallback": int(fallback)}
+
+    # -- seeded replay at the new checkpoint fault sites
+    faults.reset()
+    faults.enable(seed=seed)
+    faults.inject("ckpt.snapshot", nth=(2,), times=1)
+    faults.inject("ckpt.async_commit", nth=(2,), times=1)
+    d2 = os.path.join(workdir, "site_ck")
+    m2 = CheckpointManager(d2, async_save=True)
+    try:
+        m2.save(1, {"w": np.arange(8)})
+        m2.wait_until_finished()
+        try:
+            m2.save(2, {"w": np.arange(8)})
+            raised = False
+        except FaultInjected:
+            raised = True   # snapshot fault hits the CALLER, in-line
+        assert raised, "ckpt.snapshot fault did not surface"
+        m2.save(3, {"w": np.arange(8)})
+        try:
+            m2.wait_until_finished()
+            raised = False
+        except FaultInjected:
+            raised = True   # commit fault surfaces at the barrier
+        assert raised, "ckpt.async_commit fault did not surface"
+        assert m2.latest_step() == 1, (
+            f"a faulted commit surfaced: {m2.latest_step()}")
+        _assert_schedule_matches(
+            faults, ("ckpt.snapshot", "ckpt.async_commit"))
+    finally:
+        m2.close()
+        faults.reset()
+    out["fault_sites"] = {"injected": 2}
+    return out
+
+
+def _train_worker(run_dir: str, k: int, freq: int) -> int:
+    """Subprocess body for the train soak: fit with async full-state
+    checkpointing + resume="auto" + PreemptionGuard, announcing phase
+    markers so the parent can land kills inside specific windows.
+    Appends one "step loss-hex" line per optimizer step to losses.txt
+    (hex floats: the bit-identity assertion needs exact values)."""
+    import paddle_tpu as pt
+    from paddle_tpu import nn
+    from paddle_tpu.core import flags
+    from paddle_tpu.distributed import elastic
+    from paddle_tpu.io import TensorDataset
+    from paddle_tpu.io import checkpoint as ckpt_mod
+
+    # shared persistent compile cache: relaunches (the whole point of
+    # this soak) skip the XLA compile after the first incarnation
+    flags.set_flags({"compilation_cache_dir":
+                     os.path.join(os.path.dirname(run_dir), "xla_cache")})
+
+    # phase markers for the parent's kill targeting (patch ONCE — the
+    # merged-baseline mode calls this body twice in one process)
+    Mgr = ckpt_mod.CheckpointManager
+    if not getattr(Mgr, "_soak_markers", False):
+        orig_save, orig_commit, orig_gc = Mgr.save, Mgr._commit, Mgr._gc
+
+        def save(self, step, tree, force=False, async_=None, state=None):
+            print(f"SNAPSHOT {step}", flush=True)
+            return orig_save(self, step, tree, force=force,
+                             async_=async_, state=state)
+
+        def commit(self, step, tree, force, state):
+            print(f"COMMIT {step}", flush=True)
+            return orig_commit(self, step, tree, force, state)
+
+        def gc(self):
+            print("GC 0", flush=True)
+            return orig_gc(self)
+
+        Mgr.save, Mgr._commit, Mgr._gc = save, commit, gc
+        Mgr._soak_markers = True
+
+    pt.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 4))
+    model = pt.Model(net)
+    model.prepare(
+        optimizer=pt.optimizer.AdamW(learning_rate=1e-2, parameters=net),
+        loss=nn.CrossEntropyLoss(), metrics=pt.metric.Accuracy())
+    rng = np.random.RandomState(3)
+    n = TRAIN_EPOCH_STEPS * 4
+    x = rng.randn(n, 8).astype(np.float32)
+    y = rng.randint(0, 4, (n, 1))
+    loss_path = os.path.join(run_dir, "losses.txt")
+
+    class LossWriter(pt.callbacks.Callback):
+        """One "global-step loss-hex" line per optimizer step. fit's
+        in-epoch ``step`` is resume-aware (a mid-epoch resume starts at
+        the restored cursor), so epoch*steps + step IS the global
+        step."""
+
+        def on_epoch_begin(self, epoch, logs=None):
+            self._epoch = epoch
+
+        def on_train_batch_end(self, step, logs=None):
+            g = self._epoch * TRAIN_EPOCH_STEPS + step
+            with open(loss_path, "a") as f:
+                f.write(f"{g} {float(logs['loss']).hex()}\n")
+            print(f"STEP {g}", flush=True)
+
+    guard = elastic.PreemptionGuard()
+    model.fit(TensorDataset([x, y]), batch_size=4, epochs=2,
+              shuffle=True, verbose=0, steps_per_loop=k,
+              callbacks=[LossWriter()],
+              checkpoint_dir=os.path.join(run_dir, "ckpt"),
+              checkpoint_freq=freq, resume="auto", keep_checkpoints=3,
+              preemption_guard=guard, preemption_flush_budget=20.0)
+    print("DONE", flush=True)
+    return 0
+
+
 def _ckpt_worker(directory: str, n_steps: int) -> int:
     """Subprocess body for the SIGKILL scenario: announce, then save —
     the parent kills inside an announced window."""
@@ -723,14 +1079,34 @@ def main(argv=None) -> int:
     ap.add_argument("--fleet", action="store_true",
                     help="run ONLY the fleet scenario (router + K=3 "
                          "replica subprocesses, SIGKILL mid-decode)")
+    ap.add_argument("--train", action="store_true",
+                    help="run ONLY the train scenario (kill-anywhere "
+                         "fit workers, bit-identical resume)")
     ap.add_argument("--seed", type=int, default=1234)
     ap.add_argument("--workdir", default=None)
     ap.add_argument("--ckpt-worker", nargs=2, metavar=("DIR", "STEPS"),
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--train-worker", nargs=3,
+                    metavar=("DIR", "K", "FREQ"),
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--train-baseline", nargs=3,
+                    metavar=("DIR_K1", "DIR_K4", "FREQ"),
                     help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
     if args.ckpt_worker:
         return _ckpt_worker(args.ckpt_worker[0],
                             int(args.ckpt_worker[1]))
+    if args.train_worker:
+        return _train_worker(args.train_worker[0],
+                             int(args.train_worker[1]),
+                             int(args.train_worker[2]))
+    if args.train_baseline:
+        # both uninterrupted baselines in one process: pays the jax
+        # import once; each _train_worker call re-seeds and rebuilds
+        # its model from scratch
+        freq = int(args.train_baseline[2])
+        _train_worker(args.train_baseline[0], 1, freq)
+        return _train_worker(args.train_baseline[1], 4, freq)
     seed = 1234 if args.ci else args.seed
     workdir = args.workdir or os.path.join(
         "/tmp", f"pt_chaos_{os.getpid()}")
@@ -741,6 +1117,8 @@ def main(argv=None) -> int:
     try:
         if args.fleet:
             out["fleet"] = fleet_soak(seed, workdir)
+        elif args.train:
+            out["train"] = train_soak(seed, workdir)
         else:
             out["engine"] = engine_soak(seed)
             out["ckpt"] = ckpt_crash(seed, workdir)
@@ -749,7 +1127,8 @@ def main(argv=None) -> int:
         # make a red CI run reproducible in one copy-paste: the seed
         # IS the fault schedule (docs/RELIABILITY.md determinism)
         replay = (f"python tools/chaos_soak.py --seed {seed}"
-                  + (" --fleet" if args.fleet else ""))
+                  + (" --fleet" if args.fleet else "")
+                  + (" --train" if args.train else ""))
         print(f"CHAOS SOAK FAILED under fault seed {seed}\n"
               f"replay: {replay}", file=sys.stderr, flush=True)
         raise

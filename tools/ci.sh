@@ -62,6 +62,17 @@ echo "== fleet chaos soak (K=3 replicas, SIGKILL mid-decode -> failover)"
 # breach; failures attach a merged cross-process trace
 python tools/chaos_soak.py --ci --fleet
 
+echo "== train chaos soak (kill-anywhere -> bit-identical resume)"
+# Model.fit with async full-state checkpoints + resume="auto":
+# seeded SIGKILLs in the STEP/SNAPSHOT/COMMIT/GC windows plus a
+# SIGTERM emergency-flush pass, relaunch to completion, combined loss
+# stream bit-identical to the uninterrupted baseline at
+# steps_per_loop 1 and 4; async-save stall bounded by snapshot time;
+# a byte-rotted newest checkpoint quarantines and falls back without
+# ever surfacing through latest_step(); ckpt.* fault sites replay
+# from seed (<=45s; failures print the seed + replay command)
+python tools/chaos_soak.py --ci --train
+
 echo "== fleet serving bench (prefix-affinity vs round-robin at K=3)"
 # asserts aggregate prefix-cache hit rate with affinity routing is
 # >= 1.5x round-robin on the shared-prefix workload
