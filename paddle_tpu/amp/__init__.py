@@ -128,6 +128,27 @@ def _all_finite(grads) -> jax.Array:
     return out
 
 
+def _scaler_metrics():
+    """Loss-scaler instruments. The inf/nan skip feeds the SAME guard
+    families as reliability.guard (guard_trips_total{kind="scaler_inf",
+    action="skip"}, guard_skipped_steps_total), so scaler skips and
+    numeric-guard skips read on one dashboard — reused from guard's
+    definitions so the family specs can't drift apart."""
+    from ..observability import metrics as _obs
+    from ..reliability.guard import _guard_metrics
+    reg = _obs.default_registry()
+    g = _guard_metrics()
+    return {
+        "scale": reg.gauge(
+            "amp_loss_scale", "current GradScaler loss scale"),
+        "found_inf": reg.counter(
+            "amp_found_inf_total",
+            "optimizer steps the GradScaler skipped on inf/nan grads"),
+        "trips": g["trips"],
+        "skipped": g["skipped"],
+    }
+
+
 class GradScaler:
     """Dynamic loss scaler (ref: python/paddle/amp/grad_scaler.py:26;
     semantics of update: *2 after ``incr_every_n_steps`` good steps,
@@ -191,6 +212,22 @@ class GradScaler:
                 "good": jnp.where(grow, 0, good),
                 "bad": jnp.where(shrink, 0, bad)}
 
+    def observe_metrics(self, state, all_finite) -> None:
+        """Publish the scaler's observability: ``amp_loss_scale``
+        gauge + the skip counters shared with the numeric guard.
+        Host-side values only — jitted users call this with a fetched
+        state at their own drain boundary; ``step()`` calls it
+        automatically on the eager path."""
+        m = _scaler_metrics()
+        try:
+            m["scale"].set(float(state["scale"]))
+        except (TypeError, KeyError):  # traced/partial state: skip
+            return
+        if not bool(all_finite):
+            m["found_inf"].inc()
+            m["trips"].labels("scaler_inf", "skip").inc()
+            m["skipped"].inc()
+
     # stateful wrappers (eager path) ----------------------------------------
     def scale(self, loss):
         return self.scale_loss(loss, self._state)
@@ -201,6 +238,8 @@ class GradScaler:
             optimizer.step(grads)
         self._state = jax.tree_util.tree_map(
             lambda x: x, self.update_state(self._state, ok))
+        if self.enable:
+            self.observe_metrics(self._state, ok)
 
     def is_enable(self):
         return self.enable

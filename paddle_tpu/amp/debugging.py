@@ -25,6 +25,7 @@ exist:
 
 from __future__ import annotations
 
+import contextlib
 import enum
 from typing import Any, Dict, List, Optional
 
@@ -50,26 +51,43 @@ class TensorCheckerConfig:
         self.output_dir = output_dir
 
 
-_prev_debug_nans: Optional[bool] = None
+# a STACK, not a single slot: nested enable/disable pairs must restore
+# the jax_debug_nans value each level actually saw — the old single
+# `_prev_debug_nans` lost the original value on a nested enable, so the
+# outer disable left debug-nans stuck on
+_debug_nans_stack: List[bool] = []
 
 
 def enable_tensor_checker(config: Optional[TensorCheckerConfig] = None):
     """Per-op NaN/Inf localization (ref: enable_tensor_checker →
     FLAGS_check_nan_inf): flips jax_debug_nans, which re-executes a
-    faulting jit op-by-op and raises at the producing primitive."""
-    global _prev_debug_nans
+    faulting jit op-by-op and raises at the producing primitive.
+    Re-entrant: EVERY enable pushes the prior value (a disabled
+    config pushes without flipping — the pair stays balanced, so a
+    no-op scope nested inside an active one can't pop the outer
+    scope's saved value), each disable pops."""
+    _debug_nans_stack.append(bool(jax.config.jax_debug_nans))
     if config is not None and not config.enable:
         return
-    _prev_debug_nans = jax.config.jax_debug_nans
     jax.config.update("jax_debug_nans", True)
 
 
 def disable_tensor_checker():
-    global _prev_debug_nans
-    jax.config.update("jax_debug_nans",
-                      bool(_prev_debug_nans)
-                      if _prev_debug_nans is not None else False)
-    _prev_debug_nans = None
+    prev = _debug_nans_stack.pop() if _debug_nans_stack else False
+    jax.config.update("jax_debug_nans", prev)
+
+
+@contextlib.contextmanager
+def tensor_checker(config: Optional[TensorCheckerConfig] = None):
+    """Scoped checker: ``with tensor_checker(): ...`` — the exception-
+    safe form of the enable/disable pair (and the one nested scopes
+    should prefer). A disabled config is a no-op scope (the push/pop
+    still runs, keeping nesting balanced)."""
+    enable_tensor_checker(config)
+    try:
+        yield
+    finally:
+        disable_tensor_checker()
 
 
 def finite_bits(tree: Any) -> Dict[str, jax.Array]:

@@ -156,6 +156,15 @@ define_flag("steps_per_loop", 1,
             "K=1 (per-step keys are derived from the step index inside "
             "the scan). fit(steps_per_loop=...) overrides per call.",
             validator=lambda v: v >= 1)
+define_flag("numeric_guard", False,
+            "Arm the on-device numeric guard (reliability/guard.py) "
+            "with default GuardPolicy() in Model.prepare when no "
+            "explicit numeric_guard= policy is passed: finite-mask "
+            "over loss/grads + grad-norm + loss-spike EMA computed "
+            "inside the jitted step, tripped steps device-masked to "
+            "exact no-op updates. Off: the compiled program carries "
+            "no guard ops and the train path pays one attribute "
+            "check.")
 define_flag("compilation_cache_dir", "",
             "Persistent XLA compilation cache directory (jax "
             "jax_compilation_cache_dir), enabled at Model.prepare() "

@@ -37,6 +37,9 @@ from ..nn.layer import Layer, functional_call, split_state
 from ..observability import metrics as _obs
 from ..observability import tracing as _trace
 from ..optimizer.optimizer import Optimizer
+from ..reliability import faults as _faults
+from ..reliability import guard as _nguard
+from ..reliability.faults import FaultInjected
 from .callbacks import config_callbacks
 
 
@@ -286,6 +289,15 @@ class Model:
         # device metric outputs buffered until a log/display boundary
         # coerces them (_drain_metric_updates) — no per-step host sync
         self._metric_pending: List[Tuple[Tuple, int]] = []
+        # numeric guard (reliability/guard.py): policy armed at
+        # prepare(); verdicts/grad-norms/losses buffered per dispatch
+        # and drained with the metrics (zero extra host syncs). The
+        # legacy check_nan_inf flag buffers its losses the same way.
+        self._guard: Optional["_nguard.GuardPolicy"] = None
+        self._guard_state = None
+        self._guard_pending: List[Tuple] = []
+        self._nan_pending: List[Tuple] = []
+        self._last_batch_shapes = None
         # observability handles, created lazily on the first step
         self._obs = None
         self._obs_loop = None
@@ -293,8 +305,17 @@ class Model:
     # -- preparation --------------------------------------------------------
     def prepare(self, optimizer: Optional[Optimizer] = None, loss=None,
                 metrics: Optional[Sequence[Metric]] = None,
-                amp_configs=None) -> None:
-        """ref: hapi/model.py:1499."""
+                amp_configs=None, numeric_guard=None) -> None:
+        """ref: hapi/model.py:1499.
+
+        ``numeric_guard``: a :class:`reliability.guard.GuardPolicy`
+        (or ``True`` for the defaults) arms the on-device numeric
+        guard — finite-mask over loss/grads, global grad norm, and
+        loss-spike EMA computed INSIDE the jitted step, tripped steps
+        device-masked to exact no-op updates, verdicts drained with
+        the buffered metrics. ``None`` falls back to the
+        ``numeric_guard`` flag; disabled costs one attribute check
+        per train call and zero ops in the compiled program."""
         self._optimizer = optimizer
         self._loss = loss
         if metrics is None:
@@ -303,6 +324,14 @@ class Model:
             metrics = [metrics]
         self._metrics = list(metrics)
         self._amp_configs = amp_configs
+        if numeric_guard is None and flags.get_flag("numeric_guard"):
+            numeric_guard = True
+        if numeric_guard is True:
+            numeric_guard = _nguard.GuardPolicy()
+        self._guard = numeric_guard or None
+        self._guard_state = None
+        self._guard_pending.clear()
+        self._nan_pending.clear()
         self._train_step_fn = None
         self._train_loop_fn = None
         self._eval_step_fn = None
@@ -324,7 +353,7 @@ class Model:
             m = ref()
             if m is None:
                 return None
-            return {
+            out = {
                 "step_count": m._step_count,
                 "compiled_shapes": m.compiled_shape_count,
                 "pending_metric_buffers": len(m._metric_pending),
@@ -332,6 +361,9 @@ class Model:
                 "step_compiled": m._train_step_fn is not None,
                 "stop_training": m.stop_training,
             }
+            if m._guard is not None:
+                out["numeric_guard"] = m._guard.status()
+            return out
 
         _dbgsrv.register_status_provider(
             f"train_model_{id(self):x}", _status)
@@ -418,6 +450,44 @@ class Model:
     # -- compiled steps -----------------------------------------------------
     def _build_train_step(self):
         optimizer = self._optimizer
+        guard = self._guard
+
+        if guard is not None:
+            mask_spikes = guard.mask_spikes  # static at trace time
+
+            def gstep(params, frozen, opt_state, buffers, gstate,
+                      step_idx, key, inputs, labels, poison):
+                def loss_fn(p):
+                    with rng.key_guard(key), self._amp_context():
+                        out, new_buf = functional_call(
+                            self.network, {**p, **frozen}, buffers,
+                            *inputs, training=True)
+                    loss = self._compute_loss(out, labels)
+                    # poison: 1.0 (bit-exact identity) or NaN — the
+                    # grad.nonfinite injection point, an input so the
+                    # schedule never retraces
+                    return loss.astype(jnp.float32) * poison, \
+                        (out, new_buf)
+                (loss, (out, new_buf)), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params)
+                verdict, gnorm = guard.inspect(loss, grads, gstate)
+                ok = _nguard.apply_mask(verdict, mask_spikes)
+                new_params, new_opt = optimizer.apply_gradients(
+                    params, grads, opt_state, step_idx)
+                # tripped step → EXACT no-op update: params, optimizer
+                # moments/counters and buffers all keep their pre-step
+                # bits (jnp.where select per leaf)
+                new_params = _nguard.mask_pytree(ok, new_params, params)
+                new_opt = _nguard.mask_pytree(ok, new_opt, opt_state)
+                new_buf = _nguard.mask_pytree(ok, dict(new_buf), buffers)
+                new_gstate = guard.update_state(gstate, loss, ok)
+                metric_outs = self._metric_outputs(out, labels)
+                return (loss, new_params, new_opt, new_buf, new_gstate,
+                        (verdict, gnorm), metric_outs)
+
+            donate = (0, 2, 3, 4) if flags.get_flag("donate_buffers") \
+                else ()
+            return jax.jit(gstep, donate_argnums=donate)
 
         def step(params, frozen, opt_state, buffers, step_idx, key,
                  inputs, labels):
@@ -452,8 +522,66 @@ class Model:
         passes may reassociate one reduction differently between the
         scanned and straight-line programs on XLA:CPU — ≤1 ULP/step).
         Per-step losses and metric outputs come back stacked [K, ...]
-        and stay on device."""
+        and stay on device.
+
+        With the numeric guard armed, each scan iteration additionally
+        computes its verdict/grad-norm on device and masks the carry
+        update (``jnp.where`` per leaf) when tripped — a poisoned step
+        inside the slab becomes an exact no-op and CANNOT corrupt the
+        K-1 steps after it, while the slab stays one dispatch.
+        Verdicts come back stacked [K] and drain with the metrics."""
         optimizer = self._optimizer
+        guard = self._guard
+
+        if guard is not None:
+            mask_spikes = guard.mask_spikes
+
+            def gloop(params, frozen, opt_state, buffers, gstate,
+                      step0, base_key, inputs, labels, poison):
+                def body(carry, xs):
+                    p, opt_st, buf, gs = carry
+                    idx, pois, inp, lab = xs
+                    step_idx = step0 + idx
+
+                    def loss_fn(pp):
+                        with rng.key_guard(jax.random.fold_in(
+                                base_key, step_idx)), \
+                                self._amp_context():
+                            out, new_buf = functional_call(
+                                self.network, {**pp, **frozen}, buf,
+                                *inp, training=True)
+                        loss = self._compute_loss(out, lab)
+                        return loss.astype(jnp.float32) * pois, \
+                            (out, new_buf)
+
+                    (loss, (out, new_buf)), grads = jax.value_and_grad(
+                        loss_fn, has_aux=True)(p)
+                    verdict, gnorm = guard.inspect(loss, grads, gs)
+                    ok = _nguard.apply_mask(verdict, mask_spikes)
+                    new_p, new_opt = optimizer.apply_gradients(
+                        p, grads, opt_st, step_idx)
+                    new_p = _nguard.mask_pytree(ok, new_p, p)
+                    new_opt = _nguard.mask_pytree(ok, new_opt, opt_st)
+                    new_buf = _nguard.mask_pytree(ok, dict(new_buf),
+                                                  buf)
+                    new_gs = guard.update_state(gs, loss, ok)
+                    metric_outs = self._metric_outputs(out, lab)
+                    return (new_p, new_opt, new_buf, new_gs), \
+                        (loss, verdict, gnorm, metric_outs)
+
+                k = jax.tree_util.tree_leaves(
+                    (inputs, labels))[0].shape[0]
+                (params, opt_state, buffers, gstate), \
+                    (losses, verdicts, gnorms, metric_outs) = \
+                    jax.lax.scan(
+                        body, (params, opt_state, buffers, gstate),
+                        (jnp.arange(k), poison, inputs, labels))
+                return (losses, params, opt_state, buffers, gstate,
+                        (verdicts, gnorms), metric_outs)
+
+            donate = (0, 2, 3, 4) if flags.get_flag("donate_buffers") \
+                else ()
+            return jax.jit(gloop, donate_argnums=donate)
 
         def loop(params, frozen, opt_state, buffers, step0, base_key,
                  inputs, labels):
@@ -560,6 +688,93 @@ class Model:
                 stacklevel=3)
         return True
 
+    # -- numeric-guard plumbing ---------------------------------------------
+    def _maybe_poison_batch(self, inputs, k: int):
+        """Injection site ``data.poison``: one check per optimizer
+        step about to dispatch. A hit NaN-poisons the step's FLOAT
+        input leaves (host-side, before device_put) instead of
+        raising — models a corrupt record/decoder bug riding the data
+        stream. Only reached while chaos is armed."""
+        bad = []
+        for i in range(k):
+            try:
+                _faults.check("data.poison")
+            except FaultInjected:
+                bad.append(i)
+        if not bad:
+            return inputs
+
+        def poison(x):
+            a = np.array(np.asarray(x), copy=True)
+            if np.issubdtype(a.dtype, np.floating):
+                if k == 1:
+                    a[...] = np.nan
+                else:
+                    a[bad] = np.nan
+            return a
+
+        return jax.tree_util.tree_map(poison, inputs)
+
+    def _grad_poison(self, k: int):
+        """Injection site ``grad.nonfinite``: the per-step loss
+        multiplier fed into the guarded program — 1.0 (bit-exact
+        identity) normally, NaN on schedule, so loss AND grads go
+        non-finite inside the compiled step without retracing."""
+        vec = np.ones((k,), np.float32)
+        if _faults.enabled():
+            for i in range(k):
+                try:
+                    _faults.check("grad.nonfinite")
+                except FaultInjected:
+                    vec[i] = np.nan
+        # always [k]-shaped: the scanned loop feeds it as an xs leaf,
+        # which needs the leading axis even at k=1 (train_batch's
+        # per-step program indexes out its scalar)
+        return vec
+
+    def _buffer_guard_outs(self, verdicts, gnorms, losses,
+                           step0: int, k: int) -> None:
+        self._guard_pending.append((verdicts, gnorms, losses, step0, k))
+        if len(self._guard_pending) >= self._PENDING_DRAIN_CAP:
+            self._drain_metric_updates()
+
+    def _buffer_nan_check(self, losses, step0: int, k: int) -> None:
+        """The legacy ``check_nan_inf`` flag, deferred: buffer the
+        device loss and test it at the next drain boundary — one host
+        sync per log boundary instead of the old per-step
+        ``np.isfinite`` stall, and the K>1 report names the exact
+        in-slab step, not just the slab end."""
+        self._nan_pending.append((losses, step0, k))
+        if len(self._nan_pending) >= self._PENDING_DRAIN_CAP:
+            self._drain_metric_updates()
+
+    def _drain_guard_checks(self) -> None:
+        """Coerce buffered guard verdicts / nan-check losses and apply
+        policy. Runs inside the one metric-drain sync; may raise
+        GuardRollback/GuardAbort (guard) or FloatingPointError
+        (check_nan_inf)."""
+        if self._nan_pending:
+            pending, self._nan_pending = self._nan_pending, []
+            for losses, step0, k in pending:
+                arr = np.asarray(losses).reshape(-1)
+                finite = np.isfinite(arr)
+                if not finite.all():
+                    idx = int(np.argmin(finite))
+                    from ..amp.debugging import find_nonfinite
+                    bad = find_nonfinite({"param": self._params,
+                                          "buffer": self._buffers})
+                    raise FloatingPointError(
+                        f"NaN/Inf loss at step {step0 + idx}"
+                        + (f" (step {idx} of a {k}-step slab)"
+                           if k > 1 else "")
+                        + f"; non-finite tensors: "
+                          f"{bad or ['(loss only)']}")
+        if self._guard_pending:
+            pending, self._guard_pending = self._guard_pending, []
+            for verdicts, gnorms, losses, step0, _k in pending:
+                self._guard.process(verdicts, gnorms, losses, step0,
+                                    model=self)
+
     # -- batch-level API ----------------------------------------------------
     def train_batch(self, inputs, labels=None) -> Dict[str, Any]:
         """ref: hapi/model.py:1055."""
@@ -568,11 +783,19 @@ class Model:
             self._train_step_fn = self._build_train_step()
         inputs = _as_tuple(inputs)
         labels = _as_tuple(labels) if labels is not None else ()
+        if _faults.enabled():
+            inputs = self._maybe_poison_batch(inputs, 1)
         fresh_shape = self._guard_recompiles(inputs, labels)
         if self._obs is None:
             self._obs = _train_metrics()
         batch_n = np.shape(inputs[0])[0] if inputs and np.ndim(
             inputs[0]) else 0
+        if self._guard is not None:
+            # abort-fingerprint capture: guard-armed runs only — the
+            # disabled path stays one attribute check
+            self._last_batch_shapes = [
+                (tuple(np.shape(a)), str(getattr(a, "dtype", type(a))))
+                for a in (*inputs, *labels)]
         sp = _trace.start_span(
             "train.step", attrs={"batch": batch_n,
                                  "step": self._step_count}) \
@@ -583,10 +806,22 @@ class Model:
                 inputs = self._shard_batch(inputs)
                 labels = self._shard_batch(labels)
             key = rng.split_for_step(self._step_count)
-            loss, self._params, self._opt_state, self._buffers, \
-                metric_outs = self._train_step_fn(
-                    self._params, self._frozen, self._opt_state,
-                    self._buffers, self._step_count, key, inputs, labels)
+            if self._guard is not None:
+                if self._guard_state is None:
+                    self._guard_state = self._guard.device_state()
+                loss, self._params, self._opt_state, self._buffers, \
+                    self._guard_state, (verdict, gnorm), metric_outs = \
+                    self._train_step_fn(
+                        self._params, self._frozen, self._opt_state,
+                        dict(self._buffers), self._guard_state,
+                        self._step_count, key, inputs, labels,
+                        self._grad_poison(1)[0])
+            else:
+                loss, self._params, self._opt_state, self._buffers, \
+                    metric_outs = self._train_step_fn(
+                        self._params, self._frozen, self._opt_state,
+                        self._buffers, self._step_count, key, inputs,
+                        labels)
         except BaseException:
             # a caught-and-skipped bad batch must not leak a live span
             # (the _live registry is uncapped, unlike the finished ring)
@@ -608,24 +843,23 @@ class Model:
         if batch_n and dt > 0:
             self._obs["eps"].observe(batch_n / dt)
         self._obs["steps"].set(self._step_count)
-        if flags.get_flag("check_nan_inf") and not np.isfinite(
-                np.asarray(loss)).all():
-            # attribute the blowup to named tensors before aborting
-            # (nan_inf_utils_detail's per-tensor report, host-side)
-            from ..amp.debugging import find_nonfinite
-            bad = find_nonfinite({"param": self._params,
-                                  "buffer": self._buffers})
-            raise FloatingPointError(
-                f"NaN/Inf loss at step {self._step_count}; "
-                f"non-finite tensors: {bad or ['(loss only)']}")
         # keep the loss AND metric outputs on device — no per-step host
         # sync (the reference's dygraph adapter also returns without
         # waiting; a float()/np.asarray here would serialize every step
         # on the device stream). Metric outputs are buffered and drained
         # into the host accumulators only when a callback/display
-        # actually coerces a value (log_freq/epoch boundaries).
+        # actually coerces a value (log_freq/epoch boundaries); the
+        # guard verdicts and the legacy check_nan_inf loss test ride
+        # the same drain.
         logs = {"loss": loss}
-        self._buffer_metric_outs(metric_outs, 1)
+        if self._guard is not None:
+            self._buffer_guard_outs(verdict, gnorm, loss,
+                                    self._step_count - 1, 1)
+            self._buffer_metric_outs(metric_outs, 1, verdicts=verdict)
+        else:
+            if flags.get_flag("check_nan_inf"):
+                self._buffer_nan_check(loss, self._step_count - 1, 1)
+            self._buffer_metric_outs(metric_outs, 1)
         self._attach_metric_logs(logs)
         return logs
 
@@ -642,12 +876,18 @@ class Model:
         inputs = _as_tuple(inputs)
         labels = _as_tuple(labels) if labels is not None else ()
         k = int(np.shape(inputs[0])[0])
+        if _faults.enabled():
+            inputs = self._maybe_poison_batch(inputs, k)
         fresh_shape = self._guard_recompiles(inputs, labels, kind="loop")
         if self._obs is None:
             self._obs = _train_metrics()
         if self._obs_loop is None:
             self._obs_loop = _loop_metrics()
         batch_n = np.shape(inputs[0])[1] if np.ndim(inputs[0]) > 1 else 0
+        if self._guard is not None:
+            self._last_batch_shapes = [
+                (tuple(np.shape(a)), str(getattr(a, "dtype", type(a))))
+                for a in (*inputs, *labels)]
         sp = _trace.start_span(
             "train.dispatch", attrs={"k": k, "batch": batch_n,
                                      "step0": self._step_count}) \
@@ -658,14 +898,26 @@ class Model:
                 inputs = self._shard_superbatch(inputs)
                 labels = self._shard_superbatch(labels)
             base_key = rng.get_global_stream()._key
-            losses, self._params, self._opt_state, self._buffers, \
-                metric_outs = self._train_loop_fn(
-                    self._params, self._frozen, self._opt_state,
-                    # plain dict: the per-step path may have left an
-                    # OrderedDict here, and the scan carry's pytree
-                    # type must match the body's output (a plain dict)
-                    dict(self._buffers), self._step_count, base_key,
-                    inputs, labels)
+            if self._guard is not None:
+                if self._guard_state is None:
+                    self._guard_state = self._guard.device_state()
+                losses, self._params, self._opt_state, self._buffers, \
+                    self._guard_state, (verdicts, gnorms), metric_outs \
+                    = self._train_loop_fn(
+                        self._params, self._frozen, self._opt_state,
+                        dict(self._buffers), self._guard_state,
+                        self._step_count, base_key, inputs, labels,
+                        self._grad_poison(k))
+            else:
+                losses, self._params, self._opt_state, self._buffers, \
+                    metric_outs = self._train_loop_fn(
+                        self._params, self._frozen, self._opt_state,
+                        # plain dict: the per-step path may have left an
+                        # OrderedDict here, and the scan carry's pytree
+                        # type must match the body's output (a plain
+                        # dict)
+                        dict(self._buffers), self._step_count, base_key,
+                        inputs, labels)
         except BaseException:
             if sp is not None:
                 sp.set_status("error")
@@ -687,15 +939,14 @@ class Model:
         if batch_n and dt > 0:
             self._obs["eps"].observe(batch_n * k / dt)
         self._obs["steps"].set(self._step_count)
-        if flags.get_flag("check_nan_inf") and not np.isfinite(
-                np.asarray(losses)).all():
-            from ..amp.debugging import find_nonfinite
-            bad = find_nonfinite({"param": self._params,
-                                  "buffer": self._buffers})
-            raise FloatingPointError(
-                f"NaN/Inf loss in slab ending at step {self._step_count}; "
-                f"non-finite tensors: {bad or ['(loss only)']}")
-        self._buffer_metric_outs(metric_outs, k)
+        if self._guard is not None:
+            self._buffer_guard_outs(verdicts, gnorms, losses,
+                                    self._step_count - k, k)
+            self._buffer_metric_outs(metric_outs, k, verdicts=verdicts)
+        else:
+            if flags.get_flag("check_nan_inf"):
+                self._buffer_nan_check(losses, self._step_count - k, k)
+            self._buffer_metric_outs(metric_outs, k)
         out = []
         for i in range(k):
             logs: Dict[str, Any] = {"loss": _SlabScalar(losses, i)}
@@ -709,11 +960,18 @@ class Model:
     _PENDING_DRAIN_CAP = 64
 
     # -- deferred metric coercion -------------------------------------------
-    def _buffer_metric_outs(self, metric_outs, nsteps: int) -> None:
+    def _buffer_metric_outs(self, metric_outs, nsteps: int,
+                            verdicts=None) -> None:
+        """``verdicts`` (guard-armed train paths only) rides along so
+        the drain can DROP device-masked steps' metric rows: a skipped
+        step's forward ran on the poisoned batch (NaN logits), and
+        folding that row would pollute the accumulators of a step the
+        model never trained on — metrics must match the clean run
+        minus the batch, like the params do."""
         if self._metrics:
             if len(self._metric_pending) >= self._PENDING_DRAIN_CAP:
                 self._drain_metric_updates()
-            self._metric_pending.append((metric_outs, nsteps))
+            self._metric_pending.append((metric_outs, nsteps, verdicts))
 
     def _attach_metric_logs(self, logs: Dict[str, Any]) -> None:
         for m in self._metrics:
@@ -725,25 +983,51 @@ class Model:
         """Fold every buffered device metric output into the host-side
         accumulators — ONE sync for all steps since the last drain
         (log_freq/epoch boundaries), the deferral train_loop_drain_
-        seconds measures."""
-        if not self._metric_pending:
-            return
-        sp = _trace.start_span(
-            "train.metric_drain",
-            attrs={"pending": len(self._metric_pending)}) \
-            if _trace.enabled() else None
-        t0 = time.perf_counter()
-        try:
-            pending, self._metric_pending = self._metric_pending, []
-            for outs, nsteps in pending:
-                for m, mo in zip(self._metrics, outs):
-                    m.update_stacked(_as_tuple(mo), nsteps)
-        finally:
-            if sp is not None:
-                sp.end()
-        if self._obs_loop is None:
-            self._obs_loop = _loop_metrics()
-        self._obs_loop["drain"].observe(time.perf_counter() - t0)
+        seconds measures. Buffered guard verdicts and deferred
+        check_nan_inf losses drain here too (same single sync); their
+        policy escalations (GuardRollback / GuardAbort /
+        FloatingPointError) surface from this boundary."""
+        if self._metric_pending:
+            sp = _trace.start_span(
+                "train.metric_drain",
+                attrs={"pending": len(self._metric_pending)}) \
+                if _trace.enabled() else None
+            t0 = time.perf_counter()
+            try:
+                pending, self._metric_pending = self._metric_pending, []
+                for outs, nsteps, verdicts in pending:
+                    keep = None
+                    if verdicts is not None:
+                        v = np.asarray(verdicts).reshape(-1)
+                        masked = v == 1
+                        if self._guard is not None \
+                                and self._guard.mask_spikes:
+                            masked = masked | (v == 2)
+                        if masked.any():
+                            keep = ~masked
+                    for m, mo in zip(self._metrics, outs):
+                        mo = _as_tuple(mo)
+                        if keep is None:
+                            m.update_stacked(mo, nsteps)
+                        elif nsteps == 1:
+                            if keep[0]:
+                                m.update_stacked(mo, 1)
+                        else:
+                            # drop the device-masked rows; the rest
+                            # keep per-step update semantics. Coerce
+                            # each stacked array ONCE, not per row
+                            mos = tuple(np.asarray(o) for o in mo)
+                            for i in range(nsteps):
+                                if keep[i]:
+                                    m.update(*(o[i] for o in mos))
+            finally:
+                if sp is not None:
+                    sp.end()
+            if self._obs_loop is None:
+                self._obs_loop = _loop_metrics()
+            self._obs_loop["drain"].observe(time.perf_counter() - t0)
+        if self._guard_pending or self._nan_pending:
+            self._drain_guard_checks()
 
     def drain_metrics(self) -> None:
         """Public flush for manual ``train_batch``/``eval_batch`` loops:
@@ -812,6 +1096,10 @@ class Model:
             tree["frozen"] = self._frozen
         if self._buffers:
             tree["buffers"] = self._buffers
+        if self._guard_state is not None:
+            # the numeric guard's EMA carry: resume (and guard
+            # rollback) keeps the spike baseline instead of re-warming
+            tree["guard"] = self._guard_state
         key_data = np.asarray(
             jax.random.key_data(rng.get_global_stream()._key))
         cursor = loader.state_dict()
@@ -876,6 +1164,8 @@ class Model:
         self._frozen = put(tree.get("frozen") or {})
         self._buffers = put(tree.get("buffers") or {})
         self._opt_state = put(tree["opt"])
+        if tree.get("guard") is not None:
+            self._guard_state = put(tree["guard"])
         state = dict(state or {})
         self._step_count = int(state.get("step", mgr.latest_step() or 0))
         rng_state = state.get("rng")
@@ -899,6 +1189,71 @@ class Model:
         # restored values (same invalidation contract as Model.load)
         self._sync_state_out()
         return state
+
+    def _guard_rollback(self, mgr, loader, epoch: int, rb) -> int:
+        """Recover from a :class:`reliability.guard.GuardRollback`
+        raised at a drain boundary inside ``fit``: restore the newest
+        VERIFIED checkpoint (manifest path — params, opt state, RNG
+        key, metric accumulators, guard EMA), then fast-forward the
+        DataLoader cursor ``rb.stride`` batches PAST the offending
+        step, so the poisoned range is never re-consumed. Returns the
+        in-epoch batch index training resumes at. Steps between the
+        checkpoint and the trip are discarded along with their
+        batches — rollback trades that window for a clean restart
+        (escalating stride clears a poisoned RANGE on repeat trips).
+        Assumes the trip landed in the checkpoint's epoch; a
+        cross-epoch trip fast-forwards within the checkpoint's pass.
+        No checkpoint manager / no committed step escalates to
+        :class:`GuardAbort`."""
+        if mgr is None:
+            raise self._guard.escalate(
+                rb.step, rb.kind,
+                "rollback requested but fit() has no checkpoint_dir",
+                model=self) from rb
+        # drop buffered device state from the poisoned window — the
+        # restore rewinds metric accumulators to the manifest bundle
+        self._metric_pending.clear()
+        self._guard_pending.clear()
+        self._nan_pending.clear()
+        # EXPLICIT step, never resume="auto": auto honors the
+        # $PADDLE_ELASTIC_RESUME_STEP pin an elastic respawn leaves in
+        # the environment for the whole process — a mid-run rollback
+        # must restore the newest verified step AT OR BELOW the trip
+        # (every save drains first, so newer-than-trip can't commit;
+        # the <= filter keeps that a local invariant), walking past
+        # steps that rotted since their manifest verified
+        from ..io.checkpoint import CheckpointCorrupt
+        mgr.wait_until_finished()  # in-flight async commits manifest
+        cand = [s for s in mgr.verified_steps() if s <= rb.step]
+        st = None
+        while cand:
+            try:
+                st = self._restore_training_state(
+                    mgr, cand.pop(), loader)
+                break
+            except (CheckpointCorrupt, FileNotFoundError):
+                continue
+        if st is None:
+            raise self._guard.escalate(
+                rb.step, rb.kind,
+                "rollback requested before any verified checkpoint "
+                "committed", model=self) from rb
+        ck_step = int(st.get("step", 0))
+        cur = dict(st.get("loader") or {"pass": epoch, "batch": 0})
+        tripped = int(cur["batch"]) + (rb.step - ck_step)
+        target = tripped + rb.stride
+        loader.load_state_dict({"pass": int(cur["pass"]),
+                                "batch": target})
+        if _trace.enabled():
+            _trace.start_span("train.guard", attrs={
+                "kind": rb.kind, "action": "rollback",
+                "step": rb.step, "restored_step": ck_step,
+                "fast_forward_to_batch": target}).end()
+        print(f"[numeric-guard] rollback: {rb.kind} at step {rb.step} "
+              f"-> restored verified step {ck_step}, fast-forwarded "
+              f"cursor past batch {tripped} (stride {rb.stride})",
+              file=sys.stderr)
+        return target
 
     # -- fit/evaluate/predict loops -----------------------------------------
     def _as_loader(self, data, batch_size, shuffle) -> DataLoader:
@@ -1075,47 +1430,75 @@ class Model:
                     from ..profiler import RecordEvent as _Rec
                     profiling = _prof_events.active
                     rec = _Rec if profiling else contextlib.nullcontext
-                    if k_loop > 1:
-                        it = loader.superbatches(k_loop)
-                    else:
-                        it = iter(loader)
                     while True:
-                        with rec("Dataloader"):
-                            batch = next(it, None)
-                        if batch is None:
-                            break
-                        inputs, labels = self._split_batch(batch)
+                        # one epoch pass; restarts after a numeric-guard
+                        # ROLLBACK (the newest verified checkpoint is
+                        # restored and the loader cursor fast-forwarded
+                        # past the offending range, so the fresh
+                        # iterator resumes there)
                         if k_loop > 1:
-                            k = int(np.shape(
-                                jax.tree_util.tree_leaves(inputs)[0])[0])
-                            if k == k_loop:
-                                with rec("TrainStep"):
-                                    step_logs = self.train_loop_batch(
-                                        inputs, labels)
-                                with rec("Callbacks"):
-                                    for logs in step_logs:
-                                        cbks.on_train_batch_begin(step)
-                                        cbks.on_train_batch_end(step, logs)
-                                        step += 1
-                                ckpt_tick(epoch)
-                                continue
-                            # ragged tail slab (< K stacked steps): unstack
-                            # and run the per-step path — same math, one
-                            # extra signature at most (the K=1 program)
-                            sub_batches = [
-                                jax.tree_util.tree_map(lambda x: x[i],
-                                                       (inputs, labels))
-                                for i in range(k)]
+                            it = loader.superbatches(k_loop)
                         else:
-                            sub_batches = [(inputs, labels)]
-                        for inp, lab in sub_batches:
-                            cbks.on_train_batch_begin(step)
-                            with rec("TrainStep"):
-                                logs = self.train_batch(inp, lab)
-                            with rec("Callbacks"):
-                                cbks.on_train_batch_end(step, logs)
-                            step += 1
-                        ckpt_tick(epoch)
+                            it = iter(loader)
+                        try:
+                            while True:
+                                with rec("Dataloader"):
+                                    batch = next(it, None)
+                                if batch is None:
+                                    break
+                                inputs, labels = self._split_batch(batch)
+                                if k_loop > 1:
+                                    k = int(np.shape(
+                                        jax.tree_util.tree_leaves(
+                                            inputs)[0])[0])
+                                    if k == k_loop:
+                                        with rec("TrainStep"):
+                                            step_logs = \
+                                                self.train_loop_batch(
+                                                    inputs, labels)
+                                        with rec("Callbacks"):
+                                            for logs in step_logs:
+                                                cbks.on_train_batch_begin(
+                                                    step)
+                                                cbks.on_train_batch_end(
+                                                    step, logs)
+                                                step += 1
+                                        ckpt_tick(epoch)
+                                        continue
+                                    # ragged tail slab (< K stacked
+                                    # steps): unstack and run the
+                                    # per-step path — same math, one
+                                    # extra signature at most (the K=1
+                                    # program)
+                                    sub_batches = [
+                                        jax.tree_util.tree_map(
+                                            lambda x: x[i],
+                                            (inputs, labels))
+                                        for i in range(k)]
+                                else:
+                                    sub_batches = [(inputs, labels)]
+                                for inp, lab in sub_batches:
+                                    cbks.on_train_batch_begin(step)
+                                    with rec("TrainStep"):
+                                        logs = self.train_batch(inp, lab)
+                                    with rec("Callbacks"):
+                                        cbks.on_train_batch_end(step,
+                                                                logs)
+                                    step += 1
+                                ckpt_tick(epoch)
+                            # tail drain INSIDE the rollback scope: a
+                            # trip buffered by the pass's last batches
+                            # must escalate here, where a rollback can
+                            # still restart this epoch's iteration
+                            self._drain_metric_updates()
+                            break
+                        except _nguard.GuardRollback as rb:
+                            step = self._guard_rollback(train_ckpt,
+                                                        loader, epoch,
+                                                        rb)
+                            last_ckpt_step = self._step_count
+                            if hasattr(it, "close"):
+                                it.close()
                     # freeze the epoch's final train logs NOW (epoch
                     # boundary = display boundary): the eval pass below
                     # resets the shared metric accumulators, which would

@@ -155,6 +155,14 @@ class CheckpointCorrupt(RuntimeError):
 # manifest-<step>.json.corrupt and stops being surfaced by latest_step.
 
 _MANIFEST_FMT = "manifest-{step}.json"
+# Touched before the FIRST data commit (and at open of any directory
+# that already holds manifests): marks the directory as manifest-era.
+# Without it, a kill between the first-ever commit and its manifest
+# write leaves an unmanifested data dir that the legacy heuristic
+# ("no manifests ⇒ pre-manifest repo") would resurrect UNVERIFIED and
+# WITHOUT its exact-resume state bundle — silent stream divergence
+# instead of the documented "costs that step" semantics.
+_ERA_MARKER = ".manifest-era"
 _CORRUPT_SUFFIX = ".corrupt"
 
 
@@ -284,16 +292,48 @@ class CheckpointManager:
         return [s for s in _scan_manifest_steps(self.directory)
                 if s in disk]
 
+    def _marker_step(self) -> Optional[int]:
+        """First manifest-era step, or None when the directory has no
+        era marker (pre-manifest repo, or never saved through this
+        manager)."""
+        try:
+            with open(os.path.join(self.directory, _ERA_MARKER)) as f:
+                return int(json.load(f)["first_step"])
+        except (OSError, ValueError, KeyError, TypeError):
+            return None
+
+    def _ensure_marker(self, step: int) -> None:
+        """Record (once, before the first data commit) the first
+        manifest-era step: dirs below it can be legacy rollback
+        points; dirs at/above it without a manifest are debris —
+        steps are monotonic, so the boundary never moves."""
+        path = os.path.join(self.directory, _ERA_MARKER)
+        if os.path.exists(path):
+            return
+        os.makedirs(self.directory, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"first_step": int(step)}, f)
+        os.replace(tmp, path)
+
     def _legacy_steps(self) -> List[int]:
         """Pre-manifest-era checkpoints: data dirs OLDER than the
-        oldest manifest (or all of them when no manifest exists).
-        Steps are monotonic, so debris from a crashed manifest-era
-        commit is always newer than some manifest — anything older can
-        only predate manifests. Quarantined steps are excluded."""
+        oldest manifest (or, in a directory with no manifests AND no
+        era marker, all of them). Steps are monotonic, so debris from
+        a crashed manifest-era commit is always newer than some
+        manifest — and when no manifest survives at all, the era
+        marker (written before the first commit) distinguishes "this
+        manager's first commit crashed pre-manifest" (debris) from a
+        genuine pre-manifest repo (legacy rollback points).
+        Quarantined steps are excluded."""
         manifested = _scan_manifest_steps(self.directory)
         disk = self._disk_steps()
         if manifested:
             disk = [s for s in disk if s < manifested[0]]
+        else:
+            marker = self._marker_step()
+            if marker is not None:
+                disk = [s for s in disk if s < marker]
         return [s for s in disk if not os.path.exists(
             _manifest_path(self.directory, s) + _CORRUPT_SUFFIX)]
 
@@ -313,11 +353,24 @@ class CheckpointManager:
                 shutil.rmtree(os.path.join(self.directory, name),
                               ignore_errors=True)
         manifested = set(_scan_manifest_steps(self.directory))
-        if not manifested:
-            return
-        oldest_manifested = min(manifested)
+        if manifested:
+            # upgrade path: a manifest-era directory that predates the
+            # marker gets one now, so debris stays classifiable even
+            # if every manifest later rotates out or crashes away
+            self._ensure_marker(min(manifested))
+            oldest = min(manifested)
+        else:
+            # marker but zero manifests: the first manifest-era commit
+            # crashed before its manifest write — dirs at/above the
+            # marker step are debris (the "costs that step" window),
+            # never legacy rollback points. No marker: pre-manifest
+            # legacy directory, not ours to sweep.
+            marker = self._marker_step()
+            if marker is None:
+                return
+            oldest = marker
         for s in self._disk_steps():
-            if s >= oldest_manifested and s not in manifested \
+            if s >= oldest and s not in manifested \
                     and not os.path.exists(
                         _manifest_path(self.directory, s)
                         + _CORRUPT_SUFFIX):
@@ -418,6 +471,9 @@ class CheckpointManager:
         self._clear_debris(step)
         if force:
             self._delete_step(step)
+        # marker BEFORE the first data commit: a kill in the
+        # commit→manifest window must leave debris, not a fake legacy
+        self._ensure_marker(step)
         dt = self.retry.call(
             self._dispatch_save, step, tree,
             describe=f"checkpoint save step {step}")
@@ -539,8 +595,19 @@ class CheckpointManager:
         else:
             candidates = list(reversed(self._manifest_steps()))
             if not candidates:
-                legacy = self._disk_steps()  # pre-manifest directory
-                candidates = list(reversed(legacy))
+                # legacy (pre-manifest) dirs restore unverified;
+                # quarantined dirs stay in the walk so an all-corrupt
+                # directory raises CheckpointCorrupt, NOT the
+                # FileNotFoundError auto-resume reads as "fresh start".
+                # Marker-era unmanifested debris (a crashed first
+                # commit) is in neither set — never resurrected.
+                quarantined = {
+                    s for s in self._disk_steps() if os.path.exists(
+                        _manifest_path(self.directory, s)
+                        + _CORRUPT_SUFFIX)}
+                candidates = sorted(
+                    set(self._legacy_steps()) | quarantined,
+                    reverse=True)
         last_corrupt: Optional[CheckpointCorrupt] = None
         for s in candidates:
             manifest = self._read_manifest(s)
@@ -564,10 +631,10 @@ class CheckpointManager:
                 else:
                     tree = self._ckptr.restore(self._step_dir(s))
             except FileNotFoundError:
-                raise
+                if explicit:
+                    raise
+                continue  # dir vanished under the walk (racing GC)
             except Exception as e:  # noqa: BLE001
-                if manifest is None:
-                    raise  # legacy dir: no verification contract
                 # corruption severe enough that orbax/tensorstore can't
                 # even read the step (CRC failures, truncated files):
                 # same verdict as a digest mismatch
@@ -575,6 +642,14 @@ class CheckpointManager:
                     s, {"<restore>": {"expected":
                                       "readable checkpoint data",
                                       "actual": repr(e)}})
+                if manifest is None:
+                    # legacy dir (no verification contract): raise for
+                    # an explicit request, but never let one unreadable
+                    # dir end the step=None fallback walk
+                    if explicit:
+                        raise
+                    last_corrupt = err
+                    continue
                 self._on_verify_failure(s, err.diff)
                 if explicit:
                     raise err from e
@@ -618,6 +693,15 @@ class CheckpointManager:
             pass
 
     # -- introspection / lifecycle ------------------------------------------
+    def verified_steps(self) -> List[int]:
+        """Manifested, non-quarantined steps oldest→newest — the
+        restore candidates ``restore_with_state(None)`` walks in
+        reverse, and the rollback points the numeric guard
+        (reliability/guard.py) can fall back to. Public so /statusz
+        providers and the guard soak can assert on the set without
+        poking privates."""
+        return self._manifest_steps()
+
     def latest_step(self) -> Optional[int]:
         """Newest step safe to resume from: manifested (commit
         completed) and not quarantined. Falls back to raw committed

@@ -27,3 +27,16 @@ from . import retry  # noqa: F401
 from .faults import FaultInjected  # noqa: F401
 from .retry import (Deadline, DeadlineExceeded, RetryExhausted,  # noqa: F401
                     RetryPolicy, as_deadline, backoff_delay)
+
+
+def __getattr__(name):
+    # guard imports jax (device-side detector), so it loads lazily —
+    # faults/retry stay importable from stdlib-only contexts (the
+    # elastic launcher, subprocess workers before jax init).
+    # importlib, not `from . import`: the from-import form re-enters
+    # this __getattr__ through _handle_fromlist and recurses
+    if name in ("guard", "GuardPolicy", "GuardRollback", "GuardAbort"):
+        import importlib
+        mod = importlib.import_module(".guard", __name__)
+        return mod if name == "guard" else getattr(mod, name)
+    raise AttributeError(name)

@@ -42,6 +42,16 @@ Named sites (the catalog; see docs/RELIABILITY.md):
 ``replica.crash``         serving replica process: hard-crash trigger
                           (the replica main loop exits the process on
                           injection — a SIGKILL the schedule controls)
+``data.poison``           trainer: one host batch about to dispatch —
+                          injection NaN-poisons its float inputs
+                          instead of raising (the trainer catches the
+                          FaultInjected and corrupts the batch)
+``grad.nonfinite``        trainer: one optimizer step inside the
+                          guarded jitted program — injection feeds a
+                          NaN loss multiplier, making that step's
+                          loss AND grads non-finite on schedule
+                          without retracing (requires the numeric
+                          guard armed; see reliability/guard.py)
 ========================  ==================================================
 
 Stdlib-only by design: any module may import this without cycles.
@@ -68,6 +78,8 @@ SITES = (
     "router.dispatch",
     "router.healthz",
     "replica.crash",
+    "data.poison",
+    "grad.nonfinite",
 )
 
 

@@ -718,6 +718,10 @@ def test_gc_and_sweep_keep_legacy_steps_at_migration_boundary(tmp_path):
             mgr.save(s, _tree(float(s)))
     for s in (1, 2, 3):
         os.unlink(os.path.join(d, f"manifest-{s}.json"))  # legacy era
+    # a genuine pre-manifest directory has no era marker either — with
+    # it, manifest-less dirs are (correctly) debris from a crashed
+    # first commit, not legacy rollback points
+    os.unlink(os.path.join(d, ".manifest-era"))
     with CheckpointManager(d, max_to_keep=3, async_save=False) as mgr:
         assert mgr.latest_step() == 3      # legacy fallback
         mgr.save(5, _tree(5.0))
@@ -728,6 +732,26 @@ def test_gc_and_sweep_keep_legacy_steps_at_migration_boundary(tmp_path):
     with CheckpointManager(d, max_to_keep=3, async_save=False) as mgr:
         assert mgr.all_steps() == [2, 3, 5], "reopen swept legacy steps"
         assert mgr.latest_step() == 5
+
+
+def test_first_commit_crash_debris_not_legacy(tmp_path):
+    """A kill between the FIRST-ever data commit and its manifest
+    write leaves an unmanifested data dir in a directory with zero
+    manifests. Without the era marker that dir read as a pre-manifest
+    LEGACY checkpoint and was resurrected unverified — with no resume
+    state bundle, silently diverging the loss stream (chaos-soak
+    flake). It must classify as debris: swept at open, never restored,
+    latest_step None."""
+    d = str(tmp_path)
+    with CheckpointManager(d, async_save=False) as mgr:
+        mgr.save(1, _tree(1.0))
+    os.unlink(os.path.join(d, "manifest-1.json"))  # the crash window
+    assert os.path.isdir(os.path.join(d, "1"))
+    with CheckpointManager(d, async_save=False) as mgr:
+        assert mgr.latest_step() is None
+        assert mgr.all_steps() == []       # swept at open, not legacy
+        with pytest.raises(FileNotFoundError):
+            mgr.restore()
 
 
 def test_duplicate_step_save_skips_like_legacy(tmp_path):

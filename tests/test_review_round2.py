@@ -246,8 +246,12 @@ def test_trainer_flag_reports_bad_tensor_names():
         loss=nn.MSELoss())
     flags.set_flags({"check_nan_inf": True})
     try:
+        # the check is DEFERRED to the buffered drain (ISSUE 9: no
+        # per-step host sync) — train_batch returns, the next drain
+        # boundary raises with the per-tensor report
         with pytest.raises(FloatingPointError, match="weight"):
             model.train_batch([np.ones((2, 4), np.float32)],
                               [np.zeros((2, 2), np.float32)])
+            model.drain_metrics()
     finally:
         flags.set_flags({"check_nan_inf": False})

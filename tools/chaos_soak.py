@@ -45,6 +45,17 @@ seed; ``faults.preview(site, N)`` recomputes the faulting call
 numbers purely, and the soak asserts the observed injection log
 equals that schedule.
 
+6b. POISONED-STREAM SOAK (rides ``--train``) — the numeric-guard gate
+   (ISSUE 9): under a seeded ``data.poison`` / ``grad.nonfinite``
+   schedule with the on-device NumericGuard armed (skip policy), the
+   final params hex must be BYTE-IDENTICAL to a clean run over the
+   same stream with the tripped steps removed, at steps_per_loop ∈
+   {1, 4}; the rollback policy must restore a verified checkpoint and
+   complete; and guard-off must add zero device work (the lowered
+   step program carries no finite-check ops — the one-flag-check
+   discipline, plus a wall-clock sanity bound). Assertion failures
+   print the fault seed + replay command and attach a flight dump.
+
 6. TRAIN SOAK (``--train``) — the kill-anywhere/resume-exactly gate
    (ISSUE 8): a training worker runs ``Model.fit`` with async
    full-state checkpointing (``checkpoint_dir`` + ``resume="auto"`` +
@@ -67,7 +78,9 @@ CI:   python tools/chaos_soak.py --ci       # fixed seeds, ~30s budget
       python tools/chaos_soak.py --ci --fleet   # replica-kill soak,
                                                 # ≤45s budget
       python tools/chaos_soak.py --ci --train   # kill-anywhere train
-                                                # soak, ≤45s budget
+                                                # soak + poisoned-
+                                                # stream guard gate,
+                                                # ≤90s budget
 Any assertion failure prints the fault seed and the one-line replay
 command, so a red CI run reproduces in one copy-paste.
 """
@@ -878,6 +891,161 @@ def train_soak(seed: int, workdir: str) -> dict:
         f"only {landed}/4 seeded kills landed inside the run — the "
         f"soak under-exercised the kill windows: {out['kills']}")
     out.update(_train_soak_inprocess(seed, workdir))
+    out["guard"] = _train_soak_guard(seed, workdir)
+    return out
+
+
+def _train_soak_guard(seed: int, workdir: str) -> dict:
+    """Scenario 6b: the poisoned-stream numeric-guard gate. Any
+    assertion failure prints the fault seed + replay command and
+    attaches a flight-recorder dump (same contract as the fleet/train
+    phases)."""
+    import hashlib
+
+    from paddle_tpu import Model, nn, optimizer as pt_opt, seed as pt_seed
+    from paddle_tpu.io import TensorDataset, stack_batches
+    from paddle_tpu.io.checkpoint import CheckpointManager
+    from paddle_tpu.observability import flight
+    from paddle_tpu.reliability import faults
+    from paddle_tpu.reliability import guard as nguard
+
+    rng = np.random.RandomState(seed)
+    n_batches, batch = 16, 4
+    batches = [(rng.randn(batch, 8).astype(np.float32),
+                rng.randint(0, 4, (batch, 1)))
+               for _ in range(n_batches)]
+
+    def build(policy):
+        pt_seed(0)
+        net = nn.Sequential(nn.Linear(8, 16), nn.Tanh(),
+                            nn.Linear(16, 4))
+        m = Model(net)
+        # constant-LR Adam, no dropout: the exactness scope of skip ≡
+        # clean-minus (per-step keys / LR schedules would key on the
+        # shifted step index)
+        m.prepare(optimizer=pt_opt.Adam(learning_rate=1e-2,
+                                        parameters=net),
+                  loss=nn.CrossEntropyLoss(), numeric_guard=policy)
+        return m
+
+    def params_hex(m):
+        m.sync_weights()
+        h = hashlib.blake2b(digest_size=16)
+        for name, v in sorted(m.network.state_dict().items()):
+            h.update(name.encode())
+            h.update(np.ascontiguousarray(np.asarray(v)).tobytes())
+        return h.hexdigest()
+
+    def run(m, k, skip_idx=()):
+        kept = [b for i, b in enumerate(batches) if i not in skip_idx]
+        if k == 1:
+            for x, y in kept:
+                m.train_batch([x], [y])
+        else:
+            for lo in range(0, len(kept), k):
+                slab = stack_batches(kept[lo:lo + k])
+                m.train_loop_batch([slab[0]], [slab[1]])
+        m.drain_metrics()
+        return m
+
+    rec = flight.install_flight_recorder(
+        os.path.join(workdir, "guard_flight"))
+    out = {}
+    try:
+        # -- phase A: skip-policy determinism at K ∈ {1, 4}, both
+        # fault sites. Poisoned final params hex must equal the clean
+        # run over the stream minus the scheduled steps.
+        for site in ("data.poison", "grad.nonfinite"):
+            for k in (1, 4):
+                faults.reset()
+                faults.enable(seed=seed)
+                faults.inject(site, nth=(4, 11))
+                m = run(build(nguard.GuardPolicy(on_nonfinite="skip",
+                                                 budget=8)), k)
+                assert m._guard.n_skipped == 2, m._guard.status()
+                schedule = faults.preview(site, n_batches)
+                assert schedule == [4, 11], schedule
+                _assert_schedule_matches(faults, (site,))
+                poisoned = params_hex(m)
+                faults.reset()
+                clean = params_hex(run(
+                    build(nguard.GuardPolicy(on_nonfinite="skip")),
+                    k, skip_idx={c - 1 for c in schedule}))
+                assert poisoned == clean, (
+                    f"{site} k={k}: skip-policy params {poisoned} != "
+                    f"clean-minus params {clean} — skip is not an "
+                    f"exact no-op")
+                out[f"{site}.k{k}"] = poisoned
+        # -- phase B: rollback restores a verified step and completes
+        faults.reset()
+        faults.enable(seed=seed)
+        faults.inject("data.poison", nth=(10,))
+        pol = nguard.GuardPolicy(on_nonfinite="rollback",
+                                 max_rollbacks=3)
+        m = build(pol)
+        x = np.concatenate([b[0] for b in batches])
+        y = np.concatenate([b[1] for b in batches])
+        ck_dir = os.path.join(workdir, "guard_ck")
+        m.fit(TensorDataset([x, y]), batch_size=batch, epochs=2,
+              shuffle=False, verbose=0, checkpoint_dir=ck_dir,
+              checkpoint_freq=3, keep_checkpoints=4)
+        assert pol.n_rollbacks >= 1, pol.status()
+        mgr = CheckpointManager(ck_dir, async_save=False)
+        steps = mgr.verified_steps()
+        mgr.close()
+        assert steps and steps[-1] == m._step_count, (
+            f"rollback run did not finish with a verified final "
+            f"checkpoint: {steps} vs step {m._step_count}")
+        faults.reset()
+        out["rollback"] = {"rollbacks": pol.n_rollbacks,
+                           "final_step": int(m._step_count)}
+        # -- phase C: guard-off zero overhead — the lowered program
+        # has no finite-check ops (the one-flag-check discipline made
+        # structural), plus a wall-clock sanity bound vs guard-on
+        moff = build(None)
+        x0, y0 = batches[0]
+        moff.train_batch([x0], [y0])
+        lowered = moff._train_step_fn.lower(
+            moff._params, moff._frozen, moff._opt_state,
+            moff._buffers, moff._step_count, jax.random.key(0),
+            (x0,), (y0,)).as_text()
+        assert "is_finite" not in lowered, (
+            "guard-off train step still contains finite-check ops — "
+            "the disabled path is not zero-overhead")
+        assert moff._guard is None and not moff._guard_pending
+        mon = build(nguard.GuardPolicy(on_nonfinite="skip"))
+        mon.train_batch([x0], [y0])
+
+        def med_step(m):
+            ts = []
+            for _ in range(30):
+                t0 = time.perf_counter()
+                m.train_batch([x0], [y0])
+                ts.append(time.perf_counter() - t0)
+            return float(np.median(ts))
+
+        t_off, t_on = med_step(moff), med_step(mon)
+        moff.drain_metrics()
+        mon.drain_metrics()
+        assert t_off <= t_on * 1.5 + 2e-3, (
+            f"guard-OFF per-step time {t_off * 1e3:.2f}ms vs guard-on "
+            f"{t_on * 1e3:.2f}ms — the disabled path must not cost "
+            f"more than one flag check")
+        out["bench"] = {"off_ms": round(t_off * 1e3, 3),
+                        "on_ms": round(t_on * 1e3, 3)}
+    except AssertionError as e:
+        path = rec.dump("guard_soak_failure",
+                        extra={"what": "guard_soak_assertion",
+                               "seed": seed, "error": str(e),
+                               "injected": faults.injected_log()})
+        print(f"GUARD SOAK FAILED under fault seed {seed}\n"
+              f"replay: python tools/chaos_soak.py --train "
+              f"--seed {seed}\nflight dump: {path}",
+              file=sys.stderr, flush=True)
+        raise
+    finally:
+        faults.reset()
+        rec.uninstall()
     return out
 
 

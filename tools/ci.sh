@@ -62,7 +62,8 @@ echo "== fleet chaos soak (K=3 replicas, SIGKILL mid-decode -> failover)"
 # breach; failures attach a merged cross-process trace
 python tools/chaos_soak.py --ci --fleet
 
-echo "== train chaos soak (kill-anywhere -> bit-identical resume)"
+echo "== train chaos soak (kill-anywhere -> bit-identical resume"
+echo "   + poisoned-stream numeric-guard gate)"
 # Model.fit with async full-state checkpoints + resume="auto":
 # seeded SIGKILLs in the STEP/SNAPSHOT/COMMIT/GC windows plus a
 # SIGTERM emergency-flush pass, relaunch to completion, combined loss
@@ -70,7 +71,12 @@ echo "== train chaos soak (kill-anywhere -> bit-identical resume)"
 # steps_per_loop 1 and 4; async-save stall bounded by snapshot time;
 # a byte-rotted newest checkpoint quarantines and falls back without
 # ever surfacing through latest_step(); ckpt.* fault sites replay
-# from seed (<=45s; failures print the seed + replay command)
+# from seed. Then the poisoned-stream phase: seeded data.poison /
+# grad.nonfinite schedules against the on-device NumericGuard —
+# skip-policy final params byte-identical to a clean run minus the
+# tripped steps at K in {1,4}, rollback restores a verified step and
+# completes, guard-off program carries zero guard ops (failures print
+# the seed + replay command and attach a flight dump)
 python tools/chaos_soak.py --ci --train
 
 echo "== fleet serving bench (prefix-affinity vs round-robin at K=3)"
