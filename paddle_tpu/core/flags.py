@@ -156,6 +156,20 @@ define_flag("steps_per_loop", 1,
             "K=1 (per-step keys are derived from the step index inside "
             "the scan). fit(steps_per_loop=...) overrides per call.",
             validator=lambda v: v >= 1)
+define_flag("decode_ticks_per_dispatch", 1,
+            "Default number of decode ticks LLMEngine fuses into ONE "
+            "XLA dispatch (a lax.scan over the fused tick body with "
+            "sampling, EOS/limit detection, position advance and "
+            "in-pool KV page writes carried on device; the host "
+            "surfaces only at admission/drain/deadline/cancel "
+            "boundaries). N=1 keeps the per-tick path (the compiled "
+            "program carries no scan op); N>1 amortizes the "
+            "Python->XLA dispatch + scheduler overhead that dominates "
+            "decode at small batch. Token streams are identical to "
+            "N=1 (sampling keys fold (nonce, position) only). "
+            "LLMEngine(decode_ticks_per_dispatch=...) overrides per "
+            "engine.",
+            validator=lambda v: v >= 1)
 define_flag("numeric_guard", False,
             "Arm the on-device numeric guard (reliability/guard.py) "
             "with default GuardPolicy() in Model.prepare when no "

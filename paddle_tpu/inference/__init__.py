@@ -545,7 +545,8 @@ def __getattr__(name):
     # lazy: the LLM engine pulls in model/ops modules that plain
     # CNN-artifact serving never needs
     if name in ("LLMEngine", "serve_llm", "AdmissionShed",
-                "AdmissionTimeout", "RequestCancelled"):
+                "AdmissionTimeout", "RequestCancelled",
+                "DecodeCarry"):
         from . import llm
         return getattr(llm, name)
     if name == "PrefixCache":

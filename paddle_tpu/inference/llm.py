@@ -38,18 +38,19 @@ import time
 import weakref
 from collections import deque
 from concurrent.futures import Future
-from typing import List, Optional, Sequence
+from typing import Dict, List, NamedTuple, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core import flags as _flags
 from ..nn.layer import Layer, functional_call, split_state
 from ..observability import metrics as _obs
 from ..observability import propagation as _propagation
 from ..observability import server as _dbgsrv
 from ..observability import tracing as _trace
-from ..ops.paged_attention import paged_attention, paged_attention_kernel
+from ..ops.paged_attention import paged_attention
 from ..reliability import faults as _faults
 from ..reliability.retry import Deadline, DeadlineExceeded, as_deadline
 
@@ -163,6 +164,22 @@ def _engine_metrics():
         "tick_ratio": reg.gauge(
             "llm_prefill_decode_tick_ratio",
             "prefill ticks / decode ticks since engine start"),
+        # device-resident decode loop (fused slabs): how many ticks
+        # each dispatch actually realized, and how often the host
+        # touched the device at all — the dispatch-overhead lens the
+        # --decode-ticks bench sweep reads
+        "slab_ticks": reg.histogram(
+            "llm_decode_slab_ticks",
+            "realized decode ticks per fused-slab dispatch (max "
+            "emitted across slots; < decode_ticks_per_dispatch when "
+            "every slot finished mid-slab or the slab shrank to a "
+            "page boundary)",
+            buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0)),
+        "host_dispatches": reg.counter(
+            "llm_host_dispatches_total",
+            "XLA dispatches issued by the engine loop (prefill "
+            "chunks, decode steps/slabs, speculative draft+verify "
+            "passes) — the quantity fused slabs divide by N"),
         # hardened failure semantics (docs/RELIABILITY.md): these
         # outcomes are terminal and disjoint from completed/truncated/
         # failed — submitted = completed + truncated + failed + shed +
@@ -218,6 +235,45 @@ def _sample(logits, temperature, key, nonces, positions):
     return jnp.where(temperature > 0.0, sampled, greedy)
 
 
+class DecodeCarry(NamedTuple):
+    """Device-resident per-slot decode state: the scan carry of one
+    fused decode slab (``decode_ticks_per_dispatch`` ticks as ONE XLA
+    dispatch), and the typed contract for everything that used to be
+    host-side control plane between ticks.
+
+    This structure is deliberately public and documented: it is the
+    shared foundation for on-device draft+verify rounds (ROADMAP
+    item 5) and for chaos injection around slab boundaries — extend it
+    with new per-slot fields rather than growing ad-hoc tuples.
+
+    Fields (B = max_seqs; all device arrays, donated across the slab):
+
+    - ``tokens``    [B] i32 — each slot's last sampled token, i.e. the
+      NEXT tick's input (the on-device analog of ``_tokens_dev``).
+    - ``positions`` [B] i32 — the KV-pool position ``tokens`` will be
+      written at (== the slot's current context length). Advances by 1
+      per tick for active slots only.
+    - ``budgets``   [B] i32 — tokens the slot may still emit inside
+      this slab; decremented per active tick, zeroed on EOS. 0 marks
+      the slot INACTIVE: its tick is a masked no-op (KV writes land on
+      scratch page 0, ``tokens``/``positions`` hold) exactly like the
+      guard's masked updates — finished slots ride out the slab
+      without corrupting anything.
+    - ``k_pages``/``v_pages`` — the paged KV pool, updated in place
+      tick to tick (donated, like the per-tick path).
+
+    Scan-invariant per-slot state (block tables, temperatures, nonces,
+    the engine PRNG key) rides OUTSIDE the carry as ordinary arguments:
+    the slab pre-reserves pages for up to N tokens at entry, so the
+    body never grows the page table and stays shape-stable."""
+
+    tokens: jax.Array
+    positions: jax.Array
+    budgets: jax.Array
+    k_pages: jax.Array
+    v_pages: jax.Array
+
+
 class _PagedDecode(Layer):
     """One batched decode step as a pure Layer (so functional_call
     threads the GPT's params): feed each active slot's last token,
@@ -230,10 +286,8 @@ class _PagedDecode(Layer):
         self.attention_impl = attention_impl
 
     def _paged_attention(self, q, k_pages, v_pages, tables, lens):
-        if self.attention_impl == "pallas":
-            return paged_attention_kernel(q, k_pages, v_pages, tables,
-                                          lens)
-        return paged_attention(q, k_pages, v_pages, tables, lens)
+        return paged_attention(q, k_pages, v_pages, tables, lens,
+                               impl=self.attention_impl)
 
     def forward(self, tokens, positions, block_tables, context_lens,
                 k_pages, v_pages, temperature, nonces, key):
@@ -551,6 +605,8 @@ def _engine_status_provider(ref):
             "health": eng.health,
             "consecutive_device_errors": eng._consec_device_errors,
             "lookahead": eng.lookahead,
+            "decode_ticks_per_dispatch": eng.decode_ticks_per_dispatch,
+            "host_dispatches": eng.n_host_dispatches,
             "n_steps": eng.n_steps,
             "n_tokens": eng.n_tokens,
             "prompt_tokens": eng.n_prompt_tokens,
@@ -612,6 +668,25 @@ class LLMEngine:
     ``lookahead`` steps and up to ``lookahead`` wasted step-slots of
     compute after a sequence finishes.
 
+    ``decode_ticks_per_dispatch``: DEVICE-RESIDENT DECODE LOOP — run
+    N decode ticks as ONE ``lax.scan`` XLA dispatch (default
+    ``FLAGS.decode_ticks_per_dispatch``; the serving analog of
+    ``Model.fit(steps_per_loop=K)``). Sampling, per-slot EOS/limit
+    detection, position advance and in-pool KV page writes are all
+    carried on device in a typed :class:`DecodeCarry`; the host
+    surfaces only at admission, drain, deadline and cancel
+    boundaries, so cancel/deadline reaction lags by at most one slab.
+    KV pages are pre-reserved for up to N tokens at slab entry (the
+    scan body never grows the page table); under page pressure the
+    slab shrinks to the nearest coverable boundary instead of
+    truncating early. Token streams are IDENTICAL to N=1 (the scan
+    body is the per-tick program; sampling keys fold (nonce,
+    position) only — test-pinned), and N=1 keeps the per-tick path:
+    its compiled program carries no scan op. Does not compose with
+    ``lookahead`` (the slab must drain at its boundary) and is
+    clamped to 1 for speculative engines (rounds are their own
+    fusion).
+
     ``prefix_cache`` + ``prefill_chunk``: PREFIX CACHING over the page
     pool (full prompt pages become immutable, refcounted, and keyed by
     a rolling hash — a new request whose prompt prefix matches maps
@@ -644,7 +719,8 @@ class LLMEngine:
                  admit_timeout: Optional[float] = 300.0,
                  device_retry_budget: int = 0,
                  degraded_after: int = 1,
-                 drain_after: int = 8):
+                 drain_after: int = 8,
+                 decode_ticks_per_dispatch: Optional[int] = None):
         cfg = net.cfg
         self.cfg = cfg
         self.max_seqs = max_seqs
@@ -673,7 +749,35 @@ class LLMEngine:
         # device-chained last tokens (authoritative between fetches)
         self._tokens_dev = jnp.zeros((max_seqs,), jnp.int32)
         self.lookahead = int(lookahead)
-        self._inflight = deque()   # (issue_seq, slots, tokens, kind)
+        # DEVICE-RESIDENT DECODE LOOP: fuse N decode ticks into one
+        # lax.scan dispatch (DecodeCarry docs the on-device state).
+        # Defaults from FLAGS.decode_ticks_per_dispatch; speculative
+        # engines run their own round fusion and clamp to 1.
+        if decode_ticks_per_dispatch is None:
+            decode_ticks_per_dispatch = _flags.get_flag(
+                "decode_ticks_per_dispatch")
+        self.decode_ticks_per_dispatch = max(
+            1, int(decode_ticks_per_dispatch))
+        if draft_net is not None:
+            self.decode_ticks_per_dispatch = 1
+        if self.decode_ticks_per_dispatch > 1 and self.lookahead:
+            raise ValueError(
+                "decode_ticks_per_dispatch > 1 does not compose with "
+                "lookahead: a fused slab must drain at its boundary "
+                "(on-device EOS decides how far positions advanced), "
+                "and the slab already keeps the device busy for N "
+                "ticks per fetch — use one knob or the other")
+        # recompile-signature guard (same discipline as Model
+        # _guard_recompiles): fused-slab programs ("decode_loop", one
+        # per distinct realized slab length) are counted separately
+        # from per-tick ("decode_step") and prefill signatures, so an
+        # N-knob sweep can't silently blow the 4096 cap
+        self._shape_signatures: set = set()
+        # (issue_seq, slots, tokens, kind, meta): kind "p" = prefill
+        # first-token record, "d" = one decode tick, "D" = fused slab
+        # ([n_ticks, max_seqs] tokens; meta carries the host copy of
+        # the slab-entry budgets + positions the drain replays)
+        self._inflight = deque()
         self._issue_seq = 0
         self._fetch_seq = 0
         # per-slot sampling-key salts (the occupant request's nonce)
@@ -762,6 +866,49 @@ class LLMEngine:
         # donate the pools: XLA updates pages in place step to step
         self._decode_fn = jax.jit(decode_fn, donate_argnums=(6, 7))
 
+        # the fused slab: n_ticks chained decode ticks as ONE program.
+        # Each tick is EXACTLY the per-tick body (same functional_call,
+        # same fold_in(nonce, position) sampling keys), so token
+        # streams are identical to N=1 by construction; finished slots
+        # (budget 0) are masked no-ops — lens 0 routes their KV writes
+        # to scratch page 0 and where() holds their carry. When every
+        # slot finishes mid-slab, a cond skips the remaining tick
+        # bodies entirely (device-side early exit). eos is closed over
+        # (engine-constant); -1 never matches a sampled id.
+        eos_tok = -1 if eos_token_id is None else int(eos_token_id)
+
+        def slab_fn(params, buffers, carry, tables, temps, nonces,
+                    key, n_ticks):
+            def tick(c, _):
+                def live_step(c):
+                    active = c.budgets > 0
+                    lens = jnp.where(active, c.positions + 1, 0)
+                    ((nxt, kp, vp), _) = functional_call(
+                        decode, params, buffers, c.tokens, c.positions,
+                        tables, lens, c.k_pages, c.v_pages, temps,
+                        nonces, key, training=False)
+                    nxt = jnp.where(active, nxt, c.tokens)
+                    budgets = jnp.where(active, c.budgets - 1,
+                                        c.budgets)
+                    budgets = jnp.where(active & (nxt == eos_tok),
+                                        0, budgets)
+                    return DecodeCarry(
+                        tokens=nxt,
+                        positions=jnp.where(active, c.positions + 1,
+                                            c.positions),
+                        budgets=budgets, k_pages=kp, v_pages=vp)
+
+                c = jax.lax.cond(jnp.any(c.budgets > 0), live_step,
+                                 lambda c: c, c)
+                return c, c.tokens
+
+            carry, toks = jax.lax.scan(tick, carry, None,
+                                       length=n_ticks)
+            return toks, carry
+
+        self._slab_fn = jax.jit(slab_fn, static_argnums=(7,),
+                                donate_argnums=(2,))
+
         if self.spec_k:
             # speculative engines keep the inline one-shot prefill
             # (round-synced anyway; the draft pool would need the same
@@ -822,6 +969,7 @@ class LLMEngine:
         # serving stats
         self.n_steps = 0
         self.n_tokens = 0
+        self.n_host_dispatches = 0   # jit dispatches the loop issued
         self.n_prompt_tokens = 0    # admitted prompt tokens
         self.n_cached_tokens = 0    # of those, served from the cache
         self.n_prefill_ticks = 0
@@ -1226,6 +1374,58 @@ class LLMEngine:
                 return b
         return self.prefill_buckets[-1]
 
+    def _guard_recompiles(self, kind: str, sig=()) -> bool:
+        """Engine analog of ``Model._guard_recompiles`` (PR 3's
+        step-vs-loop discipline): one signature per distinct compiled
+        engine program, keyed by ``kind`` — ``"decode_step"`` (the
+        per-tick program), ``"decode_loop"`` (one per realized fused-
+        slab length, so a decode_ticks_per_dispatch sweep or a
+        page-pressure shrink is counted as the recompile it is),
+        ``"prefill"`` (chunk or inline bucket). Bounded at 4096 like
+        the Model guard; FLAGS.recompile_warn_threshold 0 disables.
+        Returns True when the signature is new (a compile is
+        coming)."""
+        thresh = _flags.get_flag("recompile_warn_threshold")
+        if not thresh:
+            return False
+        seen = self._shape_signatures
+        if len(seen) >= 4096:
+            return False
+        full = (kind,) + tuple(sig)
+        if full in seen:
+            return False
+        seen.add(full)
+        if len(seen) == thresh + 1:
+            import warnings
+            warnings.warn(
+                f"LLMEngine has now compiled {len(seen)} distinct "
+                f"programs (latest: {full}); each is a full XLA "
+                f"recompile. A decode_ticks_per_dispatch sweep or "
+                f"page-pressure slab shrinking multiplies "
+                f"decode_loop signatures — raise "
+                f"FLAGS.recompile_warn_threshold if intentional.",
+                stacklevel=3)
+        return True
+
+    def _count_dispatch(self, n: int = 1) -> None:
+        """One engine-loop jit dispatch reached the device (the
+        quantity fused slabs divide by N; the bench sweep reports it
+        per 100 tokens)."""
+        self.n_host_dispatches += n
+        self._m["host_dispatches"].inc(n)
+
+    def _inflight_tokens(self, slot: int) -> int:
+        """Tokens already issued for ``slot`` and not yet fetched:
+        one per per-tick/prefill record naming it, its device budget
+        for a fused-slab record."""
+        n = 0
+        for _, slots_list, _, kind, meta in self._inflight:
+            if kind == "D":
+                n += meta["budgets"].get(slot, 0)
+            elif slot in slots_list:
+                n += 1
+        return n
+
     def _admit(self, req: _Request) -> str:
         """"ok" (admitted), "retry" (transiently out of slots/pages),
         "never" (the prompt cannot fit this pool at all), or "shed"
@@ -1347,6 +1547,7 @@ class LLMEngine:
         if _faults.enabled():
             _faults.check("device.dispatch")
         bucket = self._bucket(n)
+        self._guard_recompiles("prefill", (bucket,))
         ids = np.zeros((1, bucket), np.int32)
         ids[0, :n] = req.prompt
         nxt, self.k_pages, self.v_pages = self._prefill_fn(
@@ -1364,31 +1565,30 @@ class LLMEngine:
                 jnp.asarray(self.block_tables[slot]),
                 self.draft_k_pages, self.draft_v_pages,
                 jnp.float32(0.0), jnp.int32(req.nonce), self._key)
-        tok = int(nxt)        # blocks until the prefill has executed —
-        req.t_first = time.monotonic()   # TTFT includes device time
-        req.tokens.append(tok)
+        self._count_dispatch(2)
+        # NO host sync here (this was the last admission-path blocking
+        # fetch): the first token chains into _tokens_dev on device
+        # and is harvested by the async drain like any decode token —
+        # TTFT is observed at the fetch on every admission path
+        self._tokens_dev = self._tokens_dev.at[slot].set(nxt)
+        self._issue_seq += 1
+        self._inflight.append((self._issue_seq, [slot],
+                               self._tokens_dev, "p", None))
         req.prefill_done = True
         if req.spans is not None:
-            # inline prefill blocks through the first token, so the
-            # tree skips the first_token phase: prefill ends at the
-            # fetch and decode starts there
+            # the prompt is computed (dispatched); what remains before
+            # the first token reaches the host is the async drain —
+            # its own phase, exactly like the chunked path
             tp = time.perf_counter()
             req.spans["prefill"].end(tp)
-            req.spans["decode"] = _trace.start_span(
-                "llm.decode", parent=req.spans["root"], t0=tp)
-            req.spans["root"].add_event(
-                "first_token",
-                {"ttft_s": round(req.t_first - req.t_submit, 6)}, ts=tp)
+            req.spans["first_token"] = _trace.start_span(
+                "llm.first_token", parent=req.spans["root"], t0=tp)
         self.context_lens[slot] = n
-        self._tokens_dev = self._tokens_dev.at[slot].set(req.tokens[-1])
         self.temperatures[slot] = req.temperature
         self._nonces[slot] = req.nonce
-        self.n_tokens += 1
         self.n_prompt_tokens += n
         self._m["prompt_tokens"].inc(n)
-        self._m["ttft"].observe(req.t_first - req.t_submit)
         self._m["prefills"].inc()
-        self._m["tokens"].inc()
         self._update_kv_gauge()
         return "ok"
 
@@ -1450,6 +1650,7 @@ class LLMEngine:
                 break   # chunk budget exhausted mid-prompt
         if _faults.enabled():
             _faults.check("device.dispatch")
+        self._guard_recompiles("prefill")
         nxt, self.k_pages, self.v_pages = self._chunk_fn(
             self._params, self._buffers, jnp.asarray(tok),
             jnp.asarray(pos), jnp.asarray(lim), jnp.asarray(tbl),
@@ -1457,6 +1658,7 @@ class LLMEngine:
             self.k_pages, self.v_pages,
             jnp.asarray(self.temperatures),
             jnp.asarray(self._nonces), self._key)
+        self._count_dispatch()
         if finishing:
             mask = np.zeros((self.max_seqs,), bool)
             for req in finishing:
@@ -1468,7 +1670,7 @@ class LLMEngine:
             self._issue_seq += 1
             self._inflight.append(
                 (self._issue_seq, [r.slot for r in finishing], nxt,
-                 "p"))
+                 "p", None))
             for req in finishing:
                 req.prefill_done = True
                 self.context_lens[req.slot] = len(req.prompt)
@@ -1528,6 +1730,15 @@ class LLMEngine:
                 if live and self.spec_k:
                     self._spec_round(live)
                     busy = True
+                elif live and self.decode_ticks_per_dispatch > 1:
+                    # device-resident decode loop: N ticks, ONE
+                    # dispatch; the slab drains at its own boundary
+                    # below (the device decides how far each slot
+                    # advanced — mid-slab EOS), which is also where
+                    # cancel/deadline/admission surface — at most one
+                    # slab of added reaction latency
+                    self._issue_slab(live)
+                    busy = True
                 elif live:
                     self._issue(live)
                     busy = True
@@ -1537,7 +1748,12 @@ class LLMEngine:
                         max(1, self.n_decode_ticks))
                 if busy:
                     # fetch with a lag: the chain keeps the device busy
-                    while len(self._inflight) > self.lookahead:
+                    # (fused slabs always drain to the boundary: the
+                    # next slab's budgets/positions need this one's
+                    # realized EOS/length outcome)
+                    lag = 0 if self.decode_ticks_per_dispatch > 1 \
+                        else self.lookahead
+                    while len(self._inflight) > lag:
                         self._drain_one()
                 else:
                     while self._inflight:   # nothing to issue: drain
@@ -1747,9 +1963,11 @@ class LLMEngine:
             with self._mu:
                 self._pending.append(req)
             return
-        if req.prefill_done and self._harvest(req.slot):
-            # inline (speculative) admissions already hold their first
-            # token; chunked admissions resolve through the drain path
+        if req.prefill_done and req.tokens and self._harvest(req.slot):
+            # both admission paths now deliver their first token
+            # through the async drain (tokens is empty here), so this
+            # immediate-finish check is a belt for re-admissions that
+            # kept already-fetched tokens
             self._begin_close(req.slot)
             self._maybe_finalize()
 
@@ -1758,8 +1976,7 @@ class LLMEngine:
         from the previous step ON DEVICE (no fetch here)."""
         for slot in list(live):
             req = self._slots[slot]
-            in_flight = sum(1 for _, sl, _, _ in self._inflight
-                            if slot in sl)
+            in_flight = self._inflight_tokens(slot)
             if len(req.tokens) + in_flight >= req.max_new_tokens:
                 # length completion is already provable on the host:
                 # issuing more would only burn pages/compute on tokens
@@ -1785,16 +2002,18 @@ class LLMEngine:
             lens[slot] = self.context_lens[slot] + 1
         if _faults.enabled():
             _faults.check("device.dispatch")
+        self._guard_recompiles("decode_step")
         tokens, self.k_pages, self.v_pages = self._decode_fn(
             self._params, self._buffers,
             self._tokens_dev, jnp.asarray(positions),
             jnp.asarray(self.block_tables), jnp.asarray(lens),
             self.k_pages, self.v_pages, jnp.asarray(self.temperatures),
             jnp.asarray(self._nonces), self._key)
+        self._count_dispatch()
         self._tokens_dev = tokens
         self._issue_seq += 1
         self._inflight.append((self._issue_seq, list(live), tokens,
-                               "d"))
+                               "d", None))
         for slot in live:
             self.context_lens[slot] += 1
         self.n_decode_ticks += 1
@@ -1803,12 +2022,151 @@ class LLMEngine:
         self._m["occupancy"].observe(len(live) / self.max_seqs)
         self._update_kv_gauge()
 
+    def _issue_slab(self, live: List[int]):
+        """Dispatch up to ``decode_ticks_per_dispatch`` decode ticks
+        for the live slots as ONE fused-scan program (the device-
+        resident decode loop; see :class:`DecodeCarry`).
+
+        Host work at slab ENTRY: per-slot emission budgets (length
+        completion provable here, like :meth:`_issue`) and KV-page
+        PRE-RESERVATION for every position the slab could touch — the
+        scan body never allocates, so it stays shape-stable. A slot
+        that cannot cover its full share shrinks the whole slab to
+        the nearest boundary it CAN cover (pages freed by other
+        requests become visible at the next slab entry, preserving
+        the per-tick path's truncation decisions); a slot that cannot
+        even cover its NEXT token truncates exactly as N=1 would.
+        Over-reserved pages (slab shrank after a greedy reserve) are
+        returned to the pool before dispatch.
+
+        EOS/limit detection, sampling, position advance and page
+        writes all happen on device; the drain (same loop iteration —
+        a slab is its own lookahead) replays the device's masking
+        decisions from the host copy of the budgets."""
+        N = self.decode_ticks_per_dispatch
+        ps = self.page_size
+        plan: Dict[int, tuple] = {}   # slot -> (pos0, covered, want)
+        new_pages: List[tuple] = []   # (slot, idx) allocated here
+        for slot in list(live):
+            req = self._slots[slot]
+            in_flight = self._inflight_tokens(slot)
+            want = req.max_new_tokens - len(req.tokens) - in_flight
+            if want <= 0:
+                self._begin_close(slot, accept_inflight=True)
+                live.remove(slot)
+                continue
+            pos0 = int(self.context_lens[slot])
+            covered = 0
+            for j in range(min(N, want)):
+                pos = pos0 + j
+                if pos >= self.max_len:
+                    break
+                idx = pos // ps
+                if self.block_tables[slot, idx] == 0:
+                    page = self._alloc_page()
+                    if page is None:
+                        break
+                    self.block_tables[slot, idx] = page
+                    new_pages.append((slot, idx))
+                covered += 1
+            if covered == 0:
+                # the NEXT token can't be cached — the same condition
+                # the per-tick path truncates on (nothing was newly
+                # reserved: the first position failed)
+                req.truncated = True
+                self._begin_close(slot, accept_inflight=True)
+                live.remove(slot)
+                continue
+            plan[slot] = (pos0, covered, want)
+        if not live:
+            return
+        n_eff = N
+        for pos0, covered, want in plan.values():
+            if covered < min(N, want):
+                n_eff = min(n_eff, covered)
+        budgets = {slot: min(n_eff, want, covered)
+                   for slot, (pos0, covered, want) in plan.items()}
+        for slot, idx in new_pages:
+            pos0 = plan[slot][0]
+            if idx > (pos0 + budgets[slot] - 1) // ps:
+                self._free_pages.append(
+                    int(self.block_tables[slot, idx]))
+                self.block_tables[slot, idx] = 0
+        if _faults.enabled():
+            _faults.check("device.dispatch")
+            _faults.check("engine.slab")
+        self._guard_recompiles("decode_loop", (n_eff,))
+        pos_arr = np.zeros((self.max_seqs,), np.int32)
+        bud_arr = np.zeros((self.max_seqs,), np.int32)
+        for slot in live:
+            pos_arr[slot] = plan[slot][0]
+            bud_arr[slot] = budgets[slot]
+        carry = DecodeCarry(
+            tokens=self._tokens_dev, positions=jnp.asarray(pos_arr),
+            budgets=jnp.asarray(bud_arr), k_pages=self.k_pages,
+            v_pages=self.v_pages)
+        toks, carry = self._slab_fn(
+            self._params, self._buffers, carry,
+            jnp.asarray(self.block_tables),
+            jnp.asarray(self.temperatures),
+            jnp.asarray(self._nonces), self._key, n_eff)
+        self._count_dispatch()
+        self._tokens_dev = carry.tokens
+        self.k_pages, self.v_pages = carry.k_pages, carry.v_pages
+        self._issue_seq += 1
+        # context_lens advances at the DRAIN (the device decides how
+        # far each slot really went — mid-slab EOS stops its writes);
+        # the record carries the host copy of the entry state
+        self._inflight.append((self._issue_seq, list(live), toks, "D",
+                               {"budgets": budgets,
+                                "pos0": {s: plan[s][0] for s in live}}))
+        self.tick_history.append("D")
+        self._m["occupancy"].observe(len(live) / self.max_seqs)
+        self._update_kv_gauge()
+
+    def _deliver_token(self, slot: int, req: _Request, tok: int,
+                       seq: int) -> None:
+        """Append ONE fetched token to its request — TTFT on the
+        first, span bookkeeping, EOS acceptance, length harvest.
+        Shared by the per-tick and fused-slab drains so their
+        emission semantics cannot drift."""
+        req.tokens.append(tok)
+        self.n_tokens += 1
+        if req.t_first is None:
+            # async first token (chunked or inline prefill): admission
+            # never blocked on the device; TTFT lands here, at the
+            # fetch
+            req.t_first = time.monotonic()
+            self._m["ttft"].observe(req.t_first - req.t_submit)
+            if req.spans is not None:
+                tp = time.perf_counter()
+                ft = req.spans.get("first_token")
+                if ft is not None:
+                    ft.end(tp)
+                req.spans["decode"] = _trace.start_span(
+                    "llm.decode", parent=req.spans["root"], t0=tp)
+                req.spans["root"].add_event(
+                    "first_token",
+                    {"ttft_s": round(req.t_first - req.t_submit,
+                                     6)}, ts=tp)
+        elif req.spans is not None and "decode" in req.spans:
+            # decode-tick annotation (bounded per span): which
+            # fetch delivered the request's n-th token
+            req.spans["decode"].add_event(
+                "fetch", {"n_tokens": len(req.tokens),
+                          "issue_seq": seq})
+        if self.eos_token_id is not None and \
+                tok == self.eos_token_id:
+            req.accepts_inflight = False  # nothing after EOS
+        if not req.closing and self._harvest(slot):
+            self._begin_close(slot)
+
     def _drain_one(self):
         """Fetch the oldest in-flight step's tokens and process them
         (emission, EOS/length, finalization of drained closers)."""
         if _faults.enabled():
             _faults.check("device.transfer")
-        seq, slots_list, tokens, kind = self._inflight.popleft()
+        seq, slots_list, tokens, kind, meta = self._inflight.popleft()
         host = np.asarray(tokens)          # the only blocking fetch
         self._fetch_seq = seq
         if self._consec_device_errors:
@@ -1816,48 +2174,76 @@ class LLMEngine:
             # sticky until reset_health — see _update_health)
             self._consec_device_errors = 0
             self._update_health()
-        if kind == "d":
-            self.n_steps += 1
-        emitted = 0
-        for slot in slots_list:
-            req = self._slots[slot]
-            if req is None:
-                continue
-            if req.closing and (not req.accepts_inflight or
-                                len(req.tokens) >= req.max_new_tokens):
-                continue  # overrun token of a finished request
-            req.tokens.append(int(host[slot]))
-            self.n_tokens += 1
-            emitted += 1
-            if req.t_first is None:
-                # chunked-prefill first token: admission never blocked
-                # on the device; TTFT lands here, at the async fetch
-                req.t_first = time.monotonic()
-                self._m["ttft"].observe(req.t_first - req.t_submit)
-                if req.spans is not None:
-                    tp = time.perf_counter()
-                    ft = req.spans.get("first_token")
-                    if ft is not None:
-                        ft.end(tp)
-                    req.spans["decode"] = _trace.start_span(
-                        "llm.decode", parent=req.spans["root"], t0=tp)
-                    req.spans["root"].add_event(
-                        "first_token",
-                        {"ttft_s": round(req.t_first - req.t_submit,
-                                         6)}, ts=tp)
-            elif req.spans is not None and "decode" in req.spans:
-                # decode-tick annotation (bounded per span): which
-                # fetch delivered the request's n-th token
-                req.spans["decode"].add_event(
-                    "fetch", {"n_tokens": len(req.tokens),
-                              "issue_seq": seq})
-            if self.eos_token_id is not None and \
-                    req.tokens[-1] == self.eos_token_id:
-                req.accepts_inflight = False  # nothing after EOS
-            if not req.closing and self._harvest(slot):
-                self._begin_close(slot)
-        self._observe_step(emitted, timed=(kind == "d"))
+        if kind == "D":
+            emitted = self._drain_slab(seq, slots_list, host, meta)
+        else:
+            if kind == "d":
+                self.n_steps += 1
+            emitted = 0
+            for slot in slots_list:
+                req = self._slots[slot]
+                if req is None:
+                    continue
+                if req.closing and (not req.accepts_inflight or
+                                    len(req.tokens) >=
+                                    req.max_new_tokens):
+                    continue  # overrun token of a finished request
+                self._deliver_token(slot, req, int(host[slot]), seq)
+                emitted += 1
+        self._observe_step(emitted, timed=(kind != "p"))
         self._maybe_finalize()
+
+    def _drain_slab(self, seq: int, slots_list: List[int], host,
+                    meta: dict) -> int:
+        """Drain one fused-slab record ([n_ticks, max_seqs] host
+        tokens) by replaying the device's masking decisions from the
+        host copy of the slab-entry budgets: row j delivers a token
+        to every slot still active at tick j (budget left, no EOS
+        yet) — exactly the ``budgets > 0`` mask the scan body
+        applied, so ``req.tokens`` and ``context_lens`` land on what
+        the device actually wrote (tokens past a slot's EOS are the
+        masked no-ops and are never surfaced). Advances each slot's
+        context length by its realized emission count, counts the
+        realized ticks, and marks the slab boundary on each decode
+        span."""
+        remaining = dict(meta["budgets"])
+        pos0 = meta["pos0"]
+        emitted_per = {s: 0 for s in slots_list}
+        emitted = 0
+        for j in range(host.shape[0]):
+            for slot in slots_list:
+                if remaining.get(slot, 0) <= 0:
+                    continue
+                req = self._slots[slot]
+                if req is None or (req.closing and
+                                   (not req.accepts_inflight or
+                                    len(req.tokens) >=
+                                    req.max_new_tokens)):
+                    remaining[slot] = 0
+                    continue
+                tok = int(host[j, slot])
+                remaining[slot] -= 1
+                if self.eos_token_id is not None and \
+                        tok == self.eos_token_id:
+                    remaining[slot] = 0  # the device zeroed it too
+                self._deliver_token(slot, req, tok, seq)
+                emitted_per[slot] += 1
+                emitted += 1
+        ticks = max(emitted_per.values(), default=0)
+        for slot in slots_list:
+            if self._slots[slot] is None:
+                continue
+            self.context_lens[slot] = pos0[slot] + emitted_per[slot]
+            sp = self._slots[slot].spans
+            if sp is not None and "decode" in sp:
+                sp["decode"].add_event(
+                    "slab", {"issue_seq": seq, "ticks": ticks,
+                             "tokens": emitted_per[slot]})
+        self.n_steps += ticks
+        self.n_decode_ticks += ticks
+        self._m["decode_ticks"].inc(ticks)
+        self._m["slab_ticks"].observe(ticks)
+        return emitted
 
     def _observe_step(self, emitted: int, timed: bool = True):
         """Per-fetch timing → step-time and tokens/sec histograms.
@@ -1883,6 +2269,18 @@ class LLMEngine:
         K-th draft step exists for cache coverage (it writes d_{K-1}'s KV
         so a fully-accepted round leaves no draft-cache gap); its output
         is discarded."""
+        # drain first: a just-admitted request's async first token
+        # must land in req.tokens (in issue order, observing TTFT at
+        # the fetch) BEFORE this round's accepted tokens are appended
+        # — and that first token's EOS/length may already close the
+        # slot, so the live set is re-filtered after the drain
+        while self._inflight:
+            self._drain_one()
+        live = [s for s in live if self._slots[s] is not None
+                and not self._slots[s].closing]
+        if not live:
+            self._maybe_finalize()
+            return
         K = self.spec_k
         # per-slot CACHE CAPACITY this round: how many of positions
         # base..base+K-1 are actually writable (max_len + pages).
@@ -1931,12 +2329,14 @@ class LLMEngine:
                     self.draft_k_pages, self.draft_v_pages, zeros_temp,
                     jnp.asarray(self._nonces), self._key)
             self.n_draft_steps += 1
+            self._count_dispatch()
             if j < K - 1:
                 tok_cols.append(cur)
         tokens_mat = jnp.stack(tok_cols, axis=1)            # [B, K]
         greedy, self.k_pages, self.v_pages = self._verify_fn(
             self._params, self._buffers, tokens_mat,
             jnp.asarray(base_arr), tables, self.k_pages, self.v_pages)
+        self._count_dispatch()
         self.n_steps += 1
         self.n_spec_rounds += 1
         self._m["occupancy"].observe(len(live) / self.max_seqs)

@@ -261,13 +261,25 @@ def paged_attention_ragged(q, k_pages, v_pages, token_tables,
 
 
 def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
-                    scale: Optional[float] = None):
+                    scale: Optional[float] = None, impl: str = "xla"):
     """Single-query attention over paged KV (the decode step).
 
     q: [B, heads, d]; k/v_pages: [num_pages, page_size, kv_heads, d];
     block_tables: [B, pages_per_seq] page ids (-1 pads);
     context_lens: [B] valid token counts. Returns [B, heads, d].
-    GQA: heads may be a multiple of kv_heads."""
+    GQA: heads may be a multiple of kv_heads.
+
+    Pure-functional and trace-safe by contract: every input may be a
+    traced value, so the op is callable from inside a ``lax.scan``
+    body — the fused decode slab (``LLMEngine``'s device-resident
+    tick loop) carries block tables and context lengths as scan
+    state and calls this per tick. ``impl="pallas"`` routes through
+    the fused kernel (:func:`paged_attention_kernel`) under the same
+    contract, mirroring :func:`paged_attention_ragged`."""
+    if impl == "pallas":
+        return paged_attention_kernel(q, k_pages, v_pages,
+                                      block_tables, context_lens,
+                                      scale=scale)
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     # the K=1 case of the chunk core, with limit = context_lens

@@ -26,6 +26,11 @@ Named sites (the catalog; see docs/RELIABILITY.md):
 ========================  ==================================================
 ``device.dispatch``       engine jit dispatch (decode step / prefill chunk /
                           speculative round) — a PJRT/compile failure
+``engine.slab``           fused decode slab dispatch (one lax.scan
+                          program over decode_ticks_per_dispatch
+                          ticks) — fires alongside device.dispatch so
+                          chaos schedules can target slabs without
+                          perturbing per-tick call numbering
 ``device.transfer``       device→host fetch of sampled tokens
 ``ckpt.write``            checkpoint save dispatch (pre-write)
 ``ckpt.rename``           checkpoint commit/rename stage (post-write)
@@ -67,6 +72,7 @@ from typing import Callable, Dict, Iterable, List, Optional, Tuple, Union
 
 SITES = (
     "device.dispatch",
+    "engine.slab",
     "device.transfer",
     "ckpt.write",
     "ckpt.rename",

@@ -73,8 +73,19 @@ equals that schedule.
    train-loop stall stays bounded by the device→host snapshot time
    while a (slowed) commit runs in the background.
 
+7. FUSED-SLAB SOAK (``--slab``) — the device-resident decode loop
+   (ISSUE 10): the engine scenarios replayed at
+   ``decode_ticks_per_dispatch=8`` with the new ``engine.slab`` fault
+   site killing slab dispatches on schedule. Every future resolves;
+   budgeted retries reproduce streams TOKEN-IDENTICAL to a fault-free
+   reference engine (nonce-pinned); deadline/cancel storms landing
+   mid-slab resolve typed within a slab boundary with their KV pages
+   reclaimed; the injected sequence replays from its seed.
+
 Run:  python tools/chaos_soak.py            # full soak (default seed)
 CI:   python tools/chaos_soak.py --ci       # fixed seeds, ~30s budget
+      python tools/chaos_soak.py --ci --slab    # fused decode slabs,
+                                                # ~30s budget
       python tools/chaos_soak.py --ci --fleet   # replica-kill soak,
                                                 # ≤45s budget
       python tools/chaos_soak.py --ci --train   # kill-anywhere train
@@ -257,6 +268,117 @@ def engine_soak(seed: int) -> dict:
     tracing.disable()
     assert not open_llm, f"span trees left open: {open_llm}"
     return outcomes
+
+
+def slab_soak(seed: int) -> dict:
+    """ISSUE 10 phase: the engine invariants under FUSED DECODE SLABS
+    (``decode_ticks_per_dispatch=8``) — an injected ``engine.slab``
+    kill storm at the slab dispatch, hopeless deadlines, and a
+    cancellation storm landing mid-slab. Asserts: every future
+    resolves; retried streams are TOKEN-IDENTICAL to a fault-free
+    reference engine over the same prompts (device retries keep the
+    nonce, and a slab re-admission replays the same sampled stream);
+    deadlines/cancels resolve typed within a slab boundary; zero KV
+    pages leak and no ``llm.*`` span stays open after close; the
+    injected sequence equals the pure seeded schedule."""
+    from paddle_tpu.inference.llm import LLMEngine, RequestCancelled
+    from paddle_tpu.observability import tracing
+    from paddle_tpu.reliability import faults
+    from paddle_tpu.reliability.retry import DeadlineExceeded
+
+    rng = np.random.RandomState(seed)
+    prompts = [rng.randint(0, 97, int(rng.randint(3, 12))).tolist()
+               for _ in range(6)]
+    gens = [int(rng.randint(8, 20)) for _ in range(6)]
+    net = _tiny_gpt()
+
+    def build(**kw):
+        return LLMEngine(net, max_seqs=4, page_size=4, num_pages=96,
+                         prefill_buckets=(16,), drain_after=64,
+                         decode_ticks_per_dispatch=8, **kw)
+
+    # fault-free reference streams: same engine seed, same submission
+    # order => same nonces => the chaos run must reproduce these
+    # exactly even when its slabs die and re-admit
+    with build() as ref_eng:
+        ref = [f.result(timeout=FUTURE_TIMEOUT) for f in
+               [ref_eng.submit(p, max_new_tokens=g)
+                for p, g in zip(prompts, gens)]]
+    assert len(ref_eng._free_pages) == ref_eng.num_pages - 1
+
+    tracing.enable()
+    faults.reset()
+    faults.enable(seed=seed)
+    # at most 4 injections (2 nth + 1 capped p at the slab dispatch +
+    # 1 transfer) against a retry budget of 4: chaos must be invisible
+    # in the outcomes AND in the token streams
+    faults.inject("engine.slab", nth=(2, 5))
+    faults.inject("engine.slab", p=0.02, times=1)
+    faults.inject("device.transfer", nth=(7,))
+    eng = build(device_retry_budget=4, admit_timeout=60.0)
+    try:
+        futs = [eng.submit(p, max_new_tokens=g)
+                for p, g in zip(prompts, gens)]
+        done, not_done = fut_wait(futs, timeout=FUTURE_TIMEOUT)
+        assert not not_done, (
+            f"{len(not_done)} futures never resolved — the engine "
+            f"hung under injected slab faults")
+        for f, r in zip(futs, ref):
+            assert f.exception() is None, (
+                f"request lost to budgeted slab chaos: {f.exception()}")
+            assert f.result()["output_ids"] == r["output_ids"], (
+                "retried slab stream diverged from the fault-free "
+                "reference (nonce-pinned token identity broken)")
+        n_injected = len(faults.injected_log())
+        assert n_injected >= 2, (
+            f"schedule armed but only {n_injected} faults injected — "
+            f"the soak did not exercise the slab failure path")
+        _assert_schedule_matches(
+            faults, ("engine.slab", "device.transfer"))
+
+        # hopeless deadlines resolve typed (at a slab boundary)
+        dl = [eng.submit(rng.randint(0, 97, 5).tolist(),
+                         max_new_tokens=8, deadline=-1.0)
+              for _ in range(3)]
+        done, not_done = fut_wait(dl, timeout=FUTURE_TIMEOUT)
+        assert not not_done, "deadline futures pending under slabs"
+        assert all(isinstance(f.exception(), DeadlineExceeded)
+                   for f in dl), [f.exception() for f in dl]
+
+        # cancellation storm, faults off: cancels land mid-slab and
+        # must resolve at the boundary with pages reclaimed
+        faults.disable()
+        eng.reset_health()
+        storm = [eng.submit(rng.randint(0, 97, 6).tolist(),
+                            max_new_tokens=80) for _ in range(8)]
+        for f in storm[::2]:
+            eng.cancel(f.request_id)
+        time.sleep(0.2)
+        for f in storm[1::2]:
+            eng.cancel(f.request_id)
+        done, not_done = fut_wait(storm, timeout=FUTURE_TIMEOUT)
+        assert not not_done, (
+            "cancellation storm left futures pending under fused "
+            "slabs")
+        n_cancelled = 0
+        for f in storm:
+            exc = f.exception()
+            assert exc is None or isinstance(exc, RequestCancelled), \
+                exc
+            n_cancelled += exc is not None
+        assert n_cancelled >= 1, "storm cancelled nothing"
+    finally:
+        eng.close()
+        faults.reset()
+    assert len(eng._free_pages) == eng.num_pages - 1, (
+        f"KV pages leaked under fused slabs: "
+        f"{len(eng._free_pages)} free of {eng.num_pages - 1} usable")
+    open_llm = [s for s in tracing.live_spans()
+                if s["name"].startswith("llm.")]
+    tracing.disable()
+    assert not open_llm, f"span trees left open: {open_llm}"
+    return {"injected": n_injected, "cancelled": n_cancelled,
+            "requests": len(futs) + len(dl) + len(storm)}
 
 
 def ckpt_crash(seed: int, workdir: str) -> dict:
@@ -1250,6 +1372,10 @@ def main(argv=None) -> int:
     ap.add_argument("--train", action="store_true",
                     help="run ONLY the train scenario (kill-anywhere "
                          "fit workers, bit-identical resume)")
+    ap.add_argument("--slab", action="store_true",
+                    help="run ONLY the fused-decode-slab scenario "
+                         "(decode_ticks_per_dispatch=8 under an "
+                         "engine.slab kill/cancel/deadline storm)")
     ap.add_argument("--seed", type=int, default=1234)
     ap.add_argument("--workdir", default=None)
     ap.add_argument("--ckpt-worker", nargs=2, metavar=("DIR", "STEPS"),
@@ -1287,6 +1413,8 @@ def main(argv=None) -> int:
             out["fleet"] = fleet_soak(seed, workdir)
         elif args.train:
             out["train"] = train_soak(seed, workdir)
+        elif args.slab:
+            out["slab"] = slab_soak(seed)
         else:
             out["engine"] = engine_soak(seed)
             out["ckpt"] = ckpt_crash(seed, workdir)
@@ -1296,7 +1424,8 @@ def main(argv=None) -> int:
         # IS the fault schedule (docs/RELIABILITY.md determinism)
         replay = (f"python tools/chaos_soak.py --seed {seed}"
                   + (" --fleet" if args.fleet else "")
-                  + (" --train" if args.train else ""))
+                  + (" --train" if args.train else "")
+                  + (" --slab" if args.slab else ""))
         print(f"CHAOS SOAK FAILED under fault seed {seed}\n"
               f"replay: {replay}", file=sys.stderr, flush=True)
         raise

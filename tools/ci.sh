@@ -52,6 +52,13 @@ echo "== chaos soak (seeded fault injection -> hardened semantics)"
 # unreplayable fault schedule, or unrestorable checkpoint
 python tools/chaos_soak.py --ci
 
+echo "== fused-slab chaos soak (decode_ticks_per_dispatch=8)"
+# engine.slab kill storm at the fused slab dispatch + cancel/deadline
+# storms landing mid-slab: every future resolves, retried streams are
+# token-identical to a fault-free reference engine, zero KV-page
+# leaks, fault schedule replays from seed
+python tools/chaos_soak.py --ci --slab
+
 echo "== fleet chaos soak (K=3 replicas, SIGKILL mid-decode -> failover)"
 # router + 3 spawned replica subprocesses over TCPStore membership:
 # injected faults drain one replica (no new admissions within a poll
