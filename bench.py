@@ -36,23 +36,31 @@ import numpy as np
 
 ROUND1_GPT_TOKENS_PER_SEC = 47224.8
 
-# bf16 peak FLOP/s per chip by device kind (public figures)
-PEAK_FLOPS = {
-    "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,
-    "TPU v5litepod": 197e12,
-    "TPU v5e": 197e12,
-    "TPU v5": 459e12,
-    "TPU v5p": 459e12,
-    "TPU v6 lite": 918e12,
-    "TPU v6e": 918e12,
-}
 
+def _ledger_append(workload: str, value: float, unit: str, **kw):
+    """Append the canonical trajectory row (tools/bench_ledger.py).
+    Best-effort by contract: the measurement already printed; a ledger
+    hiccup must never cost the driver its line."""
+    try:
+        from tools import bench_ledger
+        bench_ledger.append("bench", workload, value, unit, **kw)
+    except Exception as e:  # noqa: BLE001
+        print(f"bench: ledger append failed: {e}", file=sys.stderr)
 
 def chip_peak_flops():
+    """bf16 peak FLOP/s of the attached chip, or None (CPU/unknown —
+    mfu reads null). One table for the whole repo: the live roofline
+    gauges and the bench MFU column must agree on the denominator
+    (observability/perf.py PEAK_TABLE; FLAGS.perf_peak_flops
+    overrides both)."""
     import jax
+    from paddle_tpu.core import flags as _flags
+    from paddle_tpu.observability.perf import peak_flops_for
+    override = float(_flags.get_flag("perf_peak_flops") or 0.0)
+    if override > 0:
+        return override
     d = jax.devices()[0]
-    return PEAK_FLOPS.get(getattr(d, "device_kind", ""), None)
+    return peak_flops_for(getattr(d, "device_kind", ""))
 
 
 def param_count(net) -> int:
@@ -750,6 +758,13 @@ def main():
             if hw:
                 rec["last_hw_sweep"] = hw
         print(json.dumps(rec))
+        _ledger_append(metric, gpt["value"], "tokens/sec",
+                       tokens_per_sec=gpt["value"],
+                       mfu=gpt.get("mfu"),
+                       backend=jax.devices()[0].device_kind,
+                       extra={"batch": gpt.get("batch"),
+                              "model": gpt.get("model"),
+                              "vs_baseline": vs})
     except Exception as e:  # never leave the driver without a line
         print(json.dumps({"metric": metric, "value": 0.0,
                           "unit": "tokens/sec", "vs_baseline": 0.0,
@@ -774,6 +789,15 @@ def _steps_per_loop_cli():
     rec["device"] = jax.devices()[0].device_kind
     print(json.dumps(rec))
     sys.stdout.flush()
+    best = max(rec["rows"], key=lambda r: r["tokens_per_sec"])
+    _ledger_append("train_loop_dispatch_sweep",
+                   best["tokens_per_sec"], "tokens/sec",
+                   tokens_per_sec=best["tokens_per_sec"],
+                   backend=rec["device"],
+                   extra={"steps_per_loop": best["steps_per_loop"],
+                          "speedup_vs_k1": best.get("speedup_vs_k1"),
+                          "ks": [r["steps_per_loop"]
+                                 for r in rec["rows"]]})
 
 
 if __name__ == "__main__":

@@ -179,6 +179,25 @@ define_flag("numeric_guard", False,
             "exact no-op updates. Off: the compiled program carries "
             "no guard ops and the train path pays one attribute "
             "check.")
+define_flag("perf_observability", True,
+            "Arm the continuous perf observability registry "
+            "(observability/perf.py): XLA cost analysis captured once "
+            "per compiled program signature + measured dispatch wall "
+            "time -> live perf_mfu / perf_hbm_bw_util / "
+            "perf_flops_per_second gauges and the GET /perfz "
+            "breakdown. Off: the train/serving hot paths pay one "
+            "module-flag check and record nothing (pinned like "
+            "tracing; read at import — flip at runtime with "
+            "observability.perf.enable()/disable()).")
+define_flag("perf_peak_flops", 0.0,
+            "Override the per-backend peak FLOP/s table used as the "
+            "MFU denominator (observability/perf.py PEAK_TABLE) — the "
+            "knob for TPU generations the table does not know, or for "
+            "derated fleet SKUs. 0 keeps the table (CPU falls back to "
+            "a nominal placeholder).")
+define_flag("perf_peak_hbm_gbps", 0.0,
+            "Override peak HBM bandwidth in GB/s for the "
+            "perf_hbm_bw_util denominator. 0 keeps the table/fallback.")
 define_flag("compilation_cache_dir", "",
             "Persistent XLA compilation cache directory (jax "
             "jax_compilation_cache_dir), enabled at Model.prepare() "

@@ -20,7 +20,9 @@ concrete program.
 
 from __future__ import annotations
 
+import threading
 import time
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -87,6 +89,100 @@ class CostModel:
                         **kw) -> ProgramCost:
         """Name parity with the reference's measuring entry point."""
         return self.profile(fn, args, measure=True, **kw)
+
+
+def extract_cost_analysis(lowered_or_compiled) -> Optional[Dict[str, float]]:
+    """Normalize XLA's cost analysis (object, per-device list, or
+    absent depending on backend/jax version) into a flat
+    ``{metric: float}`` dict. Accepts a ``jax.stages.Lowered`` or
+    ``Compiled``; deliberately NEVER calls ``.compile()`` on a
+    Lowered — ``Lowered.cost_analysis()`` reads the pre-optimization
+    HLO (measured: ~10 ms after the trace), whereas a second compile
+    re-pays most of the program's original XLA compile (the in-memory
+    executable cache is per-call-site and the persistent cache
+    defaults off). Returns None instead of raising when the backend
+    reports nothing usable — the caller counts the failure
+    (``perf_cost_analysis_failures_total``), it must never take the
+    serving/train loop down."""
+    try:
+        analysis = lowered_or_compiled.cost_analysis()
+        if isinstance(analysis, list):   # per-device list on pmap
+            analysis = analysis[0] if analysis else None
+        if not analysis:
+            return None
+        out = {k: float(v) for k, v in analysis.items()
+               if isinstance(v, (int, float))}
+        return out or None
+    except Exception:  # noqa: BLE001 — absent analysis is data, not a bug
+        return None
+
+
+class ProgramCostCache:
+    """Signature-keyed cache over :func:`extract_cost_analysis` so
+    /perfz lookups never re-lower: each program signature runs its
+    lowering thunk AT MOST ONCE ever — success and failure (None) are
+    both cached. Bounded with the same 4096-cap discipline as
+    ``Model._guard_recompiles`` (LRU eviction past the cap, so a
+    pathological dynamic-shape run degrades to re-lowering its oldest
+    signatures instead of growing host memory without bound)."""
+
+    CAP = 4096
+
+    def __init__(self, cap: int = CAP):
+        self.cap = int(cap)
+        self._mu = threading.Lock()
+        self._entries: "OrderedDict[Any, Optional[Dict[str, float]]]" \
+            = OrderedDict()
+
+    def get(self, key) -> Tuple[bool, Optional[Dict[str, float]]]:
+        with self._mu:
+            if key in self._entries:
+                self._entries.move_to_end(key)
+                return True, self._entries[key]
+            return False, None
+
+    def get_or_compute(self, key,
+                       lower: Callable[[], Any]
+                       ) -> Optional[Dict[str, float]]:
+        """Cached analysis for ``key``, computing it from the ``lower``
+        thunk on first sight. A thunk that raises caches None (counted
+        by the caller) — the failure is as sticky as a success, so a
+        broken backend is asked exactly once."""
+        hit, val = self.get(key)
+        if hit:
+            return val
+        try:
+            analysis = extract_cost_analysis(lower())
+        except Exception:  # noqa: BLE001 — trace/lower failure is data
+            analysis = None
+        with self._mu:
+            if key not in self._entries:
+                self._entries[key] = analysis
+                while len(self._entries) > self.cap:
+                    self._entries.popitem(last=False)
+            return self._entries[key]
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._entries)
+
+    def clear(self) -> None:
+        with self._mu:
+            self._entries.clear()
+
+
+_program_cost_cache: Optional[ProgramCostCache] = None
+_program_cost_cache_mu = threading.Lock()
+
+
+def program_cost_cache() -> ProgramCostCache:
+    """Process-wide cache instance (observability.perf resolves
+    program costs through it)."""
+    global _program_cost_cache
+    with _program_cost_cache_mu:
+        if _program_cost_cache is None:
+            _program_cost_cache = ProgramCostCache()
+        return _program_cost_cache
 
 
 @dataclass

@@ -35,6 +35,7 @@ from ..io import DataLoader, Dataset
 from ..metric import Metric
 from ..nn.layer import Layer, functional_call, split_state
 from ..observability import metrics as _obs
+from ..observability import perf as _perf
 from ..observability import tracing as _trace
 from ..optimizer.optimizer import Optimizer
 from ..reliability import faults as _faults
@@ -95,6 +96,16 @@ def _as_tuple(x):
     if isinstance(x, (list, tuple)):
         return tuple(x)
     return (x,)
+
+
+def _shape_signature(inputs, labels) -> Tuple:
+    """The (shape, dtype) tuple per input/label leaf that identifies
+    one compiled program — built ONCE per step and shared by the
+    recompile guard, the perf cost registry, and the guard's abort
+    fingerprint (three consumers, one construction)."""
+    return tuple(
+        (tuple(np.shape(a)), str(getattr(a, "dtype", type(a))))
+        for a in (*inputs, *labels))
 
 
 class _FloatView:
@@ -301,6 +312,12 @@ class Model:
         # observability handles, created lazily on the first step
         self._obs = None
         self._obs_loop = None
+        # perf cost registry handles (observability/perf.py): one per
+        # compiled train-step/loop signature, keyed by the same shape
+        # tuples _guard_recompiles tracks (same 4096-cap discipline);
+        # the scope token keeps this Model's programs distinct from
+        # any other owner's in the process-wide registry
+        self._reset_perf_scope()
 
     # -- preparation --------------------------------------------------------
     def prepare(self, optimizer: Optional[Optimizer] = None, loss=None,
@@ -337,6 +354,12 @@ class Model:
         self._eval_step_fn = None
         self._predict_fn = None
         self._metric_pending.clear()
+        # re-prepare rebuilds the compiled programs (optimizer/loss/
+        # metrics changed → different FLOPs): stale perf handles would
+        # attribute the NEW program's dispatches to the OLD program's
+        # cached cost analysis, and the dead entries would leak toward
+        # PROGRAM_CAP
+        self._reset_perf_scope()
         _enable_compilation_cache(flags.get_flag("compilation_cache_dir"))
         self._register_status_provider()
 
@@ -650,7 +673,8 @@ class Model:
         recompile guard and io.sequence bucketing bound)."""
         return len(self._shape_signatures)
 
-    def _guard_recompiles(self, inputs, labels, kind: str = "step") -> bool:
+    def _guard_recompiles(self, inputs, labels, kind: str = "step",
+                          sig_items: Optional[Tuple] = None) -> bool:
         """Every distinct input shape recompiles the jitted step (XLA
         static shapes — SURVEY §7 hard parts). Track the signatures seen
         and warn once past FLAGS.recompile_warn_threshold, pointing at
@@ -671,9 +695,9 @@ class Model:
         seen = self._shape_signatures
         if len(seen) >= 4096:
             return False
-        sig = (kind,) + tuple(
-            (tuple(np.shape(a)), str(getattr(a, "dtype", type(a))))
-            for a in (*inputs, *labels))
+        if sig_items is None:
+            sig_items = _shape_signature(inputs, labels)
+        sig = (kind,) + sig_items
         if sig in seen:
             return False
         seen.add(sig)
@@ -687,6 +711,47 @@ class Model:
                 f"FLAGS.recompile_warn_threshold if intentional.",
                 stacklevel=3)
         return True
+
+    def _reset_perf_scope(self) -> None:
+        """Fresh perf-registry scope + GC finalizer — ``__init__`` and
+        every re-prepare share this one block: the old scope's entries
+        are released (a discarded/re-prepared Model must not leak
+        toward PROGRAM_CAP or keep stale cost entries), and the
+        finalizer backstops Models dropped without either path."""
+        old = getattr(self, "_perf_scope", None)
+        if old is not None:
+            if self._perf_programs:
+                _perf.instance().remove_scope(old)
+            self._perf_finalizer.detach()
+        self._perf_programs = {}
+        self._perf_scope = _perf.next_scope()
+        self._perf_finalizer = _perf.finalize_scope(
+            self, self._perf_scope)
+
+    def _perf_program(self, kind: str, sig_items: Tuple, fn, args,
+                      steps: int):
+        """(handle, fresh) for this (kind, input-signature) compiled
+        program in the perf cost registry (observability/perf.py).
+        Registration — once per signature — converts ``args`` to an
+        ABSTRACT signature immediately (no device buffers retained
+        past the donating call) for the one-time XLA cost-analysis
+        lowering. ``fresh`` is True the first time perf sees the
+        signature (= a compile is coming), tracked HERE so compile
+        attribution stays correct even when the recompile-warning
+        guard is opted out (FLAGS.recompile_warn_threshold=0).
+        Steady state is a dict hit; callers gate the whole path on
+        ``_perf.enabled()`` (one flag check when disabled)."""
+        key = (kind,) + sig_items
+        if key in self._perf_programs:
+            return self._perf_programs[key], False
+        if len(self._perf_programs) >= _perf.PROGRAM_CAP:
+            return None, False
+        h = _perf.register_program(
+            "train", kind, sig=sig_items,
+            lower=_perf.make_lower(fn, args), steps=steps,
+            scope=self._perf_scope)
+        self._perf_programs[key] = h
+        return h, True
 
     # -- numeric-guard plumbing ---------------------------------------------
     def _maybe_poison_batch(self, inputs, k: int):
@@ -785,7 +850,14 @@ class Model:
         labels = _as_tuple(labels) if labels is not None else ()
         if _faults.enabled():
             inputs = self._maybe_poison_batch(inputs, 1)
-        fresh_shape = self._guard_recompiles(inputs, labels)
+        # one signature build serves the recompile guard, the perf
+        # registry, and the guard fingerprint; None when every
+        # consumer is off
+        sig_items = _shape_signature(inputs, labels) \
+            if (_perf.enabled() or self._guard is not None
+                or flags.get_flag("recompile_warn_threshold")) else None
+        fresh_shape = self._guard_recompiles(inputs, labels,
+                                             sig_items=sig_items)
         if self._obs is None:
             self._obs = _train_metrics()
         batch_n = np.shape(inputs[0])[0] if inputs and np.ndim(
@@ -793,14 +865,13 @@ class Model:
         if self._guard is not None:
             # abort-fingerprint capture: guard-armed runs only — the
             # disabled path stays one attribute check
-            self._last_batch_shapes = [
-                (tuple(np.shape(a)), str(getattr(a, "dtype", type(a))))
-                for a in (*inputs, *labels)]
+            self._last_batch_shapes = list(sig_items)
         sp = _trace.start_span(
             "train.step", attrs={"batch": batch_n,
                                  "step": self._step_count}) \
             if _trace.enabled() else None
         t0 = time.perf_counter()
+        perf_h, perf_fresh = None, False
         try:
             if self._shard_batch is not None:
                 inputs = self._shard_batch(inputs)
@@ -809,19 +880,27 @@ class Model:
             if self._guard is not None:
                 if self._guard_state is None:
                     self._guard_state = self._guard.device_state()
+                call_args = (self._params, self._frozen,
+                             self._opt_state, dict(self._buffers),
+                             self._guard_state, self._step_count, key,
+                             inputs, labels, self._grad_poison(1)[0])
+                if _perf.enabled():
+                    perf_h, perf_fresh = self._perf_program(
+                        "step", sig_items, self._train_step_fn,
+                        call_args, 1)
                 loss, self._params, self._opt_state, self._buffers, \
                     self._guard_state, (verdict, gnorm), metric_outs = \
-                    self._train_step_fn(
-                        self._params, self._frozen, self._opt_state,
-                        dict(self._buffers), self._guard_state,
-                        self._step_count, key, inputs, labels,
-                        self._grad_poison(1)[0])
+                    self._train_step_fn(*call_args)
             else:
+                call_args = (self._params, self._frozen,
+                             self._opt_state, self._buffers,
+                             self._step_count, key, inputs, labels)
+                if _perf.enabled():
+                    perf_h, perf_fresh = self._perf_program(
+                        "step", sig_items, self._train_step_fn,
+                        call_args, 1)
                 loss, self._params, self._opt_state, self._buffers, \
-                    metric_outs = self._train_step_fn(
-                        self._params, self._frozen, self._opt_state,
-                        self._buffers, self._step_count, key, inputs,
-                        labels)
+                    metric_outs = self._train_step_fn(*call_args)
         except BaseException:
             # a caught-and-skipped bad batch must not leak a live span
             # (the _live registry is uncapped, unlike the finished ring)
@@ -832,6 +911,18 @@ class Model:
         self._step_count += 1
         dt = time.perf_counter() - t0
         self._obs["step"].observe(dt)
+        if _perf.enabled():
+            # the SAME dt the histogram observes feeds the roofline
+            # registry — no extra clocks, no host syncs. Compile steps
+            # (perf_fresh: first sight of this signature, tracked
+            # independently of the recompile-warning opt-out) go to
+            # their own phase and are excluded from the program's MFU
+            # accounting (a compile is not a dispatch).
+            compiling = fresh_shape or perf_fresh
+            _perf.record_phase(
+                "train", "compile" if compiling else "dispatch", dt)
+            if perf_h is not None and not compiling:
+                perf_h.record(dt)
         if fresh_shape:
             self._obs["compile_count"].inc()
             self._obs["compile"].observe(dt)
@@ -878,21 +969,25 @@ class Model:
         k = int(np.shape(inputs[0])[0])
         if _faults.enabled():
             inputs = self._maybe_poison_batch(inputs, k)
-        fresh_shape = self._guard_recompiles(inputs, labels, kind="loop")
+        sig_items = _shape_signature(inputs, labels) \
+            if (_perf.enabled() or self._guard is not None
+                or flags.get_flag("recompile_warn_threshold")) else None
+        fresh_shape = self._guard_recompiles(inputs, labels,
+                                             kind="loop",
+                                             sig_items=sig_items)
         if self._obs is None:
             self._obs = _train_metrics()
         if self._obs_loop is None:
             self._obs_loop = _loop_metrics()
         batch_n = np.shape(inputs[0])[1] if np.ndim(inputs[0]) > 1 else 0
         if self._guard is not None:
-            self._last_batch_shapes = [
-                (tuple(np.shape(a)), str(getattr(a, "dtype", type(a))))
-                for a in (*inputs, *labels)]
+            self._last_batch_shapes = list(sig_items)
         sp = _trace.start_span(
             "train.dispatch", attrs={"k": k, "batch": batch_n,
                                      "step0": self._step_count}) \
             if _trace.enabled() else None
         t0 = time.perf_counter()
+        perf_h, perf_fresh = None, False
         try:
             if self._shard_superbatch is not None:
                 inputs = self._shard_superbatch(inputs)
@@ -901,23 +996,32 @@ class Model:
             if self._guard is not None:
                 if self._guard_state is None:
                     self._guard_state = self._guard.device_state()
+                call_args = (self._params, self._frozen,
+                             self._opt_state, dict(self._buffers),
+                             self._guard_state, self._step_count,
+                             base_key, inputs, labels,
+                             self._grad_poison(k))
+                if _perf.enabled():
+                    perf_h, perf_fresh = self._perf_program(
+                        "loop", sig_items, self._train_loop_fn,
+                        call_args, k)
                 losses, self._params, self._opt_state, self._buffers, \
                     self._guard_state, (verdicts, gnorms), metric_outs \
-                    = self._train_loop_fn(
-                        self._params, self._frozen, self._opt_state,
-                        dict(self._buffers), self._guard_state,
-                        self._step_count, base_key, inputs, labels,
-                        self._grad_poison(k))
+                    = self._train_loop_fn(*call_args)
             else:
+                # plain dict buffers: the per-step path may have left
+                # an OrderedDict here, and the scan carry's pytree
+                # type must match the body's output (a plain dict)
+                call_args = (self._params, self._frozen,
+                             self._opt_state, dict(self._buffers),
+                             self._step_count, base_key, inputs,
+                             labels)
+                if _perf.enabled():
+                    perf_h, perf_fresh = self._perf_program(
+                        "loop", sig_items, self._train_loop_fn,
+                        call_args, k)
                 losses, self._params, self._opt_state, self._buffers, \
-                    metric_outs = self._train_loop_fn(
-                        self._params, self._frozen, self._opt_state,
-                        # plain dict: the per-step path may have left an
-                        # OrderedDict here, and the scan carry's pytree
-                        # type must match the body's output (a plain
-                        # dict)
-                        dict(self._buffers), self._step_count, base_key,
-                        inputs, labels)
+                    metric_outs = self._train_loop_fn(*call_args)
         except BaseException:
             if sp is not None:
                 sp.set_status("error")
@@ -928,6 +1032,12 @@ class Model:
         self._obs_loop["dispatch"].observe(dt)
         self._obs_loop["slab"].observe(k)
         self._obs["step"].observe(dt / k)
+        if _perf.enabled():
+            compiling = fresh_shape or perf_fresh
+            _perf.record_phase(
+                "train", "compile" if compiling else "dispatch", dt)
+            if perf_h is not None and not compiling:
+                perf_h.record(dt)
         if fresh_shape:
             self._obs["compile_count"].inc()
             self._obs["compile"].observe(dt)
@@ -1025,7 +1135,12 @@ class Model:
                     sp.end()
             if self._obs_loop is None:
                 self._obs_loop = _loop_metrics()
-            self._obs_loop["drain"].observe(time.perf_counter() - t0)
+            drain_dt = time.perf_counter() - t0
+            self._obs_loop["drain"].observe(drain_dt)
+            if _perf.enabled():
+                # the deferred device→host sync: the "transfer/drain"
+                # leg of the /perfz step-time breakdown
+                _perf.record_phase("train", "drain", drain_dt)
         if self._guard_pending or self._nan_pending:
             self._drain_guard_checks()
 

@@ -47,6 +47,7 @@ import numpy as np
 from ..core import flags as _flags
 from ..nn.layer import Layer, functional_call, split_state
 from ..observability import metrics as _obs
+from ..observability import perf as _perf
 from ..observability import propagation as _propagation
 from ..observability import server as _dbgsrv
 from ..observability import tracing as _trace
@@ -211,6 +212,16 @@ def _engine_metrics():
             "submitted requests not yet admitted (new submissions "
             "shed at max_pending; device-error re-admissions re-enter "
             "above it, so the ceiling is max_pending + max_seqs)"),
+        # served-FLOPs attribution (the cost denominator SLO classes
+        # get): analytic 2*N_params FLOPs per COMPUTED token — cached
+        # prefix tokens cost ~0 and are excluded; counted once, at the
+        # completed/truncated finish (a failed-over request charges
+        # only the replica that actually finished it)
+        "served_flops": reg.counter(
+            "llm_served_flops_total",
+            "analytic forward FLOPs served to finished requests "
+            "(2*N_params per computed prompt/output token), by tenant",
+            label_names=("tenant",)),
     }
 
 
@@ -523,7 +534,8 @@ class _Request:
                  "nonce", "prefill_pos", "prefill_done", "digests",
                  "n_cached", "n_reg_pages", "spans", "deadline",
                  "priority", "req_id", "admit_attempts",
-                 "device_retries", "cancelled", "queued", "t_enqueued")
+                 "device_retries", "cancelled", "queued", "t_enqueued",
+                 "tenant")
 
     def __init__(self, prompt, max_new_tokens, temperature):
         self.prompt = list(map(int, prompt))
@@ -576,6 +588,8 @@ class _Request:
         # it, so admit_timeout bounds time-in-queue, not request age
         self.queued = False
         self.t_enqueued = self.t_submit
+        # served-FLOPs attribution label (router/serve_llm passthrough)
+        self.tenant: Optional[str] = None
 
 
 def _engine_status_provider(ref):
@@ -607,6 +621,7 @@ def _engine_status_provider(ref):
             "lookahead": eng.lookahead,
             "decode_ticks_per_dispatch": eng.decode_ticks_per_dispatch,
             "host_dispatches": eng.n_host_dispatches,
+            "flops_per_token": eng.flops_per_token,
             "n_steps": eng.n_steps,
             "n_tokens": eng.n_tokens,
             "prompt_tokens": eng.n_prompt_tokens,
@@ -773,6 +788,24 @@ class LLMEngine:
         # from per-tick ("decode_step") and prefill signatures, so an
         # N-knob sweep can't silently blow the 4096 cap
         self._shape_signatures: set = set()
+        # perf cost-registry handles (observability/perf.py), one per
+        # compiled engine program — decode tick, fused slab per
+        # realized length, prefill chunk (speculative engines skip:
+        # their round structure has no stable per-dispatch program).
+        # _perf_skipped marks each program's first drained fetch (the
+        # one that blocked on ITS XLA compile) so compile time lands
+        # in the "compile" phase, not the program's MFU denominator.
+        self._perf_programs: Dict[tuple, object] = {}
+        self._perf_skipped: set = set()
+        self._perf_scope = _perf.next_scope()
+        # GC finalizer mirrors close()'s explicit cleanup for engines
+        # that are dropped without closing (idempotent — remove_scope
+        # of an already-removed scope is a no-op)
+        _perf.finalize_scope(self, self._perf_scope)
+        # chunk dispatches not yet attributed: a "p" record only
+        # exists for FINISHING chunks, so the drain scales that
+        # record's FLOPs by every chunk dispatched since the last one
+        self._perf_chunks_unattributed = 0
         # (issue_seq, slots, tokens, kind, meta): kind "p" = prefill
         # first-token record, "d" = one decode tick, "D" = fused slab
         # ([n_ticks, max_seqs] tokens; meta carries the host copy of
@@ -855,6 +888,15 @@ class LLMEngine:
         # all wrappers share `net` as their only sublayer, so one
         # "net."-prefixed param dict serves decode and prefill alike
         self._params, self._buffers = split_state(decode)
+        # analytic marginal cost of ONE token through the model
+        # (2*N_params forward FLOPs): the served-FLOPs attribution
+        # unit. Shapes only — no device sync. XLA-counted program
+        # FLOPs are the roofline numerator instead; per-request
+        # attribution uses the analytic figure because the compiled
+        # programs always compute all max_seqs padded slots, which
+        # would overcharge a lone request (docs/OBSERVABILITY.md).
+        self.flops_per_token = 2.0 * float(
+            sum(int(np.prod(v.shape)) for v in self._params.values()))
 
         def decode_fn(params, buffers, tokens, positions, tables, lens,
                       kp, vp, temps, nonces, key):
@@ -1035,7 +1077,8 @@ class LLMEngine:
                temperature: float = 0.0,
                deadline=None, priority: int = 0,
                nonce: Optional[int] = None,
-               trace_context=None) -> Future:
+               trace_context=None,
+               tenant: Optional[str] = None) -> Future:
         """``nonce``: pin the sampling-key salt instead of using this
         engine's submission counter. Sampling keys depend only on
         (nonce, position), so two identically-seeded engines given the
@@ -1075,6 +1118,10 @@ class LLMEngine:
         req = _Request(prompt_ids, max_new_tokens, temperature)
         req.deadline = as_deadline(deadline)
         req.priority = int(priority)
+        # tenant label for served-FLOPs attribution
+        # (llm_served_flops_total{tenant}; the fleet router and
+        # serve_llm bodies pass it through)
+        req.tenant = str(tenant) if tenant else None
         # resolved once, outside the lock: the remote parent (if any)
         # for this request's span tree — cross-process propagation
         remote_ctx = (_propagation.context_from(trace_context)
@@ -1165,6 +1212,11 @@ class LLMEngine:
         _dbgsrv.unregister_status_provider(self._status_name)
         _dbgsrv.unregister_health_provider(self._status_name)
         _dbgsrv.unregister_reset_handler(self._status_name)
+        # drop this engine's perf-registry programs: a process
+        # creating engines in a loop must not fill PROGRAM_CAP with
+        # dead entries (already-windowed events stay — real work)
+        _perf.instance().remove_scope(self._perf_scope)
+        self._perf_programs.clear()
         with self._mu:
             self._closed = True
         self._wake.set()
@@ -1280,12 +1332,26 @@ class LLMEngine:
             self._m["truncated"].inc()
         else:
             self._m["completed"].inc()
+        # served-FLOPs attribution: analytic marginal cost of the
+        # COMPUTED tokens (cached prefix tokens cost ~0 and are
+        # excluded). Counted exactly once, here at the finish — a
+        # nonce-pinned failover charges only the replica that finished
+        # (the crashed sibling never reached this line).
+        served = self.flops_per_token * max(
+            0, len(req.prompt) - req.n_cached + len(req.tokens))
+        self._m["served_flops"].labels(req.tenant or "default").inc(
+            served)
+        if req.spans is not None:
+            req.spans["root"].set_attr("served_flops", served)
+            if req.tenant:
+                req.spans["root"].set_attr("tenant", req.tenant)
         self._end_request_spans(
             req, "truncated" if req.truncated else "completed")
         req.future.set_result({
             "prompt_ids": req.prompt,
             "output_ids": req.tokens,
             "truncated": req.truncated,
+            "served_flops": served,
             "ttft_s": (req.t_first - req.t_submit)
             if req.t_first else None,
             "latency_s": req.t_done - req.t_submit,
@@ -1406,6 +1472,72 @@ class LLMEngine:
                 f"FLAGS.recompile_warn_threshold if intentional.",
                 stacklevel=3)
         return True
+
+    def _perf_program(self, kind: str, sig: tuple, fn, args,
+                      steps: int = 1):
+        """Engine analog of ``Model._perf_program``: register this
+        compiled program in the perf cost registry
+        (observability/perf.py) once per (kind, sig). ``args`` is the
+        EXACT dispatch argument tuple — converted to an abstract
+        signature immediately, so no device buffer outlives the
+        donating call. Callers gate on ``_perf.enabled()``."""
+        key = (kind,) + tuple(sig)
+        h = self._perf_programs.get(key)
+        if h is None and key not in self._perf_programs \
+                and len(self._perf_programs) < _perf.PROGRAM_CAP:
+            h = _perf.register_program("llm", kind, sig=tuple(sig),
+                                       lower=_perf.make_lower(fn, args),
+                                       steps=steps,
+                                       scope=self._perf_scope)
+            self._perf_programs[key] = h
+        return h
+
+    def _perf_attribute(self, kind: str, host_shape0: int,
+                        emitted: int) -> None:
+        """Attribute the fetch-to-fetch wall interval to the drained
+        record's compiled program + breakdown phase. The interval is
+        the SAME quantity ``_observe_step`` measures (no added clocks
+        or syncs); each program's first fetch — the one that blocked
+        on its XLA compile — goes to the "compile" phase instead of
+        its MFU accounting. A "p" record covers EVERY chunk
+        dispatched since the last one (non-finishing chunks push no
+        record), so its FLOPs side scales by that count. Under
+        interleaved prefill+decode the phase split is approximate by
+        construction (a chunk issued between decode fetches folds
+        into the adjacent decode interval); the per-program FLOPs
+        accounting stays exact."""
+        n = 1
+        if kind == "D":
+            pkey = ("decode_loop", host_shape0)
+        elif kind == "d":
+            pkey = ("decode_step",)
+        else:
+            pkey = ("prefill_chunk",)
+            # consume the chunk count even when the interval below is
+            # unmeasurable: dispatches drained across an idle gap are
+            # simply lost (their interval is too), never carried into
+            # a later record whose interval doesn't cover them
+            n = max(1, self._perf_chunks_unattributed)
+            self._perf_chunks_unattributed = 0
+        if pkey not in self._perf_skipped:
+            # the program's first drained record blocked on ITS
+            # compile — marked even when unmeasurable, so a post-idle
+            # first record can't shift the compile-skip onto a real
+            # dispatch interval
+            self._perf_skipped.add(pkey)
+            if self._last_fetch_t is not None:
+                _perf.record_phase(
+                    "llm", "compile",
+                    time.monotonic() - self._last_fetch_t)
+            return
+        if self._last_fetch_t is None:
+            return
+        pdt = time.monotonic() - self._last_fetch_t
+        h = self._perf_programs.get(pkey)
+        if h is not None:
+            h.record(pdt, tokens=emitted, dispatches=n)
+        _perf.record_phase(
+            "llm", "prefill" if kind == "p" else "decode", pdt)
 
     def _count_dispatch(self, n: int = 1) -> None:
         """One engine-loop jit dispatch reached the device (the
@@ -1651,13 +1783,18 @@ class LLMEngine:
         if _faults.enabled():
             _faults.check("device.dispatch")
         self._guard_recompiles("prefill")
-        nxt, self.k_pages, self.v_pages = self._chunk_fn(
-            self._params, self._buffers, jnp.asarray(tok),
-            jnp.asarray(pos), jnp.asarray(lim), jnp.asarray(tbl),
-            jnp.asarray(sample_idx), jnp.asarray(sample_pos),
-            self.k_pages, self.v_pages,
-            jnp.asarray(self.temperatures),
-            jnp.asarray(self._nonces), self._key)
+        chunk_args = (self._params, self._buffers, jnp.asarray(tok),
+                      jnp.asarray(pos), jnp.asarray(lim),
+                      jnp.asarray(tbl), jnp.asarray(sample_idx),
+                      jnp.asarray(sample_pos),
+                      self.k_pages, self.v_pages,
+                      jnp.asarray(self.temperatures),
+                      jnp.asarray(self._nonces), self._key)
+        if _perf.enabled():
+            self._perf_program("prefill_chunk", (), self._chunk_fn,
+                               chunk_args)
+            self._perf_chunks_unattributed += 1
+        nxt, self.k_pages, self.v_pages = self._chunk_fn(*chunk_args)
         self._count_dispatch()
         if finishing:
             mask = np.zeros((self.max_seqs,), bool)
@@ -2003,12 +2140,15 @@ class LLMEngine:
         if _faults.enabled():
             _faults.check("device.dispatch")
         self._guard_recompiles("decode_step")
-        tokens, self.k_pages, self.v_pages = self._decode_fn(
-            self._params, self._buffers,
-            self._tokens_dev, jnp.asarray(positions),
-            jnp.asarray(self.block_tables), jnp.asarray(lens),
-            self.k_pages, self.v_pages, jnp.asarray(self.temperatures),
-            jnp.asarray(self._nonces), self._key)
+        args = (self._params, self._buffers,
+                self._tokens_dev, jnp.asarray(positions),
+                jnp.asarray(self.block_tables), jnp.asarray(lens),
+                self.k_pages, self.v_pages,
+                jnp.asarray(self.temperatures),
+                jnp.asarray(self._nonces), self._key)
+        if _perf.enabled():
+            self._perf_program("decode_step", (), self._decode_fn, args)
+        tokens, self.k_pages, self.v_pages = self._decode_fn(*args)
         self._count_dispatch()
         self._tokens_dev = tokens
         self._issue_seq += 1
@@ -2105,11 +2245,14 @@ class LLMEngine:
             tokens=self._tokens_dev, positions=jnp.asarray(pos_arr),
             budgets=jnp.asarray(bud_arr), k_pages=self.k_pages,
             v_pages=self.v_pages)
-        toks, carry = self._slab_fn(
-            self._params, self._buffers, carry,
-            jnp.asarray(self.block_tables),
-            jnp.asarray(self.temperatures),
-            jnp.asarray(self._nonces), self._key, n_eff)
+        slab_args = (self._params, self._buffers, carry,
+                     jnp.asarray(self.block_tables),
+                     jnp.asarray(self.temperatures),
+                     jnp.asarray(self._nonces), self._key, n_eff)
+        if _perf.enabled():
+            self._perf_program("decode_loop", (n_eff,), self._slab_fn,
+                               slab_args, steps=n_eff)
+        toks, carry = self._slab_fn(*slab_args)
         self._count_dispatch()
         self._tokens_dev = carry.tokens
         self.k_pages, self.v_pages = carry.k_pages, carry.v_pages
@@ -2190,6 +2333,9 @@ class LLMEngine:
                     continue  # overrun token of a finished request
                 self._deliver_token(slot, req, int(host[slot]), seq)
                 emitted += 1
+        if _perf.enabled():
+            self._perf_attribute(kind, host.shape[0] if kind == "D"
+                                 else 0, emitted)
         self._observe_step(emitted, timed=(kind != "p"))
         self._maybe_finalize()
 

@@ -34,6 +34,7 @@ from .metrics import (BYTE_BUCKETS, DEFAULT_BUCKETS,  # noqa: F401
 from .exporters import (JSONLReporter, export_chrome_tracing,  # noqa: F401
                         prometheus_text, sample_device_memory,
                         write_prometheus)
+from . import perf  # noqa: F401
 from . import propagation  # noqa: F401
 from . import tracing  # noqa: F401
 from .tracing import Span, SpanContext, start_span  # noqa: F401
@@ -57,6 +58,7 @@ __all__ = [
     "MetricFamily", "MetricRegistry", "default_registry",
     "JSONLReporter", "export_chrome_tracing", "prometheus_text",
     "sample_device_memory", "write_prometheus",
+    "perf",
     "tracing", "Span", "SpanContext", "start_span", "trace_span",
     "enable_tracing", "disable_tracing", "tracing_enabled",
     "propagation", "TRACEPARENT_HEADER", "format_traceparent",

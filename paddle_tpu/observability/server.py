@@ -17,6 +17,11 @@ profiler window):
   ``?trace_id=`` filters to one request's spans — the cross-process
   query the fleet trace merge and operators use). Spans carry
   ``ts_wall`` so snapshots from different processes align.
+- ``GET /perfz``    — live roofline view (observability.perf): MFU /
+  HBM-bandwidth-utilization / FLOPs-rate over a sliding window, the
+  per-program cost table (XLA FLOPs + bytes per compiled signature),
+  and the step-time breakdown per component (train dispatch vs
+  compile vs drain; llm decode vs prefill).
 - ``GET /fleetz``   — fleet view (registered by a serving Router):
   per-replica health/breaker/scrape digest + computed aggregates;
   404 when this process fronts no fleet.
@@ -50,6 +55,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional
 from urllib.parse import parse_qs, urlparse
 
+from . import perf as _perf
 from . import tracing
 from .exporters import prometheus_text, sample_device_memory
 from .metrics import MetricRegistry, default_registry
@@ -324,6 +330,15 @@ class DebugServer:
     def _get(self, h) -> None:
         url = urlparse(h.path)
         if url.path == "/metrics":
+            # refresh the live roofline gauges so a bare /metrics
+            # scrape (the fleet federation path) carries current
+            # perf_mfu/bw values without needing a /perfz hit first;
+            # resolved costs only — a scrape never lowers a program
+            if _perf.enabled():
+                try:
+                    _perf.instance().update_gauges()
+                except Exception:  # noqa: BLE001 — scrape must answer
+                    pass
             text = prometheus_text(self.registry)
             # registered scrape providers (fleet federation) append
             # their blocks; a broken provider must not kill the scrape
@@ -365,12 +380,17 @@ class DebugServer:
                 devmem = sample_device_memory(self.registry)
             except Exception as e:  # noqa: BLE001 — no backend yet
                 devmem = {"error": str(e)}
+            try:
+                perf_row = _perf.status_summary()
+            except Exception as e:  # noqa: BLE001 — one bad row
+                perf_row = {"error": str(e)}
             h._reply_json(200, {
                 "pid": os.getpid(),
                 "uptime_s": round(time.time() - self.t_start, 3),
                 "tracing_enabled": tracing.enabled(),
                 "providers": _collect_status(),
                 "device_memory": devmem,
+                "perf": perf_row,
                 "profilez": self._arm.status()})
         elif url.path == "/tracez":
             # ?limit=N caps the finished spans returned (0 = no cap);
@@ -400,6 +420,14 @@ class DebugServer:
                              for s in fin],
                 "finished_matched": matched,
                 "finished_total": total})
+        elif url.path == "/perfz":
+            # live roofline view: program cost registry (FLOPs/bytes
+            # per compiled signature, resolved at most once each —
+            # cost_model.ProgramCostCache), MFU / HBM-bw / FLOPs-rate
+            # gauges over the sliding window, and the step-time
+            # breakdown per component (docs/OBSERVABILITY.md "Perf
+            # surfaces")
+            h._reply_json(200, _perf.perfz_payload())
         elif url.path == "/fleetz":
             fleets = _collect_dict_providers(_fleet_providers)
             if not fleets:
@@ -422,7 +450,7 @@ class DebugServer:
             h._reply_json(404, {
                 "error": f"unknown path {url.path}",
                 "endpoints": ["/metrics", "/healthz", "/statusz",
-                              "/tracez", "/fleetz", "/sloz",
+                              "/tracez", "/perfz", "/fleetz", "/sloz",
                               "POST /profilez", "POST /reset_health"]})
 
     def _post(self, h) -> None:

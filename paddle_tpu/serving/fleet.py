@@ -145,10 +145,11 @@ class FleetScraper:
     AGGREGATE_SOURCES = ("llm_batch_occupancy", "llm_kv_page_utilization",
                         "llm_prefix_cache_hit_tokens",
                         "llm_prompt_tokens", "llm_tokens_generated",
-                        "llm_requests_completed")
+                        "llm_requests_completed", "perf_mfu",
+                        "perf_flops_per_second")
 
     def __init__(self, registry: Optional[MetricRegistry] = None,
-                 federate_prefixes: Tuple[str, ...] = ("llm_",),
+                 federate_prefixes: Tuple[str, ...] = ("llm_", "perf_"),
                  stale_after: float = 10.0):
         self.registry = registry or default_registry()
         self.federate_prefixes = tuple(federate_prefixes)
@@ -182,6 +183,20 @@ class FleetScraper:
             "fleet_replica_up",
             "1 when the replica's /metrics answered the last scrape",
             label_names=("replica",))
+        self._g_mfu = reg.gauge(
+            "fleet_mfu",
+            "mean perf_mfu across UP replicas that export it — a down "
+            "replica is a HOLE in the mean, never a zero (its capacity "
+            "is gone, not idle); 0 with fleet_mfu_replicas=0 means no "
+            "replica reports MFU yet")
+        self._g_mfu_n = reg.gauge(
+            "fleet_mfu_replicas",
+            "replicas whose perf_mfu entered the fleet_mfu mean at the "
+            "last scrape (the denominator that makes the hole "
+            "semantics auditable)")
+        self._g_fps = reg.gauge(
+            "fleet_flops_per_second",
+            "sum of perf_flops_per_second across scraped replicas")
 
     # -- ingestion ------------------------------------------------------
     @staticmethod
@@ -254,10 +269,18 @@ class FleetScraper:
 
     def _refresh_aggregates(self) -> dict:
         up = self._snapshot_up()
-        occ, kv = [], []
-        hit_tok = prompt_tok = tokens = completed = 0.0
+        occ, kv, mfu = [], [], []
+        hit_tok = prompt_tok = tokens = completed = fps = 0.0
         for st in up.values():
             fams = st["families"]
+            # perf federation: only replicas that EXPORT perf_mfu
+            # enter the mean — a down replica (absent from `up`) or a
+            # replica without the perf registry is a hole, not a zero
+            m = _series_value(fams.get("perf_mfu"), "perf_mfu")
+            if m is not None:
+                mfu.append(m)
+            fps += _series_value(fams.get("perf_flops_per_second"),
+                                 "perf_flops_per_second") or 0.0
             o_sum = _series_value(fams.get("llm_batch_occupancy"),
                                   "llm_batch_occupancy_sum")
             o_cnt = _series_value(fams.get("llm_batch_occupancy"),
@@ -288,6 +311,9 @@ class FleetScraper:
                                       if prompt_tok else 0.0),
             "tokens_generated": tokens,
             "requests_completed": completed,
+            "mfu": (sum(mfu) / len(mfu)) if mfu else None,
+            "mfu_replicas": len(mfu),
+            "flops_per_second": fps,
         }
         self._g_scraped.set(agg["replicas_scraped"])
         self._g_occ.set(agg["occupancy"])
@@ -295,6 +321,9 @@ class FleetScraper:
         self._g_hit.set(agg["prefix_cache_hit_rate"])
         self._g_tokens.set(agg["tokens_generated"])
         self._g_completed.set(agg["requests_completed"])
+        self._g_mfu.set(agg["mfu"] or 0.0)
+        self._g_mfu_n.set(agg["mfu_replicas"])
+        self._g_fps.set(agg["flops_per_second"])
         return agg
 
     def aggregates(self) -> dict:
@@ -359,5 +388,6 @@ class FleetScraper:
                 "requests_completed": _series_value(
                     fams.get("llm_requests_completed"),
                     "llm_requests_completed"),
+                "mfu": _series_value(fams.get("perf_mfu"), "perf_mfu"),
             }
         return out

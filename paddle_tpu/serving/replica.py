@@ -55,12 +55,18 @@ class LocalReplica:
 
     def submit(self, prompt_ids, max_new_tokens=32, temperature=0.0,
                deadline_s: Optional[float] = None, priority: int = 0,
-               nonce: Optional[int] = None, trace_context=None) -> dict:
+               nonce: Optional[int] = None, trace_context=None,
+               tenant: Optional[str] = None) -> dict:
+        kw = {}
+        if tenant is not None:
+            # passed only when set so bare submit/cancel stubs (and
+            # older engines) keep working tenant-less
+            kw["tenant"] = tenant
         fut = self.engine.submit(
             prompt_ids, max_new_tokens=max_new_tokens,
             temperature=temperature, deadline=deadline_s,
             priority=priority, nonce=nonce,
-            trace_context=trace_context)
+            trace_context=trace_context, **kw)
         out = fut.result(timeout=600)
         out["request_id"] = fut.request_id
         return out
@@ -138,7 +144,8 @@ class HTTPReplica:
 
     def submit(self, prompt_ids, max_new_tokens=32, temperature=0.0,
                deadline_s: Optional[float] = None, priority: int = 0,
-               nonce: Optional[int] = None, trace_context=None) -> dict:
+               nonce: Optional[int] = None, trace_context=None,
+               tenant: Optional[str] = None) -> dict:
         body = {"prompt_ids": list(map(int, prompt_ids)),
                 "max_new_tokens": int(max_new_tokens),
                 "temperature": float(temperature),
@@ -147,6 +154,9 @@ class HTTPReplica:
             body["deadline_s"] = float(deadline_s)
         if nonce is not None:
             body["nonce"] = int(nonce)
+        if tenant is not None:
+            # served-FLOPs attribution label on the replica engine
+            body["tenant"] = str(tenant)
         # the HTTP wait must outlive the request's own deadline so the
         # typed 504 arrives instead of a transport timeout
         timeout = self.timeout if deadline_s is None \

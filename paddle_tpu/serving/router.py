@@ -255,7 +255,7 @@ class Router:
                  policy: str = "affinity",
                  max_workers: int = 32,
                  scrape_metrics: bool = True,
-                 federate_prefixes=("llm_",),
+                 federate_prefixes=("llm_", "perf_"),
                  slo_windows=DEFAULT_WINDOWS,
                  slo_default_target: float = 0.99,
                  slo_breach_threshold: float = 10.0,
@@ -684,12 +684,18 @@ class Router:
             try:
                 if _faults.enabled():
                     _faults.check("router.dispatch")
+                kw = {}
+                if req.tenant is not None:
+                    # tenant rides to the replica engine so
+                    # llm_served_flops_total{tenant} attributes the
+                    # request's cost where the FLOPs actually ran
+                    kw["tenant"] = req.tenant
                 out = st.client.submit(
                     req.prompt, max_new_tokens=req.max_new_tokens,
                     temperature=req.temperature,
                     deadline_s=(req.deadline.remaining()
                                 if req.deadline is not None else None),
-                    priority=req.priority, nonce=req.nonce,
+                    priority=req.priority, nonce=req.nonce, **kw,
                     # the dispatch span rides to the replica (HTTP
                     # header / direct SpanContext) so its llm.request
                     # tree shares this request's trace_id end to end

@@ -102,6 +102,12 @@ echo "== bench smoke (CPU backend)"
 # default orchestrator mode would spend its TPU probe windows first
 PT_BENCH_FORCE_CPU=1 python bench.py
 
+echo "== perf ledger regression gate (BENCH_LEDGER.jsonl trajectory)"
+# the bench steps above appended this run's canonical rows; the gate
+# fails LOUDLY if the trajectory is empty/unreadable or any series
+# regressed past tolerance (wide on CPU, tight on real chips)
+python tools/bench_ledger.py --ci
+
 echo "== wheel build + import smoke"
 tmp=$(mktemp -d)
 pip wheel . --no-deps --no-build-isolation -w "$tmp" -q

@@ -54,6 +54,11 @@ jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 
+try:  # run as `python tools/llm_bench.py` OR imported as tools.llm_bench
+    from tools import bench_ledger as _ledger  # noqa: E402
+except ImportError:  # script dir (tools/) leads sys.path
+    import bench_ledger as _ledger  # noqa: E402
+
 
 def build_net(vocab=211, layers=2, hidden=128, heads=4, max_pos=512):
     import paddle_tpu as pt
@@ -252,6 +257,12 @@ def fleet_main(args):
     if args.out:
         with open(args.out, "a") as f:
             f.write(json.dumps(row) + "\n")
+    # canonical trajectory row (PERF.md "The perf ledger")
+    _ledger.append("llm_bench", row["metric"], row["value"],
+                   row["unit"],
+                   extra={"affinity_hit_rate": aff["hit_rate"],
+                          "round_robin_hit_rate": rr["hit_rate"],
+                          "workload": row["workload"]})
     if args.ci:
         assert [o["output_ids"] for o in aff_outs] == \
             [o["output_ids"] for o in rr_outs], \
@@ -361,6 +372,14 @@ def decode_ticks_main(args, net=None, assert_ci=False):
     if args.out:
         with open(args.out, "a") as f:
             f.write(json.dumps(row) + "\n")
+    n8_b1 = next(r for r in sweep["batch_1"]
+                 if r["decode_ticks_per_dispatch"] == 8)
+    _ledger.append("llm_bench", row["metric"], row["value"],
+                   row["unit"],
+                   tokens_per_sec=n8_b1["tokens_per_sec"],
+                   dispatches=n8_b1["host_dispatches_per_100_tokens"],
+                   extra={"ratios": ratios,
+                          "workload": row["workload"]})
     if assert_ci:
         for bsz, ratio in ratios.items():
             assert ratio >= 1.2, (
@@ -427,6 +446,12 @@ def main(argv=None):
     if args.out:
         with open(args.out, "a") as f:
             f.write(json.dumps(row) + "\n")
+    _ledger.append("llm_bench", row["metric"], row["value"],
+                   row["unit"],
+                   tokens_per_sec=on["e2e_tokens_per_sec"],
+                   extra={"ttft_p50_s": on["ttft_p50_s"],
+                          "cache_off_ttft_p50_s": off["ttft_p50_s"],
+                          "workload": row["workload"]})
 
     if args.ci:
         assert on["tokens_reused"] > 0, \

@@ -5,8 +5,12 @@ chrome://tracing JSON (≥1 complete "X" event per recorded host
 annotation, plus span events with parent links and row-label metadata),
 and the JSONL reporter stream. Then exercise the live surfaces: start
 the debug server on an ephemeral port and scrape /metrics, /healthz,
-/statusz and /tracez; finally force-crash a subprocess with the flight
-recorder installed and assert the JSONL dump was written. Exits
+/statusz, /tracez and /perfz — the perf gate asserts nonzero live MFU
+after the fit run, resolved XLA program costs for the fused train
+loop AND a decode-slab LLMEngine pass, breakdown phases that
+reproduce the dispatch/drain histogram totals, and the per-tenant
+served-FLOPs counter; finally force-crash a subprocess with the
+flight recorder installed and assert the JSONL dump was written. Exits
 non-zero on any missing signal so a refactor that silently unhooks an
 instrument fails CI, not a 3am bench round.
 
@@ -138,6 +142,45 @@ def main(outdir: str = "/tmp/pt_obs_smoke") -> int:
                                     timeout=30) as r:
             tz = json.loads(r.read())
         assert tz["finished_total"] > 0
+
+        # -- /perfz: live MFU + step-time breakdown for the fit run ----
+        # (the continuous-perf acceptance: nonzero MFU after a few
+        # steps, and the breakdown phases reproduce the step-time
+        # totals the histograms measured — same clocks, no drift)
+        assert st.get("perf", {}).get("enabled") is True, st.get("perf")
+        with urllib.request.urlopen(base + "/perfz", timeout=60) as r:
+            pz = json.loads(r.read())
+        assert pz["enabled"], pz
+        assert pz["mfu"] > 0, f"zero MFU after a fit run: {pz}"
+        assert pz["peaks"]["flops"] > 0
+        train_progs = [p for p in pz["programs"]
+                       if p["component"] == "train"]
+        assert train_progs and any(
+            p["cost_resolved"] and p["flops"] and p["dispatches"] > 0
+            for p in train_progs), train_progs
+        ph = pz["breakdown"]["train"]["phases"]
+        assert ph.get("dispatch", 0) > 0, ph
+        reg = observability.default_registry()
+        loop_hist = reg.get("train_loop_dispatch_seconds")
+        dispatched = loop_hist.sum if loop_hist is not None else 0.0
+        phase_sum = ph.get("dispatch", 0.0) + ph.get("compile", 0.0)
+        # the fit ran entirely through the fused loop: compile+dispatch
+        # phases are the SAME dt values the dispatch histogram observed
+        assert dispatched > 0 and \
+            abs(phase_sum - dispatched) / dispatched < 0.05, \
+            (phase_sum, dispatched, ph)
+        drain_hist = reg.get("train_loop_drain_seconds")
+        if drain_hist is not None and drain_hist.sum > 0:
+            assert abs(ph.get("drain", 0.0) - drain_hist.sum) \
+                / drain_hist.sum < 0.05, (ph, drain_hist.sum)
+        with urllib.request.urlopen(base + "/metrics", timeout=30) as r:
+            rescraped = r.read().decode()
+        assert "perf_mfu" in rescraped and \
+            "perf_flops_per_second" in rescraped, \
+            "perf gauges missing from /metrics"
+
+        # -- /perfz for a decode-slab LLMEngine run --------------------
+        _engine_perf_section(base)
     finally:
         srv.stop()
     tracing.disable()
@@ -171,8 +214,51 @@ raise RuntimeError("forced crash for the obs smoke gate")
     print(f"observability smoke OK: {len(events)} trace events "
           f"({len(span_evs)} spans), {len(text.splitlines())} prom "
           f"lines, {len(lines)} jsonl rows, debug server scraped, "
+          f"/perfz mfu={pz['mfu']:.4g} (train+llm programs costed), "
           f"crash dump {dumps[0]} -> {outdir}")
     return 0
+
+
+def _engine_perf_section(base: str) -> None:
+    """Decode-slab half of the /perfz acceptance: a tiny LLMEngine at
+    decode_ticks_per_dispatch=4 serves a couple of requests, then
+    /perfz must show the fused-slab program with resolved cost, a
+    nonzero llm MFU contribution, the decode phase in the breakdown,
+    and the per-tenant served-FLOPs counter."""
+    import paddle_tpu as pt
+    from paddle_tpu import observability
+    from paddle_tpu.inference.llm import LLMEngine
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt_config
+
+    pt.seed(0)
+    cfg = gpt_config("gpt2-small", num_layers=2, hidden_size=64,
+                     num_heads=4, vocab_size=97,
+                     max_position_embeddings=128,
+                     hidden_dropout=0.0, attention_dropout=0.0)
+    net = GPTForCausalLM(cfg)
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, 97, 8).tolist() for _ in range(3)]
+    with LLMEngine(net, max_seqs=4, page_size=8, num_pages=32,
+                   max_len=64, prefill_buckets=(8,),
+                   decode_ticks_per_dispatch=4) as eng:
+        outs = [eng.submit(p, max_new_tokens=24,
+                           tenant="smoke").result(timeout=240)
+                for p in prompts]
+        # /perfz while the engine is LIVE (close() drops its program
+        # entries from the registry; the windowed rates persist)
+        with urllib.request.urlopen(base + "/perfz", timeout=60) as r:
+            pz = json.loads(r.read())
+    assert all(o["output_ids"] for o in outs)
+    assert all(o.get("served_flops", 0) > 0 for o in outs), outs
+    slabs = [p for p in pz["programs"]
+             if p["component"] == "llm" and p["kind"] == "decode_loop"]
+    assert slabs and any(p["cost_resolved"] and p["dispatches"] > 0
+                         for p in slabs), pz["programs"]
+    llm_ph = pz["breakdown"].get("llm", {}).get("phases", {})
+    assert llm_ph.get("decode", 0) > 0, pz["breakdown"]
+    snap = observability.default_registry().snapshot()
+    assert snap.get('llm_served_flops_total{tenant="smoke"}', 0) > 0, \
+        {k: v for k, v in snap.items() if "served" in k}
 
 
 def _get_json(url: str, timeout: float = 30.0):
@@ -267,6 +353,13 @@ def fleet_main(outdir: str = "/tmp/pt_obs_fleet_smoke") -> int:
                 in scraped, f"federated series for {n} missing"
         assert "fleet_prefix_cache_hit_rate" in scraped
         assert "router_dispatches_total" in scraped
+        # perf federation: replica perf_* gauges ride the same scrape
+        # and aggregate into fleet_mfu (holes for down replicas —
+        # pinned unit-side in tests/test_perf_observability.py)
+        assert 'fleet_perf_mfu{replica=' in scraped, \
+            "replica perf gauges not federated"
+        assert "fleet_mfu " in scraped or "fleet_mfu{" in scraped, \
+            "fleet_mfu aggregate missing"
         # -- ONE cross-process trace ------------------------------------
         out = outs[0]
         tid = out["trace_id"]

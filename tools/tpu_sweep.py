@@ -100,7 +100,37 @@ def run_one(spec: dict) -> dict:
     rec["tag"] = spec["tag"]
     rec["device"] = dev.device_kind
     rec["metrics"] = _metrics_snapshot()
+    _emit_ledger(rec, spec)
     return rec
+
+
+def _emit_ledger(rec: dict, spec: dict) -> None:
+    """Append the canonical trajectory row (tools/bench_ledger.py)
+    beside the legacy PERF_SWEEP.jsonl shape — the legacy row keeps
+    being written for one release; the field mapping is documented in
+    PERF.md ("The perf ledger"). Best-effort: a ledger hiccup must not
+    cost the sweep its hardware row."""
+    try:
+        try:
+            from tools import bench_ledger
+        except ImportError:
+            import bench_ledger
+        bench_ledger.append(
+            "tpu_sweep", rec.get("tag", spec.get("tag", "?")),
+            rec["value"], rec["unit"],
+            tokens_per_sec=(rec["value"]
+                            if rec.get("unit") == "tokens/sec"
+                            else None),
+            mfu=rec.get("mfu"),
+            backend=rec.get("device"),
+            # the full registry snapshot already rides the legacy row;
+            # the ledger row carries the bounded counters/gauges view
+            extra={k: rec.get(k) for k in
+                   ("batch", "seq", "params", "model", "fused",
+                    "optimizer", "lookahead", "n_requests")
+                   if rec.get(k) is not None})
+    except Exception as e:  # noqa: BLE001
+        print(f"tpu_sweep: ledger append failed: {e}", file=sys.stderr)
 
 
 def _metrics_snapshot() -> dict:
