@@ -32,7 +32,7 @@ Design:
   in-process — rank-0-hosted exactly like the reference's TCPStore.
 
 Wire format: one JSON line request, one JSON line response, per
-connection. Ops: set k v | get k | ages prefix | list prefix.
+connection. Ops: set k v | get k | del k | ages prefix | list prefix.
 """
 
 from __future__ import annotations
@@ -100,6 +100,14 @@ class TCPStoreServer:
                 if ent is None:
                     return {"ok": True, "v": None, "age": None}
                 return {"ok": True, "v": ent[0], "age": now - ent[1]}
+            if op == "del":
+                # planned departure (serving scale-in): the key is
+                # removed NOW instead of aging out at the observer's
+                # stale_after — deleting an absent key is a no-op, so
+                # withdraw races with crash-cleanup harmlessly
+                return {"ok": True,
+                        "existed": self._data.pop(req["k"], None)
+                        is not None}
             if op == "ages":
                 pref = req.get("prefix", "")
                 return {"ok": True, "ages": {
@@ -394,11 +402,35 @@ class TCPMembership:
 
     def stop(self) -> None:
         """Stop heartbeating (the entry ages out at the observer's
-        ``stale_after``; there is no explicit deregistration — a
-        crashed member couldn't send one either, so one path serves
-        both)."""
+        ``stale_after`` — the path a crashed member takes too, since
+        it couldn't deregister either). A PLANNED departure that must
+        leave the roster immediately — a scale-in, where a lingering
+        record would let the router re-attach a replica the autoscaler
+        just killed — uses :meth:`leave` instead."""
         self._stop.set()
         self._thread.join(timeout=5)
+
+    def leave(self) -> None:
+        """Planned-departure deregistration: stop heartbeating AND
+        delete the roster record, so observers see the member gone on
+        their next poll instead of after ``stale_after``. Best-effort
+        — a store that is already gone means nobody is watching the
+        roster anyway."""
+        self.stop()
+        try:
+            self.client.request({"op": "del",
+                                 "k": self.PREFIX + self.name})
+        except StoreUnavailable:
+            pass
+
+    @classmethod
+    def withdraw(cls, client: TCPStoreClient, name: str) -> bool:
+        """Remove ``name`` from the roster on the member's behalf —
+        the autoscaler's backstop for a replica that died (or was
+        killed) without running its own :meth:`leave`. Returns True
+        when a record was actually deleted."""
+        resp = client.request({"op": "del", "k": cls.PREFIX + name})
+        return bool(resp.get("existed"))
 
     @classmethod
     def list_members(cls, client: TCPStoreClient,

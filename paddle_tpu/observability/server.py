@@ -27,6 +27,9 @@ profiler window):
   404 when this process fronts no fleet.
 - ``GET /sloz``     — SLO report (registered SLOTracker): per-class
   burn rates, deadline hit ratios, breach latches; 404 when none.
+- ``GET /scalez``   — autoscaler view (registered by a serving
+  Autoscaler): config, damping state, live fleet load, and the
+  bounded decision log (inputs → action + reason); 404 when none.
 - ``POST /profilez`` — arm an on-demand profiler window:
   ``{"duration_s": 5, "log_dir": "/tmp/prof"}`` starts a
   ``profiler.Profiler`` and stops it after the window; 409 while one
@@ -93,6 +96,11 @@ _fleet_providers: Dict[str, Callable[[], Optional[dict]]] = {}
 
 # name → callable returning the /sloz JSON payload (SLOTracker.report)
 _slo_providers: Dict[str, Callable[[], Optional[dict]]] = {}
+
+# name → callable returning the /scalez JSON payload (the serving
+# Autoscaler's decision log + config + live load view). 404 when empty
+# — no autoscaler runs in this process.
+_scale_providers: Dict[str, Callable[[], Optional[dict]]] = {}
 
 _server: Optional["DebugServer"] = None
 _server_mu = threading.Lock()
@@ -162,6 +170,17 @@ def register_slo_provider(name: str,
 def unregister_slo_provider(name: str) -> None:
     with _providers_mu:
         _slo_providers.pop(name, None)
+
+
+def register_scale_provider(name: str,
+                            fn: Callable[[], Optional[dict]]) -> None:
+    with _providers_mu:
+        _scale_providers[name] = fn
+
+
+def unregister_scale_provider(name: str) -> None:
+    with _providers_mu:
+        _scale_providers.pop(name, None)
 
 
 def _collect_dict_providers(table: Dict[str, Callable[[], Optional[dict]]]
@@ -444,6 +463,15 @@ class DebugServer:
                              "process (the router registers one)"})
             else:
                 h._reply_json(200, {"slo": slos})
+        elif url.path == "/scalez":
+            scalers = _collect_dict_providers(_scale_providers)
+            if not scalers:
+                h._reply_json(404, {
+                    "error": "no autoscaler registered in this "
+                             "process (the serving Autoscaler "
+                             "registers one)"})
+            else:
+                h._reply_json(200, {"autoscalers": scalers})
         elif url.path == "/profilez":
             h._reply_json(200, {"armed": self._arm.status()})
         else:
@@ -451,7 +479,8 @@ class DebugServer:
                 "error": f"unknown path {url.path}",
                 "endpoints": ["/metrics", "/healthz", "/statusz",
                               "/tracez", "/perfz", "/fleetz", "/sloz",
-                              "POST /profilez", "POST /reset_health"]})
+                              "/scalez", "POST /profilez",
+                              "POST /reset_health"]})
 
     def _post(self, h) -> None:
         url = urlparse(h.path)

@@ -275,6 +275,46 @@ class SLOTracker:
                     else 0.0
             return out
 
+    def window_status(self, slo: Optional[str] = None) -> dict:
+        """The controller query API (the serving autoscaler's sensor):
+        LIVE window state per class — burn rate, sample count, and
+        min-samples eligibility per window, plus ``tripped``: True
+        while EVERY window burns above ``breach_threshold`` with
+        enough samples (the same multi-window rule the breach latch
+        fires on, but computed from the live windows, not the sticky
+        latch). An acknowledged breach (``reset_breach``) therefore
+        does NOT read as tripped once the windows have decayed — a
+        controller keyed on this re-acts only when the windows
+        re-trip, never on a stale acknowledgment."""
+        now = self._clock()
+        with self._mu:
+            items = (self._classes.items() if slo is None else
+                     [(slo, self._classes[slo])]
+                     if slo in self._classes else [])
+            out = {}
+            for name, st in items:
+                budget = max(1.0 - st.target, 1e-9)
+                windows = {}
+                tripped = bool(st.windows)
+                for wname, w in zip(_WINDOW_NAMES, st.windows):
+                    total, errors = w.totals(now)
+                    burn = ((errors / total) / budget) if total else 0.0
+                    eligible = total >= self.min_samples
+                    windows[wname] = {"burn_rate": round(burn, 4),
+                                      "requests": total,
+                                      "eligible": eligible}
+                    tripped = tripped and eligible \
+                        and burn > self.breach_threshold
+                out[name] = {"windows": windows, "tripped": tripped,
+                             "breached": st.breached}
+            return out
+
+    def tripped_classes(self) -> list:
+        """Classes whose live windows ALL burn above the threshold
+        right now (see :meth:`window_status`)."""
+        return sorted(s for s, st in self.window_status().items()
+                      if st["tripped"])
+
     def breached(self):
         with self._mu:
             return sorted(s for s, st in self._classes.items()

@@ -44,6 +44,16 @@ Named sites (the catalog; see docs/RELIABILITY.md):
 ``io.worker``             DataLoader host-batch production
 ``router.dispatch``       fleet router: one request dispatch to a replica
 ``router.healthz``        fleet router: one replica health poll
+``autoscale.spawn``       serving autoscaler: one spawn attempt during a
+                          scale-out/replacement — injection makes the
+                          spawn fail; the autoscaler must retry with
+                          backoff and never count the failed replica
+                          toward capacity
+``autoscale.drain``       serving autoscaler: one iteration of the
+                          scale-in drain wait — injection reads as the
+                          drain deadline expiring NOW, so the replica
+                          is killed with stragglers in flight (which
+                          must fail over nonce-pinned, token-identical)
 ``replica.crash``         serving replica process: hard-crash trigger
                           (the replica main loop exits the process on
                           injection — a SIGKILL the schedule controls)
@@ -83,6 +93,8 @@ SITES = (
     "io.worker",
     "router.dispatch",
     "router.healthz",
+    "autoscale.spawn",
+    "autoscale.drain",
     "replica.crash",
     "data.poison",
     "grad.nonfinite",

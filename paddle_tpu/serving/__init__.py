@@ -15,14 +15,19 @@ nonce and all replicas share weights and seed.
     out = router.submit(prompt_ids, deadline=5.0).result()
 """
 
+from .autoscaler import (Autoscaler, SubprocessReplica,
+                         make_subprocess_spawner)
 from .breaker import CircuitBreaker
 from .fleet import FleetScraper, parse_prometheus_text
 from .replica import (HTTPReplica, LocalReplica, ReplicaUnavailable,
                       build_net_from_spec, make_engine_from_spec,
-                      spawn_replica)
+                      spawn_replica, terminate_replica)
 from .router import Router, SLOClass, TenantQuota
 
 __all__ = [
+    "Autoscaler",
+    "SubprocessReplica",
+    "make_subprocess_spawner",
     "CircuitBreaker",
     "FleetScraper",
     "parse_prometheus_text",
@@ -35,4 +40,5 @@ __all__ = [
     "build_net_from_spec",
     "make_engine_from_spec",
     "spawn_replica",
+    "terminate_replica",
 ]

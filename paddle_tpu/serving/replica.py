@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import json
 import os
+import signal
 import subprocess
 import sys
 import threading
@@ -316,6 +317,23 @@ def replica_main(spec: dict) -> int:
     name = spec.get("name", f"replica-{os.getpid()}")
     if spec.get("tracing"):
         tracing.enable()
+    # GRACEFUL TERMINATE — the planned-departure path beside the
+    # ``replica.crash`` site: SIGTERM/SIGINT set a stop event and the
+    # main loop runs the same orderly teardown a clean exit would
+    # (membership LEAVES the roster, engine closes, server stops).
+    # Registered BEFORE the flight recorder installs its own SIGTERM
+    # hook so a dump-then-chain still lands here: a preempted replica
+    # dumps its flight record AND departs cleanly.
+    stop_evt = threading.Event()
+
+    def _graceful(signum, frame):  # noqa: ARG001 — signal signature
+        stop_evt.set()
+
+    try:
+        signal.signal(signal.SIGTERM, _graceful)
+        signal.signal(signal.SIGINT, _graceful)
+    except ValueError:
+        pass   # not the main thread (embedded use): kill paths only
     reporter = None
     if spec.get("obs_dir"):
         from ..observability import flight
@@ -345,7 +363,7 @@ def replica_main(spec: dict) -> int:
                                    spec.get("beat_interval", 0.2)))
     print(READY_MARK + json.dumps(info), flush=True)
     try:
-        while True:
+        while not stop_evt.is_set():
             time.sleep(0.05)
             if faults.enabled():
                 try:
@@ -358,12 +376,35 @@ def replica_main(spec: dict) -> int:
         pass
     finally:
         if member is not None:
-            member.stop()
+            # planned departure: LEAVE the roster (delete the record)
+            # so the router's membership sync sees this replica gone
+            # on its next poll, not after stale_after — a scale-in
+            # must not race a re-attach of the replica it just ended
+            member.leave()
         if reporter is not None:
             reporter.stop()
         eng.close()
         srv.shutdown()
     return 0
+
+
+def terminate_replica(proc, timeout: float = 15.0) -> Optional[int]:
+    """Graceful terminate for a spawned replica — the scale-in path
+    beside the crash site: SIGTERM (the replica leaves membership,
+    closes its engine, stops serving), a bounded wait, then SIGKILL
+    escalation for a wedged child. Returns the exit code (None only
+    if even the SIGKILL wait timed out)."""
+    if proc.poll() is None:
+        proc.terminate()
+        try:
+            proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                pass
+    return proc.poll()
 
 
 def spawn_replica(spec: dict, timeout: float = 120.0,

@@ -178,7 +178,8 @@ def _router_metrics():
 
 class _ReplicaState:
     __slots__ = ("name", "client", "breaker", "health", "inflight",
-                 "dispatched", "from_membership", "info")
+                 "dispatched", "from_membership", "info", "warming",
+                 "admin_draining")
 
     def __init__(self, name, client, breaker):
         self.name = name
@@ -189,6 +190,18 @@ class _ReplicaState:
         self.dispatched = 0
         self.from_membership = False
         self.info: dict = {}
+        # WARMING: spawned but not yet counted toward capacity (no
+        # READY + healthy probe yet). A warming replica is a HOLE —
+        # it absorbs no dispatches AND stays out of the occupancy
+        # denominator, the same semantics PR 11 gave fleet_mfu (a
+        # replica that isn't serving must neither take traffic nor
+        # drag the fleet average toward a spurious scale-in).
+        self.warming = False
+        # ADMIN DRAINING: the autoscaler marked this replica for
+        # scale-in. Routing excludes it immediately; the health poll
+        # must NOT overwrite the verdict back to "healthy" while the
+        # drain is in progress.
+        self.admin_draining = False
 
 
 class _FleetRequest:
@@ -277,6 +290,22 @@ class Router:
         self.tenants = dict(tenants or {})
         self._mu = threading.Lock()
         self._replicas: Dict[str, _ReplicaState] = {}
+        # names pre-declared warming (Autoscaler.expect_warming): a
+        # membership attach racing the spawner's explicit attach must
+        # not slip a half-booted replica into rotation
+        self._expect_warm: set = set()
+        # detach tombstones: name -> detach time. A membership sync
+        # whose roster SNAPSHOT predates a scale-in's withdraw+detach
+        # must not resurrect the killed replica from the stale
+        # snapshot (a ghost that would sit breaker-open forever —
+        # roster records going stale never detaches). Entries expire
+        # after membership_stale_after: by then any lingering record
+        # has aged out, and a legitimately re-registered same name
+        # (fresh heartbeats) attaches normally.
+        self._detached_at: Dict[str, float] = {}
+        # zero-arg callables run at the tail of every health-poll
+        # cycle (the Autoscaler's tick rides this cadence)
+        self._poll_hooks: list = []
         self._tenant_inflight: Dict[str, int] = {}
         self._by_id: Dict[int, _FleetRequest] = {}
         self._nonce_seq = itertools.count()
@@ -338,24 +367,128 @@ class Router:
                 self._status_name, self._render_federated)
 
     # -- membership ---------------------------------------------------------
-    def attach(self, name: str, client) -> None:
+    def attach(self, name: str, client, warming: bool = False) -> None:
         """Add (or re-point) a replica. Re-attaching an existing name
         keeps its breaker — a restarted replica re-earns trust through
-        half-open probes instead of resetting its history."""
+        half-open probes instead of resetting its history.
+        ``warming=True`` (or a prior :meth:`expect_warming`) attaches
+        it as a capacity HOLE: no dispatches, no occupancy weight,
+        until :meth:`mark_ready`."""
         with self._mu:
+            # an explicit attach overrides a detach tombstone — the
+            # caller knows the replica exists
+            self._detached_at.pop(name, None)
             st = self._replicas.get(name)
             if st is None:
                 st = _ReplicaState(name, client,
                                    CircuitBreaker(**self._breaker_kw))
+                st.warming = warming or name in self._expect_warm
                 self._replicas[name] = st
             else:
                 st.client = client
+                if warming:
+                    st.warming = True
+
+    def expect_warming(self, name: str) -> None:
+        """Pre-declare ``name`` as warming BEFORE its process exists:
+        whichever attach path lands first (the spawner's explicit
+        :meth:`attach` or the TCPStore membership sync — a booting
+        replica announces membership before it prints READY) the
+        replica enters warming, never rotation. Cleared by
+        :meth:`mark_ready` or :meth:`detach`."""
+        with self._mu:
+            self._expect_warm.add(name)
+            st = self._replicas.get(name)
+            if st is not None:
+                st.warming = True
+
+    def mark_ready(self, name: str) -> bool:
+        """Promote a warming replica into rotation (the autoscaler
+        calls this after READY + the first successful health probe).
+        Returns False when the name is unknown."""
+        with self._mu:
+            self._expect_warm.discard(name)
+            st = self._replicas.get(name)
+            if st is None:
+                return False
+            st.warming = False
+            return True
+
+    def drain(self, name: str) -> bool:
+        """Mark a replica ADMIN-DRAINING for scale-in: routing
+        excludes it from the next :meth:`submit` on (nothing new is
+        admitted within one poll interval — in fact immediately), and
+        the health poll stops overwriting the verdict. The caller
+        then waits for :meth:`inflight_of` to reach zero before
+        terminating (docs/RELIABILITY.md "Autoscaling failure
+        model")."""
+        with self._mu:
+            st = self._replicas.get(name)
+            if st is None:
+                return False
+            st.admin_draining = True
+            st.health = "draining"
+            return True
+
+    def inflight_of(self, name: str) -> Optional[int]:
+        """Router-side in-flight dispatches to ``name`` (None when
+        unknown) — the scale-in verify-empty check. The router is the
+        replica's only admission path, so zero here means the replica
+        holds no request this fleet could lose."""
+        with self._mu:
+            st = self._replicas.get(name)
+            return None if st is None else st.inflight
+
+    def fleet_load(self, slots_per_replica: Optional[int] = None
+                   ) -> dict:
+        """Capacity/occupancy accounting over the attached fleet.
+        READY replicas (not warming, not draining, breaker not open,
+        reachable) define the capacity; warming and draining replicas
+        are counted but are HOLES in the occupancy denominator.
+        ``occupancy`` is total ready in-flight / (slots × ready), or
+        None when no ready capacity exists (a hole, not a zero — the
+        autoscaler must not read an all-warming fleet as idle)."""
+        with self._mu:
+            states = list(self._replicas.values())
+        ready = [st for st in states
+                 if not st.warming and not st.admin_draining
+                 and st.breaker.state != "open"
+                 and st.health not in ("draining", "unreachable")]
+        warming = sum(1 for st in states if st.warming)
+        draining = sum(1 for st in states if not st.warming
+                       and (st.admin_draining
+                            or st.health == "draining"))
+        inflight = sum(st.inflight for st in ready)
+        out = {"attached": len(states), "ready": len(ready),
+               "warming": warming, "draining": draining,
+               "inflight": inflight,
+               "ready_names": sorted(st.name for st in ready)}
+        if slots_per_replica:
+            cap = int(slots_per_replica) * len(ready)
+            out["capacity"] = cap
+            out["occupancy"] = (inflight / cap) if cap else None
+        return out
 
     def detach(self, name: str) -> None:
         with self._mu:
             self._replicas.pop(name, None)
+            self._expect_warm.discard(name)
+            self._detached_at[name] = time.monotonic()
         if self.scraper is not None:
             self.scraper.forget(name)
+
+    # -- poll hooks ---------------------------------------------------------
+    def add_poll_hook(self, fn) -> None:
+        """Run ``fn()`` at the tail of every health-poll cycle — the
+        cadence the Autoscaler's control loop rides (one poll, one
+        health verdict, one scrape, one scaling decision)."""
+        with self._mu:
+            self._poll_hooks.append(fn)
+
+    def remove_poll_hook(self, fn) -> None:
+        with self._mu:
+            if fn in self._poll_hooks:
+                self._poll_hooks.remove(fn)
 
     def replica_names(self):
         with self._mu:
@@ -370,8 +503,22 @@ class Router:
                 stale_after=self._membership_stale_after)
         except StoreUnavailable:
             return
+        now = time.monotonic()
+        with self._mu:
+            # tombstones expire unconditionally — most detached names
+            # (fresh auto-N incarnations) never reappear in a roster,
+            # so sweeping only on reappearance would grow the dict by
+            # one entry per scale-in forever
+            for n in [n for n, ts in self._detached_at.items()
+                      if now - ts >= self._membership_stale_after]:
+                del self._detached_at[n]
         for mname, info in members.items():
             with self._mu:
+                if mname in self._detached_at:
+                    # this roster snapshot may predate the detach
+                    # (scale-in withdraw): do not resurrect a replica
+                    # that was just removed
+                    continue
                 st = self._replicas.get(mname)
                 same = st is not None and st.info == info
             if same:
@@ -409,7 +556,13 @@ class Router:
                 h = st.client.health()
             except Exception:  # noqa: BLE001 — a poll failure is data
                 h = None
-            st.health = h if h is not None else "unreachable"
+            if not st.admin_draining:
+                # an admin drain (scale-in in progress) pins the
+                # verdict: the replica itself still answers "healthy"
+                # right up to the kill, and one optimistic poll
+                # re-admitting traffic mid-drain would break the
+                # verify-empty contract
+                st.health = h if h is not None else "unreachable"
             if h is None:
                 st.breaker.record_failure()
             else:
@@ -441,6 +594,13 @@ class Router:
                 self.slo.refresh()
             except Exception:  # noqa: BLE001 — the poller must survive
                 pass
+            with self._mu:
+                hooks = list(self._poll_hooks)
+            for fn in hooks:
+                try:
+                    fn()
+                except Exception:  # noqa: BLE001 — a broken hook must
+                    pass           # not stop health polling
 
     def reset_breakers(self) -> None:
         """Operator escape hatch: force every breaker closed (e.g.
@@ -450,7 +610,10 @@ class Router:
             states = list(self._replicas.values())
         for st in states:
             st.breaker.reset()
-            if st.health == "draining":
+            if st.health == "draining" and not st.admin_draining:
+                # an ADMIN drain is the autoscaler's scale-in in
+                # progress, not sticky failure state — the operator
+                # reset must not re-admit a replica mid-drain
                 st.health = "unknown"   # re-polled next interval
             self._m["breaker"].labels(st.name).set(0)
 
@@ -474,7 +637,8 @@ class Router:
             states = dict(self._replicas)
         eligible = {n: st for n, st in states.items()
                     if n not in req.excluded
-                    and st.health != "draining"}
+                    and st.health != "draining"
+                    and not st.warming and not st.admin_draining}
         preferred_all = self._rendezvous(req.affinity_key, states) \
             if self.policy == "affinity" else None
         while eligible:
@@ -800,6 +964,8 @@ class Router:
                 "inflight": st.inflight,
                 "dispatched": st.dispatched,
                 "from_membership": st.from_membership,
+                "warming": st.warming,
+                "admin_draining": st.admin_draining,
             } for st in states},
         }
 
@@ -808,12 +974,17 @@ class Router:
             return None
         with self._mu:
             states = list(self._replicas.values())
-        routable = [st for st in states
+        # warming replicas are expected capacity-in-progress, not
+        # sickness: they neither count as routable nor drag the
+        # aggregate toward degraded
+        considered = [st for st in states if not st.warming]
+        routable = [st for st in considered
                     if st.health != "draining"
+                    and not st.admin_draining
                     and st.breaker.state != "open"]
         if not routable:
             return "draining"
-        if len(routable) < len(states):
+        if len(routable) < len(considered):
             return "degraded"
         return "healthy"
 
@@ -853,6 +1024,8 @@ class Router:
                 "inflight": st.inflight,
                 "dispatched": st.dispatched,
                 "from_membership": st.from_membership,
+                "warming": st.warming,
+                "admin_draining": st.admin_draining,
             }
             entry["metrics"] = scraped.pop(st.name, None)
             replicas[st.name] = entry

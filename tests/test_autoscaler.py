@@ -1,0 +1,638 @@
+"""Autoscaler tests (ISSUE 13 tentpole): flap damping and dwell on an
+injectable clock, spawn-fault retry without double-counted capacity,
+the drain → verify-empty → kill sequence, warming-hole routing and
+occupancy accounting, min/max bounds, death-as-replacement, and the
+/scalez surface.
+
+The control loop runs against a scriptable FakeRouter (no engines, no
+subprocesses, no sleeps — the injected ``sleep`` ADVANCES the fake
+clock, so drain waits and spawn backoffs are instantaneous and
+exact); two tests use the real Router over stub replicas to pin the
+warming/drain lifecycle where it actually lives."""
+
+import json
+import threading
+import time
+from urllib.request import urlopen
+
+import pytest
+
+from paddle_tpu.inference.llm import AdmissionShed
+from paddle_tpu.observability.metrics import MetricRegistry
+from paddle_tpu.observability.slo import SLOTracker
+from paddle_tpu.reliability import faults
+from paddle_tpu.serving import Autoscaler, Router
+from paddle_tpu.serving.router import affinity_key, rendezvous_pick
+
+
+# ---------------------------------------------------------------------------
+# fakes
+# ---------------------------------------------------------------------------
+
+
+class FakeClient:
+    def __init__(self, healthy=True):
+        self.healthy = healthy
+
+    def health(self):
+        return "healthy" if self.healthy else None
+
+
+class FakeHandle:
+    def __init__(self):
+        self._alive = True
+        self.terminated = 0
+
+    def alive(self):
+        return self._alive
+
+    def terminate(self, grace_s=0.0):
+        self.terminated += 1
+        self._alive = False
+
+
+class FakeRouter:
+    """The exact Router surface the Autoscaler consumes, scriptable."""
+
+    health_poll_interval = 0.0
+
+    def __init__(self, slots=4):
+        self.slots = slots
+        self.replicas = {}          # name -> {"warming","draining"}
+        self.inflight = {}          # name -> int OR callable(clock)
+        self.expected = set()
+        self.drained = []
+        self.detached = []
+
+    def expect_warming(self, name):
+        self.expected.add(name)
+        if name in self.replicas:
+            self.replicas[name]["warming"] = True
+
+    def attach(self, name, client, warming=False):
+        self.replicas[name] = {
+            "warming": warming or name in self.expected,
+            "draining": False}
+        self.inflight.setdefault(name, 0)
+
+    def mark_ready(self, name):
+        self.expected.discard(name)
+        if name not in self.replicas:
+            return False
+        self.replicas[name]["warming"] = False
+        return True
+
+    def drain(self, name):
+        if name not in self.replicas:
+            return False
+        self.replicas[name]["draining"] = True
+        self.drained.append(name)
+        return True
+
+    def inflight_of(self, name):
+        if name not in self.replicas:
+            return None
+        v = self.inflight.get(name, 0)
+        return v() if callable(v) else v
+
+    def detach(self, name):
+        self.replicas.pop(name, None)
+        self.expected.discard(name)
+        self.detached.append(name)
+
+    def fleet_load(self, slots=None):
+        ready = [n for n, r in self.replicas.items()
+                 if not r["warming"] and not r["draining"]]
+        infl = sum(self.inflight_of(n) or 0 for n in ready)
+        cap = (slots or self.slots) * len(ready)
+        return {
+            "attached": len(self.replicas),
+            "ready": len(ready),
+            "warming": sum(1 for r in self.replicas.values()
+                           if r["warming"]),
+            "draining": sum(1 for r in self.replicas.values()
+                            if r["draining"]),
+            "inflight": infl, "capacity": cap,
+            "occupancy": (infl / cap) if cap else None,
+            "ready_names": sorted(ready)}
+
+    def add_poll_hook(self, fn):
+        pass
+
+    def remove_poll_hook(self, fn):
+        pass
+
+
+class Harness:
+    """Fake clock + fake router + spawner, wired into a synchronous
+    Autoscaler. ``sleep`` ADVANCES the clock, so every drain wait and
+    spawn backoff resolves instantly and deterministically."""
+
+    def __init__(self, **kw):
+        self.t = [0.0]
+        self.router = FakeRouter(slots=kw.get("replica_slots", 4))
+        self.burn = {}               # window_status()-shaped dict
+        self.spawn_calls = []
+        self.handles = {}
+
+        def spawner(name):
+            self.spawn_calls.append(name)
+            h = FakeHandle()
+            self.handles[name] = h
+            return FakeClient(), h
+
+        kw.setdefault("min_replicas", 1)
+        kw.setdefault("max_replicas", 4)
+        kw.setdefault("backoff_base_s", 1.0)
+        kw.setdefault("backoff_cap_s", 60.0)
+        kw.setdefault("dwell_s", 8.0)
+        kw.setdefault("low_water", 0.1)
+        kw.setdefault("drain_deadline_s", 5.0)
+        kw.setdefault("spawn_backoff_s", 0.01)
+        self.scaler = Autoscaler(
+            self.router, spawner, synchronous=True,
+            clock=lambda: self.t[0],
+            sleep=lambda s: self.t.__setitem__(0, self.t[0] + s),
+            burn_fn=lambda: self.burn, **kw)
+
+    def trip(self, cls="gold", burn=50.0):
+        self.burn = {cls: {"tripped": True, "windows": {
+            "short": {"burn_rate": burn, "requests": 99,
+                      "eligible": True},
+            "long": {"burn_rate": burn, "requests": 99,
+                     "eligible": True}}}}
+
+    def untrip(self):
+        self.burn = {}
+
+    def run(self, seconds, dt=0.25):
+        """Tick on a cadence over fake time; returns actions taken."""
+        actions = []
+        end = self.t[0] + seconds
+        while self.t[0] < end:
+            a = self.scaler.tick()
+            if a:
+                actions.append((round(self.t[0], 3), a))
+            self.t[0] += dt
+        return actions
+
+
+@pytest.fixture
+def harness():
+    faults.reset()
+    h = Harness()
+    h.router.attach("r0", FakeClient())   # the unmanaged seed replica
+    yield h
+    faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# damping: square wave, backoff growth, dwell, curve reset
+# ---------------------------------------------------------------------------
+
+
+def test_square_wave_bounded_actions(harness):
+    """A burn-rate square wave faster than the dwell must NOT produce
+    one spawn/kill per edge: flips are dwell-gated and repeats ride
+    the exponential curve, so the action count stays bounded."""
+    h = harness
+    edges = 0
+    actions = []
+    # 2s tripped / 2s calm for 120s of fake time = 60 edges
+    for _cycle in range(30):
+        h.trip()
+        edges += 1
+        actions += h.run(2.0, dt=0.25)
+        h.untrip()
+        edges += 1
+        actions += h.run(2.0, dt=0.25)
+    assert edges == 60
+    n_actions = len([a for _, a in actions
+                     if a in ("scale_out", "scale_in")])
+    # the flap gate: same-direction repeats climb the exponential
+    # curve and every flip waits out max(8s dwell, the climbed
+    # curve), so the worst case is ~one action per dwell period
+    # (120/8 = 15, plus the few extra the healthy-dwell reset allows
+    # while the fleet idles at the min floor between episodes) — 60
+    # edges collapse to that, never one spawn/kill per edge
+    assert 1 <= n_actions <= 19, (n_actions, actions)
+    load = h.router.fleet_load()
+    assert 1 <= load["ready"] <= h.scaler.max_replicas, load
+
+
+def test_consecutive_same_direction_backoff_grows(harness):
+    """Repeated scale-outs back off exponentially: gaps between
+    consecutive same-direction actions follow base · 2^(n-1)."""
+    h = harness
+    h.scaler.max_replicas = 8
+    h.trip()
+    actions = h.run(20.0, dt=0.05)
+    outs = [t for t, a in actions if a == "scale_out"]
+    assert len(outs) >= 4, actions
+    gaps = [round(b - a, 2) for a, b in zip(outs, outs[1:])]
+    # base=1.0: gaps must be >= 1, 2, 4 (small slack for tick grain)
+    assert gaps[0] >= 0.95 and gaps[1] >= 1.95 and gaps[2] >= 3.95, \
+        gaps
+
+
+def test_direction_flip_waits_out_the_dwell(harness):
+    h = harness
+    h.trip()
+    assert h.scaler.tick() == "scale_out"
+    h.untrip()                      # occupancy 0 → wants scale-in
+    acts = h.run(7.5, dt=0.25)      # still inside the 8s dwell
+    assert not acts, acts
+    acts = h.run(2.0, dt=0.25)      # dwell expires → the flip lands
+    assert [a for _, a in acts] == ["scale_in"], acts
+    assert h.scaler.n_scale_in == 1
+
+
+def test_healthy_dwell_resets_backoff_curve(harness):
+    h = harness
+    h.scaler.max_replicas = 8
+    h.trip()
+    h.run(8.0, dt=0.1)              # builds an out-streak ≥ 3
+    assert h.scaler.n_scale_out >= 3
+    streak = h.scaler._streak
+    assert streak >= 3
+    # a quiet dwell (no trigger in either direction: occupancy in
+    # band) resets the curve
+    h.untrip()
+    h.router.inflight["r0"] = 3      # occupancy above low_water
+    h.run(9.0, dt=0.25)
+    assert h.scaler._streak == 0
+    # the next episode starts fresh — no leftover 2^n wait
+    h.trip()
+    assert h.scaler.tick() == "scale_out"
+
+
+# ---------------------------------------------------------------------------
+# SLO wiring: live windows, not the sticky latch
+# ---------------------------------------------------------------------------
+
+
+def test_latched_then_acked_breach_needs_windows_to_retrip():
+    """The satellite pin: a latched breach that an operator
+    acknowledged (POST /reset_health → reset_breach) must NOT
+    re-trigger scale-out; only windows that RE-TRIP do."""
+    faults.reset()
+    t = [0.0]
+    tracker = SLOTracker(targets={"gold": 0.99},
+                         windows=(10.0, 40.0), min_samples=3,
+                         breach_threshold=5.0,
+                         registry=MetricRegistry(),
+                         clock=lambda: t[0])
+    router = FakeRouter()
+    router.attach("r0", FakeClient())
+    router.inflight["r0"] = 2       # mid-band: no occupancy trigger
+
+    def spawner(name):
+        return FakeClient(), FakeHandle()
+
+    scaler = Autoscaler(router, spawner, synchronous=True,
+                        min_replicas=1, max_replicas=4,
+                        low_water=0.01, backoff_base_s=0.5,
+                        dwell_s=2.0,
+                        clock=lambda: t[0],
+                        sleep=lambda s: t.__setitem__(0, t[0] + s),
+                        burn_fn=tracker.window_status)
+    for _ in range(5):
+        tracker.record("gold", None, 1.0, "deadline",
+                       had_deadline=True)
+    assert tracker.window_status()["gold"]["tripped"]
+    assert scaler.tick() == "scale_out"
+    assert scaler.n_scale_out == 1
+    # the storm ends; the windows decay but the LATCH stays sticky
+    t[0] = 100.0
+    assert tracker.breached() == ["gold"]
+    assert not tracker.window_status()["gold"]["tripped"]
+    assert scaler.tick() is None
+    # operator acknowledges — still no re-trigger from the ack alone
+    tracker.reset_breach()
+    assert scaler.tick() is None
+    assert scaler.n_scale_out == 1
+    # a NEW storm re-trips the windows → the controller re-acts
+    for _ in range(5):
+        tracker.record("gold", None, 1.0, "deadline",
+                       had_deadline=True)
+    assert scaler.tick() == "scale_out"
+    assert scaler.n_scale_out == 2
+
+
+# ---------------------------------------------------------------------------
+# spawn faults: retry with backoff, never double-count
+# ---------------------------------------------------------------------------
+
+
+def test_spawn_fault_retries_and_counts_capacity_once(harness):
+    h = harness
+    faults.enable(seed=7)
+    faults.inject("autoscale.spawn", nth=(1,))
+    assert faults.preview("autoscale.spawn", 3) == [1]
+    h.trip()
+    assert h.scaler.tick() == "scale_out"
+    # attempt 1 faulted before the spawner ran; attempt 2 spawned
+    assert len(h.spawn_calls) == 1
+    d = h.scaler.decisions()[-1]
+    assert d["action"] == "scale_out" and d["attempts"] == 2
+    load = h.router.fleet_load()
+    assert load["ready"] == 2 and load["warming"] == 0, load
+    assert faults.injected_log() == [("autoscale.spawn", 1)]
+
+
+def test_spawn_exhaustion_leaves_no_ghost_capacity(harness):
+    h = harness
+    faults.enable(seed=7)
+    faults.inject("autoscale.spawn", nth=(1, 2, 3))
+    h.trip()
+    h.scaler.tick()
+    assert h.scaler.n_scale_out == 0
+    d = h.scaler.decisions()[-1]
+    assert d["action"] == "scale_out_failed" and d["attempts"] == 3
+    load = h.router.fleet_load()
+    # the failed name must not linger as a warming hole or an
+    # expected-warming entry
+    assert load["ready"] == 1 and load["warming"] == 0, load
+    assert not h.router.expected
+    assert not h.spawn_calls
+
+
+def test_spawned_but_never_healthy_is_torn_down(harness):
+    h = harness
+
+    def bad_spawner(name):
+        h.spawn_calls.append(name)
+        handle = FakeHandle()
+        h.handles[name] = handle
+        return FakeClient(healthy=False), handle
+
+    h.scaler.spawner = bad_spawner
+    h.scaler.ready_timeout_s = 1.0
+    h.trip()
+    h.scaler.tick()
+    assert h.scaler.n_scale_out == 0
+    assert h.scaler.decisions()[-1]["action"] == "scale_out_failed"
+    name = h.spawn_calls[0]
+    assert h.handles[name].terminated  # the half-up process was ended
+    assert name not in h.router.replicas
+    assert h.router.fleet_load()["warming"] == 0
+
+
+# ---------------------------------------------------------------------------
+# scale-in: drain → verify-empty → kill
+# ---------------------------------------------------------------------------
+
+
+def scaled_out(h):
+    """One managed replica up (via a real scale-out), damping aged
+    past the dwell so a scale-in is immediately available. The drain
+    tests park a victim with stragglers, so the low-water mark is
+    raised to keep the occupancy trigger live."""
+    h.trip()
+    assert h.scaler.tick() == "scale_out"
+    h.untrip()
+    h.scaler.low_water = 0.6
+    name = h.scaler.decisions()[-1]["replica"]
+    h.t[0] += h.scaler.dwell_s + 1
+    return name
+
+
+def test_drain_verifies_empty_before_kill(harness):
+    h = harness
+    name = scaled_out(h)
+    t_empty = h.t[0] + 0.8
+    h.router.inflight[name] = lambda: 3 if h.t[0] < t_empty else 0
+    assert h.scaler.tick() == "scale_in"
+    d = h.scaler.decisions()[-1]
+    assert d["action"] == "scale_in" and d["replica"] == name
+    assert d["stragglers"] == 0
+    assert d["drain_s"] >= 0.8          # waited for the drain
+    assert h.handles[name].terminated   # then killed
+    assert name in h.router.drained and name in h.router.detached
+    assert h.scaler.n_scale_in == 1
+
+
+def test_drain_deadline_kills_with_stragglers(harness):
+    h = harness
+    name = scaled_out(h)
+    h.router.inflight[name] = 2          # never drains
+    h.scaler.drain_deadline_s = 1.5
+    assert h.scaler.tick() == "scale_in"
+    d = h.scaler.decisions()[-1]
+    assert d["stragglers"] == 2, d
+    assert 1.5 <= d["drain_s"] <= 2.5, d
+    assert h.handles[name].terminated
+    # the stragglers' recovery is the router's nonce-pinned failover
+    # (pinned end-to-end in chaos_soak --autoscale)
+
+
+def test_drain_fault_expires_deadline_immediately(harness):
+    h = harness
+    name = scaled_out(h)
+    h.router.inflight[name] = 4
+    h.scaler.drain_deadline_s = 1e9      # the fault IS the deadline
+    faults.enable(seed=11)
+    faults.inject("autoscale.drain", nth=(1,))
+    assert h.scaler.tick() == "scale_in"
+    d = h.scaler.decisions()[-1]
+    assert d["stragglers"] == 4
+    assert d["drain_s"] < 5.0
+    assert h.handles[name].terminated
+    assert faults.injected_log() == [("autoscale.drain", 1)]
+
+
+# ---------------------------------------------------------------------------
+# bounds + replacement
+# ---------------------------------------------------------------------------
+
+
+def test_min_max_bounds_hold(harness):
+    h = harness
+    h.scaler.max_replicas = 2
+    h.trip()
+    h.run(60.0, dt=0.5)
+    assert h.scaler.n_scale_out == 1     # 1 seed + 1 managed = max
+    assert h.router.fleet_load()["ready"] == 2
+    assert any(d["action"] == "hold" and d["reason"] == "at_max"
+               for d in h.scaler.decisions())
+    # at min: occupancy 0 wants in, but ready == min_replicas
+    h.untrip()
+    h.t[0] += 100
+    name = [n for n in h.router.replicas if n != "r0"][0]
+    h.router.inflight[name] = 0
+    h.scaler.tick()                      # drains the one managed
+    h.t[0] += 100
+    assert h.scaler.tick() is None       # ready=1=min: never below
+    assert h.router.fleet_load()["ready"] == 1
+    assert h.scaler.n_scale_in == 1
+
+
+def test_dead_managed_replica_respawns_as_replacement(harness):
+    h = harness
+    name = scaled_out(h)
+    h.handles[name]._alive = False       # SIGKILL'd out-of-band
+    h.router.inflight["r0"] = 2          # mid-band: no other trigger
+    assert h.scaler.tick() == "replace"
+    assert h.scaler.n_replaced == 1
+    assert h.scaler.n_scale_out == 1     # NOT counted as scale-out
+    assert name in h.router.detached
+    d = h.scaler.decisions()[-1]
+    assert d["action"] == "replace" and d["reason"] == "replica_died"
+    new = d["replica"]
+    assert new != name and new in h.router.replicas
+    assert h.router.fleet_load()["ready"] == 2
+
+
+def test_bootstrap_to_min_replicas():
+    faults.reset()
+    t = [0.0]
+    router = FakeRouter()                # EMPTY fleet
+
+    def spawner(name):
+        return FakeClient(), FakeHandle()
+
+    scaler = Autoscaler(router, spawner, synchronous=True,
+                        min_replicas=2, max_replicas=4,
+                        backoff_base_s=0.1,
+                        clock=lambda: t[0],
+                        sleep=lambda s: t.__setitem__(0, t[0] + s),
+                        burn_fn=lambda: {})
+    for _ in range(8):
+        scaler.tick()
+        t[0] += 0.5
+    assert router.fleet_load()["ready"] == 2
+    assert all(d["reason"] == "min_replicas"
+               for d in scaler.decisions()
+               if d["action"] == "scale_out")
+
+
+# ---------------------------------------------------------------------------
+# the real Router: warming holes + admin drain
+# ---------------------------------------------------------------------------
+
+
+class StubReplica:
+    def __init__(self):
+        self.calls = []
+        self._mu = threading.Lock()
+
+    def submit(self, prompt_ids, **kw):
+        with self._mu:
+            self.calls.append(list(prompt_ids))
+        return {"output_ids": [1] * kw.get("max_new_tokens", 1)}
+
+    def health(self):
+        return "healthy"
+
+    def cancel(self, request_id):
+        return False
+
+    def close(self):
+        pass
+
+
+def test_real_router_warming_is_a_hole():
+    """Satellite pin: a spawned-but-not-READY replica absorbs no
+    dispatches AND stays out of the occupancy denominator."""
+    ready_stub, warm_stub = StubReplica(), StubReplica()
+    with Router({"a": ready_stub}, health_poll_interval=0.05) as r:
+        r.expect_warming("w")
+        r.attach("w", warm_stub)          # expectation → warming
+        # a warming replica absorbs no dispatches, even ones whose
+        # affinity prefers it
+        names = ("a", "w")
+        rng_prompts, found = [], 0
+        for i in range(200):
+            p = [i % 97, (3 * i) % 97, (7 * i) % 97]
+            if rendezvous_pick(affinity_key(p, 16, 2), names) == "w":
+                rng_prompts.append(p)
+                found += 1
+                if found == 4:
+                    break
+        for p in rng_prompts:
+            assert r.submit(p, max_new_tokens=1).result(timeout=30)
+        assert not warm_stub.calls
+        assert len(ready_stub.calls) == len(rng_prompts)
+        # occupancy: denominator counts ONLY the ready replica
+        load = r.fleet_load(slots_per_replica=4)
+        assert load["ready"] == 1 and load["warming"] == 1
+        assert load["capacity"] == 4
+        # promote → it joins rotation
+        assert r.mark_ready("w")
+        assert r.fleet_load(slots_per_replica=4)["capacity"] == 8
+        for p in rng_prompts:
+            r.submit(p, max_new_tokens=1).result(timeout=30)
+        assert warm_stub.calls, "promoted replica still got nothing"
+
+
+def test_real_router_admin_drain_sticks_across_polls():
+    """drain() must exclude the replica immediately AND survive the
+    next health poll (the replica itself still answers healthy)."""
+    a, b = StubReplica(), StubReplica()
+    with Router({"a": a, "b": b}, health_poll_interval=0.03) as r:
+        assert r.drain("b")
+        time.sleep(0.12)                  # several poll cycles
+        st = r._status()["replicas"]["b"]
+        assert st["health"] == "draining" and st["admin_draining"]
+        n_before = len(b.calls)
+        for i in range(6):
+            r.submit([i, i + 1, i + 2], max_new_tokens=1) \
+                .result(timeout=30)
+        assert len(b.calls) == n_before, "admin-draining got traffic"
+        assert r.inflight_of("b") == 0
+        assert r.inflight_of("nope") is None
+        # a drained-out fleet sheds typed, reason draining
+        assert r.drain("a")
+        with pytest.raises(AdmissionShed):
+            r.submit([9, 9, 9]).result(timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# /scalez
+# ---------------------------------------------------------------------------
+
+
+def test_scalez_payload_and_http_endpoint():
+    faults.reset()
+    from paddle_tpu.observability.server import DebugServer
+    t = [0.0]
+    router = FakeRouter()
+    router.attach("r0", FakeClient())
+
+    def spawner(name):
+        return FakeClient(), FakeHandle()
+
+    scaler = Autoscaler(router, spawner, synchronous=True,
+                        min_replicas=1, max_replicas=3,
+                        clock=lambda: t[0],
+                        sleep=lambda s: t.__setitem__(0, t[0] + s),
+                        burn_fn=lambda: {})
+    scaler.start()
+    dbg = DebugServer(port=0).start()
+    try:
+        scaler.tick()
+        t[0] += 1.0
+        scaler.tick()
+        with urlopen(f"http://127.0.0.1:{dbg.port}/scalez",
+                     timeout=10) as resp:
+            payload = json.loads(resp.read())
+        (_name, sz), = payload["autoscalers"].items()
+        assert sz["config"]["min_replicas"] == 1
+        assert sz["config"]["max_replicas"] == 3
+        assert sz["state"]["scale_out"] == 0
+        assert sz["load"]["ready"] == 1
+        assert isinstance(sz["decisions"], list)
+        # replica-seconds integrate across ticks
+        assert sz["state"]["replica_seconds"] >= 1.0
+    finally:
+        dbg.stop()
+        scaler.close()
+    # after close the provider self-unregisters (404)
+    dbg2 = DebugServer(port=0).start()
+    try:
+        import urllib.error
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urlopen(f"http://127.0.0.1:{dbg2.port}/scalez", timeout=10)
+        assert ei.value.code == 404
+    finally:
+        dbg2.stop()

@@ -45,6 +45,23 @@ seed; ``faults.preview(site, N)`` recomputes the faulting call
 numbers purely, and the soak asserts the observed injection log
 equals that schedule.
 
+5b. AUTOSCALE SOAK (``--autoscale``) — the SLO-driven autoscaler over
+   a live subprocess fleet (ISSUE 13): a gold-class deadline-miss
+   storm trips both burn windows and triggers a scale-out whose first
+   spawn attempt dies on the seeded ``autoscale.spawn`` fault (the
+   retry absorbs it; the replica counts toward capacity only after
+   READY + a successful health probe, and a failed attempt leaves no
+   ghost capacity); a SIGKILL of the autoscaled replica mid-decode
+   loses ZERO requests (nonce-pinned token-identical failover) and is
+   respawned as a REPLACEMENT, not a scale-out; a seeded
+   ``autoscale.drain`` fault expires the scale-in drain deadline with
+   stragglers in flight, which must complete token-identically on a
+   sibling; the terminated replica leaves TCPStore membership
+   immediately; both sites replay from the seed. (The static-K vs
+   autoscaled replica-seconds/SLO comparison rides
+   ``tools/llm_bench.py --ci --storm`` — together they are the
+   ISSUE-13 CI gate.)
+
 6b. POISONED-STREAM SOAK (rides ``--train``) — the numeric-guard gate
    (ISSUE 9): under a seeded ``data.poison`` / ``grad.nonfinite``
    schedule with the on-device NumericGuard armed (skip policy), the
@@ -88,6 +105,8 @@ CI:   python tools/chaos_soak.py --ci       # fixed seeds, ~30s budget
                                                 # ~30s budget
       python tools/chaos_soak.py --ci --fleet   # replica-kill soak,
                                                 # ≤45s budget
+      python tools/chaos_soak.py --ci --autoscale  # autoscaler soak,
+                                                # ≤90s budget
       python tools/chaos_soak.py --ci --train   # kill-anywhere train
                                                 # soak + poisoned-
                                                 # stream guard gate,
@@ -855,6 +874,256 @@ def fleet_soak(seed: int, workdir: str) -> dict:
     return out
 
 
+def autoscale_soak(seed: int, workdir: str) -> dict:
+    """Scenario 5b (``--autoscale``, ISSUE 13): the SLO-driven
+    autoscaler over a LIVE subprocess fleet. Asserts the acceptance
+    invariants: a deadline-miss storm trips the gold class's burn
+    windows and triggers a scale-out whose FIRST spawn attempt dies on
+    the seeded ``autoscale.spawn`` fault (the retry must absorb it and
+    never double-count capacity; the replica counts only after READY +
+    a successful health probe); a SIGKILL of the autoscaled replica
+    mid-decode loses ZERO requests (nonce-pinned token-identical
+    failover, checked against a reference engine) and is respawned as
+    a REPLACEMENT, not a scale-out; a seeded ``autoscale.drain`` fault
+    expires the scale-in drain deadline with stragglers in flight,
+    which must complete token-identically on a sibling; the terminated
+    replica is withdrawn from TCPStore membership immediately (no
+    stale-record re-attach); and both autoscale fault sites replay
+    from the seed. Failures attach the merged cross-process trace
+    next to the fault seed + replay command, like every fleet phase."""
+    from paddle_tpu.distributed.tcp_store import (TCPMembership,
+                                                  TCPStoreClient,
+                                                  TCPStoreServer)
+    from paddle_tpu.observability import tracing
+    from paddle_tpu.reliability import faults
+    from paddle_tpu.reliability.retry import DeadlineExceeded
+    from paddle_tpu.serving import (Autoscaler, HTTPReplica,
+                                    LocalReplica, Router, SLOClass,
+                                    make_engine_from_spec,
+                                    make_subprocess_spawner,
+                                    spawn_replica)
+    from paddle_tpu.serving.router import affinity_key, rendezvous_pick
+
+    rng = np.random.RandomState(seed)
+    faults.reset()
+    tracing.enable()
+    store = TCPStoreServer("127.0.0.1", 0)
+    endpoint = f"127.0.0.1:{store.port}"
+    obs_dir = os.path.join(workdir, "obs")
+    model = {"vocab": 97, "layers": 2, "hidden": 64, "heads": 4,
+             "max_pos": 96, "model_seed": 0,
+             "tracing": True, "obs_dir": obs_dir}
+    engine_kw = {"device_retry_budget": 2, "max_pending": 64,
+                 "seed": 0}
+    cache_dir = os.path.join(workdir, "xla_cache")
+    os.makedirs(cache_dir, exist_ok=True)
+    # the seed replica (unmanaged — the autoscaler can only kill what
+    # it spawned) boots first and warms the shared compile cache
+    procs, infos = {}, {}
+    spec0 = dict(model, name="r0", store=endpoint,
+                 cache_dir=cache_dir, engine=dict(engine_kw))
+    procs["r0"], infos["r0"] = spawn_replica(spec0, timeout=180)
+    HTTPReplica(infos["r0"]["generate"],
+                infos["r0"]["healthz"]).submit([1, 2, 3],
+                                               max_new_tokens=2)
+    # reference engine: same weights/seed/cache — replays any
+    # failover'd stream nonce-pinned to pin token identity
+    import jax
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      0.0)
+    ref = LocalReplica(make_engine_from_spec(dict(model,
+                                                  engine=engine_kw)))
+    ref.submit([1, 2, 3], max_new_tokens=1)
+
+    router = Router(store_endpoint=endpoint, page_size=16,
+                    affinity_pages=2, failover_budget=2,
+                    health_poll_interval=0.2,
+                    membership_stale_after=1.5,
+                    breaker_fail_threshold=3, breaker_open_for=1.0,
+                    slo_classes={"gold": SLOClass(
+                        "gold", deadline_s=60.0, target=0.99)},
+                    slo_windows=(2.0, 8.0), slo_min_samples=5,
+                    slo_breach_threshold=5.0)
+    auto_spec = dict(model, store=endpoint, cache_dir=cache_dir,
+                     engine=dict(engine_kw))
+    scaler = Autoscaler(
+        router, make_subprocess_spawner(auto_spec, timeout=180),
+        min_replicas=1, max_replicas=2, replica_slots=4,
+        # scale-in disarmed until phase C flips low_water — the
+        # phases need the fleet to HOLD at 2 through the SIGKILL
+        low_water=-1.0, dwell_s=3.0,
+        backoff_base_s=0.5, backoff_cap_s=8.0,
+        drain_deadline_s=30.0, spawn_backoff_s=0.2,
+        ready_timeout_s=180.0, name_prefix="auto")
+    out = {}
+    client = TCPStoreClient(endpoint)
+
+    def affine_prompt(target, names, length):
+        while True:
+            p = rng.randint(0, 97, length).tolist()
+            key = affinity_key(p, router.page_size,
+                               router.affinity_pages)
+            if rendezvous_pick(key, names) == target:
+                return p
+
+    try:
+        _poll_until(lambda: router.replica_names() == ["r0"], 30,
+                    "r0 membership convergence")
+        scaler.start()
+        faults.enable(seed=seed)
+        # the FIRST spawn attempt of the storm's scale-out must die
+        # and be retried without ghost capacity
+        faults.inject("autoscale.spawn", nth=(1,))
+
+        # -- phase A: gold deadline-miss storm trips both burn
+        # windows → scale-out (1 → 2), spawn fault absorbed
+        storm = [router.submit(rng.randint(0, 97, 8).tolist(),
+                               max_new_tokens=4, slo="gold",
+                               deadline=0.001) for _ in range(8)]
+        n_missed = 0
+        for f in storm:
+            try:
+                f.result(timeout=120)
+            except DeadlineExceeded:
+                n_missed += 1
+        assert n_missed == 8, (
+            f"storm deadlines not hopeless enough: {n_missed}/8")
+        _poll_until(lambda: scaler.n_scale_out >= 1, 240,
+                    "burn-tripped scale-out")
+        _poll_until(
+            lambda: router.fleet_load(4)["ready"] == 2, 240,
+            "spawned replica READY + healthy and counted")
+        d_out = [d for d in scaler.decisions()
+                 if d["action"] == "scale_out"][0]
+        assert d_out["reason"].startswith("slo_burn:gold"), d_out
+        assert d_out["attempts"] == 2, (
+            f"autoscale.spawn fault was not retried: {d_out}")
+        load = router.fleet_load(4)
+        assert load["attached"] == 2 and load["warming"] == 0, (
+            f"failed spawn attempt left ghost capacity: {load}")
+        auto1 = d_out["replica"]
+        h1 = scaler._managed[auto1].handle
+        infos[auto1] = dict(h1.info)
+        out["scale_out"] = {"replica": auto1,
+                            "attempts": d_out["attempts"],
+                            "missed": n_missed}
+
+        # -- phase B: SIGKILL the autoscaled replica mid-decode —
+        # zero lost requests (token-identical failover), respawned as
+        # a REPLACEMENT (not a scale-out)
+        names = ("r0", auto1)
+        prompts = [affine_prompt(auto1, names, 16) for _ in range(4)]
+        futs = [router.submit(p, max_new_tokens=32, temperature=0.9)
+                for p in prompts]
+        _poll_until(lambda: (router.inflight_of(auto1) or 0) > 0, 60,
+                    "autoscaled replica taking traffic")
+        os.kill(h1.proc.pid, signal.SIGKILL)
+        h1.proc.wait(timeout=30)
+        results = [f.result(timeout=240) for f in futs]
+        assert all(r["output_ids"] for r in results), results
+        flipped = [(p, r) for p, r in zip(prompts, results)
+                   if r["failovers"] > 0]
+        assert flipped, (
+            "SIGKILL mid-decode caused no failover — the kill missed "
+            f"the in-flight window: {[r['replica'] for r in results]}")
+        for p, r in flipped[:2]:
+            ref_out = ref.submit(p, max_new_tokens=32,
+                                 temperature=0.9,
+                                 nonce=r["request_id"])
+            assert ref_out["output_ids"] == r["output_ids"], (
+                "failover was not token-identical: "
+                f"{ref_out['output_ids']} != {r['output_ids']}")
+        _poll_until(lambda: scaler.n_replaced >= 1, 240,
+                    "replacement spawn after the SIGKILL")
+        _poll_until(
+            lambda: router.fleet_load(4)["ready"] == 2, 240,
+            "replacement READY + healthy")
+        assert scaler.n_scale_out == 1, (
+            "a SIGKILL respawn was counted as a scale-out: "
+            f"{scaler.decisions()}")
+        d_rep = [d for d in scaler.decisions()
+                 if d["action"] == "replace"][-1]
+        auto2 = d_rep["replica"]
+        h2 = scaler._managed[auto2].handle
+        infos[auto2] = dict(h2.info)
+        _poll_until(
+            lambda: auto1 not in TCPMembership.list_members(client),
+            15, "dead replica withdrawn from the roster")
+        out["kill"] = {"failovers": len(flipped),
+                       "replacement": auto2}
+
+        # -- phase C: scale-in under the seeded drain fault — the
+        # drain deadline expires with stragglers in flight, the kill
+        # proceeds, and the stragglers complete token-identically on
+        # the sibling. Zero lost requests across the scale-in.
+        faults.inject("autoscale.drain", nth=(1,))
+        names = ("r0", auto2)
+        c_prompts = [affine_prompt(auto2, names, 16)
+                     for _ in range(6)]
+        c_futs = [router.submit(p, max_new_tokens=64,
+                                temperature=0.9) for p in c_prompts]
+        _poll_until(lambda: (router.inflight_of(auto2) or 0) > 0, 60,
+                    "victim holding in-flight work")
+        scaler.low_water = 0.8      # arm the scale-in trigger
+        _poll_until(lambda: scaler.n_scale_in >= 1, 120,
+                    "fault-forced scale-in")
+        c_results = [f.result(timeout=240) for f in c_futs]
+        assert all(r["output_ids"] for r in c_results), c_results
+        d_in = [d for d in scaler.decisions()
+                if d["action"] == "scale_in"][-1]
+        assert d_in["replica"] == auto2, d_in
+        assert d_in["stragglers"] >= 1, (
+            f"the drain fault should have expired the deadline with "
+            f"stragglers in flight: {d_in}")
+        moved = [(p, r) for p, r in zip(c_prompts, c_results)
+                 if r["replica"] != auto2]
+        assert moved, (
+            "no straggler finished on a sibling — the drain kill "
+            f"lost its in-flight work? {c_results}")
+        for p, r in moved[:2]:
+            ref_out = ref.submit(p, max_new_tokens=64,
+                                 temperature=0.9,
+                                 nonce=r["request_id"])
+            assert ref_out["output_ids"] == r["output_ids"], (
+                "straggler failover was not token-identical: "
+                f"{ref_out['output_ids']} != {r['output_ids']}")
+        _poll_until(
+            lambda: router.fleet_load(4)["ready"] == 1, 60,
+            "fleet back at min_replicas after the scale-in")
+        _poll_until(
+            lambda: set(TCPMembership.list_members(client)) == {"r0"},
+            15, "scaled-in replica withdrawn from the roster")
+        out["scale_in"] = {"stragglers": d_in["stragglers"],
+                           "drain_s": d_in["drain_s"],
+                           "moved": len(moved)}
+
+        # -- determinism: both autoscale sites replay from the seed
+        _assert_schedule_matches(
+            faults, ("autoscale.spawn", "autoscale.drain"))
+        out["decisions"] = len(scaler.decisions())
+    except AssertionError:
+        path, summary = _attach_fleet_trace(workdir, infos)
+        if path is not None:
+            print(f"merged cross-process trace attached: {path} "
+                  f"({summary['spans']} spans from "
+                  f"{summary['processes']} processes)",
+                  file=sys.stderr, flush=True)
+        raise
+    finally:
+        faults.reset()
+        tracing.disable()
+        scaler.close(terminate_managed=True)
+        router.close()
+        ref.engine.close()
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=30)
+        store.close()
+    return out
+
+
 TRAIN_STEPS = 16          # 2 epochs × 8 steps (32 samples / batch 4)
 TRAIN_EPOCH_STEPS = TRAIN_STEPS // 2
 TRAIN_CKPT_FREQ = 5
@@ -1376,6 +1645,11 @@ def main(argv=None) -> int:
                     help="run ONLY the fused-decode-slab scenario "
                          "(decode_ticks_per_dispatch=8 under an "
                          "engine.slab kill/cancel/deadline storm)")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="run ONLY the autoscaler scenario (burn-"
+                         "tripped scale-out with a seeded spawn "
+                         "fault, SIGKILL → replacement, fault-forced "
+                         "straggler drain → token-identical failover)")
     ap.add_argument("--seed", type=int, default=1234)
     ap.add_argument("--workdir", default=None)
     ap.add_argument("--ckpt-worker", nargs=2, metavar=("DIR", "STEPS"),
@@ -1411,6 +1685,8 @@ def main(argv=None) -> int:
     try:
         if args.fleet:
             out["fleet"] = fleet_soak(seed, workdir)
+        elif args.autoscale:
+            out["autoscale"] = autoscale_soak(seed, workdir)
         elif args.train:
             out["train"] = train_soak(seed, workdir)
         elif args.slab:
@@ -1424,6 +1700,7 @@ def main(argv=None) -> int:
         # IS the fault schedule (docs/RELIABILITY.md determinism)
         replay = (f"python tools/chaos_soak.py --seed {seed}"
                   + (" --fleet" if args.fleet else "")
+                  + (" --autoscale" if args.autoscale else "")
                   + (" --train" if args.train else "")
                   + (" --slab" if args.slab else ""))
         print(f"CHAOS SOAK FAILED under fault seed {seed}\n"

@@ -69,6 +69,28 @@ echo "== fleet chaos soak (K=3 replicas, SIGKILL mid-decode -> failover)"
 # breach; failures attach a merged cross-process trace
 python tools/chaos_soak.py --ci --fleet
 
+echo "== autoscale chaos soak (SLO-driven scale-out/in over a live fleet)"
+# the ISSUE-13 gate, half 1: a gold-class deadline-miss storm trips
+# both burn windows -> scale-out (first spawn attempt dies on the
+# seeded autoscale.spawn fault; the retry absorbs it with no ghost
+# capacity); SIGKILL of the autoscaled replica mid-decode loses zero
+# requests (nonce-pinned token-identical failover) and respawns as a
+# REPLACEMENT, not a scale-out; a seeded autoscale.drain fault expires
+# the scale-in drain deadline with stragglers in flight, which
+# complete token-identically on a sibling; membership is withdrawn
+# immediately; both sites replay from seed. Failures attach the
+# merged cross-process trace next to the seed + replay command.
+python tools/chaos_soak.py --ci --autoscale
+
+echo "== storm bench (diurnal+burst: static K=3 vs autoscaled fleet)"
+# the ISSUE-13 gate, half 2: the millions-of-users-shaped storm
+# (shared prefixes, mixed tenants/SLO classes) must trigger >=1
+# scale-out and >=1 scale-in with zero lost requests, hold the
+# gold-class deadline-hit ratio at least as well as static K=3, and
+# spend STRICTLY fewer replica-seconds; the comparison lands in
+# BENCH_LEDGER.jsonl as one bench_ledger/v1 row
+python tools/llm_bench.py --ci --storm
+
 echo "== train chaos soak (kill-anywhere -> bit-identical resume"
 echo "   + poisoned-stream numeric-guard gate)"
 # Model.fit with async full-state checkpoints + resume="auto":
