@@ -198,6 +198,27 @@ define_flag("perf_peak_flops", 0.0,
 define_flag("perf_peak_hbm_gbps", 0.0,
             "Override peak HBM bandwidth in GB/s for the "
             "perf_hbm_bw_util denominator. 0 keeps the table/fallback.")
+define_flag("mem_observability", True,
+            "Arm the HBM attribution ledger (observability/memory.py): "
+            "owners (Model device trees, the engine's paged KV pool, "
+            "DecodeCarry scratch, checkpoint staging buffers) register "
+            "attributed reservations at allocation boundaries, "
+            "reconciled each read against device.memory_stats() with "
+            "an explicit unattributed residual -> GET /memz, "
+            "mem_bytes{owner,kind} / mem_watermark_bytes / "
+            "mem_headroom_pages gauges, and OOM flight-dump "
+            "forensics. Off: every call site pays one module-flag "
+            "check and records nothing (pinned like tracing/perf; "
+            "read at import — flip at runtime with "
+            "observability.memory.enable()/disable()).")
+define_flag("mem_near_oom_fraction", 0.92,
+            "Near-OOM threshold for the memory ledger's one-shot "
+            "forensic snapshot: when device bytes_in_use crosses this "
+            "fraction of bytes_limit at any ledger read, the "
+            "attribution table is dumped through the flight recorder "
+            "ONCE (reason near_oom) — the pre-crash baseline an "
+            "actual RESOURCE_EXHAUSTED dump diffs against. 0 "
+            "disables.", flag_type=float)
 define_flag("compilation_cache_dir", "",
             "Persistent XLA compilation cache directory (jax "
             "jax_compilation_cache_dir), enabled at Model.prepare() "

@@ -34,6 +34,7 @@ from ..core import flags, rng
 from ..io import DataLoader, Dataset
 from ..metric import Metric
 from ..nn.layer import Layer, functional_call, split_state
+from ..observability import memory as _memobs
 from ..observability import metrics as _obs
 from ..observability import perf as _perf
 from ..observability import tracing as _trace
@@ -318,6 +319,11 @@ class Model:
         # the scope token keeps this Model's programs distinct from
         # any other owner's in the process-wide registry
         self._reset_perf_scope()
+        # memory-ledger scope (observability/memory.py): params /
+        # opt-state / buffers bytes registered per-dtype when the
+        # device trees are built (same reset-on-reprepare discipline
+        # as the perf scope — stale rows must not survive a rebuild)
+        self._reset_mem_scope()
 
     # -- preparation --------------------------------------------------------
     def prepare(self, optimizer: Optional[Optimizer] = None, loss=None,
@@ -360,6 +366,14 @@ class Model:
         # cached cost analysis, and the dead entries would leak toward
         # PROGRAM_CAP
         self._reset_perf_scope()
+        # fresh ledger rows: the opt-state tree this prepare implies
+        # may differ (AdamW -> Adafactor is a 3 orders-of-magnitude
+        # accounting change); register what exists NOW (the network's
+        # param/buffer trees), and again with the optimizer state when
+        # _sync_state_in builds the device trees
+        self._reset_mem_scope()
+        if _memobs.enabled():
+            self._register_memory()
         _enable_compilation_cache(flags.get_flag("compilation_cache_dir"))
         self._register_status_provider()
 
@@ -395,6 +409,7 @@ class Model:
         """Pull state out of the stateful network into device trees.
         Only trainable params are differentiated/updated; frozen ones
         (Parameter(trainable=False)) ride along as constants."""
+        built = False
         if self._params is None:
             params, buffers = split_state(self.network)
             meta = self.network.param_meta()
@@ -409,8 +424,15 @@ class Model:
             self._params = dict(trainable)
             self._frozen = dict(frozen)
             self._buffers = dict(buffers)
+            built = True
         if self._opt_state is None and self._optimizer is not None:
             self._opt_state = self._optimizer.init_state(self._params)
+            built = True
+        if built and _memobs.enabled():
+            # allocation boundary: the device trees (and now the
+            # opt-state tree) exist — re-register the per-dtype rows
+            # under the same scope keys (overwrite, never accumulate)
+            self._register_memory()
 
     def sync_weights(self):
         """Rebind the latest device state onto the network's attributes.
@@ -728,6 +750,42 @@ class Model:
         self._perf_finalizer = _perf.finalize_scope(
             self, self._perf_scope)
 
+    def _reset_mem_scope(self) -> None:
+        """Fresh memory-ledger scope + GC finalizer (the perf-scope
+        discipline): a re-prepared/discarded Model's rows are
+        released, and the finalizer backstops Models dropped without
+        either path."""
+        old = getattr(self, "_mem_scope", None)
+        if old is not None:
+            _memobs.instance().remove_scope(old)
+            self._mem_finalizer.detach()
+        self._mem_scope = _memobs.next_scope()
+        self._mem_finalizer = _memobs.finalize_scope(
+            self, self._mem_scope)
+
+    def _register_memory(self) -> None:
+        """Register this Model's attributed reservations: params (the
+        trainable + frozen trees), buffers, and — once built —
+        optimizer state, per dtype, bytes from the ABSTRACT tree
+        (shape x itemsize; no device sync, no buffer retained).
+        Idempotent per scope: re-registration overwrites the same
+        (owner, kind) rows, so prepare-then-train registers twice and
+        the second write adds the opt-state rows the first couldn't
+        know."""
+        if self._params is not None:
+            params = dict(self._params)
+            params.update(self._frozen or {})
+            buffers = self._buffers or {}
+        else:
+            params, buffers = split_state(self.network)
+        trees = {"train_params": params, "train_buffers": buffers}
+        if self._opt_state is not None:
+            trees["train_opt_state"] = self._opt_state
+        led = _memobs.instance()
+        for owner, tree in trees.items():
+            for dt, nb in _memobs.tree_bytes_by_dtype(tree).items():
+                led.set_entry(self._mem_scope, owner, dt, nb)
+
     def _perf_program(self, kind: str, sig_items: Tuple, fn, args,
                       steps: int):
         """(handle, fresh) for this (kind, input-signature) compiled
@@ -901,12 +959,15 @@ class Model:
                         call_args, 1)
                 loss, self._params, self._opt_state, self._buffers, \
                     metric_outs = self._train_step_fn(*call_args)
-        except BaseException:
+        except BaseException as e:
             # a caught-and-skipped bad batch must not leak a live span
             # (the _live registry is uncapped, unlike the finished ring)
             if sp is not None:
                 sp.set_status("error")
                 sp.end()
+            # RESOURCE_EXHAUSTED: flight-dump the memory ledger's
+            # per-owner table before the error unwinds (one-shot)
+            _memobs.maybe_dump_oom(e, component="train")
             raise
         self._step_count += 1
         dt = time.perf_counter() - t0
@@ -1022,10 +1083,11 @@ class Model:
                         call_args, k)
                 losses, self._params, self._opt_state, self._buffers, \
                     metric_outs = self._train_loop_fn(*call_args)
-        except BaseException:
+        except BaseException as e:
             if sp is not None:
                 sp.set_status("error")
                 sp.end()
+            _memobs.maybe_dump_oom(e, component="train")
             raise
         self._step_count += k
         dt = time.perf_counter() - t0

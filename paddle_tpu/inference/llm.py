@@ -46,6 +46,7 @@ import numpy as np
 
 from ..core import flags as _flags
 from ..nn.layer import Layer, functional_call, split_state
+from ..observability import memory as _memobs
 from ..observability import metrics as _obs
 from ..observability import perf as _perf
 from ..observability import propagation as _propagation
@@ -592,6 +593,46 @@ class _Request:
         self.tenant: Optional[str] = None
 
 
+def _engine_memory_provider(ref):
+    """Live memory-ledger source over a weakref'd engine: the paged
+    KV pool split into free / private / prefix-cache-shared pages
+    (refcounted shared pages counted ONCE — a page is either still in
+    the free list, registered in the prefix cache, or privately held
+    by exactly one sequence), plus scratch page 0. Computed at READ
+    time from the same host counters the allocator already mutates —
+    the tick pays nothing. Headroom is ``eng._avail_pages()`` — the
+    EXACT quantity the admission path consults, not a re-derivation
+    that could drift from it. Reads are lock-free python ints (a
+    snapshot may be one tick stale, the /statusz discipline); the
+    pool total is exact at any instant: free + private + shared +
+    scratch == num_pages."""
+
+    def _provider():
+        eng = ref()
+        if eng is None or eng._closed:
+            return None
+        pb = eng._page_bytes
+        usable = eng.num_pages - 1
+        free = len(eng._free_pages)
+        cache = eng._cache
+        shared = cache.shared_page_count if cache is not None else 0
+        private = max(0, usable - free - shared)
+        rows = [
+            {"owner": "kv_pool", "kind": "free", "bytes": free * pb},
+            {"owner": "kv_pool", "kind": "private",
+             "bytes": private * pb},
+            {"owner": "kv_pool", "kind": "prefix_shared",
+             "bytes": shared * pb},
+            {"owner": "kv_pool", "kind": "scratch", "bytes": pb,
+             "detail": {"note": "page 0: masked/inactive writes"}},
+        ]
+        return {"rows": rows,
+                "headroom_pages": eng._avail_pages(),
+                "page_bytes": pb}
+
+    return _provider
+
+
 def _engine_status_provider(ref):
     """/statusz snapshot closure over a weakref'd engine: occupancy,
     page pool, prefix-cache and tick state — the live-inspection view
@@ -1021,6 +1062,31 @@ class LLMEngine:
         self.tick_history: deque = deque(maxlen=512)
         self._m = _engine_metrics()
         self._last_fetch_t: Optional[float] = None
+        # HBM attribution ledger (observability/memory.py): bytes one
+        # pool page occupies across all layers, K and V (draft pools
+        # share the page allocator, so their per-page bytes fold in),
+        # the unit every kv_pool ledger row and the headroom estimate
+        # are denominated in. Registered ONCE here — the live
+        # free/private/shared split is computed by the read, and the
+        # DecodeCarry control-plane arrays are a static scratch row.
+        self._page_bytes = (self.k_pages.nbytes + self.v_pages.nbytes)
+        if self.spec_k:
+            self._page_bytes += (self.draft_k_pages.nbytes +
+                                 self.draft_v_pages.nbytes)
+        self._page_bytes //= num_pages
+        self._mem_scope = _memobs.next_scope()
+        _memobs.finalize_scope(self, self._mem_scope)
+        if _memobs.enabled():
+            _memobs.register_provider(
+                self._mem_scope,
+                _engine_memory_provider(weakref.ref(self)))
+            n_carry = 4 if self.decode_ticks_per_dispatch > 1 else 1
+            _memobs.set_entry(
+                self._mem_scope, "decode_carry", "scratch",
+                n_carry * max_seqs * 4,
+                detail={"arrays": "tokens/positions/budgets + "
+                                  "_tokens_dev" if n_carry == 4
+                                  else "_tokens_dev"})
         # live-debug surface: /statusz reports this engine while it's
         # alive (weakref closure — a collected engine vanishes from
         # the listing instead of raising)
@@ -1217,6 +1283,10 @@ class LLMEngine:
         # dead entries (already-windowed events stay — real work)
         _perf.instance().remove_scope(self._perf_scope)
         self._perf_programs.clear()
+        # drop the memory-ledger rows too: a closed engine's pool is
+        # about to be garbage, and a stale kv_pool/headroom row would
+        # keep routing traffic at capacity that no longer exists
+        _memobs.instance().remove_scope(self._mem_scope)
         with self._mu:
             self._closed = True
         self._wake.set()
@@ -1920,7 +1990,12 @@ class LLMEngine:
                 # pending: fail OR re-admit the in-flight requests
                 # (per-request device_retry_budget), reclaim their
                 # pages, advance the health state machine, and keep
-                # serving — fresh requests may succeed
+                # serving — fresh requests may succeed. A
+                # RESOURCE_EXHAUSTED additionally flight-dumps the
+                # memory ledger's per-owner table BEFORE any pages are
+                # reclaimed below — the accounting at the instant of
+                # the OOM, not after the cleanup rewrote it
+                _memobs.maybe_dump_oom(e, component="llm")
                 self._inflight.clear()
                 self._prefill_q.clear()
                 self._fetch_seq = self._issue_seq

@@ -51,6 +51,7 @@ import jax
 import numpy as np
 
 from ..core.monitor import stat_add
+from ..observability import memory as _memobs
 from ..observability import metrics as _obs
 from ..reliability import faults as _faults
 from ..reliability.faults import FaultInjected
@@ -267,7 +268,26 @@ class CheckpointManager:
         self._pending = 0
         self._writer_err: Optional[BaseException] = None
         self._flush_timed_out = False
+        # memory-ledger accounting for the host-side staging buffers
+        # async saves hold alive (≤ 2 snapshots: one queued + one
+        # committing): registered as a placement="host" row so /memz
+        # can say WHY host RSS jumped by a full model copy mid-train.
+        # _staging_bytes is guarded by _cv like the rest of the
+        # writer state.
+        self._staging_bytes = 0
+        self._mem_scope = _memobs.next_scope()
+        _memobs.finalize_scope(self, self._mem_scope)
         self._sweep_debris()
+
+    def _note_staging(self, delta: int) -> None:
+        """Adjust the ledger's view of live host staging bytes; caller
+        does NOT hold _cv."""
+        with self._cv:
+            self._staging_bytes += delta
+            nbytes = self._staging_bytes
+        if _memobs.enabled():
+            _memobs.set_entry(self._mem_scope, "ckpt_staging", "host",
+                              nbytes, placement="host")
 
     # -- directory scanning -------------------------------------------------
     def _step_dir(self, step: int) -> str:
@@ -496,7 +516,7 @@ class CheckpointManager:
             item = self._q.get()
             if item is self._CLOSE:
                 return
-            step, host_tree, force, state = item
+            step, host_tree, force, state, staged = item
             try:
                 # injection site ckpt.async_commit: the queued commit
                 # about to run on the writer thread
@@ -507,6 +527,8 @@ class CheckpointManager:
                 with self._cv:          # the next save/barrier
                     self._writer_err = e
             finally:
+                del host_tree, item     # staging buffers die with the
+                self._note_staging(-staged)     # ledger row decrement
                 with self._cv:
                     self._pending -= 1
                     _ckpt_metrics()["queue"].set(self._pending)
@@ -562,13 +584,18 @@ class CheckpointManager:
         host_tree = jax.tree_util.tree_map(
             lambda x: np.array(x, copy=True), tree)
         _ckpt_metrics()["snapshot"].observe(time.perf_counter() - t0)
+        # ledger: this snapshot's host bytes are alive from here until
+        # the writer commits (or dies trying) — the row tracks the SUM
+        # over the ≤ 2 concurrently-alive snapshots
+        staged = _tree_bytes(host_tree)
+        self._note_staging(staged)
         self._ensure_writer()
         with self._cv:
             self._pending += 1
             _ckpt_metrics()["queue"].set(self._pending)
         # maxsize=1: blocks while another snapshot is still QUEUED —
         # the "barrier at the next save" that bounds host memory
-        self._q.put((step, host_tree, force, state))
+        self._q.put((step, host_tree, force, state, staged))
         return True
 
     # -- restore ------------------------------------------------------------

@@ -14,10 +14,15 @@ without numbers). Four parts:
 - exporters: Prometheus text exposition, chrome://tracing JSON merging
   spans + profiler host annotations onto one timeline, a periodic
   JSONL file reporter (atexit-flushed), jax device-memory gauges;
+- memory: the HBM attribution ledger (``/memz``) — owners register
+  reservations at allocation boundaries, reads reconcile against
+  ``device.memory_stats()`` with an explicit unattributed residual,
+  and RESOURCE_EXHAUSTED becomes a flight dump carrying the
+  per-owner table;
 - server + flight: a live HTTP debug surface (``/metrics /healthz
-  /statusz /tracez`` + ``POST /profilez``) and a crash flight
-  recorder that dumps the recent-span ring to JSONL on unhandled
-  exceptions, SIGTERM, and elastic preemption.
+  /statusz /tracez /perfz /memz`` + ``POST /profilez``) and a crash
+  flight recorder that dumps the recent-span ring to JSONL on
+  unhandled exceptions, SIGTERM, and elastic preemption.
 
 Hot paths ship instrumented: ``inference.llm`` (metrics + a span tree
 per request: queue → prefill chunks → first token → decode),
@@ -34,6 +39,7 @@ from .metrics import (BYTE_BUCKETS, DEFAULT_BUCKETS,  # noqa: F401
 from .exporters import (JSONLReporter, export_chrome_tracing,  # noqa: F401
                         prometheus_text, sample_device_memory,
                         write_prometheus)
+from . import memory  # noqa: F401
 from . import perf  # noqa: F401
 from . import propagation  # noqa: F401
 from . import tracing  # noqa: F401
@@ -58,7 +64,7 @@ __all__ = [
     "MetricFamily", "MetricRegistry", "default_registry",
     "JSONLReporter", "export_chrome_tracing", "prometheus_text",
     "sample_device_memory", "write_prometheus",
-    "perf",
+    "memory", "perf",
     "tracing", "Span", "SpanContext", "start_span", "trace_span",
     "enable_tracing", "disable_tracing", "tracing_enabled",
     "propagation", "TRACEPARENT_HEADER", "format_traceparent",

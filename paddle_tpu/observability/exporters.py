@@ -293,7 +293,11 @@ def sample_device_memory(registry: Optional[MetricRegistry] = None
     """Sample ``memory_stats()`` from every jax device into
     ``device_memory_bytes{device=..., kind=...}`` gauges; returns the
     raw per-device dicts. Backends without stats (CPU returns None)
-    contribute nothing — callers need no platform gate."""
+    contribute NO device gauge — a hole, never zeros (a zero would
+    read as "HBM empty" to every consumer of the series). When no
+    device reported anything, the documented fallback gauge
+    ``host_rss_bytes`` (process resident set size) is set instead so
+    the process still has ONE memory trend line."""
     import jax
     registry = registry or default_registry()
     gauge = registry.gauge(
@@ -314,4 +318,14 @@ def sample_device_memory(registry: Optional[MetricRegistry] = None
             if isinstance(v, (int, float)):
                 gauge.labels(device=name, kind=k).set(v)
                 out[name][k] = float(v)
+    if not out:
+        from .memory import host_rss_bytes
+        rss = host_rss_bytes()
+        if rss is not None:
+            registry.gauge(
+                "host_rss_bytes",
+                "process resident set size — the fallback memory "
+                "signal on backends whose devices export no "
+                "memory_stats() (CPU); see docs/OBSERVABILITY.md "
+                "\"Memory surfaces\"").set(rss)
     return out

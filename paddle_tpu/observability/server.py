@@ -22,6 +22,12 @@ profiler window):
   per-program cost table (XLA FLOPs + bytes per compiled signature),
   and the step-time breakdown per component (train dispatch vs
   compile vs drain; llm decode vs prefill).
+- ``GET /memz``     — the HBM attribution ledger
+  (observability.memory): per-owner table (model trees, KV pool split
+  free/private/prefix-shared, checkpoint staging), reconciled against
+  ``device.memory_stats()`` with an explicit unattributed residual,
+  per-phase high-watermarks, and the "KV pages addable" headroom
+  estimate.
 - ``GET /fleetz``   — fleet view (registered by a serving Router):
   per-replica health/breaker/scrape digest + computed aggregates;
   404 when this process fronts no fleet.
@@ -58,6 +64,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional
 from urllib.parse import parse_qs, urlparse
 
+from . import memory as _mem
 from . import perf as _perf
 from . import tracing
 from .exporters import prometheus_text, sample_device_memory
@@ -302,6 +309,15 @@ class DebugServer:
         self.registry = registry or default_registry()
         self.t_start = time.time()
         self._arm = _ProfilerArm()
+        # /statusz device-memory sample cache: a scrape storm must not
+        # hammer memory_stats() on every request (1s TTL; errors are
+        # cached too — a raising backend hurts just as much).
+        # Deliberately separate from MemoryLedger's 1s stats cache:
+        # this row is the RAW per-device dict (and sets the
+        # device_memory_bytes gauges), the ledger's is the summed
+        # reconcile aggregate — two shapes, each bounded to one
+        # memory_stats() sweep per second
+        self._devmem_cache: tuple = (0.0, None)
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -358,6 +374,15 @@ class DebugServer:
                     _perf.instance().update_gauges()
                 except Exception:  # noqa: BLE001 — scrape must answer
                     pass
+            # same discipline for the memory ledger: mem_bytes /
+            # mem_watermark_bytes / mem_headroom_pages refresh at the
+            # read boundary so the fleet federation scrape carries
+            # current attribution without a /memz hit first
+            if _mem.enabled():
+                try:
+                    _mem.instance().update_gauges()
+                except Exception:  # noqa: BLE001 — scrape must answer
+                    pass
             text = prometheus_text(self.registry)
             # registered scrape providers (fleet federation) append
             # their blocks; a broken provider must not kill the scrape
@@ -395,14 +420,34 @@ class DebugServer:
             # out of rotation while in-flight work finishes
             h._reply_json(503 if worst >= 2 else 200, body)
         elif url.path == "/statusz":
-            try:
-                devmem = sample_device_memory(self.registry)
-            except Exception as e:  # noqa: BLE001 — no backend yet
-                devmem = {"error": str(e)}
+            now = time.monotonic()
+            ts, cached = self._devmem_cache
+            if cached is not None and now - ts < 1.0:
+                devmem = cached
+            else:
+                try:
+                    devmem = sample_device_memory(self.registry)
+                except Exception as e:  # noqa: BLE001 — no backend yet
+                    devmem = {"error": str(e)}
+                if not devmem:
+                    # backends without memory_stats (CPU) used to show
+                    # a misleading empty dict here: report the hole
+                    # explicitly, with the documented host-RSS fallback
+                    rss = _mem.host_rss_bytes()
+                    devmem = {
+                        "note": "no device exports memory_stats() on "
+                                "this backend; host_rss_bytes is the "
+                                "fallback gauge",
+                        "host_rss_bytes": rss}
+                self._devmem_cache = (now, devmem)
             try:
                 perf_row = _perf.status_summary()
             except Exception as e:  # noqa: BLE001 — one bad row
                 perf_row = {"error": str(e)}
+            try:
+                mem_row = _mem.status_summary()
+            except Exception as e:  # noqa: BLE001 — one bad row
+                mem_row = {"error": str(e)}
             h._reply_json(200, {
                 "pid": os.getpid(),
                 "uptime_s": round(time.time() - self.t_start, 3),
@@ -410,6 +455,7 @@ class DebugServer:
                 "providers": _collect_status(),
                 "device_memory": devmem,
                 "perf": perf_row,
+                "memory": mem_row,
                 "profilez": self._arm.status()})
         elif url.path == "/tracez":
             # ?limit=N caps the finished spans returned (0 = no cap);
@@ -447,6 +493,14 @@ class DebugServer:
             # breakdown per component (docs/OBSERVABILITY.md "Perf
             # surfaces")
             h._reply_json(200, _perf.perfz_payload())
+        elif url.path == "/memz":
+            # the HBM attribution ledger: per-owner table + the
+            # device reconciliation with its explicit unattributed
+            # residual (docs/OBSERVABILITY.md "Memory surfaces").
+            # The payload refreshes the mem_* gauges from its own
+            # snapshot (ONE provider pass), so /memz and /metrics
+            # never disagree within a read.
+            h._reply_json(200, _mem.memz_payload())
         elif url.path == "/fleetz":
             fleets = _collect_dict_providers(_fleet_providers)
             if not fleets:
@@ -478,8 +532,8 @@ class DebugServer:
             h._reply_json(404, {
                 "error": f"unknown path {url.path}",
                 "endpoints": ["/metrics", "/healthz", "/statusz",
-                              "/tracez", "/perfz", "/fleetz", "/sloz",
-                              "/scalez", "POST /profilez",
+                              "/tracez", "/perfz", "/memz", "/fleetz",
+                              "/sloz", "/scalez", "POST /profilez",
                               "POST /reset_health"]})
 
     def _post(self, h) -> None:

@@ -18,9 +18,14 @@ the router, riding the health-poll cycle it already runs:
   in-process;
 - fleet-level AGGREGATES are computed into first-class gauges
   (``fleet_occupancy``, ``fleet_prefix_cache_hit_rate``,
-  ``fleet_tokens_generated``, ``fleet_replicas_scraped``) — the
-  numbers ROADMAP item 2's device-resident-decode case needs
-  fleet-wide, not per-process;
+  ``fleet_tokens_generated``, ``fleet_replicas_scraped``,
+  ``fleet_mfu`` and ``fleet_headroom_pages`` — the latter two with
+  hole semantics: a down/warming replica or one without the series
+  is ABSENT from the mean/sum, never a zero, with
+  ``fleet_mfu_replicas``/``fleet_headroom_replicas`` as auditable
+  denominators) — the numbers ROADMAP item 2's device-resident-decode
+  case and item 3's KV-page-migration routing need fleet-wide, not
+  per-process;
 - ``GET /fleetz`` (observability.server) renders the whole picture as
   JSON: per-replica health + breaker + key series next to the
   aggregates.
@@ -146,10 +151,11 @@ class FleetScraper:
                         "llm_prefix_cache_hit_tokens",
                         "llm_prompt_tokens", "llm_tokens_generated",
                         "llm_requests_completed", "perf_mfu",
-                        "perf_flops_per_second")
+                        "perf_flops_per_second", "mem_headroom_pages")
 
     def __init__(self, registry: Optional[MetricRegistry] = None,
-                 federate_prefixes: Tuple[str, ...] = ("llm_", "perf_"),
+                 federate_prefixes: Tuple[str, ...] = ("llm_", "perf_",
+                                                       "mem_"),
                  stale_after: float = 10.0):
         self.registry = registry or default_registry()
         self.federate_prefixes = tuple(federate_prefixes)
@@ -197,6 +203,22 @@ class FleetScraper:
         self._g_fps = reg.gauge(
             "fleet_flops_per_second",
             "sum of perf_flops_per_second across scraped replicas")
+        self._g_headroom = reg.gauge(
+            "fleet_headroom_pages",
+            "sum of mem_headroom_pages (KV pages each replica's paged "
+            "pools could still hand out) across UP replicas that "
+            "export it — a down or warming replica is a HOLE in the "
+            "sum, never a zero (its capacity is gone, not exhausted). "
+            "Per-replica values federate as "
+            "fleet_mem_headroom_pages{replica=...} via the mem_ "
+            "re-export prefix — the series KV-page-migration routing "
+            "reads")
+        self._g_headroom_n = reg.gauge(
+            "fleet_headroom_replicas",
+            "replicas whose mem_headroom_pages entered the "
+            "fleet_headroom_pages sum at the last scrape (the "
+            "auditable hole-semantics denominator, like "
+            "fleet_mfu_replicas)")
 
     # -- ingestion ------------------------------------------------------
     @staticmethod
@@ -269,7 +291,7 @@ class FleetScraper:
 
     def _refresh_aggregates(self) -> dict:
         up = self._snapshot_up()
-        occ, kv, mfu = [], [], []
+        occ, kv, mfu, headroom = [], [], [], []
         hit_tok = prompt_tok = tokens = completed = fps = 0.0
         for st in up.values():
             fams = st["families"]
@@ -279,6 +301,14 @@ class FleetScraper:
             m = _series_value(fams.get("perf_mfu"), "perf_mfu")
             if m is not None:
                 mfu.append(m)
+            # memory federation, same hole semantics: a replica whose
+            # pool closed (or never opened — warming) exports no
+            # mem_headroom_pages family at all and stays OUT of the
+            # sum and its denominator
+            hp = _series_value(fams.get("mem_headroom_pages"),
+                               "mem_headroom_pages")
+            if hp is not None:
+                headroom.append(hp)
             fps += _series_value(fams.get("perf_flops_per_second"),
                                  "perf_flops_per_second") or 0.0
             o_sum = _series_value(fams.get("llm_batch_occupancy"),
@@ -314,6 +344,8 @@ class FleetScraper:
             "mfu": (sum(mfu) / len(mfu)) if mfu else None,
             "mfu_replicas": len(mfu),
             "flops_per_second": fps,
+            "mem_headroom_pages": sum(headroom) if headroom else None,
+            "mem_headroom_replicas": len(headroom),
         }
         self._g_scraped.set(agg["replicas_scraped"])
         self._g_occ.set(agg["occupancy"])
@@ -324,6 +356,8 @@ class FleetScraper:
         self._g_mfu.set(agg["mfu"] or 0.0)
         self._g_mfu_n.set(agg["mfu_replicas"])
         self._g_fps.set(agg["flops_per_second"])
+        self._g_headroom.set(agg["mem_headroom_pages"] or 0.0)
+        self._g_headroom_n.set(agg["mem_headroom_replicas"])
         return agg
 
     def aggregates(self) -> dict:
@@ -389,5 +423,8 @@ class FleetScraper:
                     fams.get("llm_requests_completed"),
                     "llm_requests_completed"),
                 "mfu": _series_value(fams.get("perf_mfu"), "perf_mfu"),
+                "mem_headroom_pages": _series_value(
+                    fams.get("mem_headroom_pages"),
+                    "mem_headroom_pages"),
             }
         return out

@@ -67,8 +67,8 @@ BASELINE_WINDOW = 8
 
 # registry snapshot prefixes a ledger row carries (counters/gauges
 # only — histogram percentiles would bloat every row)
-METRIC_PREFIXES = ("llm_", "perf_", "train_compile_count",
-                   "train_step_count", "fleet_")
+METRIC_PREFIXES = ("llm_", "perf_", "mem_", "host_rss_bytes",
+                   "train_compile_count", "train_step_count", "fleet_")
 
 
 def ledger_path(path: Optional[str] = None) -> Optional[str]:
@@ -124,9 +124,11 @@ def metrics_snapshot(prefixes=METRIC_PREFIXES) -> Dict[str, float]:
     gauges first — they update at read boundaries, and a ledger row IS
     a read boundary."""
     try:
-        from paddle_tpu.observability import default_registry, perf
+        from paddle_tpu.observability import default_registry, memory, perf
         if perf.enabled():
             perf.instance().update_gauges()
+        if memory.enabled():
+            memory.instance().update_gauges()
     except Exception:  # noqa: BLE001 — emitters must not need jax up
         return {}
     out: Dict[str, float] = {}
@@ -150,11 +152,17 @@ def make_row(tool: str, workload: str, value: float, unit: str,
              tokens_per_sec: Optional[float] = None,
              mfu: Optional[float] = None,
              dispatches: Optional[float] = None,
+             peak_mem_bytes: Optional[float] = None,
              backend: Optional[str] = None,
              direction: str = "higher",
              extra: Optional[dict] = None,
              metrics: Optional[dict] = None) -> dict:
-    """Build one canonical ledger row (see module docstring)."""
+    """Build one canonical ledger row (see module docstring).
+    ``peak_mem_bytes`` (optional, schema-tolerated when absent — old
+    rows predate it) carries the memory ledger's attributed
+    high-watermark so capacity changes (int8 KV pages halving pool
+    bytes) are visible IN the perf trajectory, next to the
+    throughput they bought."""
     return {
         "schema": SCHEMA,
         "run_id": uuid.uuid4().hex[:12],
@@ -171,6 +179,8 @@ def make_row(tool: str, workload: str, value: float, unit: str,
         "mfu": float(mfu) if mfu is not None else None,
         "dispatches": (float(dispatches)
                        if dispatches is not None else None),
+        "peak_mem_bytes": (float(peak_mem_bytes)
+                          if peak_mem_bytes is not None else None),
         "direction": direction,
         "metrics": metrics if metrics is not None else metrics_snapshot(),
         "extra": extra or {},
@@ -273,6 +283,9 @@ def compare(rows: List[dict],
             "newest": newest["value"],
             "newest_rev": newest["git_rev"],
             "newest_mfu": newest.get("mfu"),
+            # optional field (rows predating it have no key at all —
+            # .get keeps --compare/--ci tolerant of the old schema)
+            "newest_peak_mem_bytes": newest.get("peak_mem_bytes"),
         }
         if not prior:
             v.update(status="new", baseline=None, ratio=None)

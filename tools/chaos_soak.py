@@ -97,7 +97,13 @@ equals that schedule.
    budgeted retries reproduce streams TOKEN-IDENTICAL to a fault-free
    reference engine (nonce-pinned); deadline/cancel storms landing
    mid-slab resolve typed within a slab boundary with their KV pages
-   reclaimed; the injected sequence replays from its seed.
+   reclaimed; the injected sequence replays from its seed. Rides
+   along: a PAGE-PRESSURE STORM (ISSUE 14) against a tiny pool
+   asserting the memory ledger's ``mem_headroom_pages`` gauge hits
+   ~0 exactly when slab-shrink engages, the kv_pool attribution rows
+   tile the pool at every sampled instant, and headroom recovers to
+   the full usable pool after the storm drains (gauge unexported —
+   a hole — once the engine closes).
 
 Run:  python tools/chaos_soak.py            # full soak (default seed)
 CI:   python tools/chaos_soak.py --ci       # fixed seeds, ~30s budget
@@ -398,6 +404,97 @@ def slab_soak(seed: int) -> dict:
     assert not open_llm, f"span trees left open: {open_llm}"
     return {"injected": n_injected, "cancelled": n_cancelled,
             "requests": len(futs) + len(dl) + len(storm)}
+
+
+def page_pressure_soak(seed: int) -> dict:
+    """ISSUE 14 phase (rides --slab): a PAGE-PRESSURE STORM against a
+    deliberately tiny KV pool, polling the memory ledger's headroom
+    while fused slabs fight the allocator. Asserts the accounting
+    closes the loop: the ``mem_headroom_pages`` gauge hits ~0 exactly
+    when slab-shrink engages (a slab truncating at ``covered == 0``
+    IS the allocator returning None, i.e. headroom 0 at that entry —
+    witnessed here by truncated results + a shrunk ``decode_loop``
+    signature + the polled gauge minimum), the kv_pool ledger rows
+    tile the pool exactly at every sampled instant, and headroom
+    RECOVERS to the full usable pool after the storm drains."""
+    from paddle_tpu.inference.llm import LLMEngine
+    from paddle_tpu.observability import memory as memobs
+    from paddle_tpu.observability.metrics import default_registry
+
+    rng = np.random.RandomState(seed)
+    net = _tiny_gpt()
+    N = 8
+    # 17 usable pages of 4 tokens: 4 slots x (2 prompt pages + up to
+    # 2 slab pages per dispatch) oversubscribes the pool by design
+    eng = LLMEngine(net, max_seqs=4, page_size=4, num_pages=18,
+                    prefill_buckets=(16,), max_len=64,
+                    decode_ticks_per_dispatch=N, admit_timeout=120.0)
+    led = memobs.instance()
+    usable = eng.num_pages - 1
+    samples = []
+    stop = threading.Event()
+
+    def poll():
+        while not stop.is_set():
+            h = led.headroom()
+            rows = {r["kind"]: r["bytes"] for r in led.rows()
+                    if r["owner"] == "kv_pool"}
+            if h is not None and rows:
+                led.update_gauges()
+                g = default_registry().get("mem_headroom_pages")
+                samples.append((h["kv_pages_addable"],
+                                g.value if g is not None else None,
+                                sum(rows.values())))
+            time.sleep(0.001)
+
+    poller = threading.Thread(target=poll, daemon=True)
+    poller.start()
+    try:
+        futs = [eng.submit(rng.randint(0, 97, 8).tolist(),
+                           max_new_tokens=40) for _ in range(8)]
+        done, not_done = fut_wait(futs, timeout=FUTURE_TIMEOUT)
+        assert not not_done, "futures pending under page pressure"
+        outs = [f.result() for f in futs]
+    finally:
+        stop.set()
+        poller.join(timeout=10)
+    n_trunc = sum(o["truncated"] for o in outs)
+    assert n_trunc >= 1, (
+        "the storm never hit page pressure — shrink/truncation path "
+        "unexercised (grow max_new_tokens or shrink num_pages)")
+    shrunk = any(k[0] == "decode_loop" and k[1] < N
+                 for k in eng._shape_signatures)
+    assert shrunk, (
+        f"no shrunk decode_loop signature compiled — the slab never "
+        f"hit the coverable boundary: {sorted(eng._shape_signatures)}")
+    assert samples, "ledger poller captured nothing"
+    min_head = min(s[0] for s in samples)
+    min_gauge = min(s[1] for s in samples if s[1] is not None)
+    assert min_head <= 1, (
+        f"headroom never approached 0 under a pool-exhausting storm "
+        f"(min {min_head} of {usable} usable)")
+    assert min_gauge <= 1, (
+        f"mem_headroom_pages gauge never approached 0 (min "
+        f"{min_gauge})")
+    # attribution exactness held at EVERY sampled instant: the
+    # free/private/shared/scratch rows tile the pool
+    pool_bytes = eng.num_pages * eng._page_bytes
+    bad = [s for s in samples if s[2] != pool_bytes]
+    assert not bad, (
+        f"kv_pool ledger rows stopped tiling the pool at "
+        f"{len(bad)}/{len(samples)} samples: {bad[:3]}")
+    # drained: every page is free or an evictable cache resident again
+    h = led.headroom()
+    assert h is not None and h["kv_pages_addable"] == usable, (
+        f"headroom did not recover after drain: {h} vs {usable}")
+    eng.close()
+    assert led.headroom() is None, \
+        "closed engine still reports pool headroom (stale provider)"
+    led.update_gauges()
+    assert default_registry().get("mem_headroom_pages") is None, \
+        "mem_headroom_pages gauge survived the last pool's close"
+    return {"requests": len(outs), "truncated": n_trunc,
+            "min_headroom": min_head, "samples": len(samples)}
 
 
 def ckpt_crash(seed: int, workdir: str) -> dict:
@@ -1691,6 +1788,7 @@ def main(argv=None) -> int:
             out["train"] = train_soak(seed, workdir)
         elif args.slab:
             out["slab"] = slab_soak(seed)
+            out["page_pressure"] = page_pressure_soak(seed)
         else:
             out["engine"] = engine_soak(seed)
             out["ckpt"] = ckpt_crash(seed, workdir)

@@ -76,6 +76,22 @@ except ImportError:  # script dir (tools/) leads sys.path
     import bench_ledger as _ledger  # noqa: E402
 
 
+def _peak_mem_bytes():
+    """The memory ledger's attributed high-watermark for this run —
+    the optional ``peak_mem_bytes`` every ledger row carries (None
+    when the ledger is disabled or never saw an owner)."""
+    try:
+        from paddle_tpu.observability import memory as _memobs
+        if _memobs.enabled():
+            # watermarks advance at read boundaries; a ledger row IS
+            # a read boundary (the perf-gauge discipline)
+            _memobs.instance().update_gauges()
+        peak = _memobs.instance().watermark_bytes()
+        return peak or None
+    except Exception:  # noqa: BLE001 — a row beats no row
+        return None
+
+
 def build_net(vocab=211, layers=2, hidden=128, heads=4, max_pos=512):
     import paddle_tpu as pt
     from paddle_tpu.models.gpt import GPTForCausalLM, gpt_config
@@ -275,7 +291,7 @@ def fleet_main(args):
             f.write(json.dumps(row) + "\n")
     # canonical trajectory row (PERF.md "The perf ledger")
     _ledger.append("llm_bench", row["metric"], row["value"],
-                   row["unit"],
+                   row["unit"], peak_mem_bytes=_peak_mem_bytes(),
                    extra={"affinity_hit_rate": aff["hit_rate"],
                           "round_robin_hit_rate": rr["hit_rate"],
                           "workload": row["workload"]})
@@ -528,6 +544,7 @@ def storm_main(args):
             f.write(json.dumps(row) + "\n")
     _ledger.append(
         "llm_bench", row["metric"], row["value"], row["unit"],
+        peak_mem_bytes=_peak_mem_bytes(),
         extra={"replica_seconds_static": rs_static,
                "replica_seconds_autoscaled": rs_auto,
                "gold_hit_static":
@@ -665,6 +682,7 @@ def decode_ticks_main(args, net=None, assert_ci=False):
                    row["unit"],
                    tokens_per_sec=n8_b1["tokens_per_sec"],
                    dispatches=n8_b1["host_dispatches_per_100_tokens"],
+                   peak_mem_bytes=_peak_mem_bytes(),
                    extra={"ratios": ratios,
                           "workload": row["workload"]})
     if assert_ci:
@@ -742,6 +760,7 @@ def main(argv=None):
     _ledger.append("llm_bench", row["metric"], row["value"],
                    row["unit"],
                    tokens_per_sec=on["e2e_tokens_per_sec"],
+                   peak_mem_bytes=_peak_mem_bytes(),
                    extra={"ttft_p50_s": on["ttft_p50_s"],
                           "cache_off_ttft_p50_s": off["ttft_p50_s"],
                           "workload": row["workload"]})

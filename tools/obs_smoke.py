@@ -138,6 +138,14 @@ def main(outdir: str = "/tmp/pt_obs_smoke") -> int:
         with urllib.request.urlopen(base + "/statusz", timeout=30) as r:
             st = json.loads(r.read())
         assert any(k.startswith("train_model_") for k in st["providers"])
+        # CPU backends export no memory_stats: /statusz must show the
+        # documented host-RSS fallback, never a bare misleading {}
+        devmem = st["device_memory"]
+        assert devmem, "/statusz device_memory is an empty dict"
+        if not any(isinstance(v, dict) for v in devmem.values()):
+            assert devmem.get("host_rss_bytes"), devmem
+        assert st.get("memory", {}).get("enabled") is True, \
+            st.get("memory")
         with urllib.request.urlopen(base + "/tracez?limit=8",
                                     timeout=30) as r:
             tz = json.loads(r.read())
@@ -179,7 +187,32 @@ def main(outdir: str = "/tmp/pt_obs_smoke") -> int:
             "perf_flops_per_second" in rescraped, \
             "perf gauges missing from /metrics"
 
-        # -- /perfz for a decode-slab LLMEngine run --------------------
+        # -- /memz after the fit: train trees attributed ---------------
+        # (the engine half of the /memz acceptance — kv_pool split,
+        # headroom, pool-exactness — runs in _engine_perf_section
+        # while the engine is LIVE)
+        with urllib.request.urlopen(base + "/memz", timeout=30) as r:
+            mz = json.loads(r.read())
+        assert mz["enabled"], mz
+        assert mz["attributed_device_bytes"] > 0, \
+            f"nothing attributed after a fit run: {mz}"
+        owners = {r["owner"] for r in mz["owners"]}
+        assert "train_params" in owners, owners
+        # the residual line must EXIST either way: a real number on
+        # backends with memory_stats, an explicit null + note on CPU
+        assert "unattributed_bytes" in mz, sorted(mz)
+        if mz["device"] is not None:
+            assert mz["attributed_device_bytes"] <= \
+                mz["device"]["bytes_in_use"], mz
+            assert abs(mz["attributed_device_bytes"]
+                       + mz["unattributed_bytes"]
+                       - mz["device"]["bytes_in_use"]) < 1, mz
+        else:
+            assert mz["unattributed_bytes"] is None
+            assert mz["unattributed_note"], mz
+        assert mz["watermarks"], "no phase watermark recorded"
+
+        # -- /perfz + /memz for a decode-slab LLMEngine run ------------
         _engine_perf_section(base)
     finally:
         srv.stop()
@@ -248,6 +281,31 @@ def _engine_perf_section(base: str) -> None:
         # entries from the registry; the windowed rates persist)
         with urllib.request.urlopen(base + "/perfz", timeout=60) as r:
             pz = json.loads(r.read())
+        # /memz while the engine is LIVE: the kv_pool split must tile
+        # the pool exactly (free + private + prefix_shared + scratch
+        # == num_pages x page_bytes) and sit under the device total
+        # where the backend reports one
+        with urllib.request.urlopen(base + "/memz", timeout=60) as r:
+            mz = json.loads(r.read())
+        kv = {r["kind"]: r["bytes"] for r in mz["owners"]
+              if r["owner"] == "kv_pool"}
+        assert set(kv) == {"free", "private", "prefix_shared",
+                           "scratch"}, kv
+        page_bytes = eng._page_bytes
+        assert sum(kv.values()) == eng.num_pages * page_bytes, \
+            (kv, eng.num_pages, page_bytes)
+        assert mz["headroom"] is not None and \
+            mz["headroom"]["kv_pages_addable"] > 0, mz["headroom"]
+        if mz["device"] is not None:
+            assert mz["attributed_device_bytes"] <= \
+                mz["device"]["bytes_in_use"], mz
+        # the gauges ride the same read: the federation scrape path
+        with urllib.request.urlopen(base + "/metrics", timeout=30) as r:
+            scraped = r.read().decode()
+        assert "mem_headroom_pages" in scraped and \
+            "mem_bytes{" in scraped and \
+            "mem_watermark_bytes" in scraped, \
+            "mem gauges missing from /metrics"
     assert all(o["output_ids"] for o in outs)
     assert all(o.get("served_flops", 0) > 0 for o in outs), outs
     slabs = [p for p in pz["programs"]
@@ -360,6 +418,14 @@ def fleet_main(outdir: str = "/tmp/pt_obs_fleet_smoke") -> int:
             "replica perf gauges not federated"
         assert "fleet_mfu " in scraped or "fleet_mfu{" in scraped, \
             "fleet_mfu aggregate missing"
+        # memory federation: each replica's pool headroom rides the
+        # same scrape and sums into fleet_mem_headroom_pages (holes
+        # for down replicas — pinned unit-side in
+        # tests/test_memory_observability.py)
+        assert 'fleet_mem_headroom_pages{replica=' in scraped, \
+            "replica mem_headroom_pages not federated"
+        assert "fleet_headroom_pages " in scraped, \
+            "fleet_headroom_pages aggregate missing"
         # -- ONE cross-process trace ------------------------------------
         out = outs[0]
         tid = out["trace_id"]
