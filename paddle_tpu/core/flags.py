@@ -170,6 +170,27 @@ define_flag("decode_ticks_per_dispatch", 1,
             "LLMEngine(decode_ticks_per_dispatch=...) overrides per "
             "engine.",
             validator=lambda v: v >= 1)
+define_flag("mixed_tick", False,
+            "Default for LLMEngine(mixed_tick=...): serve prefill "
+            "chunk rows and decode rows as ONE ragged mixed batch "
+            "inside the fused DecodeCarry scan (ops ragged_paged_"
+            "attention) — a slab tick admits queued prefill work with "
+            "zero host dispatches between phases, collapsing the "
+            "alternating prefill/decode tick loop. Token streams are "
+            "identical to the legacy two-op tick path (sampling keys "
+            "fold (nonce, position) only; test-pinned). Off keeps the "
+            "legacy alternating path; speculative engines always use "
+            "their own round structure.")
+define_flag("kv_dtype", "",
+            "Default storage dtype for LLMEngine's paged KV pool: "
+            "'int8' (quantized pages + per-token scale table beside "
+            "the pool — ~2x page capacity, so ~2x decode occupancy "
+            "and ~2x effective prefix cache at fixed HBM; greedy "
+            "parity within a documented tolerance of the f32 "
+            "reference path), 'bf16'/'f16'/'f32' (plain pools), or "
+            "empty to keep the engine's cache_dtype argument "
+            "(legacy default f32). LLMEngine(kv_dtype=...) overrides "
+            "per engine.")
 define_flag("numeric_guard", False,
             "Arm the on-device numeric guard (reliability/guard.py) "
             "with default GuardPolicy() in Model.prepare when no "

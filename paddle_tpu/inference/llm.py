@@ -52,7 +52,10 @@ from ..observability import perf as _perf
 from ..observability import propagation as _propagation
 from ..observability import server as _dbgsrv
 from ..observability import tracing as _trace
-from ..ops.paged_attention import paged_attention
+from ..ops.paged_attention import (KV_DTYPES, QuantizedKV, kv_layer,
+                                   kv_nbytes, kv_page_size,
+                                   kv_scale_nbytes, kv_write, kv_zeros,
+                                   ragged_paged_attention)
 from ..reliability import faults as _faults
 from ..reliability.retry import Deadline, DeadlineExceeded, as_deadline
 
@@ -163,6 +166,15 @@ def _engine_metrics():
             "chunked-prefill engine ticks (one chunk each)"),
         "decode_ticks": reg.counter(
             "llm_decode_ticks", "decode engine ticks (one step each)"),
+        "mixed_slabs": reg.counter(
+            "llm_mixed_slabs_total",
+            "fused MIXED prefill+decode slab dispatches (one ragged "
+            "batch of chunk rows + decode rows per tick, inside the "
+            "DecodeCarry scan; mixed_tick engines only)"),
+        "mixed_prefill_tokens": reg.counter(
+            "llm_mixed_prefill_tokens_total",
+            "prompt tokens computed INSIDE mixed slabs (admitted to "
+            "the scan with zero host dispatches between phases)"),
         "tick_ratio": reg.gauge(
             "llm_prefill_decode_tick_ratio",
             "prefill ticks / decode ticks since engine start"),
@@ -272,12 +284,25 @@ class DecodeCarry(NamedTuple):
       guard's masked updates — finished slots ride out the slab
       without corrupting anything.
     - ``k_pages``/``v_pages`` — the paged KV pool, updated in place
-      tick to tick (donated, like the per-tick path).
+      tick to tick (donated, like the per-tick path). For a
+      ``kv_dtype="int8"`` engine each field holds a
+      :class:`~paddle_tpu.ops.paged_attention.QuantizedKV` (int8
+      pages + the per-token scale table) instead of a plain array —
+      the scales ride the same donated carry, quantize-on-write
+      happens inside the tick body, and non-quantized engines'
+      compiled programs are unchanged (the field is just a different
+      pytree).
 
     Scan-invariant per-slot state (block tables, temperatures, nonces,
     the engine PRNG key) rides OUTSIDE the carry as ordinary arguments:
     the slab pre-reserves pages for up to N tokens at entry, so the
-    body never grows the page table and stays shape-stable."""
+    body never grows the page table and stays shape-stable. A MIXED
+    slab (``mixed_tick=True``) additionally consumes a per-tick xs
+    pytree of prefill chunk rows — the host packs the whole prefill
+    schedule at slab entry, and a slot whose prompt completes at tick
+    j has its sampled first token, start position and emission budget
+    installed INTO the carry at that tick, so it decodes from tick
+    j+1 onward without ever surfacing to the host."""
 
     tokens: jax.Array
     positions: jax.Array
@@ -298,15 +323,17 @@ class _PagedDecode(Layer):
         self.attention_impl = attention_impl
 
     def _paged_attention(self, q, k_pages, v_pages, tables, lens):
-        return paged_attention(q, k_pages, v_pages, tables, lens,
-                               impl=self.attention_impl)
+        # the decode step IS the T=batch single-token case of the one
+        # ragged entry point (per-row table + limit — same contract)
+        return ragged_paged_attention(q, k_pages, v_pages, tables,
+                                      lens, impl=self.attention_impl)
 
     def forward(self, tokens, positions, block_tables, context_lens,
                 k_pages, v_pages, temperature, nonces, key):
         net, cfg = self.net, self.net.cfg
         gpt = net.gpt
         b = tokens.shape[0]
-        ps = k_pages.shape[2]
+        ps = kv_page_size(k_pages)
         hd = cfg.head_dim
 
         pos_ids = positions[:, None]                      # [B, 1]
@@ -337,13 +364,11 @@ class _PagedDecode(Layer):
             if cfg.use_rope:
                 q, k = apply_rotary_pos_emb(q, k, cos, sin,
                                             position_ids=pos_ids)
-            k_pages = k_pages.at[i, page_idx, offs].set(
-                k[:, 0].astype(k_pages.dtype))
-            v_pages = v_pages.at[i, page_idx, offs].set(
-                v[:, 0].astype(v_pages.dtype))
-            att = self._paged_attention(q[:, 0], k_pages[i],
-                                        v_pages[i], block_tables,
-                                        context_lens)
+            k_pages = kv_write(k_pages, i, page_idx, offs, k[:, 0])
+            v_pages = kv_write(v_pages, i, page_idx, offs, v[:, 0])
+            att = self._paged_attention(q[:, 0], kv_layer(k_pages, i),
+                                        kv_layer(v_pages, i),
+                                        block_tables, context_lens)
             x = x + layer.attn.out_proj(
                 att.reshape(b, 1, cfg.hidden_size))
             x = x + layer.mlp(layer.ln_2(x))
@@ -370,12 +395,19 @@ class _PagedVerify(Layer):
 
     def forward(self, tokens, base_lens, block_tables, k_pages,
                 v_pages):
-        from ..ops.paged_attention import paged_attention_chunk
         net, cfg = self.net, self.net.cfg
         gpt = net.gpt
         b, kq = tokens.shape
-        ps = k_pages.shape[2]
+        ps = kv_page_size(k_pages)
         hd = cfg.head_dim
+        # per-token causal limits of the verify window, flattened to
+        # the ONE ragged entry point's [T] contract (query j of slot b
+        # attends base_lens[b]+j+1 positions; inactive slots 0)
+        rag_limits = jnp.where(
+            base_lens[:, None] > 0,
+            base_lens[:, None] + jnp.arange(kq)[None, :] + 1,
+            0).reshape(-1)
+        rag_tables = jnp.repeat(block_tables, kq, axis=0)
 
         pos_ids = base_lens[:, None] + jnp.arange(kq)[None, :]  # [B,K]
         x = gpt.embeddings(tokens, position_ids=pos_ids)
@@ -402,12 +434,12 @@ class _PagedVerify(Layer):
             if cfg.use_rope:
                 q, k = apply_rotary_pos_emb(q, k, cos, sin,
                                             position_ids=pos_ids)
-            k_pages = k_pages.at[i, page_idx, offs].set(
-                k.astype(k_pages.dtype))
-            v_pages = v_pages.at[i, page_idx, offs].set(
-                v.astype(v_pages.dtype))
-            att = paged_attention_chunk(q, k_pages[i], v_pages[i],
-                                        block_tables, base_lens)
+            k_pages = kv_write(k_pages, i, page_idx, offs, k)
+            v_pages = kv_write(v_pages, i, page_idx, offs, v)
+            att = ragged_paged_attention(
+                q.reshape(b * kq, cfg.num_heads, hd),
+                kv_layer(k_pages, i), kv_layer(v_pages, i),
+                rag_tables, rag_limits)
             x = x + layer.attn.out_proj(
                 att.reshape(b, kq, cfg.hidden_size))
             x = x + layer.mlp(layer.ln_2(x))
@@ -432,18 +464,18 @@ class _PagedPrefill(Layer):
                 temperature, nonce, key):
         net, cfg = self.net, self.net.cfg
         s = ids.shape[1]
-        ps = k_pages.shape[2]
-        caches = net.init_caches(1, s, dtype=k_pages.dtype)
+        ps = kv_page_size(k_pages)
+        compute_dtype = jnp.float32 if isinstance(k_pages, QuantizedKV) \
+            else k_pages.dtype
+        caches = net.init_caches(1, s, dtype=compute_dtype)
         logits, caches = net(ids, caches=caches)
         pos = jnp.arange(s)
         valid = pos < true_len
         page_idx = jnp.where(valid, block_row[pos // ps], 0)
         offs = pos % ps
         for i, (k_c, v_c, _) in enumerate(caches):
-            k_pages = k_pages.at[i, page_idx, offs].set(
-                k_c[0].astype(k_pages.dtype))
-            v_pages = v_pages.at[i, page_idx, offs].set(
-                v_c[0].astype(v_pages.dtype))
+            k_pages = kv_write(k_pages, i, page_idx, offs, k_c[0])
+            v_pages = kv_write(v_pages, i, page_idx, offs, v_c[0])
         last = logits[0, true_len - 1][None]              # [1, V]
         nxt = _sample(last, temperature[None], key, nonce[None],
                       (true_len - 1)[None])[0]
@@ -474,11 +506,10 @@ class _ChunkedPrefill(Layer):
     def forward(self, tokens, positions, limits, tables, sample_idx,
                 sample_pos, k_pages, v_pages, temperatures, nonces,
                 key):
-        from ..ops.paged_attention import paged_attention_ragged
         net, cfg = self.net, self.net.cfg
         gpt = net.gpt
         t = tokens.shape[0]
-        ps = k_pages.shape[2]
+        ps = kv_page_size(k_pages)
         hd = cfg.head_dim
 
         pos_ids = positions[None, :]                       # [1, T]
@@ -507,11 +538,10 @@ class _ChunkedPrefill(Layer):
             if cfg.use_rope:
                 q, k = apply_rotary_pos_emb(q, k, cos, sin,
                                             position_ids=pos_ids)
-            k_pages = k_pages.at[i, page_idx, offs].set(
-                k[0].astype(k_pages.dtype))
-            v_pages = v_pages.at[i, page_idx, offs].set(
-                v[0].astype(v_pages.dtype))
-            att = paged_attention_ragged(q[0], k_pages[i], v_pages[i],
+            k_pages = kv_write(k_pages, i, page_idx, offs, k[0])
+            v_pages = kv_write(v_pages, i, page_idx, offs, v[0])
+            att = ragged_paged_attention(q[0], kv_layer(k_pages, i),
+                                         kv_layer(v_pages, i),
                                          tables, limits,
                                          impl=self.attention_impl)
             x = x + layer.attn.out_proj(
@@ -525,6 +555,97 @@ class _ChunkedPrefill(Layer):
         logits = _lm_logits(cfg, gpt.embeddings, rows[:, None],
                             getattr(net, "lm_head", None))[:, 0]
         nxt = _sample(logits, temperatures, key, nonces, sample_pos)
+        return nxt, k_pages, v_pages
+
+
+class _MixedTick(Layer):
+    """ONE ragged mixed prefill+decode tick: C prefill chunk rows
+    (queued prompts' uncached suffixes, packed exactly like
+    :class:`_ChunkedPrefill`) and B decode rows (each live slot's last
+    token, exactly like :class:`_PagedDecode`) run as a SINGLE batched
+    forward of T = C + B token rows. Every row carries its own block
+    table and causal limit, so one :func:`ragged_paged_attention` call
+    serves both phases — the ragged formulation makes "mixed" a batch
+    property, not a program property.
+
+    Exactness: each row's math is independent of the others (per-row
+    gather, per-row softmax, per-row LM-head dot), so the computed
+    KV, logits and sampling keys are IDENTICAL to the legacy two-op
+    path that dispatched the same rows as separate prefill and decode
+    programs (test-pinned token identity, greedy and seeded).
+
+    Sampling: one [max_seqs] gathered-row LM head per tick — slot b's
+    row is its finishing prompt token (``fin_row``) when its prompt
+    completes this tick, its decode row (C + b) otherwise; the sample
+    position is ``fin_pos`` (= len(prompt) - 1) or its feed position
+    — the same (nonce, position) key either phase would fold."""
+
+    def __init__(self, net, attention_impl: str = "xla"):
+        super().__init__()
+        self.net = net
+        self.attention_impl = attention_impl
+
+    def forward(self, ptok, ppos, plim, ptbl, fin, fin_row, fin_pos,
+                dtok, dpos, dlens, tables, k_pages, v_pages, temps,
+                nonces, key):
+        net, cfg = self.net, self.net.cfg
+        gpt = net.gpt
+        c = ptok.shape[0]
+        b = dtok.shape[0]
+        t = c + b
+        ps = kv_page_size(k_pages)
+        hd = cfg.head_dim
+
+        tok_all = jnp.concatenate([ptok, dtok])            # [T]
+        pos_all = jnp.concatenate([ppos, dpos])
+        lim_all = jnp.concatenate([plim, dlens])
+        tbl_all = jnp.concatenate([jnp.clip(ptbl, 0),
+                                   jnp.clip(tables, 0)], axis=0)
+        pos_ids = pos_all[None, :]                         # [1, T]
+        x = gpt.embeddings(tok_all[None, :], position_ids=pos_ids)
+        active = lim_all > 0
+        page_idx = jnp.take_along_axis(
+            tbl_all, (pos_all // ps)[:, None], axis=1)[:, 0]
+        page_idx = jnp.where(active, page_idx, 0)  # pads → scratch 0
+        offs = pos_all % ps
+
+        if cfg.use_rope:
+            from ..ops.rotary import apply_rotary_pos_emb, rope_tables
+            cos, sin = rope_tables(hd, cfg.max_position_embeddings,
+                                   cfg.rope_base)
+
+        for i, layer in enumerate(gpt.layers):
+            h = layer.ln_1(x)
+            qkv = layer.attn.qkv_proj(h)
+            q, k, v = jnp.split(
+                qkv, [cfg.hidden_size,
+                      cfg.hidden_size + cfg.num_kv_heads * hd], axis=-1)
+            q = q.reshape(1, t, cfg.num_heads, hd)
+            k = k.reshape(1, t, cfg.num_kv_heads, hd)
+            v = v.reshape(1, t, cfg.num_kv_heads, hd)
+            if cfg.use_rope:
+                q, k = apply_rotary_pos_emb(q, k, cos, sin,
+                                            position_ids=pos_ids)
+            k_pages = kv_write(k_pages, i, page_idx, offs, k[0])
+            v_pages = kv_write(v_pages, i, page_idx, offs, v[0])
+            att = ragged_paged_attention(q[0], kv_layer(k_pages, i),
+                                         kv_layer(v_pages, i),
+                                         tbl_all, lim_all,
+                                         impl=self.attention_impl)
+            x = x + layer.attn.out_proj(
+                att.reshape(1, t, cfg.hidden_size))
+            x = x + layer.mlp(layer.ln_2(x))
+        x = gpt.ln_f(x)
+        from ..models.gpt import _lm_logits
+        # one gathered LM-head row per slot: the finishing prompt row
+        # when the slot's prefill completes this tick, its decode row
+        # otherwise ([max_seqs, H] rows, never [T, V] full logits)
+        rows_idx = jnp.where(fin, fin_row, c + jnp.arange(b))
+        rows = jnp.take(x[0], rows_idx, axis=0)            # [B, H]
+        logits = _lm_logits(cfg, gpt.embeddings, rows[:, None],
+                            getattr(net, "lm_head", None))[:, 0]
+        sample_pos = jnp.where(fin, fin_pos, dpos)
+        nxt = _sample(logits, temps, key, nonces, sample_pos)
         return nxt, k_pages, v_pages
 
 
@@ -611,21 +732,38 @@ def _engine_memory_provider(ref):
         eng = ref()
         if eng is None or eng._closed:
             return None
+        # dtype/scale split: the free/private/shared/scratch rows are
+        # denominated in the KV bytes a page actually stores at the
+        # pool dtype; an int8 pool adds ONE distinct "scale_table"
+        # row for the per-token scales beside it. headroom stays
+        # exact under quantization because page_bytes (the marginal
+        # cost of adding a page) is kv + scale bytes together.
         pb = eng._page_bytes
+        pbs = eng._page_scale_bytes
+        pbk = pb - pbs
         usable = eng.num_pages - 1
         free = len(eng._free_pages)
         cache = eng._cache
         shared = cache.shared_page_count if cache is not None else 0
         private = max(0, usable - free - shared)
+        dt = {"dtype": eng.kv_dtype}
         rows = [
-            {"owner": "kv_pool", "kind": "free", "bytes": free * pb},
+            {"owner": "kv_pool", "kind": "free", "bytes": free * pbk,
+             "detail": dt},
             {"owner": "kv_pool", "kind": "private",
-             "bytes": private * pb},
+             "bytes": private * pbk, "detail": dt},
             {"owner": "kv_pool", "kind": "prefix_shared",
-             "bytes": shared * pb},
-            {"owner": "kv_pool", "kind": "scratch", "bytes": pb,
-             "detail": {"note": "page 0: masked/inactive writes"}},
+             "bytes": shared * pbk, "detail": dt},
+            {"owner": "kv_pool", "kind": "scratch", "bytes": pbk,
+             "detail": {"note": "page 0: masked/inactive writes",
+                        "dtype": eng.kv_dtype}},
         ]
+        if pbs:
+            rows.append(
+                {"owner": "kv_pool", "kind": "scale_table",
+                 "bytes": eng.num_pages * pbs,
+                 "detail": {"note": "int8 per-token dequantization "
+                                    "scales (f32, beside the pool)"}})
         return {"rows": rows,
                 "headroom_pages": eng._avail_pages(),
                 "page_bytes": pb}
@@ -661,13 +799,16 @@ def _engine_status_provider(ref):
             "consecutive_device_errors": eng._consec_device_errors,
             "lookahead": eng.lookahead,
             "decode_ticks_per_dispatch": eng.decode_ticks_per_dispatch,
+            "mixed_tick": eng.mixed_tick,
+            "kv_dtype": eng.kv_dtype,
             "host_dispatches": eng.n_host_dispatches,
             "flops_per_token": eng.flops_per_token,
             "n_steps": eng.n_steps,
             "n_tokens": eng.n_tokens,
             "prompt_tokens": eng.n_prompt_tokens,
             "ticks": {"prefill": eng.n_prefill_ticks,
-                      "decode": eng.n_decode_ticks},
+                      "decode": eng.n_decode_ticks,
+                      "mixed": eng.n_mixed_slabs},
         }
         cache = eng._cache
         if cache is not None:
@@ -743,6 +884,42 @@ class LLMEngine:
     clamped to 1 for speculative engines (rounds are their own
     fusion).
 
+    ``mixed_tick``: ONE RAGGED MIXED TICK (default
+    ``FLAGS.mixed_tick``) — serve the prefill queue's chunk rows AND
+    the live slots' decode step as a single ragged batch per tick,
+    inside the fused ``DecodeCarry`` scan
+    (:func:`~paddle_tpu.ops.paged_attention.ragged_paged_attention`
+    makes "mixed" a batch property: every row carries its own block
+    table and causal limit). A prompt that completes at tick j of a
+    slab starts decoding at tick j+1 ON DEVICE — its sampled first
+    token, start position and emission budget are installed into the
+    carry by the scan body, so a slab admits prefill work with ZERO
+    host dispatches between the phases; the legacy alternating
+    prefill-tick/decode-tick loop collapses into one dispatch. Token
+    streams are IDENTICAL to the legacy two-op path (each row's math
+    is independent; sampling keys fold (nonce, position) only —
+    test-pinned greedy AND seeded, cache on/off). Composes with
+    ``decode_ticks_per_dispatch`` (a mixed slab runs N mixed ticks);
+    conflicts with ``lookahead`` (drain-at-boundary, like the slab)
+    and is clamped off for speculative engines.
+
+    ``kv_dtype``: KV POOL STORAGE DTYPE (default ``FLAGS.kv_dtype``,
+    falling back to the legacy ``cache_dtype`` argument).
+    ``"int8"`` stores QUANTIZED pages with per-token f32 scales
+    beside the pool (quantize-on-write in every prefill/decode page
+    write, dequantize-in-kernel at every read): ~2x page capacity at
+    fixed HBM means ~2x decode occupancy and ~2x effective prefix
+    cache. Quantization is deterministic (identical KV → identical
+    bytes), so cache on/off, fused slabs and nonce-pinned retries
+    remain token-identical to each other AT int8; greedy parity vs
+    the f32 pool is pinned within a documented tolerance against the
+    f32-accumulate reference path (``impl="reference"``; see
+    PERF.md "Ragged mixed tick + int8 KV"). A quantized page rides
+    the SAME CoW/digest/refcount discipline as a plain one — the
+    prefix cache keys pages by prompt-token digests, not bytes.
+    Does not compose with ``draft_net`` (quantized draft pools
+    deferred).
+
     ``prefix_cache`` + ``prefill_chunk``: PREFIX CACHING over the page
     pool (full prompt pages become immutable, refcounted, and keyed by
     a rolling hash — a new request whose prompt prefix matches maps
@@ -776,7 +953,9 @@ class LLMEngine:
                  device_retry_budget: int = 0,
                  degraded_after: int = 1,
                  drain_after: int = 8,
-                 decode_ticks_per_dispatch: Optional[int] = None):
+                 decode_ticks_per_dispatch: Optional[int] = None,
+                 kv_dtype: Optional[str] = None,
+                 mixed_tick: Optional[bool] = None):
         cfg = net.cfg
         self.cfg = cfg
         self.max_seqs = max_seqs
@@ -790,11 +969,44 @@ class LLMEngine:
             b for b in prefill_buckets if b <= self.max_len) or \
             [self.max_len]
         net.eval()
+        # KV pool storage dtype: the ``kv_dtype`` knob ("int8" →
+        # quantized pages + per-token scale tables beside the pool,
+        # ~2x page capacity at fixed HBM; "bf16"/"f16"/"f32" → plain
+        # pools) defaults from FLAGS.kv_dtype and falls back to the
+        # legacy ``cache_dtype`` argument when unset.
+        if kv_dtype is None:
+            kv_dtype = _flags.get_flag("kv_dtype") or None
+        legacy_dtype = kv_dtype is None
+        if legacy_dtype:
+            # legacy cache_dtype argument: normalize into the SAME
+            # validation path (cache_dtype=jnp.int8 is the quantized
+            # pool too — it must hit the same guards, not silently
+            # build a QuantizedKV a draft engine can't share)
+            name = jnp.dtype(cache_dtype).name
+            kv_dtype = {"float32": "f32", "bfloat16": "bf16",
+                        "float16": "f16"}.get(name, name)
+        kv_dtype = str(kv_dtype)
+        if kv_dtype in KV_DTYPES:
+            cache_dtype = KV_DTYPES[kv_dtype]
+        elif not legacy_dtype:
+            raise ValueError(
+                f"unknown kv_dtype {kv_dtype!r}; expected one of "
+                f"{sorted(KV_DTYPES)}")
+        # else: an exotic legacy cache_dtype (e.g. float64) keeps the
+        # old plain-pool behavior, labeled by its dtype name
+        if kv_dtype == "int8" and draft_net is not None:
+            raise ValueError(
+                "kv_dtype='int8' does not compose with draft_net: "
+                "the speculative draft pool shares the block "
+                "tables and would need its own scale tables "
+                "(quantized draft pools deferred)")
+        self.kv_dtype = kv_dtype
         L = cfg.num_layers
-        self.k_pages = jnp.zeros(
+        self.k_pages = kv_zeros(
             (L, num_pages, page_size, cfg.num_kv_heads, cfg.head_dim),
             cache_dtype)
-        self.v_pages = jnp.zeros_like(self.k_pages)
+        self.v_pages = jax.tree_util.tree_map(jnp.zeros_like,
+                                              self.k_pages)
         # host-side control plane (numpy: mutated by the allocator)
         self.block_tables = np.zeros((max_seqs, self.pages_per_seq),
                                      np.int32)
@@ -823,6 +1035,22 @@ class LLMEngine:
                 "(on-device EOS decides how far positions advanced), "
                 "and the slab already keeps the device busy for N "
                 "ticks per fetch — use one knob or the other")
+        # MIXED TICK: serve prefill chunk rows and decode rows as ONE
+        # ragged batch inside the fused scan (collapses the
+        # alternating prefill/decode tick loop; the ragged entry
+        # point makes "mixed" a batch property). Speculative engines
+        # keep their own round structure (clamped off, like the slab
+        # knob); lookahead conflicts for the same drain-at-boundary
+        # reason as the slab.
+        if mixed_tick is None:
+            mixed_tick = _flags.get_flag("mixed_tick")
+        self.mixed_tick = bool(mixed_tick) and draft_net is None
+        if self.mixed_tick and self.lookahead:
+            raise ValueError(
+                "mixed_tick does not compose with lookahead: a mixed "
+                "slab must drain at its boundary (the device decides "
+                "which tick each slot's prompt completed and how far "
+                "its decode advanced) — use one knob or the other")
         # recompile-signature guard (same discipline as Model
         # _guard_recompiles): fused-slab programs ("decode_loop", one
         # per distinct realized slab length) are counted separately
@@ -1025,6 +1253,63 @@ class LLMEngine:
             self._cache = PrefixCache(page_size) if prefix_cache \
                 else None
 
+            # THE MIXED SLAB: n_ticks ragged mixed prefill+decode
+            # ticks as ONE program. Each tick consumes its slice of
+            # the pre-packed prefill schedule (xs) and the decode
+            # carry; a slot whose prompt COMPLETES at tick j gets its
+            # sampled first token, start position and emission budget
+            # installed into the carry — from tick j+1 it decodes on
+            # device, with zero host dispatches between the phases.
+            # Finished/inactive slots are masked no-ops exactly like
+            # the pure-decode slab; a tick with neither budgets nor
+            # prefill rows is skipped by the cond.
+            mixed = _MixedTick(net, attention_impl)
+
+            def mixed_fn(params, buffers, carry, xs, tables, temps,
+                         nonces, key, n_ticks):
+                def tick(c, x):
+                    def live_step(c):
+                        active = c.budgets > 0
+                        lens = jnp.where(active, c.positions + 1, 0)
+                        ((nxt, kp, vp), _) = functional_call(
+                            mixed, params, buffers, x["tok"],
+                            x["pos"], x["lim"], x["tbl"], x["fin"],
+                            x["row"], x["fpos"], c.tokens,
+                            c.positions, lens, tables, c.k_pages,
+                            c.v_pages, temps, nonces, key,
+                            training=False)
+                        fin = x["fin"]
+                        tokens = jnp.where(active | fin, nxt, c.tokens)
+                        budgets = jnp.where(active, c.budgets - 1,
+                                            c.budgets)
+                        # prompt completed this tick: install the
+                        # slab-entry grant (first token just emitted,
+                        # so grant - 1 remain)
+                        budgets = jnp.where(fin, x["grant"] - 1,
+                                            budgets)
+                        budgets = jnp.where(
+                            (active | fin) & (nxt == eos_tok), 0,
+                            budgets)
+                        positions = jnp.where(active, c.positions + 1,
+                                              c.positions)
+                        # next write position = len(prompt)
+                        positions = jnp.where(fin, x["fpos"] + 1,
+                                              positions)
+                        return DecodeCarry(
+                            tokens=tokens, positions=positions,
+                            budgets=budgets, k_pages=kp, v_pages=vp)
+
+                    run = jnp.any(c.budgets > 0) | jnp.any(x["lim"] > 0)
+                    c = jax.lax.cond(run, live_step, lambda c: c, c)
+                    return c, c.tokens
+
+                carry, toks = jax.lax.scan(tick, carry, xs,
+                                           length=n_ticks)
+                return toks, carry
+
+            self._mixed_fn = jax.jit(mixed_fn, static_argnums=(8,),
+                                     donate_argnums=(2,))
+
         self._key = jax.random.PRNGKey(seed)
         self._mu = threading.Lock()
         self._pending: List[_Request] = []
@@ -1057,6 +1342,7 @@ class LLMEngine:
         self.n_cached_tokens = 0    # of those, served from the cache
         self.n_prefill_ticks = 0
         self.n_decode_ticks = 0
+        self.n_mixed_slabs = 0   # mixed prefill+decode slab dispatches
         # recent tick kinds ('p'refill / 'd'ecode): the interleaving
         # witness — a long prompt's chunks must bracket decode ticks
         self.tick_history: deque = deque(maxlen=512)
@@ -1069,11 +1355,18 @@ class LLMEngine:
         # are denominated in. Registered ONCE here — the live
         # free/private/shared split is computed by the read, and the
         # DecodeCarry control-plane arrays are a static scratch row.
-        self._page_bytes = (self.k_pages.nbytes + self.v_pages.nbytes)
+        self._page_bytes = (kv_nbytes(self.k_pages) +
+                            kv_nbytes(self.v_pages))
         if self.spec_k:
             self._page_bytes += (self.draft_k_pages.nbytes +
                                  self.draft_v_pages.nbytes)
         self._page_bytes //= num_pages
+        # of which: bytes the int8 scale tables contribute per page
+        # (0 for plain pools) — the ledger's distinct "scale_table"
+        # row, so "KV pages addable" stays exact under quantization
+        self._page_scale_bytes = (kv_scale_nbytes(self.k_pages) +
+                                  kv_scale_nbytes(self.v_pages)) \
+            // num_pages
         self._mem_scope = _memobs.next_scope()
         _memobs.finalize_scope(self, self._mem_scope)
         if _memobs.enabled():
@@ -1517,7 +1810,11 @@ class LLMEngine:
         per-tick program), ``"decode_loop"`` (one per realized fused-
         slab length, so a decode_ticks_per_dispatch sweep or a
         page-pressure shrink is counted as the recompile it is),
-        ``"prefill"`` (chunk or inline bucket). Bounded at 4096 like
+        ``"mixed_tick"`` (the ragged mixed prefill+decode slab, one
+        per realized length — the kind decode_step/decode_loop/
+        prefill signatures collapse into when mixed_tick serves both
+        phases), ``"prefill"`` (chunk or inline bucket). Bounded at
+        4096 like
         the Model guard; FLAGS.recompile_warn_threshold 0 disables.
         Returns True when the signature is new (a compile is
         coming)."""
@@ -1577,7 +1874,9 @@ class LLMEngine:
         into the adjacent decode interval); the per-program FLOPs
         accounting stays exact."""
         n = 1
-        if kind == "D":
+        if kind == "M":
+            pkey = ("mixed_tick", host_shape0)
+        elif kind == "D":
             pkey = ("decode_loop", host_shape0)
         elif kind == "d":
             pkey = ("decode_step",)
@@ -1622,7 +1921,7 @@ class LLMEngine:
         for a fused-slab record."""
         n = 0
         for _, slots_list, _, kind, meta in self._inflight:
-            if kind == "D":
+            if kind in ("D", "M"):
                 n += meta["budgets"].get(slot, 0)
             elif slot in slots_list:
                 n += 1
@@ -1925,15 +2224,27 @@ class LLMEngine:
                 self._police_slots()
                 self._m["queue_depth"].set(self._n_queued)
                 busy = False
-                if self._prefill_q:
-                    # ONE chunk of prefill, then (below) ONE decode
-                    # step for the live batch: a long prompt's chunks
-                    # interleave with decode ticks instead of stalling
-                    # in-flight generations for its whole prefill
+                mixed = self.mixed_tick and bool(self._prefill_q) \
+                    and not self.spec_k
+                if mixed:
+                    # ONE fused mixed slab: the prefill queue's chunk
+                    # rows AND the live slots' decode ticks ride one
+                    # ragged dispatch — a prompt completing at tick j
+                    # starts decoding at tick j+1 on device, with
+                    # zero host dispatches between the phases
+                    self._issue_mixed(self._live_slots())
+                    busy = True
+                elif self._prefill_q:
+                    # LEGACY two-op tick (mixed_tick off — kept as
+                    # the parity baseline): ONE chunk of prefill,
+                    # then (below) ONE decode step for the live
+                    # batch: a long prompt's chunks interleave with
+                    # decode ticks instead of stalling in-flight
+                    # generations for its whole prefill
                     self._prefill_tick()
                     busy = True
                 self._m["prefill_queue"].set(len(self._prefill_q))
-                live = self._live_slots()
+                live = [] if mixed else self._live_slots()
                 if live and self.spec_k:
                     self._spec_round(live)
                     busy = True
@@ -1955,10 +2266,12 @@ class LLMEngine:
                         max(1, self.n_decode_ticks))
                 if busy:
                     # fetch with a lag: the chain keeps the device busy
-                    # (fused slabs always drain to the boundary: the
-                    # next slab's budgets/positions need this one's
-                    # realized EOS/length outcome)
-                    lag = 0 if self.decode_ticks_per_dispatch > 1 \
+                    # (fused slabs — pure-decode AND mixed — always
+                    # drain to the boundary: the next slab's budgets/
+                    # positions need this one's realized EOS/length
+                    # outcome)
+                    lag = 0 if (self.decode_ticks_per_dispatch > 1
+                                or self.mixed_tick) \
                         else self.lookahead
                     while len(self._inflight) > lag:
                         self._drain_one()
@@ -2237,28 +2550,20 @@ class LLMEngine:
         self._m["occupancy"].observe(len(live) / self.max_seqs)
         self._update_kv_gauge()
 
-    def _issue_slab(self, live: List[int]):
-        """Dispatch up to ``decode_ticks_per_dispatch`` decode ticks
-        for the live slots as ONE fused-scan program (the device-
-        resident decode loop; see :class:`DecodeCarry`).
-
-        Host work at slab ENTRY: per-slot emission budgets (length
-        completion provable here, like :meth:`_issue`) and KV-page
-        PRE-RESERVATION for every position the slab could touch — the
-        scan body never allocates, so it stays shape-stable. A slot
-        that cannot cover its full share shrinks the whole slab to
-        the nearest boundary it CAN cover (pages freed by other
-        requests become visible at the next slab entry, preserving
-        the per-tick path's truncation decisions); a slot that cannot
-        even cover its NEXT token truncates exactly as N=1 would.
-        Over-reserved pages (slab shrank after a greedy reserve) are
-        returned to the pool before dispatch.
-
-        EOS/limit detection, sampling, position advance and page
-        writes all happen on device; the drain (same loop iteration —
-        a slab is its own lookahead) replays the device's masking
-        decisions from the host copy of the budgets."""
-        N = self.decode_ticks_per_dispatch
+    def _plan_slab(self, live: List[int], N: int):
+        """The decode-side slab plan, shared by the pure-decode slab
+        and the MIXED slab so their coverage/truncation/shrink rules
+        can never drift (the mixed-vs-legacy token-identity pin
+        depends on it). Per live slot: provable emission ``want``
+        (length completion decided on the host, like :meth:`_issue`),
+        KV-page PRE-RESERVATION for up to N tokens, truncation when
+        even the NEXT token can't be covered (exactly N=1's
+        decision), slab SHRINK to the smallest boundary every slot
+        can cover, and surplus-page rollback for over-greedy
+        reservations. Mutates ``live`` in place (closing finished/
+        truncated slots). Returns ``(plan, entry_bud, n_eff)``:
+        ``plan[slot] = (pos0, covered, want)`` and
+        ``entry_bud[slot]`` the slab-entry emission budget."""
         ps = self.page_size
         plan: Dict[int, tuple] = {}   # slot -> (pos0, covered, want)
         new_pages: List[tuple] = []   # (slot, idx) allocated here
@@ -2293,20 +2598,45 @@ class LLMEngine:
                 live.remove(slot)
                 continue
             plan[slot] = (pos0, covered, want)
-        if not live:
-            return
         n_eff = N
         for pos0, covered, want in plan.values():
             if covered < min(N, want):
                 n_eff = min(n_eff, covered)
-        budgets = {slot: min(n_eff, want, covered)
-                   for slot, (pos0, covered, want) in plan.items()}
+        entry_bud = {slot: min(n_eff, want, covered)
+                     for slot, (pos0, covered, want) in plan.items()}
         for slot, idx in new_pages:
             pos0 = plan[slot][0]
-            if idx > (pos0 + budgets[slot] - 1) // ps:
+            if idx > (pos0 + entry_bud[slot] - 1) // ps:
                 self._free_pages.append(
                     int(self.block_tables[slot, idx]))
                 self.block_tables[slot, idx] = 0
+        return plan, entry_bud, n_eff
+
+    def _issue_slab(self, live: List[int]):
+        """Dispatch up to ``decode_ticks_per_dispatch`` decode ticks
+        for the live slots as ONE fused-scan program (the device-
+        resident decode loop; see :class:`DecodeCarry`).
+
+        Host work at slab ENTRY: per-slot emission budgets (length
+        completion provable here, like :meth:`_issue`) and KV-page
+        PRE-RESERVATION for every position the slab could touch — the
+        scan body never allocates, so it stays shape-stable. A slot
+        that cannot cover its full share shrinks the whole slab to
+        the nearest boundary it CAN cover (pages freed by other
+        requests become visible at the next slab entry, preserving
+        the per-tick path's truncation decisions); a slot that cannot
+        even cover its NEXT token truncates exactly as N=1 would.
+        Over-reserved pages (slab shrank after a greedy reserve) are
+        returned to the pool before dispatch.
+
+        EOS/limit detection, sampling, position advance and page
+        writes all happen on device; the drain (same loop iteration —
+        a slab is its own lookahead) replays the device's masking
+        decisions from the host copy of the budgets."""
+        N = self.decode_ticks_per_dispatch
+        plan, budgets, n_eff = self._plan_slab(live, N)
+        if not live:
+            return
         if _faults.enabled():
             _faults.check("device.dispatch")
             _faults.check("engine.slab")
@@ -2340,6 +2670,196 @@ class LLMEngine:
                                 "pos0": {s: plan[s][0] for s in live}}))
         self.tick_history.append("D")
         self._m["occupancy"].observe(len(live) / self.max_seqs)
+        self._update_kv_gauge()
+
+    def _issue_mixed(self, live: List[int]):
+        """Dispatch ONE fused MIXED slab: up to
+        ``decode_ticks_per_dispatch`` ragged mixed ticks, each
+        serving a ``prefill_chunk``-token slice of the prefill queue
+        AND the live slots' decode step as one batched forward
+        (:class:`_MixedTick`), inside the :class:`DecodeCarry` scan.
+
+        Host work at slab entry only: the decode side plans budgets +
+        page pre-reservation exactly like :meth:`_issue_slab`
+        (including the shrink-to-coverable-boundary rule); the
+        prefill side packs the whole slab's chunk schedule (token/
+        position/limit/table rows per tick) and, for every request
+        whose prompt COMPLETES at tick j, reserves decode pages and
+        computes an emission GRANT of ``min(max_new_tokens,
+        n_eff - j, coverable)`` tokens — the scan body installs the
+        sampled first token and that grant into the carry at tick j,
+        so the request decodes from tick j+1 with no host dispatch
+        between its phases. The drain replays the device's masking
+        from the host copy of (budgets, start tick, start position),
+        sharing :meth:`_drain_slab`."""
+        N = self.decode_ticks_per_dispatch
+        ps = self.page_size
+        C = self.prefill_chunk
+        # --- decode side: the SHARED slab plan (never drifts from
+        # the pure-decode slab's coverage/shrink/truncation rules) ---
+        plan, entry_bud, n_eff = self._plan_slab(live, N)
+        # drain metadata: decode slots emit from tick 0 at pos0;
+        # finishing-prefill slots are added below with their start
+        # tick and pos0 = len(prompt) - 1 (the first emission advances
+        # context to len(prompt))
+        meta_bud = dict(entry_bud)
+        meta_pos0 = {s: plan[s][0] for s in plan}
+        start: Dict[int, int] = {}
+        # --- prefill side: pack the slab's chunk schedule --------------
+        ptok = np.zeros((n_eff, C), np.int32)
+        ppos = np.zeros((n_eff, C), np.int32)
+        plim = np.zeros((n_eff, C), np.int32)
+        ptbl = np.zeros((n_eff, C, self.pages_per_seq), np.int32)
+        fin = np.zeros((n_eff, self.max_seqs), bool)
+        fin_row = np.zeros((n_eff, self.max_seqs), np.int32)
+        fin_pos = np.zeros((n_eff, self.max_seqs), np.int32)
+        grant = np.zeros((n_eff, self.max_seqs), np.int32)
+        touched: List[_Request] = []
+        n_prefill_tokens = 0
+        pticks = 0
+        for j in range(n_eff):
+            if not self._prefill_q:
+                # queue drained: STOP the slab here rather than
+                # running decode-only ticks that still carry C padded
+                # chunk rows each — the next loop iteration's
+                # pure-decode slab serves the remainder at decode
+                # shapes (n_run below trims the schedule)
+                break
+            used = 0
+            while self._prefill_q and used < C:
+                req = self._prefill_q[0]
+                n = len(req.prompt)
+                take = min(C - used, n - req.prefill_pos)
+                row = self.block_tables[req.slot]
+                for t in range(take):
+                    p = req.prefill_pos + t
+                    ptok[j, used + t] = req.prompt[p]
+                    ppos[j, used + t] = p
+                    plim[j, used + t] = p + 1
+                    ptbl[j, used + t] = row
+                req.prefill_pos += take
+                used += take
+                if req not in touched:
+                    touched.append(req)
+                if req.spans is not None:
+                    req.spans["prefill"].add_event(
+                        "chunk", {"tokens": take,
+                                  "pos": req.prefill_pos, "tick": j})
+                if req.prefill_pos >= n:
+                    self._prefill_q.popleft()
+                    # emission grant: first token + as many decode
+                    # ticks as the slab has left AND pages can cover
+                    # (positions n .. n+g-2 hold the fed tokens; a
+                    # clamped grant is NOT a truncation — the next
+                    # slab entry re-plans exactly like N=1 would)
+                    g_want = min(req.max_new_tokens, n_eff - j)
+                    g = 1
+                    for tt in range(1, g_want):
+                        pos = n + tt - 1
+                        if pos >= self.max_len:
+                            break
+                        idx = pos // ps
+                        if self.block_tables[req.slot, idx] == 0:
+                            page = self._alloc_page()
+                            if page is None:
+                                break
+                            self.block_tables[req.slot, idx] = page
+                        g += 1
+                    fin[j, req.slot] = True
+                    fin_row[j, req.slot] = used - 1
+                    fin_pos[j, req.slot] = n - 1
+                    grant[j, req.slot] = g
+                    start[req.slot] = j
+                    meta_bud[req.slot] = g
+                    meta_pos0[req.slot] = n - 1
+                    req.prefill_done = True
+                    if req.spans is not None:
+                        tp = time.perf_counter()
+                        req.spans["prefill"].end(tp)
+                        req.spans["first_token"] = _trace.start_span(
+                            "llm.first_token",
+                            parent=req.spans["root"], t0=tp)
+                else:
+                    break   # chunk budget exhausted mid-prompt
+            if used:
+                pticks += 1
+                n_prefill_tokens += used
+        # the slab runs only as long as the prefill schedule needs
+        # (>=1 — the queue was non-empty at entry): decode work beyond
+        # it moves to the next iteration's pure-decode slab, whose
+        # program has no chunk rows. The realized length rounds UP to
+        # a power of two (capped at the coverable bound) so a varying
+        # schedule compiles at most log2(N)+1 mixed programs instead
+        # of one per length — the decode_loop signature discipline;
+        # the padding ticks (no prefill rows) still decode. Budgets
+        # and grants clamp to the trimmed length; over-reserved pages
+        # stay with their slots (used by the very next slab, never
+        # leaked).
+        n_run = min(n_eff, 1 << (max(1, pticks) - 1).bit_length())
+        for slot in list(meta_bud):
+            j0 = start.get(slot, 0)
+            clamped = min(meta_bud[slot], n_run - j0)
+            meta_bud[slot] = clamped
+            if slot in start:
+                grant[j0, slot] = clamped
+        if _faults.enabled():
+            _faults.check("device.dispatch")
+            _faults.check("engine.slab")
+        self._guard_recompiles("mixed_tick", (n_run,))
+        pos_arr = np.zeros((self.max_seqs,), np.int32)
+        bud_arr = np.zeros((self.max_seqs,), np.int32)
+        for slot in plan:
+            pos_arr[slot] = plan[slot][0]
+            bud_arr[slot] = min(entry_bud[slot], n_run)
+        carry = DecodeCarry(
+            tokens=self._tokens_dev, positions=jnp.asarray(pos_arr),
+            budgets=jnp.asarray(bud_arr), k_pages=self.k_pages,
+            v_pages=self.v_pages)
+        xs = {"tok": jnp.asarray(ptok[:n_run]),
+              "pos": jnp.asarray(ppos[:n_run]),
+              "lim": jnp.asarray(plim[:n_run]),
+              "tbl": jnp.asarray(ptbl[:n_run]),
+              "fin": jnp.asarray(fin[:n_run]),
+              "row": jnp.asarray(fin_row[:n_run]),
+              "fpos": jnp.asarray(fin_pos[:n_run]),
+              "grant": jnp.asarray(grant[:n_run])}
+        mixed_args = (self._params, self._buffers, carry, xs,
+                      jnp.asarray(self.block_tables),
+                      jnp.asarray(self.temperatures),
+                      jnp.asarray(self._nonces), self._key, n_run)
+        if _perf.enabled():
+            self._perf_program("mixed_tick", (n_run,), self._mixed_fn,
+                               mixed_args, steps=n_run)
+        toks, carry = self._mixed_fn(*mixed_args)
+        self._count_dispatch()
+        self._tokens_dev = carry.tokens
+        self.k_pages, self.v_pages = carry.k_pages, carry.v_pages
+        self._issue_seq += 1
+        slots_list = sorted(meta_bud)
+        self._inflight.append(
+            (self._issue_seq, slots_list, toks, "M",
+             {"budgets": meta_bud, "pos0": meta_pos0, "start": start}))
+        if self._cache is not None:
+            for req in touched:
+                # promote freshly-written FULL prompt pages to shared
+                # (same incremental registration as the legacy chunk
+                # tick — a quantized page shares by the same token
+                # digests; the bytes it holds are deterministic)
+                for i in range(req.n_reg_pages,
+                               req.prefill_pos // ps):
+                    self._cache.register(
+                        req.digests[i],
+                        int(self.block_tables[req.slot, i]))
+                req.n_reg_pages = max(req.n_reg_pages,
+                                      req.prefill_pos // ps)
+        self.n_mixed_slabs += 1
+        self.n_prefill_ticks += pticks
+        self._m["prefill_ticks"].inc(pticks)
+        self._m["mixed_slabs"].inc()
+        if n_prefill_tokens:
+            self._m["mixed_prefill_tokens"].inc(n_prefill_tokens)
+        self.tick_history.append("m")
+        self._m["occupancy"].observe(len(slots_list) / self.max_seqs)
         self._update_kv_gauge()
 
     def _deliver_token(self, slot: int, req: _Request, tok: int,
@@ -2392,7 +2912,7 @@ class LLMEngine:
             # sticky until reset_health — see _update_health)
             self._consec_device_errors = 0
             self._update_health()
-        if kind == "D":
+        if kind in ("D", "M"):
             emitted = self._drain_slab(seq, slots_list, host, meta)
         else:
             if kind == "d":
@@ -2409,8 +2929,8 @@ class LLMEngine:
                 self._deliver_token(slot, req, int(host[slot]), seq)
                 emitted += 1
         if _perf.enabled():
-            self._perf_attribute(kind, host.shape[0] if kind == "D"
-                                 else 0, emitted)
+            self._perf_attribute(kind, host.shape[0]
+                                 if kind in ("D", "M") else 0, emitted)
         self._observe_step(emitted, timed=(kind != "p"))
         self._maybe_finalize()
 
@@ -2429,10 +2949,15 @@ class LLMEngine:
         span."""
         remaining = dict(meta["budgets"])
         pos0 = meta["pos0"]
+        # mixed slabs: a slot whose prompt completed at tick j emits
+        # from that tick on (its rows before j are stale carry copies)
+        start = meta.get("start") or {}
         emitted_per = {s: 0 for s in slots_list}
         emitted = 0
         for j in range(host.shape[0]):
             for slot in slots_list:
+                if j < start.get(slot, 0):
+                    continue
                 if remaining.get(slot, 0) <= 0:
                     continue
                 req = self._slots[slot]

@@ -10,6 +10,9 @@ fallback so the same kernels run (slowly) on CPU test meshes.
 from .flash_attention import flash_attention  # noqa
 from .ring_attention import ring_attention  # noqa: F401
 from .fused_xent import fused_linear_cross_entropy  # noqa
-from .paged_attention import (PagedKVCache, paged_attention,  # noqa
-                              paged_attention_ragged)  # noqa
+from .paged_attention import (PagedKVCache, QuantizedKV,  # noqa
+                              paged_attention,  # noqa
+                              paged_attention_ragged,  # noqa
+                              ragged_paged_attention,  # noqa
+                              ragged_paged_attention_reference)  # noqa
 from .rotary import apply_rotary_pos_emb, rope_tables  # noqa

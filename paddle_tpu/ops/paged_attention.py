@@ -22,7 +22,7 @@ MEMORY model, which is the serving win, and is numerically exact.
 from __future__ import annotations
 
 import math
-from typing import Optional, Tuple
+from typing import NamedTuple, Optional, Union
 
 import jax
 import jax.numpy as jnp
@@ -31,6 +31,123 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .flash_attention import _LANES, _on_cpu
 from .flash_attention import DEFAULT_MASK_VALUE as _MASK_VALUE
+
+
+# ---------------------------------------------------------------------------
+# int8-quantized KV pool
+# ---------------------------------------------------------------------------
+
+# engine knob values for LLMEngine(kv_dtype=...): the storage dtype of
+# the paged KV pool. "int8" stores QUANTIZED pages with a per-token
+# scale table beside the pool (see QuantizedKV) — ~2x page capacity at
+# fixed HBM; the rest are plain-array pools.
+KV_DTYPES = {
+    "f32": jnp.float32, "float32": jnp.float32,
+    "bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+    "f16": jnp.float16, "float16": jnp.float16,
+    "int8": jnp.int8,
+}
+
+
+class QuantizedKV(NamedTuple):
+    """An int8-quantized paged KV store: ``pages`` holds the quantized
+    values, ``scales`` the symmetric absmax scale of every page ROW
+    (one f32 per written token per layer, stored beside the pool).
+
+    Scale granularity is per token-row, not per page, by design: a
+    page FILLS INCREMENTALLY (decode writes one token per tick), so a
+    page-global scale would have to rescale already-written rows
+    whenever a later token's amplitude exceeds the page max —
+    per-row scales make quantize-on-write local and deterministic
+    (the same KV values always quantize to the same bytes, which is
+    what keeps prefix-cache sharing and nonce-pinned replay exact).
+    Storage overhead is 4 bytes per token per layer per K/V against
+    ``kv_heads*head_dim`` 1-byte values (~6% at the smallest test
+    heads, less at real widths).
+
+    Shapes (matching the plain pool with a leading scale-free tail):
+    ``pages`` [..., num_pages, page_size, kv_heads, head_dim] int8,
+    ``scales`` [..., num_pages, page_size] f32."""
+
+    pages: jax.Array
+    scales: jax.Array
+
+
+KVStore = Union[jax.Array, QuantizedKV]
+
+
+def kv_zeros(shape, dtype) -> KVStore:
+    """Allocate a zeroed KV store. ``dtype`` is a jnp dtype or a
+    KV_DTYPES key; int8 yields a :class:`QuantizedKV` (scale table
+    beside the pool), anything else a plain array."""
+    if isinstance(dtype, str):
+        dtype = KV_DTYPES[dtype]
+    if dtype == jnp.int8:
+        return QuantizedKV(jnp.zeros(shape, jnp.int8),
+                           jnp.zeros(shape[:-2], jnp.float32))
+    return jnp.zeros(shape, dtype)
+
+
+def kv_layer(store: KVStore, i) -> KVStore:
+    """Per-layer view of a [L, ...]-stacked store (what the attention
+    entry point consumes)."""
+    if isinstance(store, QuantizedKV):
+        return QuantizedKV(store.pages[i], store.scales[i])
+    return store[i]
+
+
+def kv_page_size(store: KVStore) -> int:
+    return (store.pages if isinstance(store, QuantizedKV)
+            else store).shape[-3]
+
+
+def kv_nbytes(store: KVStore) -> int:
+    """Device bytes of the store INCLUDING the scale table — the
+    honest per-pool figure the memory ledger denominates pages in."""
+    if isinstance(store, QuantizedKV):
+        return store.pages.nbytes + store.scales.nbytes
+    return store.nbytes
+
+
+def kv_scale_nbytes(store: KVStore) -> int:
+    """Bytes of the scale table alone (0 for plain stores) — the
+    ledger's distinct ``scale_table`` row."""
+    return store.scales.nbytes if isinstance(store, QuantizedKV) else 0
+
+
+def quantize_kv(rows, eps: float = 1e-8):
+    """Per-token symmetric absmax int8 quantization of KV rows
+    [..., kv_heads, head_dim] -> (int8 rows, f32 scales [...]).
+    Deterministic (pure function of the values): identical KV always
+    produces identical quantized bytes, so cache-on/off and retried
+    streams stay identical under quantization."""
+    x = rows.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(x), axis=(-2, -1))
+    scale = jnp.maximum(amax, eps) / 127.0
+    q = jnp.clip(jnp.round(x / scale[..., None, None]),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def kv_write(store: KVStore, layer, page_idx, offs, rows) -> KVStore:
+    """Scatter new KV rows into the pool at (layer, page_idx, offs),
+    quantizing on write for :class:`QuantizedKV` stores (the scale
+    lands beside the page row). ``rows`` [..., kv_heads, head_dim]
+    with ``page_idx``/``offs`` broadcast over the leading dims —
+    exactly the ``.at[i, page_idx, offs].set`` contract the engine's
+    layers already use, made dtype-aware in ONE place."""
+    if isinstance(store, QuantizedKV):
+        q, s = quantize_kv(rows)
+        return QuantizedKV(
+            store.pages.at[layer, page_idx, offs].set(q),
+            store.scales.at[layer, page_idx, offs].set(s))
+    return store.at[layer, page_idx, offs].set(rows.astype(store.dtype))
+
+
+def _split_kv(store: KVStore):
+    if isinstance(store, QuantizedKV):
+        return store.pages, store.scales
+    return store, None
 
 
 class PagedKVCache:
@@ -98,7 +215,8 @@ class PagedKVCache:
 
 def paged_attention_kernel(q, k_pages, v_pages, block_tables,
                            context_lens, scale: Optional[float] = None,
-                           interpret: Optional[bool] = None):
+                           interpret: Optional[bool] = None,
+                           k_scales=None, v_scales=None):
     """Fused Pallas decode attention over paged KV (the "fancy kernel"
     the module docstring deferred; Ragged-Paged-Attention lineage).
 
@@ -115,6 +233,15 @@ def paged_attention_kernel(q, k_pages, v_pages, block_tables,
     so the online-softmax scratch (acc/m/l) carries across it. GQA is
     native: the q block per kv head is its [group, D] query rows
     (group = heads // kv_heads), matching the repeat-kv convention.
+
+    int8 KV (``k_scales``/``v_scales`` [num_pages, page_size]):
+    dequantization happens IN-KERNEL — each grid step streams the
+    page's f32 scale row alongside its int8 block and multiplies in
+    VMEM, so HBM traffic stays at the quantized byte count (the whole
+    point of the int8 pool). NOTE: real-TPU int8 tiling wants
+    (32, 128) min tiles; the decode block here is page-granular and
+    validated in interpret mode (CPU) — the on-chip tile-shape sweep
+    rides tpu_sweep once hardware is reachable again.
     """
     if interpret is None:
         interpret = _on_cpu()  # same convention as flash_attention
@@ -123,13 +250,17 @@ def paged_attention_kernel(q, k_pages, v_pages, block_tables,
     pages_per_seq = block_tables.shape[1]
     group = n_heads // kv_heads
     sm_scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    quantized = k_scales is not None
 
     qg = q.reshape(b, kv_heads, group, d)
     tables = jnp.clip(block_tables, 0).astype(jnp.int32)
     lens = context_lens.astype(jnp.int32)
 
-    def kernel(ctx_ref, tbl_ref, q_ref, k_ref, v_ref, o_ref,
-               acc_ref, m_ref, l_ref):
+    def kernel(ctx_ref, tbl_ref, q_ref, k_ref, v_ref, *rest):
+        if quantized:
+            ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = rest
+        else:
+            o_ref, acc_ref, m_ref, l_ref = rest
         bi = pl.program_id(0)
         j = pl.program_id(2)
 
@@ -144,10 +275,14 @@ def paged_attention_kernel(q, k_pages, v_pages, block_tables,
         @pl.when(j * page_size < ctx)
         def _compute():
             qb = q_ref[0, 0]                     # [group, d]
-            k = k_ref[0, :, 0, :]                # [page_size, d]
-            v = v_ref[0, :, 0, :]
+            k = k_ref[0, :, 0, :].astype(jnp.float32)  # [page_size, d]
+            v = v_ref[0, :, 0, :].astype(jnp.float32)
+            if quantized:
+                # dequantize in VMEM: one scale per page row
+                k = k * ks_ref[0, :][:, None]
+                v = v * vs_ref[0, :][:, None]
             s = jax.lax.dot_general(
-                qb.astype(jnp.float32), k.astype(jnp.float32),
+                qb.astype(jnp.float32), k,
                 (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * sm_scale
             col = jax.lax.broadcasted_iota(
@@ -162,7 +297,7 @@ def paged_attention_kernel(q, k_pages, v_pages, block_tables,
             p = jnp.exp(s - m_new)
             l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
             acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
-                p, v.astype(jnp.float32), (((1,), (0,)), ((), ())),
+                p, v, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
             m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
             l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
@@ -173,20 +308,28 @@ def paged_attention_kernel(q, k_pages, v_pages, block_tables,
             l_safe = jnp.where(l == 0.0, 1.0, l)  # empty slot → zeros
             o_ref[0, 0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
 
+    page_spec = pl.BlockSpec((1, page_size, 1, d),
+                             lambda bi, h, j, ctx, tbl: (tbl[bi, j], 0,
+                                                         h, 0))
+    in_specs = [
+        pl.BlockSpec((1, 1, group, d),
+                     lambda bi, h, j, ctx, tbl: (bi, h, 0, 0)),
+        # the paged gather: this index map IS the block table read
+        page_spec,
+        page_spec,
+    ]
+    operands = [lens, tables, qg, k_pages, v_pages]
+    if quantized:
+        # the page's scale row streams beside its int8 block
+        scale_spec = pl.BlockSpec(
+            (1, page_size), lambda bi, h, j, ctx, tbl: (tbl[bi, j], 0))
+        in_specs += [scale_spec, scale_spec]
+        operands += [k_scales.astype(jnp.float32),
+                     v_scales.astype(jnp.float32)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, kv_heads, pages_per_seq),
-        in_specs=[
-            pl.BlockSpec((1, 1, group, d),
-                         lambda bi, h, j, ctx, tbl: (bi, h, 0, 0)),
-            # the paged gather: this index map IS the block table read
-            pl.BlockSpec((1, page_size, 1, d),
-                         lambda bi, h, j, ctx, tbl: (tbl[bi, j], 0, h,
-                                                     0)),
-            pl.BlockSpec((1, page_size, 1, d),
-                         lambda bi, h, j, ctx, tbl: (tbl[bi, j], 0, h,
-                                                     0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, group, d),
                                lambda bi, h, j, ctx, tbl: (bi, h, 0, 0)),
         scratch_shapes=[
@@ -199,18 +342,104 @@ def paged_attention_kernel(q, k_pages, v_pages, block_tables,
     # accept either so the kernel runs on every toolchain in the image
     _params_cls = getattr(pltpu, "CompilerParams", None) or \
         getattr(pltpu, "TPUCompilerParams")
+    out_dtype = q.dtype
     out = pl.pallas_call(
         kernel, grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, kv_heads, group, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, kv_heads, group, d),
+                                       out_dtype),
         compiler_params=_params_cls(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(lens, tables, qg, k_pages, v_pages)
+    )(*operands)
     return out.reshape(b, n_heads, d)
 
 
+def ragged_paged_attention(q, kv_k: KVStore, kv_v: KVStore,
+                           token_tables, token_lens,
+                           scale: Optional[float] = None,
+                           impl: str = "xla"):
+    """THE ragged paged-attention entry point: ONE op serving every
+    attention shape the engine dispatches — single-token decodes,
+    chunked-prefill suffixes, speculative-verify windows, and a MIXED
+    batch of all of them at once (the Ragged Paged Attention
+    formulation, PAPERS.md #1) — over a plain OR int8-quantized
+    (:class:`QuantizedKV`) paged pool.
+
+    q: [T, heads, d] — T tokens drawn from ANY mix of sequences;
+    token_tables: [T, pages_per_seq] — row t is the block table of
+    token t's sequence (rows of the same sequence repeat it);
+    token_lens: [T] — token t attends the first ``token_lens[t]``
+    cached positions of its sequence (its own inclusive; 0 = padding
+    or inactive slot -> zero output row). Returns [T, heads, d].
+    GQA: heads may be a multiple of kv_heads.
+
+    The T=batch single-token case IS the decode step
+    (:func:`paged_attention` aliases here); the rectangular [B, K]
+    case flattens to it (:func:`paged_attention_chunk`); causality
+    inside a prefill chunk falls out of the per-token limit, because
+    a later token of the same sequence has a strictly larger
+    ``token_lens`` and earlier chunk tokens' K/V are already
+    scattered into the pool. A mixed prefill+decode tick is just a
+    batch whose rows happen to come from both phases — nothing in
+    the contract distinguishes them, which is what lets the engine
+    collapse its alternating tick loop into one dispatch.
+
+    Pure-functional and trace-safe by contract: every input may be a
+    traced value, so the op is callable from inside a ``lax.scan``
+    body — the engine's fused slab carries the (possibly quantized)
+    pool in its :class:`DecodeCarry` and calls this per tick.
+
+    ``impl``: ``"xla"`` (gather + dense masked softmax, f32
+    accumulate), ``"pallas"`` (fused kernel streaming one real page
+    per grid step, int8 dequantized in VMEM), or ``"reference"``
+    (:func:`ragged_paged_attention_reference` — full-f32 exactness
+    baseline, kept callable for the int8 tolerance tests)."""
+    kp, ks = _split_kv(kv_k)
+    vp, vs = _split_kv(kv_v)
+    if impl == "pallas":
+        return paged_attention_kernel(q, kp, vp, token_tables,
+                                      token_lens, scale=scale,
+                                      k_scales=ks, v_scales=vs)
+    if impl == "reference":
+        return ragged_paged_attention_reference(
+            q, kv_k, kv_v, token_tables, token_lens,
+            scale=scale).astype(q.dtype)
+    if impl != "xla":
+        raise ValueError(f"unknown impl {impl!r}")
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    # the K=1 case of the gathered core, with limit = token_lens
+    # DIRECTLY (a single cached token — limit 1 — still attends)
+    out = _gathered_attention(q[:, None], kp, vp, token_tables,
+                              token_lens[:, None], scale,
+                              k_scales=ks, v_scales=vs)
+    return out[:, 0]
+
+
+def ragged_paged_attention_reference(q, kv_k: KVStore, kv_v: KVStore,
+                                     token_tables, token_lens,
+                                     scale: Optional[float] = None):
+    """f32-accumulate reference path (the exactness baseline): same
+    contract as :func:`ragged_paged_attention`, but q, the
+    (dequantized) pages, and every intermediate are f32 end to end
+    and the result is returned in f32. This is what the int8
+    quantization TOLERANCE is measured against in tests and in
+    ``llm_bench --kv-dtype``; it is deliberately simple rather than
+    fast."""
+    kp, ks = _split_kv(kv_k)
+    vp, vs = _split_kv(kv_v)
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    out = _gathered_attention(q.astype(jnp.float32)[:, None],
+                              kp, vp, token_tables,
+                              token_lens[:, None], scale,
+                              k_scales=ks, v_scales=vs)
+    return out[:, 0]
+
+
 def paged_attention_chunk(q, k_pages, v_pages, block_tables, base_lens,
-                          scale: Optional[float] = None):
+                          scale: Optional[float] = None,
+                          impl: str = "xla"):
     """Multi-query decode attention over paged KV (the speculative-
     verify / chunked-prefill step): ``q`` carries K NEW tokens per
     sequence whose K/V were just written at positions
@@ -220,82 +449,57 @@ def paged_attention_chunk(q, k_pages, v_pages, block_tables, base_lens,
 
     q: [B, K, heads, d]; base_lens [B] = valid tokens BEFORE the chunk
     (0 = inactive slot → zero output rows). Returns [B, K, heads, d].
+
+    DEPRECATED ALIAS: the rectangular [B, K] case of
+    :func:`ragged_paged_attention` (rows flattened, each carrying its
+    sequence's table and its own causal limit) — kept for source
+    compatibility; new call sites should use the ragged entry point.
     """
-    kq, d = q.shape[1], q.shape[-1]
-    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    b, kq, h, d = q.shape
     limit = jnp.where(base_lens[:, None] > 0,
                       base_lens[:, None] + jnp.arange(kq)[None, :] + 1,
                       0)                                  # [B, K]
-    return _gathered_attention(q, k_pages, v_pages, block_tables,
-                               limit, scale)
+    out = ragged_paged_attention(
+        q.reshape(b * kq, h, d), k_pages, v_pages,
+        jnp.repeat(block_tables, kq, axis=0), limit.reshape(-1),
+        scale=scale, impl=impl)
+    return out.reshape(b, kq, h, d)
 
 
 def paged_attention_ragged(q, k_pages, v_pages, token_tables,
                            token_lens, scale: Optional[float] = None,
                            impl: str = "xla"):
-    """Ragged prefill attention over paged KV: ``q`` carries T tokens
-    drawn from ANY mix of sequences (a chunked-prefill tick packs one
-    or more prompts' uncached suffixes into one fixed-size chunk), each
-    token carrying its OWN block-table row and attendable length.
-
-    q: [T, heads, d]; token_tables: [T, pages_per_seq] — row t is the
-    block table of token t's sequence; token_lens: [T] — token t
-    attends the first ``token_lens[t]`` cached positions of its
-    sequence (its own inclusive; 0 = padding token -> zero output).
-    Returns [T, heads, d].
-
-    This is the ragged generalization of :func:`paged_attention` (the
-    T=batch case where all of a row's tokens share one table) and of
-    :func:`paged_attention_chunk` (the rectangular [B, K] case):
-    causality inside a chunk falls out of the per-token limit, because
-    a later token of the same sequence has a strictly larger
-    ``token_lens`` and earlier chunk tokens' K/V are already scattered
-    into the pool. ``impl="pallas"`` routes through the fused kernel
-    (:func:`paged_attention_kernel`), whose contract is identical —
-    each grid row reads its own prefetched table row."""
-    if impl == "pallas":
-        return paged_attention_kernel(q, k_pages, v_pages, token_tables,
-                                      token_lens, scale=scale)
-    return paged_attention(q, k_pages, v_pages, token_tables,
-                           token_lens, scale=scale)
+    """DEPRECATED ALIAS of :func:`ragged_paged_attention` (the entry
+    point subsumed it verbatim — same contract, same shapes); kept
+    for source compatibility with pre-consolidation call sites."""
+    return ragged_paged_attention(q, k_pages, v_pages, token_tables,
+                                  token_lens, scale=scale, impl=impl)
 
 
 def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
                     scale: Optional[float] = None, impl: str = "xla"):
     """Single-query attention over paged KV (the decode step).
 
-    q: [B, heads, d]; k/v_pages: [num_pages, page_size, kv_heads, d];
-    block_tables: [B, pages_per_seq] page ids (-1 pads);
-    context_lens: [B] valid token counts. Returns [B, heads, d].
-    GQA: heads may be a multiple of kv_heads.
+    q: [B, heads, d]; k/v_pages: [num_pages, page_size, kv_heads, d]
+    (or a :class:`QuantizedKV`); block_tables: [B, pages_per_seq]
+    page ids (-1 pads); context_lens: [B] valid token counts.
+    Returns [B, heads, d]. GQA: heads may be a multiple of kv_heads.
 
-    Pure-functional and trace-safe by contract: every input may be a
-    traced value, so the op is callable from inside a ``lax.scan``
-    body — the fused decode slab (``LLMEngine``'s device-resident
-    tick loop) carries block tables and context lengths as scan
-    state and calls this per tick. ``impl="pallas"`` routes through
-    the fused kernel (:func:`paged_attention_kernel`) under the same
-    contract, mirroring :func:`paged_attention_ragged`."""
-    if impl == "pallas":
-        return paged_attention_kernel(q, k_pages, v_pages,
-                                      block_tables, context_lens,
-                                      scale=scale)
-    d = q.shape[-1]
-    scale = scale if scale is not None else 1.0 / math.sqrt(d)
-    # the K=1 case of the chunk core, with limit = context_lens
-    # DIRECTLY (so a single cached token — limit 1 — still attends,
-    # unlike the chunk's base-exclusive convention)
-    out = _gathered_attention(q[:, None], k_pages, v_pages,
-                              block_tables, context_lens[:, None],
-                              scale)
-    return out[:, 0]
+    DEPRECATED ALIAS: the T=batch single-token case of
+    :func:`ragged_paged_attention` — the shapes are literally the
+    ragged contract already (one table row and one limit per query
+    token), so this delegates unchanged. Trace-safety contract
+    unchanged: callable from inside a ``lax.scan`` body."""
+    return ragged_paged_attention(q, k_pages, v_pages, block_tables,
+                                  context_lens, scale=scale, impl=impl)
 
 
 def _gathered_attention(q, k_pages, v_pages, block_tables, limit,
-                        scale):
+                        scale, k_scales=None, v_scales=None):
     """Shared decode-attention core: gather the block table's pages,
-    expand GQA, masked fp32 softmax. q [B, K, H, d]; limit [B, K] =
-    attendable cached positions per query (0 → zero output row)."""
+    dequantize (optional per-row scales), expand GQA, masked fp32
+    softmax. q [B, K, H, d]; limit [B, K] = attendable cached
+    positions per query (0 → zero output row)."""
     b, kq, n_heads, d = q.shape
     _, page_size, kv_heads, _ = k_pages.shape
     pages_per_seq = block_tables.shape[1]
@@ -303,6 +507,12 @@ def _gathered_attention(q, k_pages, v_pages, block_tables, limit,
     tables = jnp.clip(block_tables, 0)               # [B, P]
     k = jnp.take(k_pages, tables, axis=0)            # [B, P, ps, KVH, d]
     v = jnp.take(v_pages, tables, axis=0)
+    if k_scales is not None:
+        # int8 pool: dequantize the gathered rows (scale per page row)
+        k = k.astype(jnp.float32) * \
+            jnp.take(k_scales, tables, axis=0)[..., None, None]
+        v = v.astype(jnp.float32) * \
+            jnp.take(v_scales, tables, axis=0)[..., None, None]
     L = pages_per_seq * page_size
     k = k.reshape(b, L, kv_heads, d)
     v = v.reshape(b, L, kv_heads, d)

@@ -1,0 +1,412 @@
+"""One ragged kernel for mixed prefill+decode, on an int8-quantized
+KV pool (ISSUE 15).
+
+Contracts under test:
+
+- ``ragged_paged_attention`` is THE entry point: the decode, chunk and
+  ragged wrappers are exact aliases of it (xla AND pallas impls), and
+  it serves a mixed batch of prefill rows and decode rows in one call.
+- int8 KV (``QuantizedKV``: quantize-on-write, per-token scales,
+  dequantize-in-kernel) stays within the documented tolerance of the
+  f32-accumulate reference path at the op level, and quantization is
+  DETERMINISTIC — cache on/off, fused slabs and the mixed tick all
+  produce identical int8 streams.
+- ``mixed_tick=True`` collapses the alternating prefill/decode tick
+  loop into one fused dispatch whose streams are TOKEN-IDENTICAL to
+  the legacy two-op tick path (greedy AND seeded, cache on/off,
+  N in {1, 8}), with a prompt admitted mid-slab decoding on device
+  (zero host dispatches between its phases).
+- ~2x page capacity at fixed HBM: int8 page bytes (scale table
+  included) buy >= 1.8x the pages of bf16, and the memory ledger's
+  kv_pool rows split dtype bytes from scale-table bytes while still
+  tiling the pool exactly.
+"""
+
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.inference.llm import LLMEngine
+from paddle_tpu.models.gpt import GPTForCausalLM, gpt_config
+from paddle_tpu.ops.paged_attention import (
+    QuantizedKV, kv_layer, kv_write, kv_zeros, paged_attention,
+    paged_attention_chunk, paged_attention_ragged,
+    ragged_paged_attention, ragged_paged_attention_reference)
+
+# the documented int8 quantization tolerances (PERF.md "Ragged mixed
+# tick + int8 KV"): op-level attention output within ATOL of the f32
+# reference on unit-variance KV; engine-level greedy token agreement
+# vs an f32-pool engine at least AGREE on the pinned workload
+INT8_ATOL = 0.05
+INT8_GREEDY_AGREE = 0.9
+
+
+def tiny_gpt():
+    pt.seed(0)
+    cfg = gpt_config("gpt2-small", num_layers=2, hidden_size=64,
+                     num_heads=4, vocab_size=97,
+                     max_position_embeddings=96, hidden_dropout=0.0,
+                     attention_dropout=0.0)
+    return GPTForCausalLM(cfg)
+
+
+# ---------------------------------------------------------------------------
+# op level: one entry point, int8 tolerance
+# ---------------------------------------------------------------------------
+
+
+def _filled_stores(rng, L=1, NP=12, PS=4, KVH=2, D=16, pages=(1, 2, 3)):
+    q8 = kv_zeros((L, NP, PS, KVH, D), "int8")
+    f32 = kv_zeros((L, NP, PS, KVH, D), jnp.float32)
+    for page in pages:
+        rows = jnp.asarray(rng.randn(PS, KVH, D), jnp.float32)
+        idx = jnp.full((PS,), page, jnp.int32)
+        offs = jnp.arange(PS)
+        q8 = kv_write(q8, 0, idx, offs, rows)
+        f32 = kv_write(f32, 0, idx, offs, rows)
+    return q8, f32
+
+
+def test_ragged_entry_subsumes_decode_chunk_and_ragged():
+    """The three legacy ops are exact aliases of the ONE ragged entry
+    point, on both impls."""
+    rng = np.random.RandomState(0)
+    _, f32 = _filled_stores(rng)
+    kp = kv_layer(f32, 0)
+    B, K, H, D = 3, 2, 4, 16
+    tables = jnp.asarray([[1, 2, 3], [2, 3, 0], [0, 0, 0]], jnp.int32)
+    lens = jnp.asarray([7, 4, 0], jnp.int32)
+    q = jnp.asarray(rng.randn(B, H, D), jnp.float32)
+    for impl in ("xla", "pallas"):
+        dec = np.asarray(paged_attention(q, kp, kp, tables, lens,
+                                         impl=impl))
+        rag = np.asarray(ragged_paged_attention(q, kp, kp, tables,
+                                                lens, impl=impl))
+        np.testing.assert_array_equal(dec, rag)
+    qc = jnp.asarray(rng.randn(B, K, H, D), jnp.float32)
+    base = jnp.asarray([5, 2, 0], jnp.int32)
+    chunk = np.asarray(paged_attention_chunk(qc, kp, kp, tables, base))
+    lims = jnp.where(base[:, None] > 0,
+                     base[:, None] + jnp.arange(K)[None, :] + 1,
+                     0).reshape(-1)
+    rag = np.asarray(ragged_paged_attention(
+        qc.reshape(B * K, H, D), kp, kp,
+        jnp.repeat(tables, K, axis=0), lims))
+    np.testing.assert_array_equal(chunk, rag.reshape(B, K, H, D))
+    old = np.asarray(paged_attention_ragged(q, kp, kp, tables, lens))
+    np.testing.assert_array_equal(
+        old, np.asarray(ragged_paged_attention(q, kp, kp, tables,
+                                               lens)))
+
+
+def test_mixed_batch_rows_equal_separate_dispatches():
+    """A batch mixing prefill-style rows and decode-style rows gives
+    each row EXACTLY what the separate dispatches gave it — the
+    property that lets the engine serve both phases in one call."""
+    rng = np.random.RandomState(1)
+    _, f32 = _filled_stores(rng)
+    kp = kv_layer(f32, 0)
+    H, D = 4, 16
+    # "decode" rows: one token per sequence, full-context limits
+    qd = jnp.asarray(rng.randn(2, H, D), jnp.float32)
+    td = jnp.asarray([[1, 2, 3], [2, 3, 0]], jnp.int32)
+    ld = jnp.asarray([9, 5], jnp.int32)
+    # "prefill" rows: successive positions of one sequence
+    qp = jnp.asarray(rng.randn(3, H, D), jnp.float32)
+    tp = jnp.asarray([[3, 1, 0]] * 3, jnp.int32)
+    lp = jnp.asarray([2, 3, 4], jnp.int32)
+    sep_d = np.asarray(ragged_paged_attention(qd, kp, kp, td, ld))
+    sep_p = np.asarray(ragged_paged_attention(qp, kp, kp, tp, lp))
+    mixed = np.asarray(ragged_paged_attention(
+        jnp.concatenate([qp, qd]), kp, kp,
+        jnp.concatenate([tp, td]), jnp.concatenate([lp, ld])))
+    np.testing.assert_array_equal(mixed[:3], sep_p)
+    np.testing.assert_array_equal(mixed[3:], sep_d)
+
+
+def test_int8_within_tolerance_of_f32_reference():
+    """int8 quantize-on-write + dequantize-in-kernel stays within the
+    documented tolerance of the f32-accumulate reference path, on
+    both impls; masked rows stay exactly zero."""
+    rng = np.random.RandomState(2)
+    q8, f32 = _filled_stores(rng)
+    q = jnp.asarray(rng.randn(5, 4, 16), jnp.float32)
+    tbl = jnp.asarray(np.tile([[1, 2, 3]], (5, 1)), jnp.int32)
+    lens = jnp.asarray([1, 4, 7, 11, 0], jnp.int32)
+    ref = np.asarray(ragged_paged_attention_reference(
+        q, kv_layer(f32, 0), kv_layer(f32, 0), tbl, lens))
+    for impl in ("xla", "pallas", "reference"):
+        got = np.asarray(ragged_paged_attention(
+            q, kv_layer(q8, 0), kv_layer(q8, 0), tbl, lens,
+            impl=impl))
+        err = np.max(np.abs(got - ref))
+        assert err < INT8_ATOL, (impl, err)
+        np.testing.assert_allclose(got[4], 0.0)
+
+
+def test_quantization_is_deterministic():
+    """Identical KV values quantize to identical bytes AND identical
+    scales — the property cache-sharing and nonce-pinned replay lean
+    on."""
+    rng = np.random.RandomState(3)
+    rows = jnp.asarray(rng.randn(4, 2, 16), jnp.float32)
+    s1 = kv_zeros((1, 8, 4, 2, 16), "int8")
+    s2 = kv_zeros((1, 8, 4, 2, 16), "int8")
+    idx = jnp.full((4,), 2, jnp.int32)
+    offs = jnp.arange(4)
+    s1 = kv_write(s1, 0, idx, offs, rows)
+    s2 = kv_write(s2, 0, idx, offs, rows)
+    np.testing.assert_array_equal(np.asarray(s1.pages),
+                                  np.asarray(s2.pages))
+    np.testing.assert_array_equal(np.asarray(s1.scales),
+                                  np.asarray(s2.scales))
+
+
+# ---------------------------------------------------------------------------
+# engine level: mixed tick parity, int8 parity/tolerance, capacity
+# ---------------------------------------------------------------------------
+
+
+def run_engine(net, prompts, gen, *, mixed, n=1, kv=None,
+               temperature=0.0, cache=True, page_size=4,
+               num_pages=128, chunk=8, seed=3, eos=None,
+               max_seqs=4, warm_first=0):
+    """One engine pass. ``warm_first``: run that many head prompts to
+    completion BEFORE the burst (their pages are registered, so the
+    burst's shared prefixes genuinely hit the cache)."""
+    eng = LLMEngine(net, max_seqs=max_seqs, page_size=page_size,
+                    num_pages=num_pages, prefill_buckets=(32,),
+                    prefix_cache=cache, prefill_chunk=chunk,
+                    eos_token_id=eos, seed=seed,
+                    decode_ticks_per_dispatch=n, mixed_tick=mixed,
+                    kv_dtype=kv)
+    with eng:
+        outs = []
+        if warm_first:
+            outs += eng.generate(prompts[:warm_first],
+                                 max_new_tokens=gen,
+                                 temperature=temperature)
+        outs += eng.generate(prompts[warm_first:],
+                             max_new_tokens=gen,
+                             temperature=temperature)
+    # leak audit rides every run: the pool is whole after close
+    assert len(eng._free_pages) == eng.num_pages - 1, "KV pages leaked"
+    return [o["output_ids"] for o in outs], outs, eng
+
+
+@pytest.mark.parametrize("temperature", [0.0, 0.8],
+                         ids=["greedy", "seeded"])
+@pytest.mark.parametrize("cache", [True, False],
+                         ids=["cache-on", "cache-off"])
+def test_mixed_tick_token_identity_vs_legacy(cache, temperature):
+    """The ISSUE-15 acceptance pin: one batch mixing cache-hit
+    prefill (shared prefix), cold prefill chunks and decodes through
+    the MIXED tick is token-identical to the legacy two-op tick path,
+    greedy and seeded, cache on/off, N in {1, 8}."""
+    net = tiny_gpt()
+    rng = np.random.RandomState(0)
+    prefix = rng.randint(0, 97, 8).tolist()          # 2 full pages
+    prompts = [prefix + rng.randint(0, 97, 5).tolist(),   # warm
+               prefix + rng.randint(0, 97, 3).tolist(),   # cache hit
+               rng.randint(0, 97, 21).tolist(),           # cold, long
+               rng.randint(0, 97, 4).tolist()]            # cold, short
+    ref, _, _ = run_engine(net, prompts, 10, mixed=False,
+                           temperature=temperature, cache=cache,
+                           warm_first=1)
+    for n in (1, 8):
+        got, outs, eng = run_engine(net, prompts, 10, mixed=True, n=n,
+                                    temperature=temperature,
+                                    cache=cache, warm_first=1)
+        assert got == ref, f"mixed tick diverged at N={n}"
+        assert eng.n_mixed_slabs > 0, "mixed path never engaged"
+        assert all(o["ttft_s"] is not None for o in outs)
+    if cache:
+        assert eng.n_cached_tokens > 0, \
+            "shared prefix never hit the cache through the mixed tick"
+
+
+def test_mixed_slab_admits_prefill_without_host_dispatches():
+    """A long prompt submitted mid-decode rides INTO the slab: the
+    tick history shows mixed slabs ('m'), the mixed-prefill counter
+    advances, and the combined streams still match the legacy run —
+    with strictly fewer host dispatches than the legacy alternating
+    loop needed."""
+    net = tiny_gpt()
+    rng = np.random.RandomState(6)
+    short = rng.randint(0, 97, 4).tolist()
+    long = rng.randint(0, 97, 40).tolist()
+
+    def interleaved(mixed, n):
+        eng = LLMEngine(net, max_seqs=2, page_size=4, num_pages=128,
+                        prefill_buckets=(64,), prefill_chunk=8,
+                        decode_ticks_per_dispatch=n, mixed_tick=mixed)
+        with eng:
+            f1 = eng.submit(short, max_new_tokens=24)
+            while not (eng.n_decode_ticks or eng.n_mixed_slabs):
+                time.sleep(0.002)
+            f2 = eng.submit(long, max_new_tokens=8)
+            outs = [f1.result(timeout=120), f2.result(timeout=120)]
+            hist = "".join(eng.tick_history)
+            dispatches = eng.n_host_dispatches
+        assert len(eng._free_pages) == eng.num_pages - 1
+        return [o["output_ids"] for o in outs], hist, dispatches
+
+    ref, _, d_ref = interleaved(False, 4)
+    got, hist, d_mixed = interleaved(True, 4)
+    assert got == ref
+    assert "m" in hist, hist
+    assert d_mixed < d_ref, (d_mixed, d_ref)
+
+
+def test_mixed_eos_and_page_pressure_match_legacy():
+    """EOS landing mid-slab and a pool too small to cover the slab
+    both resolve exactly as the legacy path does (the shrink /
+    truncation decisions re-plan at slab entry)."""
+    net = tiny_gpt()
+    rng = np.random.RandomState(1)
+    prompts = [rng.randint(0, 97, 5).tolist(),
+               rng.randint(0, 97, 7).tolist()]
+    base, _, _ = run_engine(net, prompts, 12, mixed=False)
+    eos = base[0][5]
+    ref, _, _ = run_engine(net, prompts, 12, mixed=False, eos=eos)
+    got, _, _ = run_engine(net, prompts, 12, mixed=True, n=8, eos=eos)
+    assert got == ref
+    assert len(got[0]) < 12 and got[0][-1] == eos
+    # page pressure: tiny pool forces shrink/truncation decisions
+    tight = [rng.randint(0, 97, 5).tolist()]
+    for pages in (9, 16):
+        r, routs, _ = run_engine(net, tight, 20, mixed=False, n=1,
+                                 page_size=2, num_pages=pages,
+                                 cache=False)
+        g, gouts, _ = run_engine(net, tight, 20, mixed=True, n=8,
+                                 page_size=2, num_pages=pages,
+                                 cache=False)
+        assert g == r, pages
+        assert [o["truncated"] for o in gouts] == \
+            [o["truncated"] for o in routs], pages
+
+
+def test_mixed_guard_kind_coherent():
+    """Satellite: the mixed program registers under its own
+    ``mixed_tick`` recompile-guard kind (decode_step|decode_loop|
+    prefill collapse into it while the queue is served mixed)."""
+    net = tiny_gpt()
+    rng = np.random.RandomState(2)
+    prompts = [rng.randint(0, 97, 5).tolist()]
+    _, _, eng = run_engine(net, prompts, 8, mixed=True, n=8)
+    kinds = {s[0] for s in eng._shape_signatures}
+    assert "mixed_tick" in kinds, kinds
+    # the realized mixed-slab length tracks the prefill schedule (a
+    # short prompt packs into one tick; decode continues in the
+    # cheaper pure-decode slab), always within the N bound
+    lengths = [s[1] for s in eng._shape_signatures
+               if s[0] == "mixed_tick"]
+    assert lengths and all(1 <= n <= 8 for n in lengths), lengths
+    # the legacy per-phase prefill program never compiled
+    assert "prefill" not in kinds, kinds
+
+
+def test_int8_engine_parity_and_tolerance():
+    """int8 KV engine: cache on/off, fused slabs (N=8) and the mixed
+    tick all produce IDENTICAL int8 streams (quantization is
+    deterministic), and greedy agreement vs the f32-pool engine
+    meets the documented tolerance."""
+    net = tiny_gpt()
+    rng = np.random.RandomState(4)
+    prefix = rng.randint(0, 97, 8).tolist()
+    prompts = [prefix + rng.randint(0, 97, 5).tolist(),
+               prefix + rng.randint(0, 97, 3).tolist(),
+               rng.randint(0, 97, 11).tolist()]
+    base, _, eng = run_engine(net, prompts, 10, mixed=False,
+                              kv="int8")
+    assert isinstance(eng.k_pages, QuantizedKV)
+    for kwargs in (dict(mixed=False, cache=False),
+                   dict(mixed=False, n=8),
+                   dict(mixed=True, n=8)):
+        got, _, _ = run_engine(net, prompts, 10, kv="int8", **kwargs)
+        assert got == base, f"int8 streams diverged under {kwargs}"
+    f32, _, _ = run_engine(net, prompts, 10, mixed=False)
+    agree = np.mean([np.mean([a == b for a, b in zip(x, y)])
+                     for x, y in zip(base, f32)])
+    assert agree >= INT8_GREEDY_AGREE, (
+        f"int8 greedy agreement {agree:.3f} below the documented "
+        f"tolerance {INT8_GREEDY_AGREE}")
+
+
+def test_int8_capacity_and_ledger_split():
+    """~2x page capacity at fixed HBM: int8 page bytes (scale table
+    included) are <= 1/1.8 of bf16's; the memory ledger's kv_pool
+    rows gain the dtype/scale split and still tile the pool
+    exactly."""
+    from paddle_tpu.core import flags as _flags
+    from paddle_tpu.observability import memory as memobs
+    net = tiny_gpt()
+    engines = {}
+    for kv in ("bf16", "int8"):
+        engines[kv] = LLMEngine(net, max_seqs=2, page_size=4,
+                                num_pages=32, prefill_buckets=(16,),
+                                kv_dtype=kv)
+    try:
+        ratio = engines["bf16"]._page_bytes / \
+            engines["int8"]._page_bytes
+        assert ratio >= 1.8, (
+            f"int8 pages must buy >=1.8x capacity at fixed HBM; "
+            f"page bytes give only {ratio:.2f}x")
+        eng = engines["int8"]
+        assert eng._page_scale_bytes > 0
+        if memobs.enabled():
+            rows = [r for r in memobs.instance().rows()
+                    if r["owner"] == "kv_pool"]
+            kinds = {r["kind"] for r in rows}
+            assert "scale_table" in kinds, kinds
+            total = sum(r["bytes"] for r in rows)
+            # one engine is bf16 (no scale row), one int8: each
+            # engine's rows tile ITS pool; sum over both
+            expect = sum(e.num_pages * e._page_bytes
+                         for e in engines.values())
+            assert total == expect, (total, expect)
+    finally:
+        for e in engines.values():
+            e.close()
+
+
+def test_kv_dtype_and_mixed_knob_validation():
+    net = tiny_gpt()
+    with pytest.raises(ValueError, match="kv_dtype"):
+        LLMEngine(net, max_seqs=2, page_size=4, num_pages=16,
+                  prefill_buckets=(16,), kv_dtype="int4")
+    with pytest.raises(ValueError, match="lookahead"):
+        LLMEngine(net, max_seqs=2, page_size=4, num_pages=16,
+                  prefill_buckets=(16,), mixed_tick=True, lookahead=2)
+    pt.seed(1)
+    dcfg = gpt_config("gpt2-small", num_layers=1, hidden_size=32,
+                      num_heads=2, vocab_size=97,
+                      max_position_embeddings=96, hidden_dropout=0.0,
+                      attention_dropout=0.0)
+    draft = GPTForCausalLM(dcfg)
+    with pytest.raises(ValueError, match="draft_net"):
+        LLMEngine(net, max_seqs=2, page_size=4, num_pages=16,
+                  prefill_buckets=(16,), draft_net=draft,
+                  kv_dtype="int8")
+    # a speculative engine silently clamps mixed_tick off (its rounds
+    # are their own fusion), mirroring the slab-knob clamp
+    eng = LLMEngine(net, max_seqs=2, page_size=4, num_pages=32,
+                    prefill_buckets=(16,), draft_net=draft,
+                    mixed_tick=True)
+    assert eng.mixed_tick is False
+    eng.close()
+    # flags feed the defaults
+    from paddle_tpu.core import flags
+    flags.set_flags({"mixed_tick": True, "kv_dtype": "int8"})
+    try:
+        eng = LLMEngine(net, max_seqs=2, page_size=4, num_pages=16,
+                        prefill_buckets=(16,))
+        assert eng.mixed_tick is True
+        assert eng.kv_dtype == "int8"
+        assert isinstance(eng.k_pages, QuantizedKV)
+        eng.close()
+    finally:
+        flags.set_flags({"mixed_tick": False, "kv_dtype": ""})

@@ -155,6 +155,7 @@ def make_row(tool: str, workload: str, value: float, unit: str,
              peak_mem_bytes: Optional[float] = None,
              backend: Optional[str] = None,
              direction: str = "higher",
+             kv_dtype: Optional[str] = None,
              extra: Optional[dict] = None,
              metrics: Optional[dict] = None) -> dict:
     """Build one canonical ledger row (see module docstring).
@@ -162,7 +163,11 @@ def make_row(tool: str, workload: str, value: float, unit: str,
     rows predate it) carries the memory ledger's attributed
     high-watermark so capacity changes (int8 KV pages halving pool
     bytes) are visible IN the perf trajectory, next to the
-    throughput they bought."""
+    throughput they bought. ``kv_dtype`` (optional, same absent-field
+    tolerance) records the engine KV-pool dtype a serving bench ran
+    at AND joins the series key, so an int8 run never regression-
+    gates against a bf16 baseline (different storage = different
+    trajectory)."""
     return {
         "schema": SCHEMA,
         "run_id": uuid.uuid4().hex[:12],
@@ -181,6 +186,7 @@ def make_row(tool: str, workload: str, value: float, unit: str,
                        if dispatches is not None else None),
         "peak_mem_bytes": (float(peak_mem_bytes)
                           if peak_mem_bytes is not None else None),
+        "kv_dtype": str(kv_dtype) if kv_dtype is not None else None,
         "direction": direction,
         "metrics": metrics if metrics is not None else metrics_snapshot(),
         "extra": extra or {},
@@ -247,14 +253,18 @@ def read_ledger(path: Optional[str] = None) -> List[dict]:
 
 
 def _series(rows: List[dict]) -> Dict[tuple, List[dict]]:
-    """Group by (workload, backend, host) in file (= time) order —
-    host-keying keeps a slower machine's rows from reading as a
-    regression of a faster machine's baseline (rows predating the
-    host field group under "legacy")."""
+    """Group by (workload, backend, host, kv_dtype) in file (= time)
+    order — host-keying keeps a slower machine's rows from reading as
+    a regression of a faster machine's baseline (rows predating the
+    host field group under "legacy"), and kv_dtype-keying keeps int8
+    and bf16 serving runs in SEPARATE trajectories (rows predating
+    the field, or train rows, carry None and group together as
+    before)."""
     out: Dict[tuple, List[dict]] = {}
     for r in rows:
         out.setdefault((r["workload"], r["backend"],
-                        r.get("host", "legacy")), []).append(r)
+                        r.get("host", "legacy"),
+                        r.get("kv_dtype")), []).append(r)
     return out
 
 
@@ -270,14 +280,16 @@ def compare(rows: List[dict],
     """Per-series verdicts: newest row vs the median of its prior
     rows (up to BASELINE_WINDOW). Single-row series report "new"."""
     verdicts = []
-    for (workload, backend, host), series in sorted(
-            _series(rows).items()):
+    for (workload, backend, host, kv_dtype), series in sorted(
+            _series(rows).items(),
+            key=lambda kv: tuple(str(x) for x in kv[0])):
         newest = series[-1]
         prior = series[:-1][-BASELINE_WINDOW:]
         v = {
             "workload": workload,
             "backend": backend,
             "host": host,
+            "kv_dtype": kv_dtype,
             "unit": newest["unit"],
             "rows": len(series),
             "newest": newest["value"],
@@ -328,8 +340,9 @@ def ci_gate(path: Optional[str] = None,
             v["status"]]
         base = (f" baseline {v['baseline']} ratio {v['ratio']}"
                 if v.get("baseline") is not None else "")
+        kvd = f" kv={v['kv_dtype']}" if v.get("kv_dtype") else ""
         print(f"[{mark}] {v['workload']} @ {v['backend']} "
-              f"[{v['host']}]: {v['newest']} {v['unit']}{base} "
+              f"[{v['host']}]{kvd}: {v['newest']} {v['unit']}{base} "
               f"({v['rows']} rows)")
     if bad:
         print(f"bench_ledger --ci FAIL: {len(bad)} series regressed "
@@ -368,8 +381,11 @@ def main(argv=None) -> int:
         return ci_gate(path=args.path, tolerance=args.tolerance)
     rows = read_ledger(args.path)
     if args.show:
-        for key, series in sorted(_series(rows).items()):
-            print(f"== {key[0]} @ {key[1]} [{key[2]}] "
+        for key, series in sorted(
+                _series(rows).items(),
+                key=lambda kv: tuple(str(x) for x in kv[0])):
+            kvd = f" kv={key[3]}" if key[3] else ""
+            print(f"== {key[0]} @ {key[1]} [{key[2]}]{kvd} "
                   f"({len(series)} rows)")
             for r in series:
                 print(f"  {r['git_rev']} {r['value']} {r['unit']} "

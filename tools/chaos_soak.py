@@ -295,7 +295,8 @@ def engine_soak(seed: int) -> dict:
     return outcomes
 
 
-def slab_soak(seed: int) -> dict:
+def slab_soak(seed: int, mixed: bool = False,
+              kv_dtype=None) -> dict:
     """ISSUE 10 phase: the engine invariants under FUSED DECODE SLABS
     (``decode_ticks_per_dispatch=8``) — an injected ``engine.slab``
     kill storm at the slab dispatch, hopeless deadlines, and a
@@ -305,7 +306,14 @@ def slab_soak(seed: int) -> dict:
     nonce, and a slab re-admission replays the same sampled stream);
     deadlines/cancels resolve typed within a slab boundary; zero KV
     pages leak and no ``llm.*`` span stays open after close; the
-    injected sequence equals the pure seeded schedule."""
+    injected sequence equals the pure seeded schedule.
+
+    ISSUE 15 rider (``mixed=True, kv_dtype="int8"``): the SAME storm
+    through the ragged MIXED tick on an int8-quantized pool —
+    ``engine.slab`` faults fire at the mixed dispatch too, and
+    nonce-pinned token identity must hold against an int8+mixed
+    reference (quantization is deterministic, so chaos stays
+    invisible in the streams)."""
     from paddle_tpu.inference.llm import LLMEngine, RequestCancelled
     from paddle_tpu.observability import tracing
     from paddle_tpu.reliability import faults
@@ -320,7 +328,8 @@ def slab_soak(seed: int) -> dict:
     def build(**kw):
         return LLMEngine(net, max_seqs=4, page_size=4, num_pages=96,
                          prefill_buckets=(16,), drain_after=64,
-                         decode_ticks_per_dispatch=8, **kw)
+                         decode_ticks_per_dispatch=8,
+                         mixed_tick=mixed, kv_dtype=kv_dtype, **kw)
 
     # fault-free reference streams: same engine seed, same submission
     # order => same nonces => the chaos run must reproduce these
@@ -403,10 +412,11 @@ def slab_soak(seed: int) -> dict:
     tracing.disable()
     assert not open_llm, f"span trees left open: {open_llm}"
     return {"injected": n_injected, "cancelled": n_cancelled,
-            "requests": len(futs) + len(dl) + len(storm)}
+            "requests": len(futs) + len(dl) + len(storm),
+            "mixed_tick": mixed, "kv_dtype": kv_dtype or "f32"}
 
 
-def page_pressure_soak(seed: int) -> dict:
+def page_pressure_soak(seed: int, kv_dtype=None) -> dict:
     """ISSUE 14 phase (rides --slab): a PAGE-PRESSURE STORM against a
     deliberately tiny KV pool, polling the memory ledger's headroom
     while fused slabs fight the allocator. Asserts the accounting
@@ -416,7 +426,14 @@ def page_pressure_soak(seed: int) -> dict:
     witnessed here by truncated results + a shrunk ``decode_loop``
     signature + the polled gauge minimum), the kv_pool ledger rows
     tile the pool exactly at every sampled instant, and headroom
-    RECOVERS to the full usable pool after the storm drains."""
+    RECOVERS to the full usable pool after the storm drains.
+
+    ISSUE 15 rider (``kv_dtype="int8"``): the SAME storm at the SAME
+    pool HBM budget — int8 pages (scale tables included) must buy
+    >= 1.8x the f32 pages, the kv_pool rows now include the distinct
+    ``scale_table`` kind and STILL tile the pool exactly, and the
+    headroom gauge semantics re-pin unchanged (the storm is doubled
+    so the bigger pool still runs dry and slab-shrink engages)."""
     from paddle_tpu.inference.llm import LLMEngine
     from paddle_tpu.observability import memory as memobs
     from paddle_tpu.observability.metrics import default_registry
@@ -425,10 +442,34 @@ def page_pressure_soak(seed: int) -> dict:
     net = _tiny_gpt()
     N = 8
     # 17 usable pages of 4 tokens: 4 slots x (2 prompt pages + up to
-    # 2 slab pages per dispatch) oversubscribes the pool by design
-    eng = LLMEngine(net, max_seqs=4, page_size=4, num_pages=18,
-                    prefill_buckets=(16,), max_len=64,
-                    decode_ticks_per_dispatch=N, admit_timeout=120.0)
+    # 2 slab pages per dispatch) oversubscribes the pool by design.
+    # The int8 rider holds the HBM BUDGET fixed (18 f32 pages' worth)
+    # and lets the quantized pool claim however many pages fit.
+    num_pages, n_requests = 18, 8
+    if kv_dtype is not None:
+        probe = LLMEngine(net, max_seqs=2, page_size=4, num_pages=8,
+                          prefill_buckets=(16,), max_len=64)
+        budget = 18 * probe._page_bytes
+        probe.close()
+        probe = LLMEngine(net, max_seqs=2, page_size=4, num_pages=8,
+                          prefill_buckets=(16,), max_len=64,
+                          kv_dtype=kv_dtype)
+        num_pages = int(budget // probe._page_bytes)
+        probe.close()
+        assert num_pages - 1 >= 1.8 * 17, (
+            f"kv_dtype={kv_dtype} bought only {num_pages - 1} usable "
+            f"pages at the 17-page f32 HBM budget (<1.8x)")
+        n_requests = 16   # double the storm: the bigger pool must
+        #                   still run dry for the shrink pin to hold
+    # the ~2x-occupancy witness: the int8 run serves DOUBLE the
+    # concurrent slots at the same pool HBM — 4 f32 slots' full need
+    # oversubscribes 17 pages, 8 int8 slots' oversubscribes its ~2x
+    # pool, so slab-shrink engages at twice the occupancy
+    max_seqs = 4 if kv_dtype is None else 8
+    eng = LLMEngine(net, max_seqs=max_seqs, page_size=4,
+                    num_pages=num_pages, prefill_buckets=(16,),
+                    max_len=64, decode_ticks_per_dispatch=N,
+                    admit_timeout=120.0, kv_dtype=kv_dtype)
     led = memobs.instance()
     usable = eng.num_pages - 1
     samples = []
@@ -450,8 +491,14 @@ def page_pressure_soak(seed: int) -> dict:
     poller = threading.Thread(target=poll, daemon=True)
     poller.start()
     try:
-        futs = [eng.submit(rng.randint(0, 97, 8).tolist(),
-                           max_new_tokens=40) for _ in range(8)]
+        # int8 rider: a 10-token prompt leaves decode mid-page, so a
+        # dry pool yields a PARTIAL coverage (slab shrink) rather
+        # than only boundary truncations — the shrink pin stays
+        # deterministic at the doubled occupancy
+        plen = 8 if kv_dtype is None else 10
+        futs = [eng.submit(rng.randint(0, 97, plen).tolist(),
+                           max_new_tokens=40)
+                for _ in range(n_requests)]
         done, not_done = fut_wait(futs, timeout=FUTURE_TIMEOUT)
         assert not not_done, "futures pending under page pressure"
         outs = [f.result() for f in futs]
@@ -477,12 +524,18 @@ def page_pressure_soak(seed: int) -> dict:
         f"mem_headroom_pages gauge never approached 0 (min "
         f"{min_gauge})")
     # attribution exactness held at EVERY sampled instant: the
-    # free/private/shared/scratch rows tile the pool
+    # free/private/shared/scratch (+ scale_table under int8) rows
+    # tile the pool — page bytes INCLUDE the scale tables
     pool_bytes = eng.num_pages * eng._page_bytes
     bad = [s for s in samples if s[2] != pool_bytes]
     assert not bad, (
         f"kv_pool ledger rows stopped tiling the pool at "
         f"{len(bad)}/{len(samples)} samples: {bad[:3]}")
+    if kv_dtype == "int8":
+        rows = {r["kind"] for r in led.rows()
+                if r["owner"] == "kv_pool"}
+        assert "scale_table" in rows, (
+            f"int8 pool reported no scale_table ledger row: {rows}")
     # drained: every page is free or an evictable cache resident again
     h = led.headroom()
     assert h is not None and h["kv_pages_addable"] == usable, (
@@ -494,7 +547,8 @@ def page_pressure_soak(seed: int) -> dict:
     assert default_registry().get("mem_headroom_pages") is None, \
         "mem_headroom_pages gauge survived the last pool's close"
     return {"requests": len(outs), "truncated": n_trunc,
-            "min_headroom": min_head, "samples": len(samples)}
+            "min_headroom": min_head, "samples": len(samples),
+            "kv_dtype": kv_dtype or "f32", "usable_pages": usable}
 
 
 def ckpt_crash(seed: int, workdir: str) -> dict:
@@ -1788,7 +1842,16 @@ def main(argv=None) -> int:
             out["train"] = train_soak(seed, workdir)
         elif args.slab:
             out["slab"] = slab_soak(seed)
+            # ISSUE 15: the same kill/cancel/deadline storm through
+            # the ragged MIXED tick on an int8-quantized pool —
+            # nonce-pinned identity vs an int8+mixed reference
+            out["slab_mixed_int8"] = slab_soak(seed, mixed=True,
+                                               kv_dtype="int8")
             out["page_pressure"] = page_pressure_soak(seed)
+            # ISSUE 15: same storm, same pool HBM, int8 pages —
+            # >=1.8x usable pages, scale_table row, headroom re-pin
+            out["page_pressure_int8"] = page_pressure_soak(
+                seed, kv_dtype="int8")
         else:
             out["engine"] = engine_soak(seed)
             out["ckpt"] = ckpt_crash(seed, workdir)

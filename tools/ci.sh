@@ -40,10 +40,22 @@ echo "   + one cross-process trace + disabled-tracing flag-check bound)"
 # flag check (time-bounded)
 python tools/obs_smoke.py "$(mktemp -d)" --fleet
 
-echo "== llm serving smoke (prefix cache + chunked ragged prefill)"
+echo "== llm serving smoke (prefix cache + chunked ragged prefill"
+echo "   + decode-ticks sweep + ragged MIXED-TICK gate)"
 # 4 shared-prefix prompts through the engine: asserts nonzero cache
-# hits, cache-on == cache-off generations, and a clean shutdown
+# hits, cache-on == cache-off generations, a clean shutdown, the
+# fused decode-slab sweep, and the mixed-tick gate (one ragged
+# prefill+decode slab token-identical to the legacy two-op tick loop
+# at strictly fewer host dispatches)
 python tools/llm_bench.py --ci
+
+echo "== kv-dtype bench (bf16 vs int8 KV pool at fixed HBM)"
+# quantized-tolerance gate: int8 retains >=1.8x bf16's prefix-cache
+# pages at the same pool HBM budget, int8 streams are internally
+# exact (cache on/off identical — deterministic quantization) and
+# agree with the f32 pool within the documented tolerance; ledger
+# rows are kv_dtype-keyed so int8/bf16 never gate against each other
+python tools/llm_bench.py --ci --kv-dtype
 
 echo "== chaos soak (seeded fault injection -> hardened semantics)"
 # engine under injected device faults + deadlines/shed/cancel storm,
@@ -52,11 +64,16 @@ echo "== chaos soak (seeded fault injection -> hardened semantics)"
 # unreplayable fault schedule, or unrestorable checkpoint
 python tools/chaos_soak.py --ci
 
-echo "== fused-slab chaos soak (decode_ticks_per_dispatch=8)"
+echo "== fused-slab chaos soak (decode_ticks_per_dispatch=8"
+echo "   + mixed-tick/int8 riders)"
 # engine.slab kill storm at the fused slab dispatch + cancel/deadline
 # storms landing mid-slab: every future resolves, retried streams are
 # token-identical to a fault-free reference engine, zero KV-page
-# leaks, fault schedule replays from seed
+# leaks, fault schedule replays from seed. ISSUE-15 riders: the same
+# storm through the ragged MIXED tick on an int8 pool, and the
+# page-pressure storm repeated at fixed HBM with kv_dtype=int8
+# (>=1.8x usable pages, 2x slots before slab-shrink engages,
+# scale_table ledger row, headroom gauge semantics re-pinned)
 python tools/chaos_soak.py --ci --slab
 
 echo "== fleet chaos soak (K=3 replicas, SIGKILL mid-decode -> failover)"
