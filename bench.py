@@ -40,9 +40,13 @@ ROUND1_GPT_TOKENS_PER_SEC = 47224.8
 def _ledger_append(workload: str, value: float, unit: str, **kw):
     """Append the canonical trajectory row (tools/bench_ledger.py).
     Best-effort by contract: the measurement already printed; a ledger
-    hiccup must never cost the driver its line."""
+    hiccup must never cost the driver its line. Every row also carries
+    the time ledger's goodput verdict on the run (absent when that
+    ledger is off — old-schema tolerance)."""
     try:
         from tools import bench_ledger
+        for k, v in bench_ledger.goodput_row_fields().items():
+            kw.setdefault(k, v)
         bench_ledger.append("bench", workload, value, unit, **kw)
     except Exception as e:  # noqa: BLE001
         print(f"bench: ledger append failed: {e}", file=sys.stderr)

@@ -246,3 +246,15 @@ define_flag("compilation_cache_dir", "",
             "time. Repeated runs of the same program skip the 10-120 s "
             "train-step compiles that the train_compile_seconds "
             "histogram records. Empty disables (in-memory cache only).")
+define_flag("goodput_observability", True,
+            "Arm the wall-clock time ledger (observability/goodput.py):"
+            " hot paths attribute every second since arming to one "
+            "bucket (productive / compile / input_wait / ckpt_stall / "
+            "recovery / queue_wait, plus derived host_gap and an "
+            "explicit unattributed residual) -> GET /goodputz, "
+            "goodput_fraction / badput_seconds_total{cause} gauges, "
+            "SLO-trip watermark forensics, fleet_goodput_fraction "
+            "federation. Off: every call site pays one module-flag "
+            "check and records nothing (pinned like tracing/perf/mem; "
+            "read at import — flip at runtime with "
+            "observability.goodput.enable()/disable()).")

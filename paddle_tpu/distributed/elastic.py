@@ -39,6 +39,7 @@ import threading
 import time
 
 from ..core.monitor import stat_add
+from ..observability import goodput as _goodput
 from ..reliability.retry import backoff_delay
 from .launch import find_free_port, trainer_env
 from typing import Dict, List, Optional
@@ -471,6 +472,10 @@ class ElasticManager:
                   f"{self._backoff_level})", file=sys.stderr)
             stat_add("elastic.backoff_seconds", delay)
             time.sleep(delay)
+            if _goodput.enabled():
+                # restart damping is wall clock nobody trains through:
+                # recovery badput on the time ledger
+                _goodput.note("recovery", delay)
         return delay
 
     def install_signal_forwarding(self) -> None:

@@ -34,6 +34,7 @@ from ..core import flags, rng
 from ..io import DataLoader, Dataset
 from ..metric import Metric
 from ..nn.layer import Layer, functional_call, split_state
+from ..observability import goodput as _goodput
 from ..observability import memory as _memobs
 from ..observability import metrics as _obs
 from ..observability import perf as _perf
@@ -984,6 +985,12 @@ class Model:
                 "train", "compile" if compiling else "dispatch", dt)
             if perf_h is not None and not compiling:
                 perf_h.record(dt)
+        if _goodput.enabled():
+            # the time ledger rides the SAME dt: a fresh-signature
+            # step waited on its XLA compile; any other interval is
+            # device compute (productive)
+            _goodput.note("compile" if (fresh_shape or perf_fresh)
+                          else "productive", dt)
         if fresh_shape:
             self._obs["compile_count"].inc()
             self._obs["compile"].observe(dt)
@@ -1100,6 +1107,12 @@ class Model:
                 "train", "compile" if compiling else "dispatch", dt)
             if perf_h is not None and not compiling:
                 perf_h.record(dt)
+        if _goodput.enabled():
+            # the time ledger rides the SAME dt: a fresh-signature
+            # step waited on its XLA compile; any other interval is
+            # device compute (productive)
+            _goodput.note("compile" if (fresh_shape or perf_fresh)
+                          else "productive", dt)
         if fresh_shape:
             self._obs["compile_count"].inc()
             self._obs["compile"].observe(dt)
@@ -1203,6 +1216,11 @@ class Model:
                 # the deferred device→host sync: the "transfer/drain"
                 # leg of the /perfz step-time breakdown
                 _perf.record_phase("train", "drain", drain_dt)
+            if _goodput.enabled():
+                # a measured host-overhead window — recorded with the
+                # weakest claim, so overlapping device work keeps
+                # ownership of any shared seconds
+                _goodput.note("host_gap", drain_dt)
         if self._guard_pending or self._nan_pending:
             self._drain_guard_checks()
 

@@ -46,6 +46,7 @@ import numpy as np
 
 from ..core import flags as _flags
 from ..nn.layer import Layer, functional_call, split_state
+from ..observability import goodput as _goodput
 from ..observability import memory as _memobs
 from ..observability import metrics as _obs
 from ..observability import perf as _perf
@@ -1895,18 +1896,25 @@ class LLMEngine:
             # dispatch interval
             self._perf_skipped.add(pkey)
             if self._last_fetch_t is not None:
-                _perf.record_phase(
-                    "llm", "compile",
-                    time.monotonic() - self._last_fetch_t)
+                cdt = time.monotonic() - self._last_fetch_t
+                if _perf.enabled():
+                    _perf.record_phase("llm", "compile", cdt)
+                if _goodput.enabled():
+                    _goodput.note("compile", cdt)
             return
         if self._last_fetch_t is None:
             return
         pdt = time.monotonic() - self._last_fetch_t
-        h = self._perf_programs.get(pkey)
-        if h is not None:
-            h.record(pdt, tokens=emitted, dispatches=n)
-        _perf.record_phase(
-            "llm", "prefill" if kind == "p" else "decode", pdt)
+        if _perf.enabled():
+            h = self._perf_programs.get(pkey)
+            if h is not None:
+                h.record(pdt, tokens=emitted, dispatches=n)
+            _perf.record_phase(
+                "llm", "prefill" if kind == "p" else "decode", pdt)
+        if _goodput.enabled():
+            # prefill and decode intervals are both device compute:
+            # productive seconds on the time ledger
+            _goodput.note("productive", pdt)
 
     def _count_dispatch(self, n: int = 1) -> None:
         """One engine-loop jit dispatch reached the device (the
@@ -1973,7 +1981,13 @@ class LLMEngine:
             return "retry" if active else "never"
         # admission decided: everything before this instant was queue
         # wait (slot/page availability), everything after is prefill
-        self._m["queue_wait"].observe(time.monotonic() - req.t_enqueued)
+        qdt = time.monotonic() - req.t_enqueued
+        self._m["queue_wait"].observe(qdt)
+        if _goodput.enabled():
+            # wall-clock queue residency (the ledger sweep unions
+            # overlapping requests: N queued seconds over one wall
+            # second is one second of queue_wait)
+            _goodput.note("queue_wait", qdt)
         for idx, page in enumerate(matched):
             self._cache.acquire(page)
             self.block_tables[slot, idx] = page
@@ -2027,7 +2041,13 @@ class LLMEngine:
         if need > len(self._free_pages):
             active = any(s is not None for s in self._slots)
             return "retry" if active else "never"
-        self._m["queue_wait"].observe(time.monotonic() - req.t_enqueued)
+        qdt = time.monotonic() - req.t_enqueued
+        self._m["queue_wait"].observe(qdt)
+        if _goodput.enabled():
+            # wall-clock queue residency (the ledger sweep unions
+            # overlapping requests: N queued seconds over one wall
+            # second is one second of queue_wait)
+            _goodput.note("queue_wait", qdt)
         if req.spans is not None:
             tp = time.perf_counter()
             req.spans["queue"].end(tp)
@@ -2314,6 +2334,15 @@ class LLMEngine:
                 self._fetch_seq = self._issue_seq
                 self._consec_device_errors += 1
                 self._m["device_errors"].inc()
+                if _goodput.enabled() and self._last_fetch_t is not None:
+                    # the window spent on the failed device call is
+                    # recovery badput; advance the fetch clock so the
+                    # next productive interval cannot overlap (and,
+                    # by precedence, erase) this attribution
+                    now_m = time.monotonic()
+                    _goodput.note("recovery",
+                                  now_m - self._last_fetch_t)
+                    self._last_fetch_t = now_m
                 self._update_health()
                 # closers whose generation already completed (awaiting
                 # drain only) resolve successfully; ones still owed
@@ -2928,7 +2957,7 @@ class LLMEngine:
                     continue  # overrun token of a finished request
                 self._deliver_token(slot, req, int(host[slot]), seq)
                 emitted += 1
-        if _perf.enabled():
+        if _perf.enabled() or _goodput.enabled():
             self._perf_attribute(kind, host.shape[0]
                                  if kind in ("D", "M") else 0, emitted)
         self._observe_step(emitted, timed=(kind != "p"))

@@ -31,6 +31,7 @@ import jax
 import numpy as np
 
 from ..core import rng as rng_mod
+from ..observability import goodput as _goodput
 from ..observability import metrics as _obs
 from ..observability import tracing as _tracing
 from ..reliability import faults as _faults
@@ -360,6 +361,10 @@ class _PrefetchIterator:
         t1 = time.perf_counter()
         self._obs["wait"].observe(t1 - t0)
         self._obs["batches"].inc()
+        if _goodput.enabled():
+            # the SAME wait the histogram observes: input starvation
+            # on the time ledger (input_wait badput)
+            _goodput.note("input_wait", t1 - t0)
         if _tracing.enabled():
             # post-hoc span over the wait interval: the input-starved
             # share shows up next to dispatch/drain in span rollups

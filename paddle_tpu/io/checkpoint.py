@@ -51,6 +51,7 @@ import jax
 import numpy as np
 
 from ..core.monitor import stat_add
+from ..observability import goodput as _goodput
 from ..observability import memory as _memobs
 from ..observability import metrics as _obs
 from ..reliability import faults as _faults
@@ -583,7 +584,12 @@ class CheckpointManager:
         # persist (and digest-certify) torn state
         host_tree = jax.tree_util.tree_map(
             lambda x: np.array(x, copy=True), tree)
-        _ckpt_metrics()["snapshot"].observe(time.perf_counter() - t0)
+        snap_dt = time.perf_counter() - t0
+        _ckpt_metrics()["snapshot"].observe(snap_dt)
+        if _goodput.enabled():
+            # the only phase of an async save the train loop waits on:
+            # the ckpt_stall bucket of the time ledger
+            _goodput.note("ckpt_stall", snap_dt)
         # ledger: this snapshot's host bytes are alive from here until
         # the writer commits (or dies trying) — the row tracks the SUM
         # over the ≤ 2 concurrently-alive snapshots
@@ -768,6 +774,7 @@ class CheckpointManager:
         ``ckpt_emergency_flush_total{outcome=}``."""
         dl = as_deadline(deadline)
         outcome = "committed"
+        t0 = time.perf_counter()
         with self._cv:
             if not self._pending:
                 outcome = "noop" if self._writer_err is None else "error"
@@ -787,6 +794,10 @@ class CheckpointManager:
                 outcome = "error"
         _ckpt_metrics()["flush"].labels(outcome).inc()
         stat_add(f"checkpoint.flush_{outcome}")
+        if _goodput.enabled():
+            # the emergency-flush barrier is grace budget spent NOT
+            # training/serving: ckpt_stall on the time ledger
+            _goodput.note("ckpt_stall", time.perf_counter() - t0)
         return outcome
 
     def close(self) -> None:

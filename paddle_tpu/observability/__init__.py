@@ -14,6 +14,10 @@ without numbers). Four parts:
 - exporters: Prometheus text exposition, chrome://tracing JSON merging
   spans + profiler host annotations onto one timeline, a periodic
   JSONL file reporter (atexit-flushed), jax device-memory gauges;
+- goodput: the wall-clock time ledger (``/goodputz``) — every second
+  since arming attributed to one bucket (productive vs the badput
+  taxonomy), reconciled with an explicit unattributed residual, with
+  SLO-trip watermark forensics and fleet federation;
 - memory: the HBM attribution ledger (``/memz``) — owners register
   reservations at allocation boundaries, reads reconcile against
   ``device.memory_stats()`` with an explicit unattributed residual,
@@ -39,6 +43,7 @@ from .metrics import (BYTE_BUCKETS, DEFAULT_BUCKETS,  # noqa: F401
 from .exporters import (JSONLReporter, export_chrome_tracing,  # noqa: F401
                         prometheus_text, sample_device_memory,
                         write_prometheus)
+from . import goodput  # noqa: F401
 from . import memory  # noqa: F401
 from . import perf  # noqa: F401
 from . import propagation  # noqa: F401
@@ -64,7 +69,7 @@ __all__ = [
     "MetricFamily", "MetricRegistry", "default_registry",
     "JSONLReporter", "export_chrome_tracing", "prometheus_text",
     "sample_device_memory", "write_prometheus",
-    "memory", "perf",
+    "goodput", "memory", "perf",
     "tracing", "Span", "SpanContext", "start_span", "trace_span",
     "enable_tracing", "disable_tracing", "tracing_enabled",
     "propagation", "TRACEPARENT_HEADER", "format_traceparent",

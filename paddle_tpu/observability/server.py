@@ -28,6 +28,12 @@ profiler window):
   ``device.memory_stats()`` with an explicit unattributed residual,
   per-phase high-watermarks, and the "KV pages addable" headroom
   estimate.
+- ``GET /goodputz`` — the wall-clock time ledger
+  (observability.goodput): every second since arming attributed to
+  one bucket (productive / compile / input_wait / ckpt_stall /
+  recovery / queue_wait / host_gap) with an explicit unattributed
+  closing line, the goodput fraction, the top badput cause, and
+  SLO-trip watermark forensics.
 - ``GET /fleetz``   — fleet view (registered by a serving Router):
   per-replica health/breaker/scrape digest + computed aggregates;
   404 when this process fronts no fleet.
@@ -64,6 +70,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional
 from urllib.parse import parse_qs, urlparse
 
+from . import goodput as _goodput
 from . import memory as _mem
 from . import perf as _perf
 from . import tracing
@@ -383,6 +390,14 @@ class DebugServer:
                     _mem.instance().update_gauges()
                 except Exception:  # noqa: BLE001 — scrape must answer
                     pass
+            # and the time ledger: goodput_fraction / badput counters
+            # refresh at the read boundary (a never-armed ledger mints
+            # nothing — the federation hole)
+            if _goodput.enabled():
+                try:
+                    _goodput.instance().update_gauges()
+                except Exception:  # noqa: BLE001 — scrape must answer
+                    pass
             text = prometheus_text(self.registry)
             # registered scrape providers (fleet federation) append
             # their blocks; a broken provider must not kill the scrape
@@ -448,6 +463,10 @@ class DebugServer:
                 mem_row = _mem.status_summary()
             except Exception as e:  # noqa: BLE001 — one bad row
                 mem_row = {"error": str(e)}
+            try:
+                goodput_row = _goodput.status_summary()
+            except Exception as e:  # noqa: BLE001 — one bad row
+                goodput_row = {"error": str(e)}
             h._reply_json(200, {
                 "pid": os.getpid(),
                 "uptime_s": round(time.time() - self.t_start, 3),
@@ -456,6 +475,7 @@ class DebugServer:
                 "device_memory": devmem,
                 "perf": perf_row,
                 "memory": mem_row,
+                "goodput": goodput_row,
                 "profilez": self._arm.status()})
         elif url.path == "/tracez":
             # ?limit=N caps the finished spans returned (0 = no cap);
@@ -493,6 +513,12 @@ class DebugServer:
             # breakdown per component (docs/OBSERVABILITY.md "Perf
             # surfaces")
             h._reply_json(200, _perf.perfz_payload())
+        elif url.path == "/goodputz":
+            # the wall-clock attribution ledger: bucket table with its
+            # explicit unattributed closing line, goodput fraction,
+            # top badput cause, watermark/trip forensics
+            # (docs/OBSERVABILITY.md "Goodput surfaces")
+            h._reply_json(200, _goodput.goodputz_payload())
         elif url.path == "/memz":
             # the HBM attribution ledger: per-owner table + the
             # device reconciliation with its explicit unattributed
@@ -532,8 +558,9 @@ class DebugServer:
             h._reply_json(404, {
                 "error": f"unknown path {url.path}",
                 "endpoints": ["/metrics", "/healthz", "/statusz",
-                              "/tracez", "/perfz", "/memz", "/fleetz",
-                              "/sloz", "/scalez", "POST /profilez",
+                              "/tracez", "/perfz", "/memz",
+                              "/goodputz", "/fleetz", "/sloz",
+                              "/scalez", "POST /profilez",
                               "POST /reset_health"]})
 
     def _post(self, h) -> None:

@@ -207,6 +207,16 @@ class SLOTracker:
             st.breached = True
             st.breached_at = time.time()
             self._m_breach.labels(slo).set(1)
+            # goodput forensics: snapshot which time-ledger bucket
+            # grew since the last watermark — the first question a
+            # burn-rate page asks ("did we lose the seconds to
+            # compiles? retries? input?"). Best-effort: the latch
+            # must publish even if the ledger is mid-reset.
+            try:
+                from . import goodput as _goodput
+                _goodput.note_trip(f"slo_breach:{slo}")
+            except Exception:  # noqa: BLE001
+                pass
 
     def refresh(self) -> None:
         """Recompute and republish the windowed gauges. record() only

@@ -18,8 +18,11 @@ This is the shared vocabulary they now compose from:
   error; an expired deadline raises :class:`DeadlineExceeded` instead
   of sleeping toward a budget nobody is waiting for.
 
-Every retry sleep lands in the ``retry_attempts{scope=...}`` counter,
-so "how often are we limping" is one scrape away (docs/OBSERVABILITY.md).
+Every retry sleep lands in the ``retry_attempts{scope=...}`` counter
+and its duration in ``retry_backoff_seconds_total{scope=...}`` (plus
+the time ledger's ``recovery`` bucket), so "how often are we limping"
+AND "how much wall clock it costs" are one scrape away
+(docs/OBSERVABILITY.md).
 
 Stdlib-only by design (imported by distributed/io/inference alike).
 """
@@ -142,6 +145,27 @@ def _retry_metric(scope: str, exhausted: bool = False) -> None:
         pass
 
 
+def _backoff_metric(scope: str, seconds: float) -> None:
+    """Seconds slept between attempts, independently scrapeable: the
+    series the time ledger's ``recovery`` bucket reconciles against
+    (and the /sloz reader's "slow vs retrying" discriminator)."""
+    try:
+        from ..observability import metrics as _obs
+        _obs.default_registry().counter(
+            "retry_backoff_seconds_total",
+            "cumulative backoff sleep between retry attempts",
+            label_names=("scope",)).labels(scope).inc(seconds)
+    except Exception:  # noqa: BLE001 — accounting must not mask errors
+        pass
+    try:
+        from ..observability import goodput as _goodput
+        if _goodput.enabled():
+            # a backoff sleep is time spent limping: recovery badput
+            _goodput.note("recovery", seconds)
+    except Exception:  # noqa: BLE001
+        pass
+
+
 class RetryPolicy:
     """Budgeted exponential-backoff-with-jitter retry.
 
@@ -226,5 +250,6 @@ class RetryPolicy:
                         f"{d:.3f}s exceeds remaining budget)") from e
                 if d > 0:
                     time.sleep(d)
+                    _backoff_metric(self.scope, d)
         _retry_metric(self.scope, exhausted=True)
         raise RetryExhausted(what, self.max_attempts, last) from last

@@ -19,10 +19,12 @@ the router, riding the health-poll cycle it already runs:
 - fleet-level AGGREGATES are computed into first-class gauges
   (``fleet_occupancy``, ``fleet_prefix_cache_hit_rate``,
   ``fleet_tokens_generated``, ``fleet_replicas_scraped``,
-  ``fleet_mfu`` and ``fleet_headroom_pages`` — the latter two with
+  ``fleet_mfu``, ``fleet_headroom_pages`` and
+  ``fleet_goodput_fraction`` — the latter three with
   hole semantics: a down/warming replica or one without the series
   is ABSENT from the mean/sum, never a zero, with
-  ``fleet_mfu_replicas``/``fleet_headroom_replicas`` as auditable
+  ``fleet_mfu_replicas``/``fleet_headroom_replicas``/
+  ``fleet_goodput_replicas`` as auditable
   denominators) — the numbers ROADMAP item 2's device-resident-decode
   case and item 3's KV-page-migration routing need fleet-wide, not
   per-process;
@@ -151,12 +153,19 @@ class FleetScraper:
                         "llm_prefix_cache_hit_tokens",
                         "llm_prompt_tokens", "llm_tokens_generated",
                         "llm_requests_completed", "perf_mfu",
-                        "perf_flops_per_second", "mem_headroom_pages")
+                        "perf_flops_per_second", "mem_headroom_pages",
+                        "goodput_fraction")
 
     def __init__(self, registry: Optional[MetricRegistry] = None,
                  federate_prefixes: Tuple[str, ...] = ("llm_", "perf_",
-                                                       "mem_"),
+                                                       "mem_",
+                                                       "badput_"),
                  stale_after: float = 10.0):
+        # NOTE: per-replica badput CAUSES federate
+        # (fleet_badput_seconds_total{replica=,cause=}); the replica's
+        # goodput_fraction gauge deliberately does NOT — its federated
+        # name would collide with the fleet_goodput_fraction AGGREGATE
+        # below. Per-replica fractions live on /fleetz instead.
         self.registry = registry or default_registry()
         self.federate_prefixes = tuple(federate_prefixes)
         self.stale_after = float(stale_after)
@@ -217,6 +226,19 @@ class FleetScraper:
             "fleet_headroom_replicas",
             "replicas whose mem_headroom_pages entered the "
             "fleet_headroom_pages sum at the last scrape (the "
+            "auditable hole-semantics denominator, like "
+            "fleet_mfu_replicas)")
+        self._g_goodput = reg.gauge(
+            "fleet_goodput_fraction",
+            "mean goodput_fraction across UP replicas that export it "
+            "— a down or never-armed (warming) replica is a HOLE in "
+            "the mean, never a zero (its seconds are gone, not "
+            "badput); 0 with fleet_goodput_replicas=0 means no "
+            "replica has armed its time ledger yet")
+        self._g_goodput_n = reg.gauge(
+            "fleet_goodput_replicas",
+            "replicas whose goodput_fraction entered the "
+            "fleet_goodput_fraction mean at the last scrape (the "
             "auditable hole-semantics denominator, like "
             "fleet_mfu_replicas)")
 
@@ -291,7 +313,7 @@ class FleetScraper:
 
     def _refresh_aggregates(self) -> dict:
         up = self._snapshot_up()
-        occ, kv, mfu, headroom = [], [], [], []
+        occ, kv, mfu, headroom, goodput = [], [], [], [], []
         hit_tok = prompt_tok = tokens = completed = fps = 0.0
         for st in up.values():
             fams = st["families"]
@@ -309,6 +331,13 @@ class FleetScraper:
                                "mem_headroom_pages")
             if hp is not None:
                 headroom.append(hp)
+            # goodput federation, same hole semantics: a replica that
+            # never armed its time ledger exports no goodput_fraction
+            # family at all and stays OUT of the mean and denominator
+            gp = _series_value(fams.get("goodput_fraction"),
+                               "goodput_fraction")
+            if gp is not None:
+                goodput.append(gp)
             fps += _series_value(fams.get("perf_flops_per_second"),
                                  "perf_flops_per_second") or 0.0
             o_sum = _series_value(fams.get("llm_batch_occupancy"),
@@ -346,6 +375,9 @@ class FleetScraper:
             "flops_per_second": fps,
             "mem_headroom_pages": sum(headroom) if headroom else None,
             "mem_headroom_replicas": len(headroom),
+            "goodput_fraction": (sum(goodput) / len(goodput))
+            if goodput else None,
+            "goodput_replicas": len(goodput),
         }
         self._g_scraped.set(agg["replicas_scraped"])
         self._g_occ.set(agg["occupancy"])
@@ -358,6 +390,8 @@ class FleetScraper:
         self._g_fps.set(agg["flops_per_second"])
         self._g_headroom.set(agg["mem_headroom_pages"] or 0.0)
         self._g_headroom_n.set(agg["mem_headroom_replicas"])
+        self._g_goodput.set(agg["goodput_fraction"] or 0.0)
+        self._g_goodput_n.set(agg["goodput_replicas"])
         return agg
 
     def aggregates(self) -> dict:
@@ -426,5 +460,7 @@ class FleetScraper:
                 "mem_headroom_pages": _series_value(
                     fams.get("mem_headroom_pages"),
                     "mem_headroom_pages"),
+                "goodput_fraction": _series_value(
+                    fams.get("goodput_fraction"), "goodput_fraction"),
             }
         return out

@@ -268,7 +268,8 @@ class Router:
                  policy: str = "affinity",
                  max_workers: int = 32,
                  scrape_metrics: bool = True,
-                 federate_prefixes=("llm_", "perf_", "mem_"),
+                 federate_prefixes=("llm_", "perf_", "mem_",
+                                    "badput_"),
                  slo_windows=DEFAULT_WINDOWS,
                  slo_default_target: float = 0.99,
                  slo_breach_threshold: float = 10.0,

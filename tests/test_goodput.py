@@ -1,0 +1,493 @@
+"""Goodput ledger (observability/goodput.py, ISSUE 16): every
+wall-clock second since arming has exactly one owner. Injected clocks
+pin the reconciliation identity (Σ buckets + unattributed == elapsed),
+the documented precedence chain resolves overlaps without
+double-counting, a disabled ledger records nothing, /goodputz and
+/metrics serve the table over real HTTP, an SLO burn-rate trip
+snapshots which bucket grew, fleet federation reads a never-armed
+replica as a hole, and the bench ledger row carries the optional
+goodput fields round-trip.
+"""
+
+import json
+import os
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from paddle_tpu.observability import goodput
+from paddle_tpu.observability.metrics import (MetricRegistry,
+                                              default_registry)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_ledger():
+    """Process-global singleton isolation: every test gets a fresh
+    ledger, an enabled flag, and a clean goodput metric namespace."""
+    goodput.reset()
+    was = goodput.enabled()
+    goodput.enable()
+    reg = default_registry()
+    for fam in ("goodput_fraction", "badput_seconds_total"):
+        reg.unregister(fam)
+    yield
+    goodput.reset()
+    (goodput.enable if was else goodput.disable)()
+
+
+def ticking(start=100.0):
+    """Injected monotonic clock: a one-cell list the test advances."""
+    t = [start]
+    return t, (lambda: t[0])
+
+
+# ---------------------------------------------------------------------------
+# reconciliation: Σ buckets + unattributed == elapsed, always
+# ---------------------------------------------------------------------------
+
+
+def test_injected_clock_reconciliation_pin():
+    t, clk = ticking(100.0)
+    led = goodput.TimeLedger(clock=clk, registry=MetricRegistry())
+    t[0] = 101.0
+    led.note("compile", 1.0)        # arms at 100.0, [100, 101]
+    t[0] = 103.0
+    led.note("productive", 2.0)     # [101, 103]
+    t[0] = 104.0
+    led.note("input_wait", 0.5)     # [103.5, 104]; gap [103, 103.5]
+    totals = led.totals()
+    assert totals["compile"] == pytest.approx(1.0)
+    assert totals["productive"] == pytest.approx(2.0)
+    assert totals["input_wait"] == pytest.approx(0.5)
+    # the 0.5s uncovered gap is ≤ gap_max_s → host_gap, not a leak
+    assert totals["host_gap"] == pytest.approx(0.5)
+    assert totals["unattributed"] == 0.0
+    assert led.elapsed() == pytest.approx(4.0)
+    assert sum(totals.values()) == pytest.approx(led.elapsed(),
+                                                 abs=1e-9)
+    assert led.goodput_fraction() == pytest.approx(2.0 / 4.0)
+
+
+def test_long_gap_classifies_unattributed_short_gap_host():
+    t, clk = ticking(100.0)
+    led = goodput.TimeLedger(clock=clk, registry=MetricRegistry())
+    t[0] = 101.0
+    led.note("productive", 1.0)     # [100, 101]
+    t[0] = 109.5
+    led.note("productive", 0.5)     # [109, 109.5]; gap [101,109] = 8s
+    totals = led.totals()
+    assert totals["productive"] == pytest.approx(1.5)
+    assert totals["unattributed"] == pytest.approx(8.0)
+    assert totals["host_gap"] == 0.0
+    assert sum(totals.values()) == pytest.approx(9.5, abs=1e-9)
+
+
+def test_lazy_arm_keeps_the_arming_notes_own_interval():
+    # arming at note time would clamp the first interval to zero
+    # length — the first observed compile must keep its seconds
+    t, clk = ticking(200.0)
+    led = goodput.TimeLedger(clock=clk, registry=MetricRegistry())
+    t[0] = 205.0
+    led.note("compile", 5.0)
+    assert led.armed
+    assert led.elapsed() == pytest.approx(5.0)
+    assert led.totals()["compile"] == pytest.approx(5.0)
+
+
+def test_goodput_fraction_none_before_arming():
+    led = goodput.TimeLedger(clock=lambda: 1.0,
+                             registry=MetricRegistry())
+    assert not led.armed
+    assert led.goodput_fraction() is None          # a hole, not a 0
+    assert led.elapsed() == 0.0
+    assert all(v == 0.0 for v in led.totals().values())
+
+
+# ---------------------------------------------------------------------------
+# precedence: overlaps resolve by the documented chain, once each
+# ---------------------------------------------------------------------------
+
+
+def test_overlap_precedence_no_double_count():
+    t, clk = ticking(100.0)
+    led = goodput.TimeLedger(clock=clk, registry=MetricRegistry())
+    t[0] = 110.0
+    led.note("queue_wait", 10.0)    # [100, 110]
+    led.note("productive", 4.0)     # [106, 110] — overlaps queue_wait
+    totals = led.totals()
+    # productive owns its 4s; queue_wait keeps only the uncontested 6
+    assert totals["productive"] == pytest.approx(4.0)
+    assert totals["queue_wait"] == pytest.approx(6.0)
+    assert sum(totals.values()) == pytest.approx(10.0, abs=1e-9)
+
+
+def test_same_bucket_overlap_unions_not_sums():
+    # ten queued requests over one second are one second of
+    # queue_wait, not ten
+    t, clk = ticking(100.0)
+    led = goodput.TimeLedger(clock=clk, registry=MetricRegistry())
+    t[0] = 101.0
+    for _ in range(10):
+        led.note("queue_wait", 1.0)         # all stamp [100, 101]
+    assert led.totals()["queue_wait"] == pytest.approx(1.0)
+    assert led.elapsed() == pytest.approx(1.0)
+
+
+def test_three_way_overlap_follows_precedence_order():
+    t, clk = ticking(100.0)
+    led = goodput.TimeLedger(clock=clk, registry=MetricRegistry())
+    t[0] = 106.0
+    led.note("queue_wait", 6.0)     # [100, 106]
+    led.note("recovery", 4.0)       # [102, 106]
+    led.note("productive", 2.0)     # [104, 106]
+    totals = led.totals()
+    assert totals["productive"] == pytest.approx(2.0)
+    assert totals["recovery"] == pytest.approx(2.0)   # [102, 104]
+    assert totals["queue_wait"] == pytest.approx(2.0)  # [100, 102]
+    assert sum(totals.values()) == pytest.approx(6.0, abs=1e-9)
+
+
+def test_precedence_is_the_documented_chain():
+    assert goodput.BUCKETS == ("productive", "compile", "ckpt_stall",
+                               "input_wait", "recovery", "queue_wait",
+                               "host_gap")
+    assert goodput.DERIVED == ("unattributed",)
+
+
+# ---------------------------------------------------------------------------
+# memory bound: settling keeps the identity exact
+# ---------------------------------------------------------------------------
+
+
+def test_settle_bounds_pending_and_keeps_reconciliation():
+    t, clk = ticking(0.0)
+    led = goodput.TimeLedger(clock=clk, registry=MetricRegistry())
+    n = goodput.PENDING_SOFT_CAP + 512
+    for i in range(n):
+        t[0] = (i + 1) * 0.1
+        led.note("productive", 0.05)
+    assert len(led._pending) <= goodput.PENDING_SOFT_CAP
+    totals = led.totals()
+    assert sum(totals.values()) == pytest.approx(led.elapsed(),
+                                                 abs=1e-6)
+    # every note was 0.05 covered + 0.05 gap (gaps ≤ gap_max_s)
+    assert totals["productive"] == pytest.approx(n * 0.05, rel=1e-3)
+    assert totals["unattributed"] == 0.0
+
+
+def test_note_into_settled_region_clips_never_double_books():
+    t, clk = ticking(0.0)
+    led = goodput.TimeLedger(clock=clk, registry=MetricRegistry())
+    n = goodput.PENDING_SOFT_CAP + 512
+    for i in range(n):
+        t[0] = (i + 1) * 0.1
+        led.note("productive", 0.05)
+    assert led._settled_until > 0.0
+    # a late arrival spanning the whole settled region: its settled
+    # part was already closed out — clamp and count, never re-own
+    led.note("compile", t[0])
+    totals = led.totals()
+    assert sum(totals.values()) == pytest.approx(led.elapsed(),
+                                                 abs=1e-6)
+    assert led._clipped_s > 0.0
+
+
+# ---------------------------------------------------------------------------
+# disabled: records nothing, costs one module-flag check
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_records_nothing():
+    goodput.disable()
+    try:
+        goodput.note("productive", 1.0)
+        goodput.note("compile", 1.0)
+        inst = goodput.instance()
+        assert not inst.armed                   # never armed
+        assert all(v == 0.0 for v in inst.totals().values())
+        pz = goodput.goodputz_payload()
+        assert pz["enabled"] is False
+        assert pz["armed"] is False
+        assert goodput.note_trip("x") is None
+    finally:
+        goodput.enable()
+    # re-enabled: the same entry point records again
+    goodput.note("productive", 0.01)
+    assert goodput.instance().armed
+
+
+# ---------------------------------------------------------------------------
+# export: hole until armed, monotone counters after
+# ---------------------------------------------------------------------------
+
+
+def test_update_gauges_mints_nothing_until_armed():
+    reg = MetricRegistry()
+    t, clk = ticking(100.0)
+    led = goodput.TimeLedger(clock=clk, registry=reg)
+    assert led.update_gauges() is None
+    assert reg.get("goodput_fraction") is None      # the hole
+    assert reg.get("badput_seconds_total") is None
+    t[0] = 104.0
+    led.note("productive", 3.0)     # arms at 101.0
+    led.note("compile", 1.0)        # [103, 104]
+    led.update_gauges()
+    frac = reg.get("goodput_fraction")
+    assert frac is not None
+    # compile [103,104] overlaps productive's tail? no: productive is
+    # [101,104], compile yields to it entirely → fraction = 3/3 = 1.0
+    assert frac.value == pytest.approx(1.0)
+    t[0] = 106.0
+    led.note("input_wait", 1.5)     # [104.5, 106]
+    led.update_gauges()
+    bad = reg.get("badput_seconds_total")
+    by_cause = {c.label_values[0]: c.value for c in bad.children()}
+    assert by_cause["input_wait"] == pytest.approx(1.5)
+    # counters are monotone projections: more badput only increases
+    t[0] = 108.0
+    led.note("input_wait", 2.0)
+    led.update_gauges()
+    by_cause2 = {c.label_values[0]: c.value for c in bad.children()}
+    assert by_cause2["input_wait"] == pytest.approx(3.5)
+    for cause, v in by_cause.items():
+        assert by_cause2.get(cause, 0.0) >= v
+
+
+def test_top_badput_picks_the_biggest_cause():
+    totals = {b: 0.0 for b in goodput.BUCKETS + goodput.DERIVED}
+    assert goodput.TimeLedger.top_badput(totals) is None
+    totals["productive"] = 100.0    # productive never counts as badput
+    totals["compile"] = 2.0
+    totals["input_wait"] = 5.0
+    top = goodput.TimeLedger.top_badput(totals)
+    assert top == {"cause": "input_wait", "seconds": 5.0}
+
+
+# ---------------------------------------------------------------------------
+# /goodputz + /metrics over real HTTP
+# ---------------------------------------------------------------------------
+
+
+def _get_json(base, path):
+    with urllib.request.urlopen(base + path, timeout=30) as r:
+        return json.loads(r.read())
+
+
+def test_goodputz_and_metrics_over_http():
+    from paddle_tpu.observability import server as dbg
+    goodput.note("input_wait", 0.01)
+    time.sleep(0.02)
+    goodput.note("productive", 0.01)
+    srv = dbg.DebugServer(port=0).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        gz = _get_json(base, "/goodputz")
+        assert gz["enabled"] is True and gz["armed"] is True
+        assert gz["buckets"]["productive"] > 0
+        assert gz["buckets"]["input_wait"] > 0
+        rec = gz["reconciliation"]
+        assert rec["attributed_s"] + rec["unattributed_s"] == \
+            pytest.approx(rec["elapsed_s"], abs=1e-5)
+        assert rec["residual_s"] == pytest.approx(0.0, abs=1e-6)
+        assert gz["precedence"] == list(goodput.BUCKETS)
+        st = _get_json(base, "/statusz")
+        assert st["goodput"]["enabled"] is True
+        assert st["goodput"]["armed"] is True
+        assert st["goodput"]["goodput_fraction"] is not None
+        with urllib.request.urlopen(base + "/metrics",
+                                    timeout=30) as r:
+            text = r.read().decode()
+        assert "goodput_fraction" in text
+        assert 'badput_seconds_total{cause="input_wait"}' in text
+    finally:
+        srv.stop()
+
+
+def test_goodputz_unarmed_payload_is_explicit():
+    from paddle_tpu.observability import server as dbg
+    srv = dbg.DebugServer(port=0).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        gz = _get_json(base, "/goodputz")
+        assert gz["enabled"] is True and gz["armed"] is False
+        assert gz["goodput_fraction"] is None
+        # never-armed process exports NEITHER goodput family: the
+        # hole fleet federation is specified to read
+        with urllib.request.urlopen(base + "/metrics",
+                                    timeout=30) as r:
+            text = r.read().decode()
+        # line-anchored: fleet_* aggregates minted by OTHER tests'
+        # scrapers legitimately contain these names as substrings
+        for line in text.splitlines():
+            assert not line.startswith(("goodput_fraction",
+                                        "badput_seconds_total",
+                                        "# TYPE goodput_fraction",
+                                        "# TYPE badput_seconds_total"))
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# SLO trip forensics: the breach latch snapshots which bucket grew
+# ---------------------------------------------------------------------------
+
+
+def test_slo_breach_trip_blames_the_grown_bucket():
+    from paddle_tpu.observability.slo import SLOTracker
+    goodput.note("productive", 0.01)
+    inst = goodput.instance()
+    inst.snapshot_watermark("baseline")
+    # the window must really exist post-arming: note() clamps an
+    # interval reaching before the arm point
+    time.sleep(0.25)
+    goodput.note("recovery", 0.2)   # the badput that grew since
+    tracker = SLOTracker(targets={"gold": 0.99}, min_samples=1,
+                         breach_threshold=1.0,
+                         registry=MetricRegistry())
+    tracker.record("gold", None, 0.1, "error")   # burn 100 ≫ 1
+    pz = goodput.goodputz_payload()
+    trips = pz["trips"]
+    assert trips, "breach latch did not note a trip"
+    trip = trips[-1]
+    assert trip["tag"] == "slo_breach:gold"
+    assert trip["delta"]["recovery"] == pytest.approx(0.2, abs=0.05)
+    assert trip["top_grown"] == "recovery"
+    # the trip advanced the watermark so consecutive trips don't
+    # re-blame the same seconds
+    assert pz["watermark"]["span"] == "slo_breach:gold"
+    d = pz["delta_since_watermark"]
+    assert d["recovery"] == pytest.approx(0.0, abs=1e-6)
+
+
+def test_watermark_delta_reads_against_previous_watermark():
+    t, clk = ticking(100.0)
+    led = goodput.TimeLedger(clock=clk, registry=MetricRegistry())
+    t[0] = 101.0
+    led.note("productive", 1.0)
+    first = led.snapshot_watermark("w0")
+    assert first["productive"] == pytest.approx(1.0)
+    t[0] = 103.0
+    led.note("ckpt_stall", 2.0)
+    second = led.snapshot_watermark("w1")
+    assert second["ckpt_stall"] == pytest.approx(2.0)
+    assert second["productive"] == pytest.approx(0.0)
+
+
+# ---------------------------------------------------------------------------
+# fleet federation: never-armed replica is a hole, not a zero
+# ---------------------------------------------------------------------------
+
+ARMED_TEXT = ('# TYPE goodput_fraction gauge\n'
+              'goodput_fraction 0.8\n'
+              '# TYPE badput_seconds_total counter\n'
+              'badput_seconds_total{cause="compile"} 1.5\n')
+WARMING_TEXT = ('# TYPE llm_tokens_generated counter\n'
+                'llm_tokens_generated 5\n')
+
+
+def test_fleet_goodput_federation_hole_semantics():
+    from paddle_tpu.serving.fleet import FleetScraper
+    reg = MetricRegistry()
+    s = FleetScraper(registry=reg)
+    s.record("armed", ARMED_TEXT)
+    s.record("warming", WARMING_TEXT)       # serving, never armed
+    s.record("down", None)                  # dead
+    agg = s.aggregates()
+    assert agg["goodput_fraction"] == pytest.approx(0.8)
+    assert agg["goodput_replicas"] == 1     # holes stay OUT of both
+    assert reg.get("fleet_goodput_fraction").value == \
+        pytest.approx(0.8)
+    assert reg.get("fleet_goodput_replicas").value == 1
+    # a second armed replica enters the mean
+    s.record("armed2", '# TYPE goodput_fraction gauge\n'
+                       'goodput_fraction 0.4\n')
+    agg = s.aggregates()
+    assert agg["goodput_fraction"] == pytest.approx(0.6)
+    assert agg["goodput_replicas"] == 2
+    # nobody armed: mean is None (not 0-with-denominator)
+    s.forget("armed")
+    s.forget("armed2")
+    agg = s.aggregates()
+    assert agg["goodput_fraction"] is None
+    assert agg["goodput_replicas"] == 0
+
+
+def test_fleet_federates_badput_causes_not_the_fraction():
+    from paddle_tpu.serving.fleet import FleetScraper
+    s = FleetScraper(registry=MetricRegistry())
+    s.record("r0", ARMED_TEXT)
+    text = s.render_prometheus()
+    # per-replica badput causes federate by prefix...
+    assert 'fleet_badput_seconds_total{replica="r0",cause="compile"}'\
+        in text
+    # ...but the replica's goodput_fraction gauge must NOT: its
+    # federated name would collide with the unlabeled
+    # fleet_goodput_fraction aggregate in the same exposition
+    assert "fleet_goodput_fraction{" not in text
+    # per-replica fractions surface on /fleetz instead
+    rep = s.replica_report()
+    assert rep["r0"]["goodput_fraction"] == pytest.approx(0.8)
+
+
+def test_fleet_replica_report_unarmed_fraction_is_none():
+    from paddle_tpu.serving.fleet import FleetScraper
+    s = FleetScraper(registry=MetricRegistry())
+    s.record("warming", WARMING_TEXT)
+    rep = s.replica_report()
+    assert rep["warming"]["goodput_fraction"] is None
+
+
+# ---------------------------------------------------------------------------
+# bench ledger: optional goodput fields round-trip
+# ---------------------------------------------------------------------------
+
+
+def test_bench_ledger_goodput_fields_roundtrip(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import bench_ledger as bl
+    path = str(tmp_path / "ledger.jsonl")
+    # old-schema row (no goodput keys at all) + new row
+    old = bl.make_row("llm_bench", "wl", 10.0, "tok/s", backend="cpu")
+    old.pop("goodput_fraction")
+    old.pop("badput_top")
+    bl.append_row(old, path=path)
+    new = bl.make_row("llm_bench", "wl", 11.0, "tok/s", backend="cpu",
+                      goodput_fraction=0.83, badput_top="input_wait")
+    assert new["goodput_fraction"] == 0.83
+    assert new["badput_top"] == "input_wait"
+    bl.append_row(new, path=path)
+    rows = bl.read_ledger(path)
+    assert len(rows) == 2
+    assert "goodput_fraction" not in rows[0]
+    assert rows[1]["goodput_fraction"] == 0.83
+    # --compare tolerates the absent field on the old row
+    verdicts = bl.compare(rows)
+    assert len(verdicts) == 1
+    assert verdicts[0]["newest_goodput_fraction"] == 0.83
+    assert verdicts[0]["newest_badput_top"] == "input_wait"
+    assert verdicts[0]["status"] in ("ok", "regressed")
+    assert bl.ci_gate(path=path) in (0, 3)
+
+
+def test_bench_ledger_goodput_row_fields_hole_semantics():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import bench_ledger as bl
+    # never-armed process: no fields at all (absent beats null — the
+    # same hole discipline the fleet reads)
+    assert bl.goodput_row_fields() == {}
+    goodput.note("productive", 0.05)
+    time.sleep(0.01)
+    goodput.note("input_wait", 0.005)
+    fields = bl.goodput_row_fields()
+    assert 0.0 < fields["goodput_fraction"] <= 1.0
+    assert fields["badput_top"] in goodput.BADPUT_CAUSES
+    # disabled: no fields, regardless of the armed singleton
+    goodput.disable()
+    try:
+        assert bl.goodput_row_fields() == {}
+    finally:
+        goodput.enable()

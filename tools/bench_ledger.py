@@ -68,7 +68,8 @@ BASELINE_WINDOW = 8
 # registry snapshot prefixes a ledger row carries (counters/gauges
 # only — histogram percentiles would bloat every row)
 METRIC_PREFIXES = ("llm_", "perf_", "mem_", "host_rss_bytes",
-                   "train_compile_count", "train_step_count", "fleet_")
+                   "train_compile_count", "train_step_count", "fleet_",
+                   "goodput_", "badput_")
 
 
 def ledger_path(path: Optional[str] = None) -> Optional[str]:
@@ -124,11 +125,14 @@ def metrics_snapshot(prefixes=METRIC_PREFIXES) -> Dict[str, float]:
     gauges first — they update at read boundaries, and a ledger row IS
     a read boundary."""
     try:
-        from paddle_tpu.observability import default_registry, memory, perf
+        from paddle_tpu.observability import (default_registry, goodput,
+                                              memory, perf)
         if perf.enabled():
             perf.instance().update_gauges()
         if memory.enabled():
             memory.instance().update_gauges()
+        if goodput.enabled():
+            goodput.instance().update_gauges()
     except Exception:  # noqa: BLE001 — emitters must not need jax up
         return {}
     out: Dict[str, float] = {}
@@ -148,11 +152,38 @@ def metrics_snapshot(prefixes=METRIC_PREFIXES) -> Dict[str, float]:
     return out
 
 
+def goodput_row_fields() -> Dict[str, object]:
+    """The time ledger's verdict on the current process — the optional
+    ``goodput_fraction`` + ``badput_top`` kwargs a bench row carries
+    ({} when the ledger is disabled or never armed, so old-schema rows
+    simply lack the keys). All three emitters splat this into
+    :func:`append` (the ``peak_mem_bytes`` discipline)."""
+    try:
+        from paddle_tpu.observability import goodput
+        if not goodput.enabled():
+            return {}
+        led = goodput.instance()
+        if not led.armed:
+            return {}
+        totals = led.totals()
+        frac = led.goodput_fraction()
+        top = led.top_badput(totals)
+        return {
+            "goodput_fraction": (round(frac, 4)
+                                 if frac is not None else None),
+            "badput_top": top["cause"] if top else None,
+        }
+    except Exception:  # noqa: BLE001 — a row beats no row
+        return {}
+
+
 def make_row(tool: str, workload: str, value: float, unit: str,
              tokens_per_sec: Optional[float] = None,
              mfu: Optional[float] = None,
              dispatches: Optional[float] = None,
              peak_mem_bytes: Optional[float] = None,
+             goodput_fraction: Optional[float] = None,
+             badput_top: Optional[str] = None,
              backend: Optional[str] = None,
              direction: str = "higher",
              kv_dtype: Optional[str] = None,
@@ -167,7 +198,12 @@ def make_row(tool: str, workload: str, value: float, unit: str,
     tolerance) records the engine KV-pool dtype a serving bench ran
     at AND joins the series key, so an int8 run never regression-
     gates against a bf16 baseline (different storage = different
-    trajectory)."""
+    trajectory). ``goodput_fraction`` / ``badput_top`` (optional, same
+    absent-field tolerance) carry the time ledger's verdict on the
+    run — the fraction of bench wall clock the device actually
+    computed, and the dominant badput cause — so a throughput number
+    bought by hiding stalls outside the timed region is visible IN
+    the trajectory row."""
     return {
         "schema": SCHEMA,
         "run_id": uuid.uuid4().hex[:12],
@@ -186,6 +222,9 @@ def make_row(tool: str, workload: str, value: float, unit: str,
                        if dispatches is not None else None),
         "peak_mem_bytes": (float(peak_mem_bytes)
                           if peak_mem_bytes is not None else None),
+        "goodput_fraction": (float(goodput_fraction)
+                             if goodput_fraction is not None else None),
+        "badput_top": str(badput_top) if badput_top is not None else None,
         "kv_dtype": str(kv_dtype) if kv_dtype is not None else None,
         "direction": direction,
         "metrics": metrics if metrics is not None else metrics_snapshot(),
@@ -295,9 +334,11 @@ def compare(rows: List[dict],
             "newest": newest["value"],
             "newest_rev": newest["git_rev"],
             "newest_mfu": newest.get("mfu"),
-            # optional field (rows predating it have no key at all —
+            # optional fields (rows predating them have no key at all —
             # .get keeps --compare/--ci tolerant of the old schema)
             "newest_peak_mem_bytes": newest.get("peak_mem_bytes"),
+            "newest_goodput_fraction": newest.get("goodput_fraction"),
+            "newest_badput_top": newest.get("badput_top"),
         }
         if not prior:
             v.update(status="new", baseline=None, ratio=None)

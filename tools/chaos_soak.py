@@ -40,6 +40,14 @@ docs/RELIABILITY.md):
    (router + replicas via tools/trace_merge) next to the fault seed
    and replay command.
 
+8. GOODPUT FORENSICS (default path) — chaos must be visible on the
+   time ledger: a seeded ``device.dispatch`` storm grows the
+   ``recovery`` bucket on ``GET /goodputz`` and async saves under a
+   seeded ``ckpt.async_commit`` fault grow ``ckpt_stall``, with the
+   reconciliation line closed throughout; a disabled ledger's
+   ``note()`` costs one module-flag check (time-bounded) and records
+   nothing.
+
 Determinism: every schedule is nth/probability-based with a fixed
 seed; ``faults.preview(site, N)`` recomputes the faulting call
 numbers purely, and the soak asserts the observed injection log
@@ -670,6 +678,121 @@ raise SystemExit("unreachable: the injected fault must escalate")
     assert rows[0]["kind"] == "header", rows[0]
     assert rows[0]["reason"] == "exception", rows[0]
     return {"dump": dumps[0], "rows": len(rows)}
+
+
+def goodput_soak(seed: int, workdir: str) -> dict:
+    """Scenario 8: goodput-ledger forensics under chaos. The seeded
+    fault storms must be VISIBLE on ``GET /goodputz``: a
+    ``device.dispatch`` storm grows the ``recovery`` bucket (the
+    window spent on a failed device call is recovery badput), and an
+    async-checkpoint run under a seeded ``ckpt.async_commit`` fault
+    still grows ``ckpt_stall`` by its snapshot windows (the only
+    phase the train loop waits on — the commit fault surfaces at the
+    barrier, never in the stall accounting). The reconciliation line
+    must stay closed throughout. Then the off-switch pin: with the
+    ledger disabled, ``note()`` must cost one module-flag check
+    (time-bounded, the PR-4 tracing discipline) and record nothing."""
+    from urllib.request import urlopen
+
+    from paddle_tpu.inference.llm import LLMEngine
+    from paddle_tpu.io.checkpoint import CheckpointManager
+    from paddle_tpu.observability import goodput
+    from paddle_tpu.observability.server import DebugServer
+    from paddle_tpu.reliability import faults
+    from paddle_tpu.reliability.faults import FaultInjected
+
+    assert goodput.enabled(), "goodput ledger disabled in the soak env"
+    rng = np.random.RandomState(seed)
+    dbg = DebugServer(port=0).start()
+    base = f"http://127.0.0.1:{dbg.port}"
+
+    def goodputz():
+        with urlopen(base + "/goodputz", timeout=10) as r:
+            return json.loads(r.read())
+
+    out = {}
+    try:
+        g0 = goodputz()["buckets"]
+
+        # -- phase A: device.dispatch storm → recovery badput ---------
+        faults.reset()
+        faults.enable(seed=seed)
+        # faults land AFTER the first fetches (the recovery window is
+        # measured from the last drained fetch — a fault before any
+        # fetch has no attributable start)
+        faults.inject("device.dispatch", nth=(5, 12))
+        net = _tiny_gpt()
+        with LLMEngine(net, max_seqs=4, page_size=4, num_pages=96,
+                       prefill_buckets=(16,), device_retry_budget=4,
+                       admit_timeout=60.0) as eng:
+            futs = [eng.submit(rng.randint(0, 97, 8).tolist(),
+                               max_new_tokens=8) for _ in range(6)]
+            done, not_done = fut_wait(futs, timeout=FUTURE_TIMEOUT)
+            assert not not_done, "futures pending under the storm"
+            for f in futs:
+                assert f.exception() is None, f.exception()
+        n_dispatch = sum(1 for s, _ in faults.injected_log()
+                         if s == "device.dispatch")
+        assert n_dispatch >= 2, faults.injected_log()
+        faults.reset()
+        g1 = goodputz()["buckets"]
+        assert g1["recovery"] > g0["recovery"], (
+            f"a {n_dispatch}-fault dispatch storm left the recovery "
+            f"bucket flat: {g0} -> {g1}")
+        assert g1["productive"] > g0["productive"], (g0, g1)
+
+        # -- phase B: async saves under a seeded commit fault →
+        # ckpt_stall moves by the snapshot windows
+        faults.enable(seed=seed)
+        faults.inject("ckpt.async_commit", nth=(2,), times=1)
+        d = os.path.join(workdir, "goodput_ck")
+        mgr = CheckpointManager(d, async_save=True)
+        try:
+            mgr.save(1, {"w": np.zeros((256, 256), np.float32)})
+            mgr.wait_until_finished()
+            try:
+                mgr.save(2, {"w": np.zeros((256, 256), np.float32)})
+                mgr.wait_until_finished()
+                raised = False
+            except FaultInjected:
+                raised = True
+            assert raised, "ckpt.async_commit fault did not surface"
+        finally:
+            mgr.close()
+            faults.reset()
+        gz = goodputz()
+        g2 = gz["buckets"]
+        assert g2["ckpt_stall"] > g1["ckpt_stall"], (
+            f"two async saves left the ckpt_stall bucket flat: "
+            f"{g1} -> {g2}")
+        rec = gz["reconciliation"]
+        assert abs(rec["residual_s"]) < 1e-6, rec
+        out["buckets"] = {k: round(v, 4) for k, v in g2.items() if v}
+
+        # -- phase C: ledger-off = one module-flag check --------------
+        goodput.disable()
+        try:
+            led = goodput.instance()
+            before = led.totals()["productive"]
+            n_calls = 200_000
+            t0 = time.perf_counter()
+            for _ in range(n_calls):
+                goodput.note("productive", 1.0)
+            per_call = (time.perf_counter() - t0) / n_calls
+            assert per_call < 5e-6, (
+                f"disabled goodput.note costs "
+                f"{per_call * 1e6:.2f}us/call — more than a flag "
+                f"check")
+            assert led.totals()["productive"] == before, (
+                "disabled ledger still recorded intervals")
+            assert goodputz()["enabled"] is False
+        finally:
+            goodput.enable()
+        out["off_ns_per_call"] = round(per_call * 1e9)
+    finally:
+        faults.reset()
+        dbg.stop()
+    return out
 
 
 def _poll_until(fn, timeout: float, what: str):
@@ -1856,6 +1979,7 @@ def main(argv=None) -> int:
             out["engine"] = engine_soak(seed)
             out["ckpt"] = ckpt_crash(seed, workdir)
             out["flight"] = flight_escalation(seed, workdir)
+            out["goodput"] = goodput_soak(seed, workdir)
     except AssertionError:
         # make a red CI run reproducible in one copy-paste: the seed
         # IS the fault schedule (docs/RELIABILITY.md determinism)

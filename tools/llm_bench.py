@@ -92,6 +92,30 @@ def _peak_mem_bytes():
         return None
 
 
+def _goodput_row_fields():
+    """The time ledger's verdict on this run — the optional
+    ``goodput_fraction`` + ``badput_top`` every ledger row carries
+    ({} when the ledger is disabled or never armed, the
+    ``_peak_mem_bytes`` discipline). Canonical implementation lives
+    with the schema (tools/bench_ledger.py)."""
+    return _ledger.goodput_row_fields()
+
+
+def _goodput_productive_s():
+    """Cumulative productive seconds on the process-wide time ledger
+    (None when disabled; 0.0 before arming). ``run_storm`` differences
+    this across a replay to goodput-weight that run's
+    replica-seconds — provisioned capacity discounted by the fraction
+    of wall clock the devices actually computed."""
+    try:
+        from paddle_tpu.observability import goodput as _goodput
+        if not _goodput.enabled():
+            return None
+        return _goodput.instance().totals()["productive"]
+    except Exception:  # noqa: BLE001
+        return None
+
+
 def build_net(vocab=211, layers=2, hidden=128, heads=4, max_pos=512):
     import paddle_tpu as pt
     from paddle_tpu.models.gpt import GPTForCausalLM, gpt_config
@@ -300,6 +324,7 @@ def fleet_main(args):
     # canonical trajectory row (PERF.md "The perf ledger")
     _ledger.append("llm_bench", row["metric"], row["value"],
                    row["unit"], peak_mem_bytes=_peak_mem_bytes(),
+ **_goodput_row_fields(),
                    extra={"affinity_hit_rate": aff["hit_rate"],
                           "round_robin_hit_rate": rr["hit_rate"],
                           "workload": row["workload"]})
@@ -447,6 +472,7 @@ def run_storm(engines, schedule, autoscale: bool):
         router = _storm_router({f"r{i}": LocalReplica(e)
                                 for i, e in enumerate(engines)})
     outcomes = {"ok": 0, "deadline": 0, "other": 0}
+    gp0 = _goodput_productive_s()
     t0 = time.perf_counter()
     futs = []
     try:
@@ -481,10 +507,24 @@ def run_storm(engines, schedule, autoscale: bool):
         if scaler is not None:
             scaler.close()
         router.close()
+    gp1 = _goodput_productive_s()
+    if gp0 is not None and gp1 is not None and wall > 0:
+        # run-window goodput: productive ledger seconds this replay
+        # earned per wall second; weighting replica-seconds by it
+        # prices provisioned capacity in USEFUL seconds
+        run_goodput = max(0.0, min(1.0, (gp1 - gp0) / wall))
+        goodput_rs = replica_seconds * run_goodput
+    else:
+        run_goodput = None
+        goodput_rs = None
     return {
         "mode": "autoscaled" if autoscale else f"static_k{k}",
         "wall_s": round(wall, 2),
         "replica_seconds": round(replica_seconds, 2),
+        "goodput_fraction": (round(run_goodput, 4)
+                             if run_goodput is not None else None),
+        "goodput_replica_seconds": (round(goodput_rs, 2)
+                                    if goodput_rs is not None else None),
         "gold_deadline_hit_ratio": gold.get("deadline_hit_ratio"),
         "bronze_deadline_hit_ratio": bronze.get("deadline_hit_ratio"),
         "outcomes": outcomes,
@@ -553,8 +593,16 @@ def storm_main(args):
     _ledger.append(
         "llm_bench", row["metric"], row["value"], row["unit"],
         peak_mem_bytes=_peak_mem_bytes(),
+        **_goodput_row_fields(),
         extra={"replica_seconds_static": rs_static,
                "replica_seconds_autoscaled": rs_auto,
+               # replica-seconds discounted to USEFUL seconds: each
+               # run's provisioned capacity weighted by the fraction
+               # of its wall clock the time ledger scored productive
+               "goodput_replica_seconds_static":
+                   runs["static"]["goodput_replica_seconds"],
+               "goodput_replica_seconds_autoscaled":
+                   runs["autoscaled"]["goodput_replica_seconds"],
                "gold_hit_static":
                    runs["static"]["gold_deadline_hit_ratio"],
                "gold_hit_autoscaled":
@@ -691,6 +739,7 @@ def decode_ticks_main(args, net=None, assert_ci=False):
                    tokens_per_sec=n8_b1["tokens_per_sec"],
                    dispatches=n8_b1["host_dispatches_per_100_tokens"],
                    peak_mem_bytes=_peak_mem_bytes(),
+                   **_goodput_row_fields(),
                    extra={"ratios": ratios,
                           "workload": row["workload"]})
     if assert_ci:
@@ -753,6 +802,7 @@ def mixed_tick_main(args, net=None, assert_ci=False):
                    row["unit"],
                    dispatches=mixed["host_dispatches"],
                    peak_mem_bytes=_peak_mem_bytes(),
+                   **_goodput_row_fields(),
                    extra={"legacy_dispatches":
                               legacy["host_dispatches"],
                           "mixed_slabs": mixed["mixed_slabs"],
@@ -885,6 +935,7 @@ def kv_dtype_main(args, net=None, assert_ci=False):
                        "prefix_cache_resident_pages",
                        kv_dtype=kv,
                        peak_mem_bytes=_peak_mem_bytes(),
+                       **_goodput_row_fields(),
                        extra={"usable_pages": stats[kv][
                                   "usable_pages"],
                               "page_bytes": stats[kv]["page_bytes"],
@@ -892,6 +943,7 @@ def kv_dtype_main(args, net=None, assert_ci=False):
     _ledger.append("llm_bench", row["metric"], row["value"],
                    row["unit"], kv_dtype="int8",
                    peak_mem_bytes=_peak_mem_bytes(),
+                   **_goodput_row_fields(),
                    extra={"int8_greedy_agreement_vs_f32": agree,
                           "workload": row["workload"]})
     if assert_ci:
@@ -991,6 +1043,7 @@ def main(argv=None):
                    row["unit"],
                    tokens_per_sec=on["e2e_tokens_per_sec"],
                    peak_mem_bytes=_peak_mem_bytes(),
+                   **_goodput_row_fields(),
                    extra={"ttft_p50_s": on["ttft_p50_s"],
                           "cache_off_ttft_p50_s": off["ttft_p50_s"],
                           "workload": row["workload"]})

@@ -146,6 +146,8 @@ def main(outdir: str = "/tmp/pt_obs_smoke") -> int:
             assert devmem.get("host_rss_bytes"), devmem
         assert st.get("memory", {}).get("enabled") is True, \
             st.get("memory")
+        assert st.get("goodput", {}).get("enabled") is True, \
+            st.get("goodput")
         with urllib.request.urlopen(base + "/tracez?limit=8",
                                     timeout=30) as r:
             tz = json.loads(r.read())
@@ -214,6 +216,9 @@ def main(outdir: str = "/tmp/pt_obs_smoke") -> int:
 
         # -- /perfz + /memz for a decode-slab LLMEngine run ------------
         _engine_perf_section(base)
+
+        # -- /goodputz: the time ledger after fit + engine pass --------
+        _goodput_section(base)
     finally:
         srv.stop()
     tracing.disable()
@@ -317,6 +322,45 @@ def _engine_perf_section(base: str) -> None:
     snap = observability.default_registry().snapshot()
     assert snap.get('llm_served_flops_total{tenant="smoke"}', 0) > 0, \
         {k: v for k, v in snap.items() if "served" in k}
+
+
+def _goodput_section(base: str) -> None:
+    """Tentpole acceptance for the time ledger: after the fit run AND
+    the decode-slab engine pass, ``/goodputz`` must show nonzero
+    productive seconds, a reconciliation line whose buckets +
+    unattributed sum exactly to elapsed, and device-time buckets that
+    reproduce the totals the perf instruments measured — the ledger
+    rides the SAME dt values (train: the fused-loop dispatch
+    histogram; llm: the /perfz breakdown phases), so on this serial
+    workload the interval union equals the sums."""
+    from paddle_tpu import observability
+
+    code, gz = _get_json(base + "/goodputz")
+    assert code == 200
+    assert gz["enabled"] and gz["armed"], gz
+    assert gz["buckets"]["productive"] > 0, \
+        f"zero productive time after a fit + engine run: {gz['buckets']}"
+    rec = gz["reconciliation"]
+    assert abs(rec["attributed_s"] + rec["unattributed_s"]
+               - rec["elapsed_s"]) < 1e-6, rec
+    assert abs(rec["residual_s"]) < 1e-6, rec
+    # device-time buckets vs the perf instruments' totals
+    reg = observability.default_registry()
+    loop_hist = reg.get("train_loop_dispatch_seconds")
+    dispatched = loop_hist.sum if loop_hist is not None else 0.0
+    code, pz = _get_json(base + "/perfz")
+    llm_ph = pz["breakdown"].get("llm", {}).get("phases", {})
+    expect = dispatched + sum(llm_ph.values())
+    got = gz["buckets"]["productive"] + gz["buckets"]["compile"]
+    assert expect > 0 and abs(got - expect) / expect < 0.05, \
+        (got, expect, gz["buckets"], llm_ph)
+    # the gauges ride the /metrics prescrape (the federation surface)
+    with urllib.request.urlopen(base + "/metrics", timeout=30) as r:
+        scraped = r.read().decode()
+    assert "goodput_fraction" in scraped, \
+        "goodput_fraction gauge missing from /metrics"
+    assert 'badput_seconds_total{cause=' in scraped, \
+        "badput_seconds_total counters missing from /metrics"
 
 
 def _get_json(url: str, timeout: float = 30.0):
@@ -426,6 +470,37 @@ def fleet_main(outdir: str = "/tmp/pt_obs_fleet_smoke") -> int:
             "replica mem_headroom_pages not federated"
         assert "fleet_headroom_pages " in scraped, \
             "fleet_headroom_pages aggregate missing"
+        # goodput federation: both replicas served traffic, so both
+        # time ledgers armed and export goodput_fraction — the fleet
+        # aggregate must be a mean over BOTH (auditable denominator),
+        # with the per-replica badput causes federated alongside
+        assert "fleet_goodput_fraction " in scraped, \
+            "fleet_goodput_fraction aggregate missing"
+        assert "fleet_goodput_replicas 2" in scraped, \
+            "fleet_goodput_fraction mean must cover both replicas"
+        assert 'fleet_badput_seconds_total{replica=' in scraped, \
+            "replica badput causes not federated"
+        for n in names:
+            assert (reps[n].get("metrics") or {}).get(
+                "goodput_fraction") is not None, \
+                f"/fleetz missing {n}'s goodput_fraction: {reps[n]}"
+        # warming-replica-is-a-hole: a replica that is UP but has not
+        # armed its time ledger (no goodput_fraction series yet) must
+        # be ABSENT from the fleet mean, never a zero dragging it down
+        from paddle_tpu.observability.metrics import MetricRegistry
+        from paddle_tpu.serving.fleet import FleetScraper
+        with urllib.request.urlopen(infos["r0"]["metrics"],
+                                    timeout=30) as r:
+            r0_text = r.read().decode()
+        assert "goodput_fraction" in r0_text, \
+            "armed replica exports no goodput_fraction"
+        fs = FleetScraper(registry=MetricRegistry())
+        fs.record("armed", r0_text)
+        fs.record("warming", "llm_requests_completed 0\n")
+        hole_agg = fs.aggregates()
+        assert hole_agg["goodput_replicas"] == 1, hole_agg
+        armed_frac = hole_agg["goodput_fraction"]
+        assert armed_frac is not None and armed_frac > 0, hole_agg
         # -- ONE cross-process trace ------------------------------------
         out = outs[0]
         tid = out["trace_id"]

@@ -123,6 +123,7 @@ def _emit_ledger(rec: dict, spec: dict) -> None:
                             else None),
             mfu=rec.get("mfu"),
             backend=rec.get("device"),
+            **bench_ledger.goodput_row_fields(),
             # the full registry snapshot already rides the legacy row;
             # the ledger row carries the bounded counters/gauges view
             extra={k: rec.get(k) for k in
