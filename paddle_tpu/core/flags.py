@@ -170,7 +170,7 @@ define_flag("decode_ticks_per_dispatch", 1,
             "LLMEngine(decode_ticks_per_dispatch=...) overrides per "
             "engine.",
             validator=lambda v: v >= 1)
-define_flag("mixed_tick", False,
+define_flag("mixed_tick", True,
             "Default for LLMEngine(mixed_tick=...): serve prefill "
             "chunk rows and decode rows as ONE ragged mixed batch "
             "inside the fused DecodeCarry scan (ops ragged_paged_"
@@ -178,9 +178,30 @@ define_flag("mixed_tick", False,
             "zero host dispatches between phases, collapsing the "
             "alternating prefill/decode tick loop. Token streams are "
             "identical to the legacy two-op tick path (sampling keys "
-            "fold (nonce, position) only; test-pinned). Off keeps the "
-            "legacy alternating path; speculative engines always use "
-            "their own round structure.")
+            "fold (nonce, position) only; test-pinned), so ON is the "
+            "default since the speculative parity suite passes with "
+            "it. The legacy alternating loop stays one release behind "
+            "this flag (set False / mixed_tick=False to get it back); "
+            "engines that took the default silently fall back to it "
+            "when a conflicting knob (lookahead, legacy spec rounds) "
+            "is in play — only an EXPLICIT mixed_tick=True conflicts "
+            "loudly.")
+define_flag("spec_slab", True,
+            "Default for LLMEngine(spec_slab=...): run speculative "
+            "draft-K/verify-1 rounds ON DEVICE inside the DecodeCarry "
+            "lax.scan slab — K draft steps, one ragged verify window "
+            "and the accept/rollback masking all execute as scan "
+            "ticks in ONE XLA dispatch (up to K accepted tokens + "
+            "the bonus per tick per slot), instead of the legacy "
+            "host-orchestrated round (K draft dispatches + a verify "
+            "dispatch + a host sync each). Slab spec engines ride "
+            "the prefix cache, decode_ticks_per_dispatch=N, "
+            "mixed_tick prefill fusion, kv_dtype='int8' (quantized "
+            "draft pool) and temperature>0 (on-device rejection "
+            "sampling; keys still fold (nonce, position) only). "
+            "False keeps the legacy inline path one release for "
+            "rollback (greedy-only, inline prefill, no prefix "
+            "cache; see MIGRATION.md).")
 define_flag("kv_dtype", "",
             "Default storage dtype for LLMEngine's paged KV pool: "
             "'int8' (quantized pages + per-token scale table beside "

@@ -195,6 +195,23 @@ def _engine_metrics():
             "XLA dispatches issued by the engine loop (prefill "
             "chunks, decode steps/slabs, speculative draft+verify "
             "passes) — the quantity fused slabs divide by N"),
+        # speculative decoding (draft-K/verify-1 rounds; both the
+        # legacy host-orchestrated path and the on-device spec slab
+        # feed these — the acceptance lens tools/llm_bench.py --spec
+        # sweeps over draft K)
+        "spec_rounds": reg.counter(
+            "llm_spec_rounds_total",
+            "speculative draft+verify rounds executed (slab engines: "
+            "realized scan ticks; legacy engines: host rounds)"),
+        "spec_draft_tokens": reg.counter(
+            "llm_spec_draft_tokens_total",
+            "draft tokens proposed to the verifier (spec_tokens - 1 "
+            "per round per emitting slot)"),
+        "spec_accept_rate": reg.gauge(
+            "llm_spec_accept_rate",
+            "cumulative committed draft proposals / proposed draft "
+            "tokens (the bonus/correction token is not a proposal "
+            "and is excluded from both sides)"),
         # hardened failure semantics (docs/RELIABILITY.md): these
         # outcomes are terminal and disjoint from completed/truncated/
         # failed — submitted = completed + truncated + failed + shed +
@@ -260,6 +277,107 @@ def _sample(logits, temperature, key, nonces, positions):
     return jnp.where(temperature > 0.0, sampled, greedy)
 
 
+# speculative-sampling key salts: folded into the engine key BEFORE
+# the (nonce, position) folds, so every random decision of a spec
+# round still depends only on WHAT is sampled (the key discipline all
+# determinism pins ride on) while never colliding with the plain
+# `_sample` keys. DRAFT salts the draft model's proposal sampling;
+# ACCEPT the per-proposal rejection test; RESID the residual
+# (max(p-q,0)) sample emitted at the first rejection.
+_SPEC_DRAFT_SALT = 0x5D
+_SPEC_ACCEPT_SALT = 0x5A
+_SPEC_RESID_SALT = 0x5B
+
+
+def _spec_accept(tokens_mat, draft_logits, verify_logits, temps,
+                 nonces, positions, key):
+    """The speculative accept/commit rule as a pure function (shared
+    by the on-device spec slab and pinned directly by the
+    distributional-exactness test).
+
+    Inputs (B slots, K = spec_tokens):
+    - ``tokens_mat``     [B, K]      the verify window: committed last
+      token t0 followed by the K-1 draft proposals d1..d_{K-1}
+    - ``draft_logits``   [B, K-1, V] the draft distribution each
+      proposal was sampled from (q_i proposes tokens_mat[:, i+1])
+    - ``verify_logits``  [B, K, V]   the target model's logits after
+      each window token (p_i is the target's distribution for the
+      token following tokens_mat[:, i])
+    - ``temps``/``nonces``/``positions`` [B]: per-slot temperature,
+      sampling-key salt, and the feed position of t0 (decision i keys
+      on position ``positions + i``)
+
+    Returns ``(out, n_acc)``: ``out`` [B, K] where columns
+    ``0..n_acc-1`` are the accepted proposals and column ``n_acc`` is
+    the committed correction/bonus (columns past it are padding —
+    never emitted); ``n_acc`` [B] in 0..K-1 counts accepted proposals,
+    so a round commits ``n_acc + 1`` tokens before budget clamping.
+
+    Exactness: greedy slots (T<=0) use prefix acceptance against
+    argmax(p_i) — committed tokens are IDENTICAL to the plain greedy
+    chain no matter what the draft proposed. T>0 slots accept
+    proposal t ~ q_i with probability min(1, p_i(t)/q_i(t)) and on
+    rejection commit a sample of normalize(max(p_i - q_i, 0)); when
+    every proposal is accepted the bonus is a plain ``_sample`` of
+    p_{K-1} (same key the one-token-at-a-time sampler would fold).
+    Each committed token is therefore distributed exactly as the
+    target's own sampler (standard speculative-sampling identity;
+    test-pinned Monte-Carlo)."""
+    b, kq = tokens_mat.shape
+    greedy_v = jnp.argmax(verify_logits, axis=-1)          # [B, K]
+    t_inv = 1.0 / jnp.maximum(temps, 1e-6)[:, None, None]
+    p_all = jax.nn.softmax(verify_logits * t_inv, axis=-1)
+    q_all = jax.nn.softmax(draft_logits * t_inv, axis=-1)
+
+    def fold(salt, pos):
+        def mk(n, p):
+            return jax.random.fold_in(
+                jax.random.fold_in(jax.random.fold_in(key, salt), n),
+                p)
+        return jax.vmap(mk)(nonces, pos)
+
+    props = tokens_mat[:, 1:]                              # [B, K-1]
+    p_at = jnp.take_along_axis(p_all[:, :kq - 1], props[..., None],
+                               axis=-1)[..., 0]            # [B, K-1]
+    q_at = jnp.take_along_axis(q_all, props[..., None],
+                               axis=-1)[..., 0]
+    acc_cols = []
+    for i in range(kq - 1):
+        u = jax.vmap(jax.random.uniform)(
+            fold(_SPEC_ACCEPT_SALT, positions + i))
+        stoch = u * q_at[:, i] <= p_at[:, i]
+        acc_cols.append(jnp.where(temps > 0.0, stoch,
+                                  props[:, i] == greedy_v[:, i]))
+    accept = jnp.stack(acc_cols, axis=1)                   # [B, K-1]
+    n_acc = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1),
+                    axis=1)                                # [B]
+    # correction at the break index a < K-1: greedy → argmax(p_a);
+    # T>0 → a sample of normalize(max(p_a - q_a, 0)) (q==p exactly is
+    # a probability-zero rejection — fall back to p_a for stability)
+    ia = jnp.clip(n_acc, 0, kq - 2)
+    p_a = jnp.take_along_axis(p_all, ia[:, None, None],
+                              axis=1)[:, 0]                # [B, V]
+    q_a = jnp.take_along_axis(q_all, ia[:, None, None],
+                              axis=1)[:, 0]
+    resid = jnp.maximum(p_a - q_a, 0.0)
+    rs = jnp.sum(resid, axis=-1, keepdims=True)
+    resid = jnp.where(rs > 0.0, resid, p_a)
+    rtok = jax.vmap(jax.random.categorical)(
+        fold(_SPEC_RESID_SALT, positions + ia), jnp.log(resid))
+    corr_lt = jnp.where(
+        temps > 0.0, rtok,
+        jnp.take_along_axis(greedy_v, ia[:, None], axis=1)[:, 0])
+    # all K-1 proposals accepted: the bonus token is a plain target
+    # sample of p_{K-1} — the exact key the sequential sampler folds
+    bonus = _sample(verify_logits[:, kq - 1], temps, key, nonces,
+                    positions + kq - 1)
+    corr = jnp.where(n_acc == kq - 1, bonus, corr_lt)
+    idx = jnp.arange(kq)[None, :]
+    shifted = jnp.concatenate([props, props[:, -1:]], axis=1)  # [B,K]
+    out = jnp.where(idx < n_acc[:, None], shifted, corr[:, None])
+    return out, n_acc
+
+
 class DecodeCarry(NamedTuple):
     """Device-resident per-slot decode state: the scan carry of one
     fused decode slab (``decode_ticks_per_dispatch`` ticks as ONE XLA
@@ -303,25 +421,51 @@ class DecodeCarry(NamedTuple):
     schedule at slab entry, and a slot whose prompt completes at tick
     j has its sampled first token, start position and emission budget
     installed INTO the carry at that tick, so it decodes from tick
-    j+1 onward without ever surfacing to the host."""
+    j+1 onward without ever surfacing to the host.
+
+    Speculative lanes (``spec_slab`` engines; ``None`` — an empty
+    pytree node — everywhere else, so non-speculative compiled
+    programs are unchanged):
+
+    - ``draft_k_pages``/``draft_v_pages`` — the DRAFT model's paged
+      KV pool (its own layer/head dims, the SAME page allocator and
+      block tables; a :class:`QuantizedKV` pair under
+      ``kv_dtype="int8"``). Riding the donated carry lets one scan
+      tick run the whole draft-K/verify-1 round on device: K chained
+      draft probes write here, the ragged verify window writes the
+      target pool, and the accept/rollback masking advances
+      ``tokens``/``positions``/``budgets`` by the committed run
+      length — rejected draft KV simply stays behind the position
+      frontier and is overwritten before any later tick reads it
+      (the slab-boundary rollback; never a host round-trip)."""
 
     tokens: jax.Array
     positions: jax.Array
     budgets: jax.Array
     k_pages: jax.Array
     v_pages: jax.Array
+    draft_k_pages: Optional[jax.Array] = None
+    draft_v_pages: Optional[jax.Array] = None
 
 
 class _PagedDecode(Layer):
     """One batched decode step as a pure Layer (so functional_call
     threads the GPT's params): feed each active slot's last token,
     write its K/V into the pages, attend over the paged context,
-    sample the next token on device."""
+    sample the next token on device.
 
-    def __init__(self, net, attention_impl: str = "xla"):
+    ``return_logits``: also return the [B, V] logits the token was
+    sampled from — the draft-probe mode of the on-device spec slab,
+    where the proposal distribution q_i is the rejection test's
+    denominator. Off (the default) keeps every existing compiled
+    program's output arity unchanged."""
+
+    def __init__(self, net, attention_impl: str = "xla",
+                 return_logits: bool = False):
         super().__init__()
         self.net = net
         self.attention_impl = attention_impl
+        self.return_logits = return_logits
 
     def _paged_attention(self, q, k_pages, v_pages, tables, lens):
         # the decode step IS the T=batch single-token case of the one
@@ -378,6 +522,8 @@ class _PagedDecode(Layer):
         logits = _lm_logits(cfg, gpt.embeddings, x,
                             getattr(net, "lm_head", None))[:, 0]
         nxt = _sample(logits, temperature, key, nonces, positions)
+        if self.return_logits:
+            return nxt, logits, k_pages, v_pages
         return nxt, k_pages, v_pages
 
 
@@ -385,10 +531,13 @@ class _PagedVerify(Layer):
     """Speculative-verify step: feed K tokens per slot (the committed
     last token + K-1 draft proposals), write their K/V into the pages,
     attend with per-token causal limits, and return the TARGET model's
-    greedy choice after each — one pass instead of K decode steps.
+    [B, K, V] logits after each — one pass instead of K decode steps.
     Exactness: position j's logits see precisely the same cached
-    context as the j-th sequential decode step would, so the greedy
-    tokens are identical by construction (pinned by test)."""
+    context as the j-th sequential decode step would, so greedy
+    acceptance (argmax of these logits) and T>0 rejection sampling
+    are exact by construction (pinned by test). Callers that only
+    need the greedy choice argmax outside (the legacy round's
+    ``_verify_fn`` wrapper keeps its old [B, K] token contract)."""
 
     def __init__(self, net):
         super().__init__()
@@ -413,9 +562,16 @@ class _PagedVerify(Layer):
         pos_ids = base_lens[:, None] + jnp.arange(kq)[None, :]  # [B,K]
         x = gpt.embeddings(tokens, position_ids=pos_ids)
         active = base_lens > 0
+        # a window straddling the table's end (base within K-1 of
+        # max_len) must scratch its overflow writes, not let the
+        # gather's index clamp land them on the sequence's LAST page
+        page_slot = pos_ids // ps
         page_idx = jnp.take_along_axis(
-            jnp.clip(block_tables, 0), pos_ids // ps, axis=1)
-        page_idx = jnp.where(active[:, None], page_idx, 0)
+            jnp.clip(block_tables, 0),
+            jnp.minimum(page_slot, block_tables.shape[1] - 1), axis=1)
+        page_idx = jnp.where(
+            active[:, None] & (page_slot < block_tables.shape[1]),
+            page_idx, 0)
         offs = pos_ids % ps
 
         if cfg.use_rope:
@@ -448,7 +604,7 @@ class _PagedVerify(Layer):
         from ..models.gpt import _lm_logits
         logits = _lm_logits(cfg, gpt.embeddings, x,
                             getattr(net, "lm_head", None))  # [B,K,V]
-        return jnp.argmax(logits, axis=-1), k_pages, v_pages
+        return logits, k_pages, v_pages
 
 
 class _PagedPrefill(Layer):
@@ -738,10 +894,13 @@ def _engine_memory_provider(ref):
         # pool dtype; an int8 pool adds ONE distinct "scale_table"
         # row for the per-token scales beside it. headroom stays
         # exact under quantization because page_bytes (the marginal
-        # cost of adding a page) is kv + scale bytes together.
+        # cost of adding a page) is kv + scale bytes together —
+        # including the DRAFT pool's share for speculative engines
+        # (the draft shares the page allocator, so adding a page
+        # costs both pools), which gets its own distinct owner rows
+        # below instead of inflating the kv_pool split.
         pb = eng._page_bytes
-        pbs = eng._page_scale_bytes
-        pbk = pb - pbs
+        pbk = eng._tgt_page_bytes - eng._tgt_scale_bytes
         usable = eng.num_pages - 1
         free = len(eng._free_pages)
         cache = eng._cache
@@ -759,12 +918,29 @@ def _engine_memory_provider(ref):
              "detail": {"note": "page 0: masked/inactive writes",
                         "dtype": eng.kv_dtype}},
         ]
-        if pbs:
+        if eng._tgt_scale_bytes:
             rows.append(
                 {"owner": "kv_pool", "kind": "scale_table",
-                 "bytes": eng.num_pages * pbs,
+                 "bytes": eng.num_pages * eng._tgt_scale_bytes,
                  "detail": {"note": "int8 per-token dequantization "
                                     "scales (f32, beside the pool)"}})
+        if eng._draft_page_bytes:
+            # speculative draft pool: same allocator, own owner row —
+            # OOM forensics must see what the draft model costs
+            rows.append(
+                {"owner": "draft_pool", "kind": "pages",
+                 "bytes": eng.num_pages * (eng._draft_page_bytes -
+                                           eng._draft_scale_bytes),
+                 "detail": {"note": "speculative draft model KV "
+                                    "(shares the kv_pool page "
+                                    "allocator and block tables)",
+                            "dtype": eng.kv_dtype}})
+            if eng._draft_scale_bytes:
+                rows.append(
+                    {"owner": "draft_pool", "kind": "scale_table",
+                     "bytes": eng.num_pages * eng._draft_scale_bytes,
+                     "detail": {"note": "int8 draft-pool per-token "
+                                        "dequantization scales"}})
         return {"rows": rows,
                 "headroom_pages": eng._avail_pages(),
                 "page_bytes": pb}
@@ -822,9 +998,17 @@ def _engine_status_provider(ref):
                 if eng.n_prompt_tokens else 0.0,
             }
         if eng.spec_k:
-            out["speculative"] = {"spec_tokens": eng.spec_k,
-                                  "rounds": eng.n_spec_rounds,
-                                  "draft_steps": eng.n_draft_steps}
+            prop = eng.n_spec_proposed
+            out["speculative"] = {
+                "spec_tokens": eng.spec_k,
+                "mode": "slab" if eng.spec_slab else "legacy",
+                "rounds": eng.n_spec_rounds,
+                "draft_steps": eng.n_draft_steps,
+                "draft_tokens_proposed": prop,
+                "draft_tokens_accepted": eng.n_spec_accepted,
+                "accept_rate": round(eng.n_spec_accepted / prop, 4)
+                if prop else 0.0,
+            }
         return out
 
     return _status
@@ -846,14 +1030,28 @@ class LLMEngine:
     per-request and graceful); a request whose PROMPT alone can never
     fit the pool fails its future at admission.
 
-    ``draft_net``/``spec_tokens``: SPECULATIVE DECODING (greedy-only
-    v1) — a small draft model proposes ``spec_tokens - 1`` tokens per
-    round through its own paged cache (sharing the block tables), and
-    ONE target pass verifies them all (`_PagedVerify`); the greedy
-    prefix-acceptance rule makes outputs EXACTLY equal to plain
-    decoding (test-pinned), while the big model runs once per accepted
-    run instead of once per token. Does not compose with lookahead
-    (the verify fetch is the round barrier).
+    ``draft_net``/``spec_tokens``: SPECULATIVE DECODING — a small
+    draft model proposes ``spec_tokens - 1`` tokens per round through
+    its own paged cache (sharing the block tables), and ONE target
+    pass verifies them all (`_PagedVerify`). With the default
+    ``spec_slab=True`` the WHOLE round runs inside the fused
+    ``DecodeCarry`` scan: draft probes, the ragged verify window,
+    and masked accept/rollback are one device program, so a single
+    dispatch advances up to ``decode_ticks_per_dispatch`` rounds ×
+    (K+1) tokens per slot with zero host round-trips. Greedy outputs
+    are EXACTLY equal to plain decoding (argmax prefix acceptance);
+    ``temperature>0`` is served by on-device rejection sampling —
+    accept ``u·q ≤ p``, resample the normalized residual — which is
+    distributionally exact (the speculative-sampling theorem,
+    test-pinned by Monte-Carlo), with keys folding (nonce, position)
+    only so streams stay failover-deterministic. Slab mode composes
+    with the prefix cache, chunked/mixed prefill, fused slabs and
+    ``kv_dtype="int8"`` (the draft pool quantizes too, under its own
+    ``draft_pool`` ledger owner). ``spec_slab=False`` keeps the
+    LEGACY host-paced inline path for one release (greedy-only,
+    one-shot bucketized prefill, no cache, ticks clamped to 1 — the
+    ≥2× dispatch-reduction baseline; see docs/MIGRATION.md). Neither
+    mode composes with lookahead (the round is its own chain).
 
     ``lookahead``: issue up to this many decode steps ahead of the
     token fetch. Steps CHAIN on device (each step's sampled tokens
@@ -881,9 +1079,9 @@ class LLMEngine:
     body is the per-tick program; sampling keys fold (nonce,
     position) only — test-pinned), and N=1 keeps the per-tick path:
     its compiled program carries no scan op. Does not compose with
-    ``lookahead`` (the slab must drain at its boundary) and is
-    clamped to 1 for speculative engines (rounds are their own
-    fusion).
+    ``lookahead`` (the slab must drain at its boundary). Slab-mode
+    speculative engines fuse N ROUNDS per dispatch; only the legacy
+    inline path (``spec_slab=False``) still clamps N to 1.
 
     ``mixed_tick``: ONE RAGGED MIXED TICK (default
     ``FLAGS.mixed_tick``) — serve the prefill queue's chunk rows AND
@@ -901,8 +1099,10 @@ class LLMEngine:
     is independent; sampling keys fold (nonce, position) only —
     test-pinned greedy AND seeded, cache on/off). Composes with
     ``decode_ticks_per_dispatch`` (a mixed slab runs N mixed ticks);
-    conflicts with ``lookahead`` (drain-at-boundary, like the slab)
-    and is clamped off for speculative engines.
+    conflicts with ``lookahead`` (drain-at-boundary, like the slab).
+    Slab-mode speculative engines RIDE the mixed tick (prompt chunks
+    prefill both models' pools inside the slab); only the legacy
+    inline path (``spec_slab=False``) clamps it off.
 
     ``kv_dtype``: KV POOL STORAGE DTYPE (default ``FLAGS.kv_dtype``,
     falling back to the legacy ``cache_dtype`` argument).
@@ -918,8 +1118,9 @@ class LLMEngine:
     PERF.md "Ragged mixed tick + int8 KV"). A quantized page rides
     the SAME CoW/digest/refcount discipline as a plain one — the
     prefix cache keys pages by prompt-token digests, not bytes.
-    Does not compose with ``draft_net`` (quantized draft pools
-    deferred).
+    Composes with ``draft_net`` on the slab path (the draft pool
+    quantizes alongside, with its own ``scale_table`` ledger rows);
+    only the legacy inline path (``spec_slab=False``) still raises.
 
     ``prefix_cache`` + ``prefill_chunk``: PREFIX CACHING over the page
     pool (full prompt pages become immutable, refcounted, and keyed by
@@ -934,10 +1135,11 @@ class LLMEngine:
     tokens). Generations are token-identical with the cache on or off
     (shared pages hold bitwise-identical KV; sampling keys depend only
     on request nonce + position — test-pinned). ``prefill_chunk``
-    defaults to the smallest prefill bucket. Speculative engines
-    (``draft_net``) keep the inline one-shot prefill path and force
-    the prefix cache off (the draft's paged KV would need the same
-    sharing treatment; deferred).
+    defaults to the smallest prefill bucket. Slab-mode speculative
+    engines take this chunked path like any other engine (a draft
+    chunk rides along each target chunk so the draft pool covers
+    every position); only LEGACY inline engines (``spec_slab=False``)
+    keep the one-shot prefill and force the cache off.
     """
 
     def __init__(self, net, max_seqs: int = 8, page_size: int = 16,
@@ -956,7 +1158,8 @@ class LLMEngine:
                  drain_after: int = 8,
                  decode_ticks_per_dispatch: Optional[int] = None,
                  kv_dtype: Optional[str] = None,
-                 mixed_tick: Optional[bool] = None):
+                 mixed_tick: Optional[bool] = None,
+                 spec_slab: Optional[bool] = None):
         cfg = net.cfg
         self.cfg = cfg
         self.max_seqs = max_seqs
@@ -995,12 +1198,26 @@ class LLMEngine:
                 f"{sorted(KV_DTYPES)}")
         # else: an exotic legacy cache_dtype (e.g. float64) keeps the
         # old plain-pool behavior, labeled by its dtype name
-        if kv_dtype == "int8" and draft_net is not None:
+        # ON-DEVICE SPECULATIVE SLAB (default FLAGS.spec_slab): run
+        # draft-K/verify-1 rounds as DecodeCarry scan ticks — K draft
+        # probes, one ragged verify window and the accept/rollback
+        # masking in ONE dispatch per slab. Slab engines ride the
+        # prefix cache, fused slabs, mixed_tick, int8 (quantized
+        # draft pool) and temperature>0 (on-device rejection
+        # sampling); spec_slab=False keeps the legacy host-
+        # orchestrated round one release for rollback (MIGRATION.md).
+        if spec_slab is None:
+            spec_slab = _flags.get_flag("spec_slab")
+        self.spec_slab = bool(spec_slab) and draft_net is not None
+        if kv_dtype == "int8" and draft_net is not None \
+                and not self.spec_slab:
             raise ValueError(
-                "kv_dtype='int8' does not compose with draft_net: "
-                "the speculative draft pool shares the block "
-                "tables and would need its own scale tables "
-                "(quantized draft pools deferred)")
+                "kv_dtype='int8' does not compose with the LEGACY "
+                "inline speculative path (spec_slab=False): its "
+                "draft pool is a plain array with no scale tables. "
+                "The on-device slab path (spec_slab=True, the "
+                "default) runs a quantized draft pool — use it, or "
+                "drop int8")
         self.kv_dtype = kv_dtype
         L = cfg.num_layers
         self.k_pages = kv_zeros(
@@ -1020,14 +1237,16 @@ class LLMEngine:
         self.lookahead = int(lookahead)
         # DEVICE-RESIDENT DECODE LOOP: fuse N decode ticks into one
         # lax.scan dispatch (DecodeCarry docs the on-device state).
-        # Defaults from FLAGS.decode_ticks_per_dispatch; speculative
-        # engines run their own round fusion and clamp to 1.
+        # Defaults from FLAGS.decode_ticks_per_dispatch. Slab-mode
+        # speculative engines COMPOSE: a spec slab runs N whole
+        # draft+verify rounds per dispatch (up to N*K tokens); only
+        # the legacy host-orchestrated round structure clamps to 1.
         if decode_ticks_per_dispatch is None:
             decode_ticks_per_dispatch = _flags.get_flag(
                 "decode_ticks_per_dispatch")
         self.decode_ticks_per_dispatch = max(
             1, int(decode_ticks_per_dispatch))
-        if draft_net is not None:
+        if draft_net is not None and not self.spec_slab:
             self.decode_ticks_per_dispatch = 1
         if self.decode_ticks_per_dispatch > 1 and self.lookahead:
             raise ValueError(
@@ -1039,19 +1258,29 @@ class LLMEngine:
         # MIXED TICK: serve prefill chunk rows and decode rows as ONE
         # ragged batch inside the fused scan (collapses the
         # alternating prefill/decode tick loop; the ragged entry
-        # point makes "mixed" a batch property). Speculative engines
-        # keep their own round structure (clamped off, like the slab
-        # knob); lookahead conflicts for the same drain-at-boundary
-        # reason as the slab.
+        # point makes "mixed" a batch property). Default ON
+        # (FLAGS.mixed_tick): the flip is safe because token streams
+        # are pinned identical to the legacy two-op path. LEGACY
+        # speculative engines keep their own round structure (clamped
+        # off); slab-mode spec engines ride mixed slabs for prefill.
+        # lookahead conflicts for the same drain-at-boundary reason
+        # as the slab — but only an EXPLICIT mixed_tick=True raises:
+        # the flag DEFAULT silently yields to lookahead, so the flip
+        # cannot break existing lookahead deployments.
+        mixed_explicit = mixed_tick is not None
         if mixed_tick is None:
             mixed_tick = _flags.get_flag("mixed_tick")
-        self.mixed_tick = bool(mixed_tick) and draft_net is None
+        self.mixed_tick = bool(mixed_tick) and \
+            (draft_net is None or self.spec_slab)
         if self.mixed_tick and self.lookahead:
-            raise ValueError(
-                "mixed_tick does not compose with lookahead: a mixed "
-                "slab must drain at its boundary (the device decides "
-                "which tick each slot's prompt completed and how far "
-                "its decode advanced) — use one knob or the other")
+            if mixed_explicit:
+                raise ValueError(
+                    "mixed_tick does not compose with lookahead: a "
+                    "mixed slab must drain at its boundary (the "
+                    "device decides which tick each slot's prompt "
+                    "completed and how far its decode advanced) — "
+                    "use one knob or the other")
+            self.mixed_tick = False
         # recompile-signature guard (same discipline as Model
         # _guard_recompiles): fused-slab programs ("decode_loop", one
         # per distinct realized slab length) are counted separately
@@ -1113,10 +1342,16 @@ class LLMEngine:
             self.spec_k = int(spec_tokens)
             draft_net.eval()
             dcfg = draft_net.cfg
-            self.draft_k_pages = jnp.zeros(
+            # same kv_zeros entry point as the target pool: an int8
+            # engine gets a QUANTIZED draft pool (int8 pages + its
+            # own per-token scale table) with the same quantize-on-
+            # write/dequantize-in-kernel discipline — the PR 15
+            # deferred follow-on, distinct "draft_pool" ledger rows
+            self.draft_k_pages = kv_zeros(
                 (dcfg.num_layers, num_pages, page_size,
                  dcfg.num_kv_heads, dcfg.head_dim), cache_dtype)
-            self.draft_v_pages = jnp.zeros_like(self.draft_k_pages)
+            self.draft_v_pages = jax.tree_util.tree_map(
+                jnp.zeros_like, self.draft_k_pages)
             ddecode = _PagedDecode(draft_net, attention_impl)
             dprefill = _PagedPrefill(draft_net)
             self._draft_params, self._draft_buffers = \
@@ -1142,18 +1377,24 @@ class LLMEngine:
 
             def verify_fn(params, buffers, tokens, base_lens, tables,
                           kp, vp):
-                (out, _) = functional_call(
+                # legacy round contract: the greedy choice per window
+                # position (argmax applied HERE — _PagedVerify itself
+                # now returns the [B, K, V] logits the slab's
+                # rejection sampler needs)
+                ((lg, kp, vp), _) = functional_call(
                     verify, params, buffers, tokens, base_lens,
                     tables, kp, vp, training=False)
-                return out
+                return jnp.argmax(lg, axis=-1), kp, vp
 
             self._draft_decode_fn = jax.jit(draft_decode_fn,
                                             donate_argnums=(6, 7))
             self._draft_prefill_fn = jax.jit(draft_prefill_fn,
                                              donate_argnums=(5, 6))
             self._verify_fn = jax.jit(verify_fn, donate_argnums=(5, 6))
-            self.n_spec_rounds = 0
-            self.n_draft_steps = 0
+        self.n_spec_rounds = 0
+        self.n_draft_steps = 0
+        self.n_spec_proposed = 0   # draft tokens offered to verify
+        self.n_spec_accepted = 0   # of those, committed to requests
         decode = _PagedDecode(net, attention_impl)
         # all wrappers share `net` as their only sublayer, so one
         # "net."-prefixed param dict serves decode and prefill alike
@@ -1221,10 +1462,11 @@ class LLMEngine:
         self._slab_fn = jax.jit(slab_fn, static_argnums=(7,),
                                 donate_argnums=(2,))
 
-        if self.spec_k:
-            # speculative engines keep the inline one-shot prefill
-            # (round-synced anyway; the draft pool would need the same
-            # prefix-sharing treatment) and run without a prefix cache
+        if self.spec_k and not self.spec_slab:
+            # LEGACY speculative engines keep the inline one-shot
+            # prefill (round-synced anyway) and run without a prefix
+            # cache; slab-mode spec engines take the chunked branch
+            # below like any other engine
             prefill = _PagedPrefill(net)
 
             def prefill_fn(params, buffers, ids, true_len, row, kp, vp,
@@ -1311,6 +1553,131 @@ class LLMEngine:
             self._mixed_fn = jax.jit(mixed_fn, static_argnums=(8,),
                                      donate_argnums=(2,))
 
+        if self.spec_slab:
+            # draft-side chunked prefill: every prompt chunk row ALSO
+            # runs through the draft model into ITS pool (same token/
+            # position/limit/table schedule; the sampled token is
+            # discarded — the target owns sampling). This is what
+            # makes the prefix cache valid for spec engines: prefill
+            # and quantize-on-write are deterministic, so a digest-
+            # matched shared page's draft bytes are exactly what
+            # recomputing the prefix would write.
+            dchunk = _ChunkedPrefill(draft_net, attention_impl)
+
+            def draft_chunk_fn(params, buffers, tokens, positions,
+                               limits, tables, sample_idx, sample_pos,
+                               kp, vp, temps, nonces, key):
+                (out, _) = functional_call(
+                    dchunk, params, buffers, tokens, positions,
+                    limits, tables, sample_idx, sample_pos, kp, vp,
+                    temps, nonces, key, training=False)
+                return out
+
+            self._draft_chunk_fn = jax.jit(draft_chunk_fn,
+                                           donate_argnums=(8, 9))
+
+            # THE SPEC SLAB: n_ticks draft-K/verify-1 rounds as ONE
+            # scan program — each tick runs K chained draft probes
+            # (writing the draft pool riding the carry), ONE ragged
+            # verify window over the target pool, and the
+            # accept/rollback masking (_spec_accept), advancing each
+            # active slot by 1..K committed tokens with ZERO host
+            # round-trips. `cov` [B] is the page-covered position
+            # frontier the host pre-reserved: a window straddling it
+            # has its overflow writes routed to scratch (table entry
+            # 0) and its acceptance clamped by cap, exactly the
+            # legacy round's cache-capacity rule. Rejected draft KV
+            # needs no host rollback — it sits beyond the position
+            # frontier and every later tick overwrites it before any
+            # read. Masked no-ops (budget 0) and on-device EOS follow
+            # the pure-decode slab discipline.
+            dprobe = _PagedDecode(draft_net, attention_impl,
+                                  return_logits=True)
+            spec_K = self.spec_k
+
+            def spec_slab_fn(params, buffers, dparams, dbuffers,
+                             carry, tables, temps, nonces, cov, key,
+                             n_ticks):
+                dkey = jax.random.fold_in(key, _SPEC_DRAFT_SALT)
+
+                def tick(c, _):
+                    def live_round(c):
+                        active = c.budgets > 0
+                        cap = jnp.clip(
+                            jnp.where(active, cov - c.positions, 0),
+                            0, spec_K)
+                        cur = c.tokens
+                        dkp, dvp = c.draft_k_pages, c.draft_v_pages
+                        tok_cols = [cur]
+                        dlog_cols = []
+                        for j in range(spec_K):
+                            # the K-th probe exists for draft-cache
+                            # coverage only (writes d_{K-1}'s KV so a
+                            # fully-accepted round leaves no gap);
+                            # its proposal is discarded
+                            lens = jnp.where(active & (j < cap),
+                                             c.positions + j + 1, 0)
+                            ((nxt, dlg, dkp, dvp), _) = \
+                                functional_call(
+                                    dprobe, dparams, dbuffers, cur,
+                                    c.positions + j, tables, lens,
+                                    dkp, dvp, temps, nonces, dkey,
+                                    training=False)
+                            if j < spec_K - 1:
+                                tok_cols.append(nxt)
+                                dlog_cols.append(dlg)
+                            cur = nxt
+                        tokens_mat = jnp.stack(tok_cols, axis=1)
+                        base = jnp.where(active, c.positions, 0)
+                        ((vlg, kp, vp), _) = functional_call(
+                            verify, params, buffers, tokens_mat,
+                            base, tables, c.k_pages, c.v_pages,
+                            training=False)
+                        out, n_acc = _spec_accept(
+                            tokens_mat, jnp.stack(dlog_cols, axis=1),
+                            vlg, temps, nonces, c.positions, key)
+                        n_emit = jnp.minimum(
+                            n_acc + 1, jnp.minimum(c.budgets, cap))
+                        n_emit = jnp.where(active, n_emit, 0)
+                        idx = jnp.arange(spec_K)[None, :]
+                        is_eos = (idx < n_emit[:, None]) & \
+                            (out == eos_tok)
+                        any_eos = jnp.any(is_eos, axis=1)
+                        n_emit = jnp.where(
+                            any_eos, jnp.argmax(is_eos, axis=1) + 1,
+                            n_emit)
+                        last = jnp.take_along_axis(
+                            out, jnp.maximum(n_emit - 1, 0)[:, None],
+                            axis=1)[:, 0]
+                        budgets = jnp.where(active,
+                                            c.budgets - n_emit,
+                                            c.budgets)
+                        budgets = jnp.where(any_eos, 0, budgets)
+                        return DecodeCarry(
+                            tokens=jnp.where(n_emit > 0, last,
+                                             c.tokens),
+                            positions=c.positions + n_emit,
+                            budgets=budgets,
+                            k_pages=kp, v_pages=vp,
+                            draft_k_pages=dkp,
+                            draft_v_pages=dvp), (out, n_emit)
+
+                    def idle(c):
+                        b = c.tokens.shape[0]
+                        return c, (jnp.zeros((b, spec_K), jnp.int32),
+                                   jnp.zeros((b,), jnp.int32))
+
+                    return jax.lax.cond(jnp.any(c.budgets > 0),
+                                        live_round, idle, c)
+
+                carry, ys = jax.lax.scan(tick, carry, None,
+                                         length=n_ticks)
+                return ys, carry
+
+            self._spec_slab_fn = jax.jit(spec_slab_fn,
+                                         static_argnums=(10,),
+                                         donate_argnums=(4,))
+
         self._key = jax.random.PRNGKey(seed)
         self._mu = threading.Lock()
         self._pending: List[_Request] = []
@@ -1356,18 +1723,31 @@ class LLMEngine:
         # are denominated in. Registered ONCE here — the live
         # free/private/shared split is computed by the read, and the
         # DecodeCarry control-plane arrays are a static scratch row.
-        self._page_bytes = (kv_nbytes(self.k_pages) +
-                            kv_nbytes(self.v_pages))
-        if self.spec_k:
-            self._page_bytes += (self.draft_k_pages.nbytes +
-                                 self.draft_v_pages.nbytes)
-        self._page_bytes //= num_pages
+        self._tgt_page_bytes = (kv_nbytes(self.k_pages) +
+                                kv_nbytes(self.v_pages)) // num_pages
         # of which: bytes the int8 scale tables contribute per page
         # (0 for plain pools) — the ledger's distinct "scale_table"
         # row, so "KV pages addable" stays exact under quantization
-        self._page_scale_bytes = (kv_scale_nbytes(self.k_pages) +
-                                  kv_scale_nbytes(self.v_pages)) \
+        self._tgt_scale_bytes = (kv_scale_nbytes(self.k_pages) +
+                                 kv_scale_nbytes(self.v_pages)) \
             // num_pages
+        # speculative draft pool: SAME allocator, so its per-page
+        # bytes fold into the marginal cost of a page — but the
+        # ledger reports it under its own "draft_pool" owner (kv_
+        # nbytes handles the quantized pool's int8 pages + scales)
+        self._draft_page_bytes = 0
+        self._draft_scale_bytes = 0
+        if self.spec_k:
+            self._draft_page_bytes = (
+                kv_nbytes(self.draft_k_pages) +
+                kv_nbytes(self.draft_v_pages)) // num_pages
+            self._draft_scale_bytes = (
+                kv_scale_nbytes(self.draft_k_pages) +
+                kv_scale_nbytes(self.draft_v_pages)) // num_pages
+        self._page_bytes = self._tgt_page_bytes + \
+            self._draft_page_bytes
+        self._page_scale_bytes = self._tgt_scale_bytes + \
+            self._draft_scale_bytes
         self._mem_scope = _memobs.next_scope()
         _memobs.finalize_scope(self, self._mem_scope)
         if _memobs.enabled():
@@ -1460,19 +1840,24 @@ class LLMEngine:
             raise ValueError(
                 f"prompt {len(prompt_ids)} + max_new_tokens "
                 f"{max_new_tokens} exceeds engine max_len {self.max_len}")
-        if self.spec_k and len(prompt_ids) > self.prefill_buckets[-1]:
-            # only the speculative INLINE prefill is bucket-shaped; the
-            # chunked ragged path handles any length up to max_len
+        if self.spec_k and not self.spec_slab \
+                and len(prompt_ids) > self.prefill_buckets[-1]:
+            # only the LEGACY speculative INLINE prefill is bucket-
+            # shaped; the chunked ragged path (all other engines,
+            # slab-mode spec included) handles any length up to
+            # max_len
             raise ValueError(
                 f"prompt {len(prompt_ids)} exceeds the largest prefill "
                 f"bucket {self.prefill_buckets[-1]}; raise "
                 f"prefill_buckets")
         if not prompt_ids:
             raise ValueError("empty prompt")
-        if self.spec_k and temperature > 0.0:
+        if self.spec_k and not self.spec_slab and temperature > 0.0:
             raise ValueError(
-                "speculative decoding is greedy-only (v1); use "
-                "temperature=0 or an engine without draft_net")
+                "the LEGACY speculative path (spec_slab=False) is "
+                "greedy-only; slab engines (spec_slab=True, the "
+                "default) serve temperature>0 via on-device "
+                "rejection sampling")
         if nonce is not None and not 0 <= int(nonce) < 2 ** 31:
             raise ValueError(f"nonce {nonce} out of int32 range")
         req = _Request(prompt_ids, max_new_tokens, temperature)
@@ -1877,6 +2262,8 @@ class LLMEngine:
         n = 1
         if kind == "M":
             pkey = ("mixed_tick", host_shape0)
+        elif kind == "S":
+            pkey = ("spec_round", host_shape0)
         elif kind == "D":
             pkey = ("decode_loop", host_shape0)
         elif kind == "d":
@@ -1929,7 +2316,7 @@ class LLMEngine:
         for a fused-slab record."""
         n = 0
         for _, slots_list, _, kind, meta in self._inflight:
-            if kind in ("D", "M"):
+            if kind in ("D", "M", "S"):
                 n += meta["budgets"].get(slot, 0)
             elif slot in slots_list:
                 n += 1
@@ -1949,7 +2336,7 @@ class LLMEngine:
         in ``_drain_one`` like any decode token."""
         if self._health == "draining":
             return "shed"
-        if self.spec_k:
+        if self.spec_k and not self.spec_slab:
             return self._admit_inline(req)
         n = len(req.prompt)
         need_total = -(-n // self.page_size)
@@ -2185,6 +2572,23 @@ class LLMEngine:
             self._perf_chunks_unattributed += 1
         nxt, self.k_pages, self.v_pages = self._chunk_fn(*chunk_args)
         self._count_dispatch()
+        if self.spec_k and self.spec_slab:
+            # draft ride-along: the SAME packed chunk schedule runs
+            # through the draft net so the draft pool holds valid KV
+            # for every prompt position a later verify window attends
+            # to. Prefill + quantize-on-write are deterministic, so
+            # shared prefix pages carry identical draft KV across the
+            # requests that hit them — temperature>0 realized streams
+            # stay cache-on/off identical (greedy needs none of this:
+            # prefix acceptance reproduces the target chain exactly).
+            self.draft_k_pages, self.draft_v_pages = \
+                self._draft_chunk_fn(
+                    self._draft_params, self._draft_buffers,
+                    chunk_args[2], chunk_args[3], chunk_args[4],
+                    chunk_args[5], chunk_args[6], chunk_args[7],
+                    self.draft_k_pages, self.draft_v_pages,
+                    chunk_args[10], chunk_args[11], self._key)[1:]
+            self._count_dispatch()
         if finishing:
             mask = np.zeros((self.max_seqs,), bool)
             for req in finishing:
@@ -2245,14 +2649,21 @@ class LLMEngine:
                 self._m["queue_depth"].set(self._n_queued)
                 busy = False
                 mixed = self.mixed_tick and bool(self._prefill_q) \
-                    and not self.spec_k
+                    and (not self.spec_k or self.spec_slab)
                 if mixed:
                     # ONE fused mixed slab: the prefill queue's chunk
                     # rows AND the live slots' decode ticks ride one
                     # ragged dispatch — a prompt completing at tick j
                     # starts decoding at tick j+1 on device, with
                     # zero host dispatches between the phases
-                    self._issue_mixed(self._live_slots())
+                    # spec-slab engines ride the mixed dispatch for
+                    # prompt completion only (live=[]): their decode
+                    # advances through _issue_spec_slab, whose rounds
+                    # keep the draft pool position-complete (a mixed
+                    # decode tick would write target-only KV and leave
+                    # draft gaps behind the verify window)
+                    self._issue_mixed(
+                        [] if self.spec_k else self._live_slots())
                     busy = True
                 elif self._prefill_q:
                     # LEGACY two-op tick (mixed_tick off — kept as
@@ -2264,8 +2675,14 @@ class LLMEngine:
                     self._prefill_tick()
                     busy = True
                 self._m["prefill_queue"].set(len(self._prefill_q))
-                live = [] if mixed else self._live_slots()
-                if live and self.spec_k:
+                live = self._live_slots() if self.spec_k or not mixed \
+                    else []
+                if live and self.spec_k and self.spec_slab:
+                    # on-device rounds: draft-K + verify + accept all
+                    # inside ONE scan slab dispatch of N rounds
+                    self._issue_spec_slab(live)
+                    busy = True
+                elif live and self.spec_k:
                     self._spec_round(live)
                     busy = True
                 elif live and self.decode_ticks_per_dispatch > 1:
@@ -2781,7 +3198,12 @@ class LLMEngine:
                     # (positions n .. n+g-2 hold the fed tokens; a
                     # clamped grant is NOT a truncation — the next
                     # slab entry re-plans exactly like N=1 would)
-                    g_want = min(req.max_new_tokens, n_eff - j)
+                    # spec-slab engines take the first token ONLY: the
+                    # remaining grant would be target-only decode ticks
+                    # with no draft-KV coverage behind the next verify
+                    # window — their decode belongs to _issue_spec_slab
+                    g_want = 1 if self.spec_k \
+                        else min(req.max_new_tokens, n_eff - j)
                     g = 1
                     for tt in range(1, g_want):
                         pos = n + tt - 1
@@ -2863,6 +3285,25 @@ class LLMEngine:
         self._count_dispatch()
         self._tokens_dev = carry.tokens
         self.k_pages, self.v_pages = carry.k_pages, carry.v_pages
+        if self.spec_k and self.spec_slab:
+            # draft ride-along over the slab's WHOLE packed chunk
+            # schedule, flattened to one ragged chunk (padding rows
+            # carry zero tables → scratch page 0): same coverage
+            # argument as _prefill_tick's ride-along
+            zeros = jnp.zeros((self.max_seqs,), jnp.int32)
+            self.draft_k_pages, self.draft_v_pages = \
+                self._draft_chunk_fn(
+                    self._draft_params, self._draft_buffers,
+                    jnp.asarray(ptok[:n_run].reshape(-1)),
+                    jnp.asarray(ppos[:n_run].reshape(-1)),
+                    jnp.asarray(plim[:n_run].reshape(-1)),
+                    jnp.asarray(ptbl[:n_run].reshape(
+                        -1, self.pages_per_seq)),
+                    zeros, zeros,
+                    self.draft_k_pages, self.draft_v_pages,
+                    jnp.asarray(self.temperatures),
+                    jnp.asarray(self._nonces), self._key)[1:]
+            self._count_dispatch()
         self._issue_seq += 1
         slots_list = sorted(meta_bud)
         self._inflight.append(
@@ -2889,6 +3330,111 @@ class LLMEngine:
             self._m["mixed_prefill_tokens"].inc(n_prefill_tokens)
         self.tick_history.append("m")
         self._m["occupancy"].observe(len(slots_list) / self.max_seqs)
+        self._update_kv_gauge()
+
+    def _issue_spec_slab(self, live: List[int]):
+        """Dispatch up to ``decode_ticks_per_dispatch`` speculative
+        draft-K/verify-1 ROUNDS for the live slots as ONE fused-scan
+        program (``_spec_slab_fn``): one dispatch advances each slot
+        by up to (K-1)+1 committed tokens PER ROUND with zero host
+        round-trips inside the slab — vs the legacy path's K+1
+        dispatches per single round.
+
+        Host work at slab entry mirrors :meth:`_issue_slab`: per-slot
+        emission budgets (length completion provable here) and
+        KV-page pre-reservation for every position the slab could
+        commit (up to N*K tokens). ``cov[slot]`` carries the covered
+        position frontier to the device, which clamps each round's
+        acceptance by ``cap = cov - position`` — the legacy round's
+        cache-capacity rule, computed once at entry instead of per
+        round. The invariant ``budget <= covered`` keeps ``cap >= 1``
+        for every active slot, so no slab shrink is needed and the
+        program length stays N for a stable compile signature.
+        Over-reserved pages (low acceptance) stay with their slots
+        for the next slab — used or freed at close, never leaked.
+
+        Drains all in-flight records FIRST (like the legacy round):
+        a mixed/prefill record's async first token must land before
+        budgets are computed, and a mixed-finishing slot's
+        ``context_lens`` is only advanced by its drain."""
+        while self._inflight:
+            self._drain_one()
+        live = [s for s in live if self._slots[s] is not None
+                and not self._slots[s].closing]
+        if not live:
+            self._maybe_finalize()
+            return
+        N = self.decode_ticks_per_dispatch
+        K = self.spec_k
+        budgets: Dict[int, int] = {}
+        pos0s: Dict[int, int] = {}
+        cov = np.zeros((self.max_seqs,), np.int32)
+        for slot in list(live):
+            req = self._slots[slot]
+            want = req.max_new_tokens - len(req.tokens)
+            if want <= 0:
+                self._begin_close(slot, accept_inflight=True)
+                live.remove(slot)
+                continue
+            pos0 = int(self.context_lens[slot])
+            covered = 0
+            for j in range(min(N * K, want)):
+                pos = pos0 + j
+                if pos >= self.max_len or \
+                        not self._ensure_page(slot, pos):
+                    break
+                covered += 1
+            if covered == 0:
+                # the NEXT token can't be cached — the same condition
+                # plain decode truncates on
+                req.truncated = len(req.tokens) < req.max_new_tokens
+                self._begin_close(slot)
+                live.remove(slot)
+                continue
+            budgets[slot] = min(want, covered)
+            pos0s[slot] = pos0
+            cov[slot] = pos0 + covered
+        if not live:
+            self._maybe_finalize()
+            return
+        if _faults.enabled():
+            _faults.check("device.dispatch")
+            _faults.check("engine.slab")
+        self._guard_recompiles("spec_round", (N, K))
+        pos_arr = np.zeros((self.max_seqs,), np.int32)
+        bud_arr = np.zeros((self.max_seqs,), np.int32)
+        for slot in live:
+            pos_arr[slot] = pos0s[slot]
+            bud_arr[slot] = budgets[slot]
+        carry = DecodeCarry(
+            tokens=self._tokens_dev, positions=jnp.asarray(pos_arr),
+            budgets=jnp.asarray(bud_arr), k_pages=self.k_pages,
+            v_pages=self.v_pages,
+            draft_k_pages=self.draft_k_pages,
+            draft_v_pages=self.draft_v_pages)
+        args = (self._params, self._buffers, self._draft_params,
+                self._draft_buffers, carry,
+                jnp.asarray(self.block_tables),
+                jnp.asarray(self.temperatures),
+                jnp.asarray(self._nonces), jnp.asarray(cov),
+                self._key, N)
+        if _perf.enabled():
+            self._perf_program("spec_round", (N,),
+                               self._spec_slab_fn, args, steps=N)
+        ys, carry = self._spec_slab_fn(*args)
+        self._count_dispatch()
+        self._tokens_dev = carry.tokens
+        self.k_pages, self.v_pages = carry.k_pages, carry.v_pages
+        self.draft_k_pages = carry.draft_k_pages
+        self.draft_v_pages = carry.draft_v_pages
+        self._issue_seq += 1
+        # ys = (tokens [N, B, K], n_emit [N, B]); context_lens
+        # advances at the DRAIN from the realized emission counts
+        self._inflight.append(
+            (self._issue_seq, list(live), ys, "S",
+             {"budgets": budgets, "pos0": pos0s}))
+        self.tick_history.append("S")
+        self._m["occupancy"].observe(len(live) / self.max_seqs)
         self._update_kv_gauge()
 
     def _deliver_token(self, slot: int, req: _Request, tok: int,
@@ -2934,14 +3480,23 @@ class LLMEngine:
         if _faults.enabled():
             _faults.check("device.transfer")
         seq, slots_list, tokens, kind, meta = self._inflight.popleft()
-        host = np.asarray(tokens)          # the only blocking fetch
+        if kind == "S":
+            # spec-slab record: (committed tokens [N, B, K], realized
+            # per-round emission counts [N, B])
+            host = np.asarray(tokens[0])   # the only blocking fetch
+            host_acc = np.asarray(tokens[1])
+        else:
+            host = np.asarray(tokens)      # the only blocking fetch
         self._fetch_seq = seq
         if self._consec_device_errors:
             # a successful fetch ends the error streak (draining is
             # sticky until reset_health — see _update_health)
             self._consec_device_errors = 0
             self._update_health()
-        if kind in ("D", "M"):
+        if kind == "S":
+            emitted = self._drain_spec_slab(seq, slots_list, host,
+                                            host_acc, meta)
+        elif kind in ("D", "M"):
             emitted = self._drain_slab(seq, slots_list, host, meta)
         else:
             if kind == "d":
@@ -2959,7 +3514,8 @@ class LLMEngine:
                 emitted += 1
         if _perf.enabled() or _goodput.enabled():
             self._perf_attribute(kind, host.shape[0]
-                                 if kind in ("D", "M") else 0, emitted)
+                                 if kind in ("D", "M", "S") else 0,
+                                 emitted)
         self._observe_step(emitted, timed=(kind != "p"))
         self._maybe_finalize()
 
@@ -3018,6 +3574,86 @@ class LLMEngine:
         self.n_decode_ticks += ticks
         self._m["decode_ticks"].inc(ticks)
         self._m["slab_ticks"].observe(ticks)
+        return emitted
+
+    def _drain_spec_slab(self, seq: int, slots_list: List[int],
+                         host_t, host_a, meta: dict) -> int:
+        """Drain one spec-slab record: replay the device's per-round
+        emission decisions from the realized count stack ``host_a``
+        ([n_rounds, max_seqs] — how many of row j's K token lanes in
+        ``host_t`` each slot committed) clamped by the host copy of
+        the entry budgets, exactly the :meth:`_drain_slab` discipline
+        with a K-wide token lane per round. Tokens past a slot's EOS
+        or a cancelled request's close are masked no-ops and never
+        surfaced. Accounts the round/proposal/acceptance counters the
+        legacy host round keeps per dispatch."""
+        remaining = dict(meta["budgets"])
+        pos0 = meta["pos0"]
+        K = self.spec_k
+        emitted_per = {s: 0 for s in slots_list}
+        emitted = 0
+        rounds = 0
+        proposed = 0
+        accepted = 0
+        for j in range(host_t.shape[0]):
+            row_live = False
+            for slot in slots_list:
+                if remaining.get(slot, 0) <= 0:
+                    continue
+                req = self._slots[slot]
+                if req is None or (req.closing and
+                                   (not req.accepts_inflight or
+                                    len(req.tokens) >=
+                                    req.max_new_tokens)):
+                    remaining[slot] = 0
+                    continue
+                e = min(int(host_a[j, slot]), remaining[slot])
+                if e <= 0:
+                    continue
+                row_live = True
+                # the round proposed K-1 draft tokens; e-1 of the
+                # committed run came from the drafts (the last is
+                # always the target's own bonus/correction sample)
+                proposed += K - 1
+                accepted += e - 1
+                for t in range(e):
+                    tok = int(host_t[j, slot, t])
+                    remaining[slot] -= 1
+                    if self.eos_token_id is not None and \
+                            tok == self.eos_token_id:
+                        remaining[slot] = 0  # the device zeroed it too
+                    self._deliver_token(slot, req, tok, seq)
+                    emitted_per[slot] += 1
+                    emitted += 1
+                    if remaining[slot] <= 0:
+                        break
+                    if req.closing and not req.accepts_inflight:
+                        remaining[slot] = 0
+                        break
+            if row_live:
+                rounds += 1
+        for slot in slots_list:
+            if self._slots[slot] is None:
+                continue
+            self.context_lens[slot] = pos0[slot] + emitted_per[slot]
+            sp = self._slots[slot].spans
+            if sp is not None and "decode" in sp:
+                sp["decode"].add_event(
+                    "slab", {"issue_seq": seq, "rounds": rounds,
+                             "tokens": emitted_per[slot]})
+        self.n_steps += rounds
+        self.n_spec_rounds += rounds
+        self.n_draft_steps += rounds * K
+        self.n_spec_proposed += proposed
+        self.n_spec_accepted += accepted
+        if rounds:
+            self._m["spec_rounds"].inc(rounds)
+        if proposed:
+            self._m["spec_draft_tokens"].inc(proposed)
+        if self.n_spec_proposed:
+            self._m["spec_accept_rate"].set(
+                self.n_spec_accepted / self.n_spec_proposed)
+        self._m["slab_ticks"].observe(rounds)
         return emitted
 
     def _observe_step(self, emitted: int, timed: bool = True):
@@ -3114,6 +3750,7 @@ class LLMEngine:
         self._count_dispatch()
         self.n_steps += 1
         self.n_spec_rounds += 1
+        self._m["spec_rounds"].inc()
         self._m["occupancy"].observe(len(live) / self.max_seqs)
         self._update_kv_gauge()
         host_g = np.asarray(greedy)                         # the round sync
@@ -3128,6 +3765,8 @@ class LLMEngine:
             i = 0
             while i < min(K - 1, caps[slot] - 1) and d[i + 1] == g[i]:
                 i += 1
+            self.n_spec_proposed += K - 1
+            self.n_spec_accepted += i
             req = self._slots[slot]
             for tok in list(d[1:i + 1]) + [int(g[i])]:
                 req.tokens.append(int(tok))
@@ -3142,6 +3781,10 @@ class LLMEngine:
             if self._harvest(slot):
                 self._begin_close(slot)
         self._tokens_dev = jnp.asarray(new_last)
+        self._m["spec_draft_tokens"].inc(len(live) * (K - 1))
+        if self.n_spec_proposed:
+            self._m["spec_accept_rate"].set(
+                self.n_spec_accepted / self.n_spec_proposed)
         self._observe_step(emitted)
         self._maybe_finalize()
 

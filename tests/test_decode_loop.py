@@ -188,9 +188,12 @@ def test_fused_ticks_interleave_with_chunked_prefill():
     long = rng.randint(0, 97, 40).tolist()
 
     def interleaved(n):
+        # mixed_tick off: this test witnesses the two-op interleave
+        # ('p' chunks bracketed by 'D' slabs); the ragged mixed tick
+        # has its own gate in test_mixed_ragged.py
         eng = LLMEngine(net, max_seqs=2, page_size=4, num_pages=128,
                         prefill_buckets=(64,), prefill_chunk=8,
-                        decode_ticks_per_dispatch=n)
+                        decode_ticks_per_dispatch=n, mixed_tick=False)
         with eng:
             f1 = eng.submit(short, max_new_tokens=24)
             while not eng.n_decode_ticks:   # f1 decoding

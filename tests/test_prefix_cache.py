@@ -223,8 +223,11 @@ def test_long_prompt_interleaves_with_decode():
     rng = np.random.RandomState(4)
     short = rng.randint(0, 97, 4).tolist()
     long_p = rng.randint(0, 97, 40).tolist()
+    # mixed_tick off: this pin witnesses the TWO-OP interleave
+    # (p..d..p); the fused ragged tick is gated in test_mixed_ragged
     with LLMEngine(net, max_seqs=2, page_size=4, num_pages=128,
-                   prefill_buckets=(64,), prefill_chunk=4) as eng:
+                   prefill_buckets=(64,), prefill_chunk=4,
+                   mixed_tick=False) as eng:
         fa = eng.submit(short, max_new_tokens=40)
         time.sleep(0.3)      # let the short request enter decode
         fb = eng.submit(long_p, max_new_tokens=4)   # 10 prefill chunks
@@ -265,8 +268,10 @@ def test_prefill_queue_and_inflight_survive_device_error():
     cleanly (future resolves, pages reclaimed, cache flushed) and the
     engine keeps serving."""
     net = tiny_gpt()
+    # mixed_tick off so the chunk lands on _chunk_fn (the patched
+    # site) rather than riding a mixed slab
     eng = LLMEngine(net, max_seqs=2, page_size=4, num_pages=64,
-                    prefill_buckets=(16,))
+                    prefill_buckets=(16,), mixed_tick=False)
     real = eng._chunk_fn
     calls = {"n": 0}
 

@@ -572,9 +572,12 @@ def test_spec_engine_inline_prefill_error_reclaims_pages_and_budgets():
                       max_position_embeddings=64, hidden_dropout=0.0,
                       attention_dropout=0.0)
     draft = GPTForCausalLM(dcfg)
+    # spec_slab=False: only the LEGACY inline path still one-shots
+    # prefill inside the round (slab engines chunk like everyone)
     eng = LLMEngine(net, max_seqs=2, page_size=4, num_pages=32,
                     prefill_buckets=(16,), draft_net=draft,
-                    spec_tokens=2, device_retry_budget=1)
+                    spec_tokens=2, device_retry_budget=1,
+                    spec_slab=False)
     try:
         real = eng._prefill_fn
         state = {"n": 0}
@@ -605,9 +608,11 @@ def test_spec_engine_inline_prefill_error_reclaims_pages_and_budgets():
 def test_engine_health_walks_to_draining_and_sheds():
     from paddle_tpu.inference.llm import AdmissionShed, LLMEngine
     net = tiny_gpt()
+    # mixed_tick off so prefill definitely routes through _chunk_fn
+    # (the patched site); the mixed path has its own chaos coverage
     eng = LLMEngine(net, max_seqs=2, page_size=4, num_pages=64,
                     prefill_buckets=(16,), degraded_after=1,
-                    drain_after=2)
+                    drain_after=2, mixed_tick=False)
     try:
         real = eng._chunk_fn
 

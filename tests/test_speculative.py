@@ -86,11 +86,12 @@ def test_verify_pass_equals_sequential_decode(gqa):
     assert t02 == t0
     verify = _PagedVerify(net)
     vparams, vbuffers = split_state(verify)
-    (greedy, kp2, vp2), _ = functional_call(
+    (logits, kp2, vp2), _ = functional_call(
         verify, vparams, vbuffers,
         jnp.asarray([toks[:K]], jnp.int32),
         jnp.asarray([ctx2], jnp.int32), tables2, kp2, vp2,
         training=False)
+    greedy = jnp.argmax(logits, axis=-1)
     # target greedy after each prefix == the sequential outputs
     assert np.asarray(greedy)[0].tolist() == toks[1:K + 1]
     # page contents identical everywhere the sequential run wrote
@@ -121,11 +122,12 @@ def test_verify_rejection_prefix_semantics():
     kp2, vp2, tables2, ctx2, _ = _seed_pages(net, prompt)
     verify = _PagedVerify(net)
     vparams, vbuffers = split_state(verify)
-    (greedy, _, _), _ = functional_call(
+    (logits, _, _), _ = functional_call(
         verify, vparams, vbuffers,
         jnp.asarray([[t0, wrong, wrong]], jnp.int32),
         jnp.asarray([ctx2], jnp.int32), tables2, kp2, vp2,
         training=False)
+    greedy = jnp.argmax(logits, axis=-1)
     # g_0 (after t0) must equal the true next token even though the
     # LATER positions in the chunk carried garbage drafts
     assert int(np.asarray(greedy)[0, 0]) == int(g1[0])
@@ -185,14 +187,28 @@ def test_speculative_engine_exact_with_imperfect_draft():
 def test_speculative_engine_eos_and_guards():
     from paddle_tpu.inference.llm import LLMEngine
     net = _build(False)
+    # the LEGACY inline path (spec_slab=False) keeps its guards:
+    # greedy-only sampling and the bucketized prefill bound
+    with LLMEngine(net, max_seqs=1, page_size=4, num_pages=64,
+                   prefill_buckets=(8,), draft_net=net,
+                   spec_tokens=3, eos_token_id=7,
+                   spec_slab=False) as eng:
+        with pytest.raises(ValueError, match="greedy-only"):
+            eng.submit([1, 2], max_new_tokens=4, temperature=0.9)
+        with pytest.raises(ValueError, match="prefill bucket"):
+            eng.submit(list(range(20)), max_new_tokens=2)
+        out = eng.generate([[3, 1, 4]], max_new_tokens=40)[0]
+        if 7 in out["output_ids"]:
+            assert out["output_ids"][-1] == 7
+        assert len(out["output_ids"]) <= 40
+    # the slab path (the default) lifts BOTH guards: chunked ragged
+    # prefill takes any length, rejection sampling serves temp>0
     with LLMEngine(net, max_seqs=1, page_size=4, num_pages=64,
                    prefill_buckets=(8,), draft_net=net,
                    spec_tokens=3, eos_token_id=7) as eng:
-        with pytest.raises(ValueError, match="greedy-only"):
-            eng.submit([1, 2], max_new_tokens=4, temperature=0.9)
-        # the inline (bucketized) prefill path keeps the bucket bound
-        with pytest.raises(ValueError, match="prefill bucket"):
-            eng.submit(list(range(20)), max_new_tokens=2)
+        out = eng.generate([list(range(20))], max_new_tokens=4,
+                           temperature=0.9)[0]
+        assert len(out["output_ids"]) <= 4
         out = eng.generate([[3, 1, 4]], max_new_tokens=40)[0]
         if 7 in out["output_ids"]:
             assert out["output_ids"][-1] == 7

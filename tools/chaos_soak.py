@@ -424,6 +424,133 @@ def slab_soak(seed: int, mixed: bool = False,
             "mixed_tick": mixed, "kv_dtype": kv_dtype or "f32"}
 
 
+def spec_slab_soak(seed: int) -> dict:
+    """ISSUE 17 rider (rides --slab): the SAME kill/cancel/deadline
+    storm with a DRAFT ENGINE running on-device speculative rounds
+    (``spec_slab``, prefix cache + int8 quantized draft pool + fused
+    N=8 slabs all on). Asserts: every future resolves under an
+    ``engine.slab`` storm at the spec dispatch within
+    ``device_retry_budget``; retried streams — greedy AND
+    temperature>0 — are TOKEN-IDENTICAL to a fault-free spec
+    reference (keys fold (nonce, position) only, so a re-admitted
+    slot replays its rejection-sampling decisions exactly);
+    rejected-draft pages cannot leak through a cancellation storm
+    (the draft pool shares the target's block tables — one audit
+    covers both); the injected sequence equals the pure seeded
+    schedule."""
+    import paddle_tpu as pt
+    from paddle_tpu.inference.llm import LLMEngine, RequestCancelled
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt_config
+    from paddle_tpu.observability import tracing
+    from paddle_tpu.reliability import faults
+    from paddle_tpu.reliability.retry import DeadlineExceeded
+
+    rng = np.random.RandomState(seed)
+    prompts = [rng.randint(0, 97, int(rng.randint(3, 12))).tolist()
+               for _ in range(6)]
+    gens = [int(rng.randint(8, 20)) for _ in range(6)]
+    temps = [0.0, 0.0, 0.8, 0.0, 0.8, 0.0]
+    net = _tiny_gpt()
+    pt.seed(321)
+    dcfg = gpt_config("gpt2-small", num_layers=1, hidden_size=32,
+                      num_heads=2, vocab_size=97,
+                      max_position_embeddings=96, hidden_dropout=0.0,
+                      attention_dropout=0.0)
+    draft = GPTForCausalLM(dcfg)
+
+    def build(**kw):
+        return LLMEngine(net, max_seqs=4, page_size=4, num_pages=96,
+                         prefill_buckets=(16,), drain_after=64,
+                         decode_ticks_per_dispatch=8,
+                         draft_net=draft, spec_tokens=3,
+                         kv_dtype="int8", **kw)
+
+    # fault-free spec reference: same engine seed, same submission
+    # order => same nonces => the chaos run must reproduce these
+    # exactly even when its spec slabs die and re-admit
+    with build() as ref_eng:
+        ref = [f.result(timeout=FUTURE_TIMEOUT) for f in
+               [ref_eng.submit(p, max_new_tokens=g, temperature=t)
+                for p, g, t in zip(prompts, gens, temps)]]
+        assert ref_eng.n_spec_rounds > 0, \
+            "spec reference never ran a speculative round"
+
+    tracing.enable()
+    faults.reset()
+    faults.enable(seed=seed)
+    faults.inject("engine.slab", nth=(2, 5))
+    faults.inject("engine.slab", p=0.02, times=1)
+    faults.inject("device.transfer", nth=(7,))
+    eng = build(device_retry_budget=4, admit_timeout=60.0)
+    try:
+        futs = [eng.submit(p, max_new_tokens=g, temperature=t)
+                for p, g, t in zip(prompts, gens, temps)]
+        done, not_done = fut_wait(futs, timeout=FUTURE_TIMEOUT)
+        assert not not_done, (
+            f"{len(not_done)} futures never resolved — the spec "
+            f"engine hung under injected slab faults")
+        for f, r in zip(futs, ref):
+            assert f.exception() is None, (
+                f"request lost to budgeted spec-slab chaos: "
+                f"{f.exception()}")
+            assert f.result()["output_ids"] == r["output_ids"], (
+                "retried spec stream diverged from the fault-free "
+                "reference (nonce-pinned token identity broken)")
+        n_injected = len(faults.injected_log())
+        assert n_injected >= 2, (
+            f"schedule armed but only {n_injected} faults injected — "
+            f"the soak did not exercise the spec-slab failure path")
+        _assert_schedule_matches(
+            faults, ("engine.slab", "device.transfer"))
+
+        # hopeless deadlines resolve typed (at a slab boundary)
+        dl = [eng.submit(rng.randint(0, 97, 5).tolist(),
+                         max_new_tokens=8, deadline=-1.0)
+              for _ in range(3)]
+        done, not_done = fut_wait(dl, timeout=FUTURE_TIMEOUT)
+        assert not not_done, "deadline futures pending under spec slabs"
+        assert all(isinstance(f.exception(), DeadlineExceeded)
+                   for f in dl), [f.exception() for f in dl]
+
+        # cancellation storm, faults off: cancels land mid-slab with
+        # rejected draft KV in flight — pages must all come back
+        faults.disable()
+        eng.reset_health()
+        storm = [eng.submit(rng.randint(0, 97, 6).tolist(),
+                            max_new_tokens=80) for _ in range(8)]
+        for f in storm[::2]:
+            eng.cancel(f.request_id)
+        time.sleep(0.2)
+        for f in storm[1::2]:
+            eng.cancel(f.request_id)
+        done, not_done = fut_wait(storm, timeout=FUTURE_TIMEOUT)
+        assert not not_done, (
+            "cancellation storm left futures pending under spec "
+            "slabs")
+        n_cancelled = 0
+        for f in storm:
+            exc = f.exception()
+            assert exc is None or isinstance(exc, RequestCancelled), \
+                exc
+            n_cancelled += exc is not None
+        assert n_cancelled >= 1, "storm cancelled nothing"
+    finally:
+        eng.close()
+        faults.reset()
+    assert len(eng._free_pages) == eng.num_pages - 1, (
+        f"KV pages leaked through rejected-draft rounds: "
+        f"{len(eng._free_pages)} free of {eng.num_pages - 1} usable")
+    open_llm = [s for s in tracing.live_spans()
+                if s["name"].startswith("llm.")]
+    tracing.disable()
+    assert not open_llm, f"span trees left open: {open_llm}"
+    return {"injected": n_injected, "cancelled": n_cancelled,
+            "requests": len(futs) + len(dl) + len(storm),
+            "spec_rounds": eng.n_spec_rounds,
+            "accept_rate": round(eng.n_spec_accepted /
+                                 max(1, eng.n_spec_proposed), 3)}
+
+
 def page_pressure_soak(seed: int, kv_dtype=None) -> dict:
     """ISSUE 14 phase (rides --slab): a PAGE-PRESSURE STORM against a
     deliberately tiny KV pool, polling the memory ledger's headroom
@@ -1975,6 +2102,11 @@ def main(argv=None) -> int:
             # >=1.8x usable pages, scale_table row, headroom re-pin
             out["page_pressure_int8"] = page_pressure_soak(
                 seed, kv_dtype="int8")
+            # ISSUE 17: the storm again with on-device speculative
+            # rounds (spec_slab + int8 draft pool + cache + N=8) —
+            # nonce-pinned identity incl. temperature>0 rejection
+            # sampling, rejected-draft pages leak-free
+            out["slab_spec"] = spec_slab_soak(seed)
         else:
             out["engine"] = engine_soak(seed)
             out["ckpt"] = ckpt_crash(seed, workdir)

@@ -822,6 +822,184 @@ def mixed_tick_main(args, net=None, assert_ci=False):
     return 0
 
 
+def build_draft_net(vocab=211, hidden=32, heads=2, max_pos=512,
+                    seed=123):
+    import paddle_tpu as pt
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt_config
+
+    pt.seed(seed)
+    cfg = gpt_config("gpt2-small", num_layers=1, hidden_size=hidden,
+                     num_heads=heads, vocab_size=vocab,
+                     max_position_embeddings=max_pos,
+                     hidden_dropout=0.0, attention_dropout=0.0)
+    return GPTForCausalLM(cfg)
+
+
+def run_spec(net, draft, prompts, gen_len, spec_tokens,
+             spec_slab=True, kv_dtype=None, prefix_cache=True,
+             decode_ticks=8, page_size=4, temperature=0.0):
+    """One speculative engine pass (slab or legacy) over the
+    workload: the first request warms the compile caches off the
+    clock, the rest arrive as a concurrent burst. Returns
+    (outputs, stats) with the tentpole quantities: acceptance rate,
+    accepted tokens per host dispatch, and host dispatches per
+    emitted token."""
+    from paddle_tpu.inference.llm import LLMEngine
+
+    total = max(len(p) for p in prompts) + gen_len + spec_tokens
+    pages = -(-total // page_size) * max(4, len(prompts)) + 16
+    eng = LLMEngine(net, max_seqs=4, page_size=page_size,
+                    num_pages=pages, max_len=total,
+                    prefill_buckets=(max(len(p) for p in prompts),),
+                    draft_net=draft, spec_tokens=spec_tokens,
+                    spec_slab=spec_slab, kv_dtype=kv_dtype,
+                    prefix_cache=prefix_cache,
+                    decode_ticks_per_dispatch=(
+                        1 if not spec_slab else decode_ticks))
+    with eng:
+        outs = [eng.generate([prompts[0]], max_new_tokens=gen_len,
+                             temperature=temperature)[0]]
+        d0, t0 = eng.n_host_dispatches, time.perf_counter()
+        futs = [eng.submit(p, max_new_tokens=gen_len,
+                           temperature=temperature)
+                for p in prompts[1:]]
+        outs += [f.result(timeout=600) for f in futs]
+        wall = time.perf_counter() - t0
+        dispatches = eng.n_host_dispatches - d0
+        rounds = eng.n_spec_rounds
+        proposed = eng.n_spec_proposed
+        accepted = eng.n_spec_accepted
+    tokens = sum(len(o["output_ids"]) for o in outs[1:])
+    return outs, {
+        "spec_tokens": spec_tokens,
+        "mode": "slab" if spec_slab else "legacy",
+        "kv_dtype": kv_dtype or "f32",
+        "prefix_cache": prefix_cache,
+        "tokens": tokens,
+        "tokens_per_sec": round(tokens / wall, 1),
+        "rounds": rounds,
+        "accept_rate": round(accepted / max(1, proposed), 4),
+        "accepted_tokens_per_dispatch": round(
+            tokens / max(1, dispatches), 3),
+        "host_dispatches_per_token": round(
+            dispatches / max(1, tokens), 4),
+    }
+
+
+def spec_main(args, net=None, assert_ci=False):
+    """The --spec sweep (tentpole gate): on-device speculative slab
+    over draft K in {2,4,8} x kv_dtype {f32,int8} x prefix cache
+    on/off, one bench_ledger/v1 row per combination (K, kv_dtype and
+    cache state join the series key so K=2 never regression-gates
+    against K=8). The --ci gate asserts >=2x fewer host dispatches
+    per emitted token than the LEGACY inline spec path at K=4, and
+    greedy token-identity against a target-only engine."""
+    from paddle_tpu.inference.llm import LLMEngine
+
+    Ks = (2, 4) if args.ci else (2, 4, 8)
+    if net is None:
+        net = build_net(vocab=97, hidden=64, max_pos=256) if args.ci \
+            else build_net()
+    vocab = net.cfg.vocab_size
+    draft = build_draft_net(vocab=vocab,
+                            max_pos=net.cfg.max_position_embeddings)
+    prompts = make_prompts(4, prefix_len=16, tail_len=8, vocab=vocab) \
+        if args.ci else make_prompts(args.n_requests, args.prefix_len,
+                                     args.tail_len, vocab=vocab)
+    gen_len = 12 if args.ci else args.gen_len
+
+    # greedy token-identity references, one per pool dtype (int8
+    # quantization moves logits, so it gets an int8 reference)
+    refs = {}
+    for kv in (None, "int8"):
+        total = max(len(p) for p in prompts) + gen_len + 8
+        pages = -(-total // 4) * max(4, len(prompts)) + 16
+        with LLMEngine(net, max_seqs=4, page_size=4, num_pages=pages,
+                       max_len=total,
+                       prefill_buckets=(max(len(p)
+                                            for p in prompts),),
+                       kv_dtype=kv) as ref:
+            refs[kv or "f32"] = [
+                o["output_ids"]
+                for o in ref.generate(prompts,
+                                      max_new_tokens=gen_len)]
+
+    sweep = []
+    mismatches = []
+    for K in Ks:
+        for kv in (None, "int8"):
+            for cache in (True, False):
+                outs, stats = run_spec(net, draft, prompts, gen_len,
+                                       K, kv_dtype=kv,
+                                       prefix_cache=cache)
+                got = [o["output_ids"] for o in outs]
+                ok = got == refs[kv or "f32"]
+                if not ok:
+                    mismatches.append((K, kv, cache))
+                stats["token_identity"] = ok
+                sweep.append(stats)
+                series = (f"llm_spec_accepted_per_dispatch_k{K}_"
+                          f"{'cache' if cache else 'nocache'}")
+                _ledger.append(
+                    "llm_bench", series,
+                    stats["accepted_tokens_per_dispatch"],
+                    "accepted_tokens_per_host_dispatch",
+                    tokens_per_sec=stats["tokens_per_sec"],
+                    dispatches=stats["host_dispatches_per_token"],
+                    peak_mem_bytes=_peak_mem_bytes(),
+                    kv_dtype=kv,
+                    **_goodput_row_fields(),
+                    extra={"spec_tokens": K,
+                           "accept_rate": stats["accept_rate"],
+                           "prefix_cache": cache,
+                           "gen_len": gen_len})
+
+    # the legacy inline path at K=4 — the dispatch baseline the
+    # tentpole's >=2x claim is measured against
+    _, legacy = run_spec(net, draft, prompts, gen_len, 4,
+                         spec_slab=False)
+    slab4 = next(s for s in sweep
+                 if s["spec_tokens"] == 4 and s["kv_dtype"] == "f32"
+                 and s["prefix_cache"])
+    reduction = legacy["host_dispatches_per_token"] / max(
+        1e-9, slab4["host_dispatches_per_token"])
+    row = {
+        "metric": "llm_spec_slab_dispatch_reduction",
+        "value": round(reduction, 2),
+        "unit": "legacy_k4_dispatches_per_token_over_slab_k4",
+        "device": "cpu",
+        "workload": {"n_requests": len(prompts),
+                     "prompt_len": len(prompts[0]),
+                     "gen_len": gen_len, "spec_tokens": list(Ks)},
+        "legacy_k4": legacy,
+        "sweep": sweep,
+    }
+    print(json.dumps(row))
+    if args.out:
+        with open(args.out, "a") as f:
+            f.write(json.dumps(row) + "\n")
+    _ledger.append("llm_bench", row["metric"], row["value"],
+                   row["unit"],
+                   dispatches=slab4["host_dispatches_per_token"],
+                   peak_mem_bytes=_peak_mem_bytes(),
+                   **_goodput_row_fields(),
+                   extra={"legacy_dispatches_per_token":
+                              legacy["host_dispatches_per_token"],
+                          "slab_accept_rate": slab4["accept_rate"],
+                          "workload": row["workload"]})
+    if assert_ci:
+        assert not mismatches, (
+            f"greedy spec slab diverged from the target-only engine "
+            f"at (K, kv_dtype, cache) = {mismatches}")
+        assert reduction >= 2.0, (
+            f"the spec slab must emit tokens at >=2x fewer host "
+            f"dispatches than the legacy inline path at K=4; got "
+            f"{reduction:.2f}x ({slab4['host_dispatches_per_token']} "
+            f"vs {legacy['host_dispatches_per_token']} per token)")
+        print("LLM SPEC-SLAB SMOKE OK")
+    return 0
+
+
 def run_kv_capacity(net, kv_dtype, hbm_budget_bytes, prompts, gen_len,
                     page_size=4):
     """One serial pass of DISTINCT prompts through an engine whose
@@ -988,6 +1166,12 @@ def main(argv=None):
                     help="legacy alternating prefill/decode ticks vs "
                          "ONE ragged mixed slab: token identity + "
                          "host-dispatch reduction")
+    ap.add_argument("--spec", action="store_true",
+                    help="on-device speculative slab sweep: draft K "
+                         "in {2,4,8} x kv_dtype {f32,int8} x prefix "
+                         "cache on/off — acceptance rate + accepted "
+                         "tokens per dispatch, >=2x dispatch gate vs "
+                         "the legacy inline path at K=4")
     ap.add_argument("--out", default=None,
                     help="append the BENCH row to this JSONL file")
     ap.add_argument("--n-requests", type=int, default=8)
@@ -1008,6 +1192,8 @@ def main(argv=None):
         return kv_dtype_main(args, assert_ci=args.ci)
     if args.mixed_tick:
         return mixed_tick_main(args, assert_ci=args.ci)
+    if args.spec:
+        return spec_main(args, assert_ci=args.ci)
 
     if args.ci:
         net = build_net(vocab=97, hidden=64, max_pos=256)
