@@ -53,8 +53,8 @@ from ..observability import perf as _perf
 from ..observability import propagation as _propagation
 from ..observability import server as _dbgsrv
 from ..observability import tracing as _trace
-from ..ops.paged_attention import (KV_DTYPES, QuantizedKV, kv_layer,
-                                   kv_nbytes, kv_page_size,
+from ..ops.paged_attention import (KV_DTYPES, QuantizedKV, _split_kv,
+                                   kv_layer, kv_nbytes, kv_page_size,
                                    kv_scale_nbytes, kv_write, kv_zeros,
                                    ragged_paged_attention)
 from ..reliability import faults as _faults
@@ -159,6 +159,19 @@ def _engine_metrics():
             "llm_prefix_cache_pages",
             "refcounted pages resident in the prefix cache (shared + "
             "evictable)"),
+        # cross-replica KV-page migration (disaggregated fleet): the
+        # engine counts its own sides (export/import/rejected); the
+        # router observes the end-to-end kv_migrate_seconds histogram
+        "migrate_pages": reg.counter(
+            "kv_migrate_pages_total",
+            "KV pages migrated across replicas, by direction "
+            "(export / import / rejected)",
+            label_names=("direction",)),
+        "migrate_bytes": reg.counter(
+            "kv_migrate_bytes_total",
+            "serialized KV bytes migrated across replicas, by "
+            "direction (export / import / rejected)",
+            label_names=("direction",)),
         "prefill_queue": reg.gauge(
             "llm_prefill_queue_depth",
             "admitted requests with un-prefilled prompt tokens"),
@@ -905,6 +918,7 @@ def _engine_memory_provider(ref):
         free = len(eng._free_pages)
         cache = eng._cache
         shared = cache.shared_page_count if cache is not None else 0
+        migrated = cache.migrated_page_count if cache is not None else 0
         private = max(0, usable - free - shared)
         dt = {"dtype": eng.kv_dtype}
         rows = [
@@ -913,11 +927,22 @@ def _engine_memory_provider(ref):
             {"owner": "kv_pool", "kind": "private",
              "bytes": private * pbk, "detail": dt},
             {"owner": "kv_pool", "kind": "prefix_shared",
-             "bytes": shared * pbk, "detail": dt},
+             "bytes": (shared - migrated) * pbk, "detail": dt},
             {"owner": "kv_pool", "kind": "scratch", "bytes": pbk,
              "detail": {"note": "page 0: masked/inactive writes",
                         "dtype": eng.kv_dtype}},
         ]
+        if migrated:
+            # shared pages that arrived via import_pages rather than a
+            # local prefill — a disaggregated decode replica's ledger
+            # must show what the prefill pool shipped it (the split is
+            # exact: prefix_shared above excludes these)
+            rows.append(
+                {"owner": "kv_pool", "kind": "migrated",
+                 "bytes": migrated * pbk,
+                 "detail": {"note": "prefix pages installed by "
+                                    "cross-replica KV migration",
+                            "dtype": eng.kv_dtype}})
         if eng._tgt_scale_bytes:
             rows.append(
                 {"owner": "kv_pool", "kind": "scale_table",
@@ -996,6 +1021,8 @@ def _engine_status_provider(ref):
                 "hit_rate": round(
                     eng.n_cached_tokens / eng.n_prompt_tokens, 4)
                 if eng.n_prompt_tokens else 0.0,
+                "migrated_pages": cache.migrated_page_count,
+                "pages_imported": cache.n_imported,
             }
         if eng.spec_k:
             prop = eng.n_spec_proposed
@@ -1681,6 +1708,11 @@ class LLMEngine:
         self._key = jax.random.PRNGKey(seed)
         self._mu = threading.Lock()
         self._pending: List[_Request] = []
+        # control-op queue: closures the WORKER runs at its next loop
+        # boundary (pools quiescent, no donated buffer in flight) —
+        # the only safe point to read/write the device pools from
+        # outside the loop. export_pages/import_pages post here.
+        self._ctl: List = []
         self._closed = False
         self._wake = threading.Event()
         # hardened failure semantics (docs/RELIABILITY.md):
@@ -1714,6 +1746,10 @@ class LLMEngine:
         # recent tick kinds ('p'refill / 'd'ecode): the interleaving
         # witness — a long prompt's chunks must bracket decode ticks
         self.tick_history: deque = deque(maxlen=512)
+        # recent decode-step wall times (fetch-to-fetch, the same
+        # quantity the llm_decode_step_seconds histogram observes):
+        # raw samples for jitter percentiles (llm_bench --disagg)
+        self.step_durations: deque = deque(maxlen=4096)
         self._m = _engine_metrics()
         self._last_fetch_t: Optional[float] = None
         # HBM attribution ledger (observability/memory.py): bytes one
@@ -1952,6 +1988,194 @@ class LLMEngine:
         for j, f in inflight:
             outs[j] = f.result()
         return outs
+
+    # -- KV-page migration (disaggregated prefill/decode fleet) -------------
+    def _post_ctl(self, fn) -> Future:
+        """Post a closure for the WORKER to run at its next loop
+        boundary (the only point where no donated pool buffer is in
+        flight) and return the Future it resolves."""
+        fut: Future = Future()
+
+        def op():
+            try:
+                fut.set_result(fn())
+            except BaseException as e:  # noqa: BLE001 — to the caller
+                fut.set_exception(e)
+
+        with self._mu:
+            if self._closed:
+                raise EngineClosed("engine closed")
+            self._ctl.append((op, fut))
+        self._wake.set()
+        return fut
+
+    def _wire_kv_dtype(self) -> str:
+        """Canonical kv_dtype label for the migration wire format —
+        normalized so two engines built with alias spellings ("f32" vs
+        "float32") still exchange pages."""
+        kp, _ = _split_kv(self.k_pages)
+        return "int8" if isinstance(self.k_pages, QuantizedKV) \
+            else jnp.dtype(kp.dtype).name
+
+    def export_pages(self, digests, timeout: float = 60.0) -> dict:
+        """Serialize the longest RESIDENT prefix run of ``digests``
+        (hex strings or bytes, chain order from the root) into a
+        ``kv_pages/v1`` payload: raw page blocks at the pool dtype
+        (quantized int8 bytes + per-token-row scales for int8 pools),
+        each page's token chunk, and the rolling digest chain — what
+        :meth:`import_pages` verifies on the receiving replica. Pure
+        read: exports never mutate the pool or the cache. Runs on the
+        engine worker at a loop boundary (dispatch-quiescent), so it
+        is safe against the donated-buffer step."""
+        if self._cache is None:
+            raise RuntimeError(
+                "export_pages requires the prefix cache "
+                "(LLMEngine(prefix_cache=True))")
+        if _faults.enabled():
+            _faults.check("kv.export")
+        hexes = [d if isinstance(d, str) else d.hex() for d in digests]
+        return self._post_ctl(
+            lambda: self._do_export_pages(hexes)).result(timeout=timeout)
+
+    def import_pages(self, payload: dict, timeout: float = 60.0) -> dict:
+        """Verify and install a ``kv_pages/v1`` payload as shared,
+        refcount-zero prefix-cache residents. Every page is digest-
+        verified on ingest (identity chain + transport checksum +
+        exact pool geometry — kv_transfer.verify_payload documents the
+        rules); rejected pages are reported, never installed, and
+        allocate nothing. Returns ``{"imported", "duplicates",
+        "rejected"}``. Geometry mismatches (kv_dtype / page_size /
+        shape) raise ValueError — see docs/RELIABILITY.md on matching
+        kv_dtype across disaggregated pools."""
+        if self._cache is None:
+            raise RuntimeError(
+                "import_pages requires the prefix cache "
+                "(LLMEngine(prefix_cache=True))")
+        if _faults.enabled():
+            _faults.check("kv.import")
+        return self._post_ctl(
+            lambda: self._do_import_pages(payload)).result(timeout=timeout)
+
+    def _do_export_pages(self, hexes: List[str]) -> dict:
+        from . import kv_transfer as _kvt
+        from .prefix_cache import _SEED, chain_digest
+        cache = self._cache
+        run = []  # (digest, page, tokens) — resident prefix run
+        parent = _SEED
+        for hx in hexes:
+            try:
+                d = bytes.fromhex(hx)
+            except ValueError:
+                break
+            page = cache.page_of(d)
+            toks = cache.tokens_of(d)
+            # stop at the first non-resident/non-exportable digest OR
+            # a chain break (requests must be in chain order from the
+            # root; a stale mapping must not serialize wrong bytes)
+            if page is None or toks is None or \
+                    chain_digest(parent, toks) != d:
+                break
+            run.append((d, page, toks))
+            parent = d
+        kp, ksc = _split_kv(self.k_pages)
+        vp, vsc = _split_kv(self.v_pages)
+        L, _n, ps, H, Dh = kp.shape
+        recs: List[dict] = []
+        n_bytes = 0
+        if run:
+            idx = np.array([p for _, p, _ in run], np.int32)
+            k_np = np.asarray(kp[:, idx])    # [L, n, ps, H, Dh]
+            v_np = np.asarray(vp[:, idx])
+            ks_np = np.asarray(ksc[:, idx]) if ksc is not None else None
+            vs_np = np.asarray(vsc[:, idx]) if vsc is not None else None
+            parent = _SEED
+            for j, (d, _pg, toks) in enumerate(run):
+                k_b = np.ascontiguousarray(k_np[:, j]).tobytes()
+                v_b = np.ascontiguousarray(v_np[:, j]).tobytes()
+                ks_b = np.ascontiguousarray(ks_np[:, j]).tobytes() \
+                    if ks_np is not None else b""
+                vs_b = np.ascontiguousarray(vs_np[:, j]).tobytes() \
+                    if vs_np is not None else b""
+                recs.append(_kvt.encode_page(d, parent, toks,
+                                             k_b, v_b, ks_b, vs_b))
+                n_bytes += (len(k_b) + len(v_b) + len(ks_b)
+                            + len(vs_b))
+                parent = d
+        if recs:
+            self._m["migrate_pages"].labels("export").inc(len(recs))
+            self._m["migrate_bytes"].labels("export").inc(n_bytes)
+        return _kvt.make_payload(recs, kv_dtype=self._wire_kv_dtype(),
+                                 page_size=self.page_size,
+                                 kv_shape=(L, ps, H, Dh))
+
+    def _do_import_pages(self, payload: dict) -> dict:
+        from . import kv_transfer as _kvt
+        cache = self._cache
+        kp, ksc = _split_kv(self.k_pages)
+        vp, vsc = _split_kv(self.v_pages)
+        L, _n, ps, H, Dh = kp.shape
+        kv_shape = (L, ps, H, Dh)
+        kv_nb = L * ps * H * Dh * kp.dtype.itemsize
+        sc_nb = L * ps * 4 if ksc is not None else 0
+        accepted, rejected = _kvt.verify_payload(
+            payload, kv_dtype=self._wire_kv_dtype(),
+            page_size=self.page_size, kv_shape=kv_shape,
+            kv_nbytes=kv_nb, scale_nbytes=sc_nb,
+            resident=lambda d: cache.page_of(d) is not None)
+        dups = 0
+        alloc = []  # (record, target page id)
+        for i, rec in enumerate(accepted):
+            if cache.page_of(rec.digest) is not None:
+                dups += 1
+                continue
+            pg = self._alloc_page()
+            if pg is None:
+                # pool exhausted: the rest of the chain cannot install
+                # (and would be unmatchable behind the gap anyway) —
+                # report, leak nothing
+                rejected.extend(
+                    {"digest": r.digest.hex(), "reason": "no_free_pages"}
+                    for r in accepted[i:]
+                    if cache.page_of(r.digest) is None)
+                break
+            alloc.append((rec, pg))
+        n_bytes = 0
+        if alloc:
+            idx = np.array([pg for _, pg in alloc], np.int32)
+            k_new = np.stack(
+                [np.frombuffer(r.k, kp.dtype).reshape(kv_shape)
+                 for r, _ in alloc], axis=1)
+            v_new = np.stack(
+                [np.frombuffer(r.v, vp.dtype).reshape(kv_shape)
+                 for r, _ in alloc], axis=1)
+            if ksc is not None:
+                ks_new = np.stack(
+                    [np.frombuffer(r.k_scales, np.float32)
+                     .reshape((L, ps)) for r, _ in alloc], axis=1)
+                vs_new = np.stack(
+                    [np.frombuffer(r.v_scales, np.float32)
+                     .reshape((L, ps)) for r, _ in alloc], axis=1)
+                self.k_pages = QuantizedKV(
+                    kp.at[:, idx].set(k_new),
+                    ksc.at[:, idx].set(ks_new))
+                self.v_pages = QuantizedKV(
+                    vp.at[:, idx].set(v_new),
+                    vsc.at[:, idx].set(vs_new))
+            else:
+                self.k_pages = kp.at[:, idx].set(k_new)
+                self.v_pages = vp.at[:, idx].set(v_new)
+            for rec, pg in alloc:
+                cache.register_imported(rec.digest, pg, rec.tokens)
+                n_bytes += rec.nbytes
+        if alloc:
+            self._m["migrate_pages"].labels("import").inc(len(alloc))
+            self._m["migrate_bytes"].labels("import").inc(n_bytes)
+        if rejected:
+            self._m["migrate_pages"].labels("rejected").inc(
+                len(rejected))
+        self._update_kv_gauge()
+        return {"imported": len(alloc), "duplicates": dups,
+                "rejected": rejected}
 
     def close(self):
         _dbgsrv.unregister_status_provider(self._status_name)
@@ -2624,7 +2848,8 @@ class LLMEngine:
                 for i in range(req.n_reg_pages, req.prefill_pos // ps):
                     self._cache.register(
                         req.digests[i],
-                        int(self.block_tables[req.slot, i]))
+                        int(self.block_tables[req.slot, i]),
+                        req.prompt[i * ps:(i + 1) * ps])
                 req.n_reg_pages = max(req.n_reg_pages,
                                       req.prefill_pos // ps)
         self.n_prefill_ticks += 1
@@ -2639,6 +2864,15 @@ class LLMEngine:
                     closed = self._closed
                     pending = self._pending
                     self._pending = []
+                    ctl = self._ctl
+                    self._ctl = []
+                # control ops run HERE: the previous iteration drained
+                # its dispatches to the lag boundary, so the pool
+                # arrays are settled outputs (no donated input buffer
+                # is still feeding a queued program). Each op resolves
+                # its own future and never raises into the loop.
+                for op, _fut in ctl:
+                    op()
                 # higher priority admits first; FIFO (by submission
                 # order) within a priority class — retries re-enter
                 # the next drain and re-sort with new arrivals
@@ -2725,12 +2959,18 @@ class LLMEngine:
                             with self._mu:
                                 leftovers = self._pending
                                 self._pending = []
+                                ctl_left = self._ctl
+                                self._ctl = []
                             for req in leftovers:
                                 self._end_request_spans(
                                     req, "failed",
                                     error="engine closed")
                                 req.future.set_exception(
                                     EngineClosed("engine closed"))
+                            for _op, fut in ctl_left:
+                                if not fut.done():
+                                    fut.set_exception(
+                                        EngineClosed("engine closed"))
                             return
                         self._wake.wait(timeout=0.05)
                         self._wake.clear()
@@ -3319,7 +3559,8 @@ class LLMEngine:
                                req.prefill_pos // ps):
                     self._cache.register(
                         req.digests[i],
-                        int(self.block_tables[req.slot, i]))
+                        int(self.block_tables[req.slot, i]),
+                        req.prompt[i * ps:(i + 1) * ps])
                 req.n_reg_pages = max(req.n_reg_pages,
                                       req.prefill_pos // ps)
         self.n_mixed_slabs += 1
@@ -3668,6 +3909,7 @@ class LLMEngine:
         if timed and self._last_fetch_t is not None:
             dt = now - self._last_fetch_t
             self._m["step"].observe(dt)
+            self.step_durations.append(dt)
             if dt > 0 and emitted:
                 self._m["tps"].observe(emitted / dt)
         if emitted:
@@ -3893,9 +4135,36 @@ def serve_llm(engine, host: str = "127.0.0.1", port: int = 0):
                 cspan.set_attr("cancelled", bool(ok)).end()
             return 200, {"cancelled": bool(ok)}
 
+        def _kv_pages(self, body: dict):
+            # KV-page migration endpoint (disaggregated fleet):
+            # {"digests": [hex, ...]} exports; {"payload": {...}}
+            # imports. Only real engines expose the surface — a
+            # router fronted by serve_llm 404s here by design (page
+            # transfer is replica-to-replica, not through the router's
+            # public face).
+            exp = getattr(engine, "export_pages", None)
+            imp = getattr(engine, "import_pages", None)
+            if exp is None or imp is None:
+                return 404, {"error": "no KV-page surface"}
+            try:
+                if "digests" in body:
+                    return 200, exp(body["digests"])
+                return 200, imp(body["payload"])
+            except EngineClosed as e:
+                return 503, {"error": str(e), "outcome": "shed",
+                             "reason": "draining"}
+            except _faults.FaultInjected as e:
+                # injected transfer fault: a 5xx the HTTP client maps
+                # to ReplicaUnavailable — the router's migrate step
+                # falls back to local recompute
+                return 500, {"error": str(e), "outcome": "fault"}
+            except Exception as e:  # noqa: BLE001 — report to client
+                return 400, {"error": str(e)}
+
         def do_POST(self):
             routes = {"/generate": self._generate,
-                      "/cancel": self._cancel}
+                      "/cancel": self._cancel,
+                      "/kv_pages": self._kv_pages}
             fn = routes.get(self.path)
             if fn is None:
                 self.send_error(404)

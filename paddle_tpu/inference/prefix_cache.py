@@ -37,9 +37,17 @@ from __future__ import annotations
 
 import hashlib
 from collections import OrderedDict
-from typing import Dict, List, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 _SEED = b"\x00" * 16
+
+
+def chain_digest(parent: bytes, chunk: Sequence[int]) -> bytes:
+    """One rolling step: digest of ``chunk`` appended to the history
+    committed by ``parent`` (``_SEED`` for the root page)."""
+    h = hashlib.blake2b(parent, digest_size=16)
+    h.update(",".join(map(str, chunk)).encode())
+    return h.digest()
 
 
 def page_digests(tokens: Sequence[int], page_size: int) -> List[bytes]:
@@ -48,10 +56,7 @@ def page_digests(tokens: Sequence[int], page_size: int) -> List[bytes]:
     out: List[bytes] = []
     d = _SEED
     for i in range(len(tokens) // page_size):
-        h = hashlib.blake2b(d, digest_size=16)
-        chunk = tokens[i * page_size:(i + 1) * page_size]
-        h.update(",".join(map(str, chunk)).encode())
-        d = h.digest()
+        d = chain_digest(d, tokens[i * page_size:(i + 1) * page_size])
         out.append(d)
     return out
 
@@ -68,8 +73,16 @@ class PrefixCache:
         self._key_of: Dict[int, bytes] = {}
         self._refs: Dict[int, int] = {}
         self._lru: "OrderedDict[int, None]" = OrderedDict()
+        # export surface: the token chunk behind each shared digest, so
+        # a page is serializable (digest chain re-derivable) without the
+        # original prompt in hand
+        self._tokens: Dict[bytes, Tuple[int, ...]] = {}
+        # pages installed by import_pages (subset of shared pages);
+        # drives the memory ledger's "migrated" row
+        self._migrated: set = set()
         # cumulative accounting (engine metrics read these)
         self.n_evicted = 0
+        self.n_imported = 0
 
     # -- queries --------------------------------------------------------
     def lookup(self, digests: Sequence[bytes]) -> List[int]:
@@ -89,6 +102,12 @@ class PrefixCache:
     def is_evictable(self, page: int) -> bool:
         return page in self._lru
 
+    def page_of(self, digest: bytes) -> Optional[int]:
+        return self._by_key.get(digest)
+
+    def tokens_of(self, digest: bytes) -> Optional[Tuple[int, ...]]:
+        return self._tokens.get(digest)
+
     @property
     def shared_page_count(self) -> int:
         return len(self._key_of)
@@ -96,6 +115,10 @@ class PrefixCache:
     @property
     def evictable_count(self) -> int:
         return len(self._lru)
+
+    @property
+    def migrated_page_count(self) -> int:
+        return len(self._migrated)
 
     # -- reference lifecycle --------------------------------------------
     def acquire(self, page: int) -> None:
@@ -113,9 +136,11 @@ class PrefixCache:
         else:
             self._refs[page] = r
 
-    def register(self, digest: bytes, page: int) -> bool:
+    def register(self, digest: bytes, page: int,
+                 tokens: Optional[Sequence[int]] = None) -> bool:
         """Promote a private, fully-written page to shared under
         ``digest``, holding one reference for the owning sequence.
+        ``tokens`` (the page's token chunk) makes the page exportable.
         Returns False (page stays private) if the digest is already
         cached — e.g. two identical prompts prefilled concurrently."""
         if digest in self._by_key:
@@ -123,14 +148,34 @@ class PrefixCache:
         self._by_key[digest] = page
         self._key_of[page] = digest
         self._refs[page] = self._refs.get(page, 0) + 1
+        if tokens is not None:
+            self._tokens[digest] = tuple(tokens)
         return True
+
+    def register_imported(self, digest: bytes, page: int,
+                          tokens: Sequence[int]) -> None:
+        """Install a migrated page as a shared, refcount-ZERO resident:
+        no live sequence maps it yet, so it lands straight on the LRU
+        tail (evictable under pressure like any cold shared page).
+        Caller has already verified the digest chain and written the
+        page's KV into the pool."""
+        assert digest not in self._by_key, "duplicate import"
+        self._by_key[digest] = page
+        self._key_of[page] = digest
+        self._tokens[digest] = tuple(tokens)
+        self._lru[page] = None
+        self._migrated.add(page)
+        self.n_imported += 1
 
     # -- reclamation ----------------------------------------------------
     def evict_one(self) -> int:
         """Reclaim the least-recently-freed refcount-zero page for the
         allocator; raises KeyError when nothing is evictable."""
         page, _ = self._lru.popitem(last=False)
-        del self._by_key[self._key_of.pop(page)]
+        digest = self._key_of.pop(page)
+        del self._by_key[digest]
+        self._tokens.pop(digest, None)
+        self._migrated.discard(page)
         self.n_evicted += 1
         return page
 

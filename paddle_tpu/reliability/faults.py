@@ -44,6 +44,15 @@ Named sites (the catalog; see docs/RELIABILITY.md):
 ``io.worker``             DataLoader host-batch production
 ``router.dispatch``       fleet router: one request dispatch to a replica
 ``router.healthz``        fleet router: one replica health poll
+``router.migrate``        fleet router: one KV-page migration attempt
+                          (prefill fill + export + import) — injection
+                          abandons the transfer; the request MUST fall
+                          back to nonce-pinned local recompute on its
+                          decode replica, token-identical
+``kv.export``             engine: one export_pages call about to read
+                          resident prefix pages off the device
+``kv.import``             engine: one import_pages call about to verify
+                          and install a migrated page run
 ``autoscale.spawn``       serving autoscaler: one spawn attempt during a
                           scale-out/replacement — injection makes the
                           spawn fail; the autoscaler must retry with
@@ -93,6 +102,9 @@ SITES = (
     "io.worker",
     "router.dispatch",
     "router.healthz",
+    "router.migrate",
+    "kv.export",
+    "kv.import",
     "autoscale.spawn",
     "autoscale.drain",
     "replica.crash",

@@ -210,6 +210,7 @@ class Autoscaler:
                  backoff_cap_s: float = 60.0,
                  dwell_s: float = 10.0,
                  decision_log_cap: int = 256,
+                 role: Optional[str] = None,
                  name_prefix: str = "auto",
                  name: str = "autoscaler",
                  synchronous: bool = False,
@@ -239,6 +240,16 @@ class Autoscaler:
         self.backoff_base_s = float(backoff_base_s)
         self.backoff_cap_s = float(backoff_cap_s)
         self.dwell_s = float(dwell_s)
+        # pool role in a disaggregated fleet: this controller sizes
+        # ONLY its own pool (role-filtered fleet_load) and tags its
+        # spawns/attaches with the role. One Autoscaler per pool,
+        # each off its pool's own burn signal.
+        self.role = role
+        if role is not None:
+            if name_prefix == "auto":
+                name_prefix = f"auto-{role}"
+            if name == "autoscaler":
+                name = f"autoscaler-{role}"
         self.name_prefix = name_prefix
         self.name = name
         self.synchronous = bool(synchronous)
@@ -336,6 +347,9 @@ class Autoscaler:
     def _load(self) -> dict:
         if self._occupancy_fn is not None:
             return self._occupancy_fn()
+        if self.role is not None:
+            return self.router.fleet_load(self.replica_slots,
+                                          role=self.role)
         return self.router.fleet_load(self.replica_slots)
 
     # -- damping ------------------------------------------------------------
@@ -596,7 +610,11 @@ class Autoscaler:
         m = _Managed(name, client, handle, self._clock())
         with self._mu:
             self._managed[name] = m
-        self.router.attach(name, client, warming=True)
+        if self.role is not None:
+            self.router.attach(name, client, warming=True,
+                               role=self.role)
+        else:
+            self.router.attach(name, client, warming=True)
         if not self._wait_healthy(client, handle):
             # spawned but never became healthy: tear it down and keep
             # it uncounted — a half-up replica must not hold capacity
@@ -731,6 +749,7 @@ class Autoscaler:
             log = list(self._log)
         return {
             "config": {
+                "role": self.role,
                 "min_replicas": self.min_replicas,
                 "max_replicas": self.max_replicas,
                 "replica_slots": self.replica_slots,

@@ -159,7 +159,8 @@ class FleetScraper:
     def __init__(self, registry: Optional[MetricRegistry] = None,
                  federate_prefixes: Tuple[str, ...] = ("llm_", "perf_",
                                                        "mem_",
-                                                       "badput_"),
+                                                       "badput_",
+                                                       "kv_migrate_"),
                  stale_after: float = 10.0):
         # NOTE: per-replica badput CAUSES federate
         # (fleet_badput_seconds_total{replica=,cause=}); the replica's

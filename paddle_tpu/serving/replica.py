@@ -90,6 +90,15 @@ class LocalReplica:
     def cancel(self, request_id: int) -> bool:
         return self.engine.cancel(request_id)
 
+    # KV-page migration (disaggregated fleet): direct handoff to the
+    # engine's export/import surface — the same payload the HTTP
+    # /kv_pages endpoint carries, minus the serialization hop
+    def export_pages(self, digests, trace_context=None) -> dict:
+        return self.engine.export_pages(digests)
+
+    def import_pages(self, payload: dict, trace_context=None) -> dict:
+        return self.engine.import_pages(payload)
+
     def close(self) -> None:
         pass   # the engine's owner closes it
 
@@ -225,6 +234,31 @@ class HTTPReplica:
             return False
         return bool(out.get("cancelled")) if code == 200 else False
 
+    def _kv_pages(self, body: dict, trace_context=None) -> dict:
+        code, out = self._post("/kv_pages", body, 60.0,
+                               trace_context=trace_context)
+        if code == 200:
+            return out
+        err = out.get("error", f"HTTP {code}")
+        if code == 503:
+            raise AdmissionShed(err, reason="draining")
+        if code == 400:
+            raise ValueError(err)
+        # 404 (no KV surface), 500 (injected transfer fault), and any
+        # other 5xx: the migrate step's fallback-to-recompute signal
+        raise ReplicaUnavailable(
+            f"replica at {self.generate_url} /kv_pages failed "
+            f"(HTTP {code}): {err}")
+
+    def export_pages(self, digests, trace_context=None) -> dict:
+        hexes = [d if isinstance(d, str) else d.hex() for d in digests]
+        return self._kv_pages({"digests": hexes},
+                              trace_context=trace_context)
+
+    def import_pages(self, payload: dict, trace_context=None) -> dict:
+        return self._kv_pages({"payload": payload},
+                              trace_context=trace_context)
+
     def close(self) -> None:
         pass
 
@@ -355,6 +389,11 @@ def replica_main(spec: dict) -> int:
             "metrics": f"{dbg.address}/metrics",
             "tracez": f"{dbg.address}/tracez",
             "pid": os.getpid()}
+    if spec.get("role"):
+        # disaggregated pool membership ("prefill" / "decode"): rides
+        # the roster record so the router's membership sync attaches
+        # this replica to the right pool
+        info["role"] = str(spec["role"])
     member = None
     if spec.get("store"):
         from ..distributed.tcp_store import TCPMembership

@@ -54,6 +54,7 @@ from typing import Dict, Optional
 from ..inference.llm import (AdmissionShed, EngineClosed,
                              RequestCancelled)
 from ..inference.prefix_cache import page_digests
+from ..observability import goodput as _goodput
 from ..observability import metrics as _obs
 from ..observability import propagation as _propagation
 from ..observability import server as _dbgsrv
@@ -173,13 +174,27 @@ def _router_metrics():
         "latency": reg.histogram(
             "router_request_seconds",
             "router submit → resolution (failover latency included)"),
+        "role_dispatches": reg.counter(
+            "router_role_dispatches_total",
+            "dispatch attempts by replica pool role (disaggregated "
+            "fleets run 'prefill' and 'decode' pools; replicas with "
+            "no declared role count as 'unified')",
+            label_names=("role",)),
+        "migrate_seconds": reg.histogram(
+            "kv_migrate_seconds",
+            "end-to-end KV-page migration wall time as the router "
+            "sees it: prefill fill + page export + verified import"),
+        "migrate_failed": reg.counter(
+            "router_migrate_failed_total",
+            "migrations abandoned mid-flight; the request fell back "
+            "to nonce-pinned local recompute on its decode replica"),
     }
 
 
 class _ReplicaState:
     __slots__ = ("name", "client", "breaker", "health", "inflight",
                  "dispatched", "from_membership", "info", "warming",
-                 "admin_draining")
+                 "admin_draining", "role")
 
     def __init__(self, name, client, breaker):
         self.name = name
@@ -190,6 +205,10 @@ class _ReplicaState:
         self.dispatched = 0
         self.from_membership = False
         self.info: dict = {}
+        # pool role in a disaggregated fleet: "prefill" replicas fill
+        # KV pages and hand them off; "decode" (or None = unified)
+        # replicas serve the requests themselves
+        self.role = None
         # WARMING: spawned but not yet counted toward capacity (no
         # READY + healthy probe yet). A warming replica is a HOLE —
         # it absorbs no dispatches AND stays out of the occupancy
@@ -209,7 +228,7 @@ class _FleetRequest:
                  "priority", "tenant", "nonce", "future", "cancelled",
                  "span", "excluded", "t_submit", "failovers",
                  "affinity_key", "quota_held", "rr_slot", "slo_name",
-                 "had_deadline", "last_dispatch")
+                 "had_deadline", "last_dispatch", "digests", "migrate")
 
     def __init__(self, prompt, max_new_tokens, temperature):
         self.prompt = list(map(int, prompt))
@@ -234,6 +253,12 @@ class _FleetRequest:
         # the next attempt links back to it so a failover reads as
         # one story on the merged timeline
         self.last_dispatch = None
+        # full-page digest chain of the prompt (computed once at
+        # submit); drives both affinity and KV-page migration
+        self.digests = []
+        # result of a completed migration for this request, attached
+        # to the final result dict ({"seconds", "pages", "prefill"})
+        self.migrate = None
 
 
 class Router:
@@ -269,7 +294,8 @@ class Router:
                  max_workers: int = 32,
                  scrape_metrics: bool = True,
                  federate_prefixes=("llm_", "perf_", "mem_",
-                                    "badput_"),
+                                    "badput_", "kv_migrate_"),
+                 disagg_threshold_tokens: Optional[int] = None,
                  slo_windows=DEFAULT_WINDOWS,
                  slo_default_target: float = 0.99,
                  slo_breach_threshold: float = 10.0,
@@ -317,6 +343,21 @@ class Router:
         self.n_failovers = 0
         self.n_rebalanced = 0
         self.n_shed = 0
+        # -- disaggregated prefill/decode fleet state --
+        # migrate when the decode target would have to prefill more
+        # than this many uncached tokens locally (None: 2 pages — one
+        # page of savings is not worth a network round trip)
+        self.disagg_threshold_tokens = disagg_threshold_tokens
+        self.n_migrations = 0
+        self.n_migrate_failed = 0
+        self.n_pages_migrated = 0
+        self.n_pages_rejected = 0
+        # optimistic per-replica digest residency: updated on every
+        # completion/migration, dropped when the replica goes
+        # unreachable (it may restart blank). Wrong-in-either-
+        # direction is safe — a stale "resident" only re-migrates or
+        # recomputes; verification on import keeps it exact.
+        self._resident: Dict[str, set] = {}
         for rname, client in (replicas or {}).items():
             self.attach(rname, client)
         # TCPStore membership: poll the roster alongside health
@@ -368,13 +409,16 @@ class Router:
                 self._status_name, self._render_federated)
 
     # -- membership ---------------------------------------------------------
-    def attach(self, name: str, client, warming: bool = False) -> None:
+    def attach(self, name: str, client, warming: bool = False,
+               role: Optional[str] = None) -> None:
         """Add (or re-point) a replica. Re-attaching an existing name
         keeps its breaker — a restarted replica re-earns trust through
         half-open probes instead of resetting its history.
         ``warming=True`` (or a prior :meth:`expect_warming`) attaches
         it as a capacity HOLE: no dispatches, no occupancy weight,
-        until :meth:`mark_ready`."""
+        until :meth:`mark_ready`. ``role`` declares the replica's pool
+        in a disaggregated fleet ("prefill" / "decode"; None on
+        re-attach preserves the existing role)."""
         with self._mu:
             # an explicit attach overrides a detach tombstone — the
             # caller knows the replica exists
@@ -384,11 +428,14 @@ class Router:
                 st = _ReplicaState(name, client,
                                    CircuitBreaker(**self._breaker_kw))
                 st.warming = warming or name in self._expect_warm
+                st.role = role
                 self._replicas[name] = st
             else:
                 st.client = client
                 if warming:
                     st.warming = True
+                if role is not None:
+                    st.role = role
 
     def expect_warming(self, name: str) -> None:
         """Pre-declare ``name`` as warming BEFORE its process exists:
@@ -440,17 +487,23 @@ class Router:
             st = self._replicas.get(name)
             return None if st is None else st.inflight
 
-    def fleet_load(self, slots_per_replica: Optional[int] = None
-                   ) -> dict:
+    def fleet_load(self, slots_per_replica: Optional[int] = None,
+                   role: Optional[str] = None) -> dict:
         """Capacity/occupancy accounting over the attached fleet.
         READY replicas (not warming, not draining, breaker not open,
         reachable) define the capacity; warming and draining replicas
         are counted but are HOLES in the occupancy denominator.
         ``occupancy`` is total ready in-flight / (slots × ready), or
         None when no ready capacity exists (a hole, not a zero — the
-        autoscaler must not read an all-warming fleet as idle)."""
+        autoscaler must not read an all-warming fleet as idle).
+        ``role`` restricts the accounting to one pool of a
+        disaggregated fleet ("unified" matches undeclared roles) —
+        each pool's autoscaler sizes off its OWN burn signal."""
         with self._mu:
             states = list(self._replicas.values())
+        if role is not None:
+            states = [st for st in states
+                      if (st.role or "unified") == role]
         ready = [st for st in states
                  if not st.warming and not st.admin_draining
                  and st.breaker.state != "open"
@@ -474,6 +527,7 @@ class Router:
         with self._mu:
             self._replicas.pop(name, None)
             self._expect_warm.discard(name)
+            self._resident.pop(name, None)
             self._detached_at[name] = time.monotonic()
         if self.scraper is not None:
             self.scraper.forget(name)
@@ -526,7 +580,7 @@ class Router:
                 continue
             client = HTTPReplica(info["generate"], info["healthz"],
                                  metrics_url=info.get("metrics"))
-            self.attach(mname, client)
+            self.attach(mname, client, role=info.get("role"))
             with self._mu:
                 st = self._replicas[mname]
                 st.from_membership = True
@@ -566,6 +620,10 @@ class Router:
                 st.health = h if h is not None else "unreachable"
             if h is None:
                 st.breaker.record_failure()
+                with self._mu:
+                    # an unreachable replica may come back blank —
+                    # drop the optimistic digest-residency view
+                    self._resident.pop(st.name, None)
             else:
                 # ANY answer settles as success — the breaker judges
                 # reachability only; a draining verdict keeps the
@@ -636,6 +694,19 @@ class Router:
         """(state, affinity_hit) or (None, all_draining)."""
         with self._mu:
             states = dict(self._replicas)
+        # role awareness: requests DECODE on non-prefill replicas.
+        # Prefill-pool replicas only enter the candidate set when no
+        # non-prefill replica could possibly serve (a degraded fleet
+        # must never lose a request to pool purity — the prefill
+        # replica is a full engine and can decode, just wastefully).
+        serving = {n: st for n, st in states.items()
+                   if st.role != "prefill"}
+        if any(n not in req.excluded
+               and st.health != "draining"
+               and not st.warming and not st.admin_draining
+               and st.breaker.state != "open"
+               for n, st in serving.items()):
+            states = serving
         eligible = {n: st for n, st in states.items()
                     if n not in req.excluded
                     and st.health != "draining"
@@ -662,6 +733,152 @@ class Router:
         all_draining = bool(states) and all(
             st.health == "draining" for st in states.values())
         return None, all_draining
+
+    # -- disaggregated prefill/decode migration -----------------------------
+    def _migrate_threshold(self) -> int:
+        if self.disagg_threshold_tokens is not None:
+            return int(self.disagg_threshold_tokens)
+        return 2 * self.page_size
+
+    def _uncached_estimate(self, req: _FleetRequest, name: str) -> int:
+        """Tokens ``name`` would have to prefill locally, per the
+        router's optimistic residency view (the true answer lives on
+        the replica; over-estimating only migrates pages that turn
+        out to be duplicates, which import_pages dedups)."""
+        cap = (len(req.prompt) - 1) // self.page_size
+        seen = self._resident.get(name)
+        n = 0
+        if seen:
+            for d in req.digests[:cap]:
+                if d not in seen:
+                    break
+                n += 1
+        return len(req.prompt) - n * self.page_size
+
+    def _pick_prefill(self, req: _FleetRequest):
+        """Rendezvous-choose a ready prefill-pool replica for this
+        request's prefix family (same key as decode affinity: one
+        family keeps hitting one prefill replica's cache). None when
+        the fleet has no usable prefill pool."""
+        with self._mu:
+            pool = {n: st for n, st in self._replicas.items()
+                    if st.role == "prefill"
+                    and n not in req.excluded
+                    and st.health not in ("draining", "unreachable")
+                    and not st.warming and not st.admin_draining
+                    and st.breaker.state != "open"}
+        if not pool:
+            return None
+        pick = self._rendezvous(req.affinity_key, pool)
+        st = pool[pick]
+        return st if st.breaker.allow() else None
+
+    def _maybe_migrate(self, req: _FleetRequest, dst: _ReplicaState,
+                       dspan) -> None:
+        """The disaggregation hot path: when the decode target would
+        have to prefill a long uncached prompt locally, have a
+        prefill-pool replica fill the pages instead (one-token
+        generate, SAME nonce), pull the page run by digest, and
+        install it on the decode replica via the digest-verified
+        import. Every failure mode — prefill shed, replica lost
+        mid-transfer, pages rejected on verify — degrades to the
+        decode replica recomputing locally under the same pinned
+        nonce: slower, never wrong, never a lost request."""
+        if dst.role == "prefill":
+            return                     # already landing on a prefill
+        cap = (len(req.prompt) - 1) // self.page_size
+        if cap <= 0:
+            return
+        if self._uncached_estimate(req, dst.name) \
+                <= self._migrate_threshold():
+            return
+        pst = self._pick_prefill(req)
+        if pst is None:
+            return
+        t0 = time.monotonic()
+        mspan = None
+        if dspan is not None:
+            mspan = _trace.start_span(
+                "llm.migrate", parent=dspan,
+                attrs={"prefill_replica": pst.name,
+                       "decode_replica": dst.name,
+                       "pages_wanted": cap})
+        mctx = mspan.context if mspan is not None else None
+        self._m["dispatches"].labels(pst.name).inc()
+        self._m["role_dispatches"].labels("prefill").inc()
+        with self._mu:
+            pst.dispatched += 1
+            pst.inflight += 1
+        self._m["inflight"].labels(pst.name).set(pst.inflight)
+        try:
+            if _faults.enabled():
+                _faults.check("router.migrate")
+            # 1. fill: one-token generate on the prefill replica
+            # under the request's own nonce — its pages are the exact
+            # pages the decode replica would have computed
+            pst.client.submit(
+                req.prompt, max_new_tokens=1,
+                temperature=req.temperature,
+                deadline_s=(req.deadline.remaining()
+                            if req.deadline is not None else None),
+                nonce=req.nonce, trace_context=mctx)
+            digs = req.digests[:cap]
+            # 2. pull the page run from the source by digest list
+            payload = pst.client.export_pages(
+                [d.hex() for d in digs], trace_context=mctx)
+            # 3. verified install on the decode target
+            res = dst.client.import_pages(payload, trace_context=mctx)
+            pst.breaker.record_success()
+            dt = time.monotonic() - t0
+            imported = int(res.get("imported", 0))
+            dups = int(res.get("duplicates", 0))
+            rejected = res.get("rejected") or []
+            self._m["migrate_seconds"].observe(dt)
+            with self._mu:
+                self.n_migrations += 1
+                self.n_pages_migrated += imported
+                self.n_pages_rejected += len(rejected)
+                self._resident.setdefault(pst.name, set()).update(digs)
+                # the accepted run is a chain prefix; dups were
+                # already resident
+                self._resident.setdefault(dst.name, set()).update(
+                    digs[:imported + dups])
+            if _goodput.enabled():
+                # migration wall time is time this request spent
+                # waiting to start decoding — queue-side badput, not
+                # generate time
+                _goodput.note("queue_wait", dt)
+            req.migrate = {"seconds": dt, "pages": imported,
+                           "duplicates": dups,
+                           "rejected": len(rejected),
+                           "prefill": pst.name}
+            if mspan is not None:
+                mspan.set_attr("pages", imported)
+                mspan.set_attr("duplicates", dups)
+                mspan.set_attr("rejected", len(rejected))
+                mspan.set_attr("seconds", round(dt, 6))
+                mspan.end()
+        except Exception as e:  # noqa: BLE001 — fallback, never fatal
+            if isinstance(e, ReplicaUnavailable):
+                # transport-level loss: charge the breaker and drop
+                # the residency view (the replica may restart blank)
+                pst.breaker.record_failure()
+                pst.health = "unreachable"
+                with self._mu:
+                    self._resident.pop(pst.name, None)
+            with self._mu:
+                self.n_migrate_failed += 1
+            self._m["migrate_failed"].inc()
+            if _goodput.enabled():
+                _goodput.note("queue_wait", time.monotonic() - t0)
+            if mspan is not None:
+                mspan.set_attr("fallback", "local_recompute")
+                mspan.set_status("error") \
+                     .set_attr("error", str(e)).end()
+        finally:
+            with self._mu:
+                pst.inflight -= 1
+            self._m["inflight"].labels(pst.name).set(pst.inflight)
 
     # -- submission ---------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int = 32,
@@ -692,7 +909,15 @@ class Router:
         req.slo_name = slo
         req.nonce = next(self._nonce_seq) & 0x7FFFFFFF
         req.future.request_id = req.nonce
-        req.affinity_key = self._affinity_key(req.prompt)
+        # one digest-chain walk serves both the affinity key and the
+        # migration page list
+        req.digests = page_digests(req.prompt, self.page_size)
+        if req.digests:
+            req.affinity_key = req.digests[:self.affinity_pages][-1]
+        else:
+            req.affinity_key = hashlib.blake2b(
+                ",".join(map(str, req.prompt)).encode(),
+                digest_size=16).digest()
         req.rr_slot = next(self._rr_seq)
         self.n_submitted += 1
         if _trace.enabled():
@@ -833,6 +1058,13 @@ class Router:
                         "relation": "retry_of",
                         "replica": prev_name})
                 req.last_dispatch = (dspan.context, st.name)
+            # disaggregated fleets: long-uncached prompts detour
+            # through the prefill pool before this dispatch. Only the
+            # first attempt migrates — a failover retry goes straight
+            # to recompute (the fallback that cannot fail).
+            if req.failovers == 0 and req.migrate is None \
+                    and not req.excluded:
+                self._maybe_migrate(req, st, dspan)
             if self.policy == "affinity":
                 self._m["affinity_total"].inc()
                 if flag:
@@ -842,6 +1074,8 @@ class Router:
                     self._m["affinity_routed"].value
                     / max(1.0, fam.value))
             self._m["dispatches"].labels(st.name).inc()
+            self._m["role_dispatches"].labels(
+                st.role or "unified").inc()
             with self._mu:
                 st.dispatched += 1
                 st.inflight += 1
@@ -891,6 +1125,9 @@ class Router:
                 st.breaker.record_failure()
                 st.health = "unreachable"
                 req.excluded.add(st.name)
+                with self._mu:
+                    # a lost replica may restart with a blank pool
+                    self._resident.pop(st.name, None)
                 if dspan is not None:
                     dspan.set_attr("verdict", "unavailable")
                     dspan.set_status("error").end()
@@ -936,6 +1173,17 @@ class Router:
             out["replica"] = st.name
             out["failovers"] = req.failovers
             out["request_id"] = req.nonce
+            if req.migrate is not None:
+                out["migrate_s"] = req.migrate["seconds"]
+                out["migrated_pages"] = req.migrate["pages"]
+                out["prefill_replica"] = req.migrate["prefill"]
+            cap = (len(req.prompt) - 1) // self.page_size
+            if cap > 0:
+                with self._mu:
+                    # the completed request computed (or re-used)
+                    # every full prompt page on this replica
+                    self._resident.setdefault(st.name, set()).update(
+                        req.digests[:cap])
             if req.span is not None:
                 # hand the client its trace id: one GET
                 # /tracez?trace_id= on any fleet process pulls this
@@ -958,6 +1206,12 @@ class Router:
             "rebalanced": self.n_rebalanced,
             "shed": self.n_shed,
             "tenant_inflight": tenants,
+            "migrations": {
+                "completed": self.n_migrations,
+                "failed": self.n_migrate_failed,
+                "pages": self.n_pages_migrated,
+                "pages_rejected": self.n_pages_rejected,
+            },
             "replicas": {st.name: {
                 "health": st.health,
                 "breaker": st.breaker.state,
@@ -967,6 +1221,7 @@ class Router:
                 "from_membership": st.from_membership,
                 "warming": st.warming,
                 "admin_draining": st.admin_draining,
+                "role": st.role or "unified",
             } for st in states},
         }
 
@@ -1017,6 +1272,7 @@ class Router:
         scraped = self.scraper.replica_report() \
             if self.scraper is not None else {}
         replicas = {}
+        roles: Dict[str, dict] = {}
         for st in states:
             entry = {
                 "health": st.health,
@@ -1027,19 +1283,42 @@ class Router:
                 "from_membership": st.from_membership,
                 "warming": st.warming,
                 "admin_draining": st.admin_draining,
+                "role": st.role or "unified",
             }
             entry["metrics"] = scraped.pop(st.name, None)
             replicas[st.name] = entry
+            # per-role pool state: a down replica is a DOWN count, a
+            # hole in ready capacity — never a ready entry of zero
+            r = roles.setdefault(st.role or "unified", {
+                "attached": 0, "ready": 0, "warming": 0,
+                "draining": 0, "down": 0})
+            r["attached"] += 1
+            if st.warming:
+                r["warming"] += 1
+            elif st.admin_draining or st.health == "draining":
+                r["draining"] += 1
+            elif st.breaker.state == "open" or \
+                    st.health in ("unreachable", "unknown"):
+                r["down"] += 1
+            else:
+                r["ready"] += 1
         # scrapes for since-detached replicas, if any, still show
         for name, digest in scraped.items():
             replicas[name] = {"health": "detached", "metrics": digest}
         out = {
             "policy": self.policy,
             "replicas": replicas,
+            "roles": roles,
             "submitted": self.n_submitted,
             "failovers": self.n_failovers,
             "rebalanced": self.n_rebalanced,
             "shed": self.n_shed,
+            "migrations": {
+                "completed": self.n_migrations,
+                "failed": self.n_migrate_failed,
+                "pages": self.n_pages_migrated,
+                "pages_rejected": self.n_pages_rejected,
+            },
         }
         if self.scraper is not None:
             out["aggregates"] = self.scraper.aggregates()

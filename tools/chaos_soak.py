@@ -1275,6 +1275,172 @@ def fleet_soak(seed: int, workdir: str) -> dict:
     return out
 
 
+def disagg_soak(seed: int, workdir: str) -> dict:
+    """Scenario 5c (rides ``--fleet``, ISSUE 18): the disaggregated
+    prefill/decode fleet under migration-path chaos. One SPAWNED
+    prefill replica (real HTTP /kv_pages) feeds two in-process decode
+    replicas over int8 KV-page migration; asserts: the happy path is
+    token-identical to a unified reference; a seeded router.migrate
+    fault replays from the seed and falls back to local recompute
+    (token-identical, request never lost); a page corrupted in flight
+    is REJECTED by digest verification and recomputed locally
+    (token-identical); SIGKILLing the prefill replica mid-migration
+    degrades the same way; and the decode pools leak zero pages
+    through all of it."""
+    from paddle_tpu.inference import kv_transfer as kvt
+    from paddle_tpu.reliability import faults
+    from paddle_tpu.serving import (HTTPReplica, LocalReplica, Router,
+                                    make_engine_from_spec,
+                                    spawn_replica)
+
+    rng = np.random.RandomState(seed + 1)
+    faults.reset()
+    cache_dir = os.path.join(workdir, "xla_cache")
+    os.makedirs(cache_dir, exist_ok=True)
+    model = {"vocab": 97, "layers": 2, "hidden": 64, "heads": 4,
+             "max_pos": 96, "model_seed": 0}
+    engine_kw = {"page_size": 4, "num_pages": 96, "max_seqs": 4,
+                 "prefill_buckets": (32,), "seed": 0,
+                 "kv_dtype": "int8"}
+    spec = dict(model, name="pre0", role="prefill",
+                cache_dir=cache_dir, engine=dict(engine_kw))
+    proc, info = spawn_replica(spec, timeout=180)
+    import jax
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      0.0)
+    dec = [make_engine_from_spec(dict(model, engine=dict(engine_kw)))
+           for _ in range(2)]
+    ref = make_engine_from_spec(dict(model, engine=dict(engine_kw)))
+    prefill_client = HTTPReplica(info["generate"], info["healthz"],
+                                 metrics_url=info.get("metrics"))
+
+    class _TamperedPrefill:
+        """Client wrapper that sabotages export_pages: 'corrupt'
+        flips one KV byte in flight (digest verification must catch
+        it); 'kill' SIGKILLs the prefill process first (the transfer
+        must degrade to ReplicaUnavailable → local recompute)."""
+
+        def __init__(self, inner):
+            self.inner = inner
+            self.mode = None
+
+        def __getattr__(self, name):
+            return getattr(self.inner, name)
+
+        def export_pages(self, digests, trace_context=None):
+            if self.mode == "kill":
+                os.kill(proc.pid, signal.SIGKILL)
+                proc.wait(timeout=30)
+            payload = self.inner.export_pages(
+                digests, trace_context=trace_context)
+            if self.mode == "corrupt" and payload["pages"]:
+                rec = payload["pages"][1]
+                k = bytearray(kvt._unb64(rec["k"]))
+                k[0] ^= 0x40
+                rec["k"] = kvt._b64(bytes(k))
+            return payload
+
+    tampered = _TamperedPrefill(prefill_client)
+    router = Router(page_size=4, disagg_threshold_tokens=8,
+                    failover_budget=2, health_poll_interval=0.25)
+    router.attach("pre0", tampered, role="prefill")
+    router.attach("dec0", LocalReplica(dec[0]), role="decode")
+    router.attach("dec1", LocalReplica(dec[1]), role="decode")
+    out = {}
+
+    def prompt_of(n=24):
+        return rng.randint(0, 97, n).tolist()
+
+    def check_identity(p, r, temperature=0.0):
+        want = ref.submit(p, max_new_tokens=16,
+                          temperature=temperature,
+                          nonce=r["request_id"]).result(timeout=240)
+        assert want["output_ids"] == r["output_ids"], (
+            "disagg stream diverged from the unified reference: "
+            f"{want['output_ids']} != {r['output_ids']}")
+
+    try:
+        # -- phase A: happy-path migration, greedy AND seeded
+        p = prompt_of()
+        r = router.submit(p, max_new_tokens=16).result(timeout=240)
+        assert r["replica"].startswith("dec"), r
+        assert r.get("migrated_pages", 0) > 0, (
+            "long uncached prompt did not migrate: "
+            f"{router._status()['migrations']}")
+        check_identity(p, r)
+        p = prompt_of()
+        r = router.submit(p, max_new_tokens=16,
+                          temperature=0.9).result(timeout=240)
+        assert r.get("migrated_pages", 0) > 0, r
+        check_identity(p, r, temperature=0.9)
+        assert router.n_migrations == 2, router._status()
+        out["happy"] = dict(router._status()["migrations"])
+
+        # -- phase B: seeded router.migrate fault — fallback to local
+        # recompute, seed-replayable schedule, request never lost
+        faults.enable(seed=seed)
+        faults.inject("router.migrate", nth=(1,), times=1)
+        p = prompt_of()
+        r = router.submit(p, max_new_tokens=16).result(timeout=240)
+        assert "migrate_s" not in r, r
+        check_identity(p, r)
+        assert ("router.migrate", 1) in faults.injected_log(), \
+            faults.injected_log()
+        _assert_schedule_matches(faults, ("router.migrate",))
+        faults.reset()
+        assert router.n_migrate_failed == 1, router._status()
+        out["fault_fallback"] = {"failed": router.n_migrate_failed}
+
+        # -- phase C: one page corrupted in flight — digest
+        # verification rejects it, the decode replica recomputes the
+        # gap locally, the stream stays identical, nothing leaks
+        tampered.mode = "corrupt"
+        p = prompt_of()
+        r = router.submit(p, max_new_tokens=16).result(timeout=240)
+        tampered.mode = None
+        assert r.get("migrated_pages", 5) < 5, (
+            "corrupt page was not rejected: "
+            f"{router._status()['migrations']}")
+        assert router.n_pages_rejected >= 1, router._status()
+        check_identity(p, r)
+        out["corruption"] = {
+            "rejected": router.n_pages_rejected,
+            "installed": r.get("migrated_pages")}
+
+        # -- phase D: prefill replica SIGKILLed mid-migration — the
+        # pull fails, the request falls back and completes locally
+        tampered.mode = "kill"
+        p = prompt_of()
+        r = router.submit(p, max_new_tokens=16).result(timeout=240)
+        assert "migrate_s" not in r, r
+        check_identity(p, r)
+        assert router.n_migrate_failed == 2, router._status()
+        out["kill"] = {"failed": router.n_migrate_failed}
+
+        # -- leak audit: idle decode pools must account for every
+        # page (free + shared residents + the scratch page)
+        for eng in dec:
+            free = len(eng._free_pages)
+            shared = eng._cache.shared_page_count
+            assert free + shared + 1 == eng.num_pages, (
+                f"page leak: free={free} shared={shared} "
+                f"of {eng.num_pages}")
+        out["pages_leaked"] = 0
+    finally:
+        faults.reset()
+        router.close()
+        for eng in dec + [ref]:
+            eng.close()
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+    for eng in dec:
+        assert len(eng._free_pages) == eng.num_pages - 1, (
+            "decode pool did not return to full size at close")
+    return out
+
+
 def autoscale_soak(seed: int, workdir: str) -> dict:
     """Scenario 5b (``--autoscale``, ISSUE 13): the SLO-driven
     autoscaler over a LIVE subprocess fleet. Asserts the acceptance
@@ -2086,6 +2252,11 @@ def main(argv=None) -> int:
     try:
         if args.fleet:
             out["fleet"] = fleet_soak(seed, workdir)
+            # ISSUE 18: the disaggregated prefill/decode fleet under
+            # migration chaos (corrupt page in flight, prefill killed
+            # mid-pull, seeded router.migrate fault) — every mode
+            # falls back to token-identical local recompute
+            out["disagg"] = disagg_soak(seed, workdir)
         elif args.autoscale:
             out["autoscale"] = autoscale_soak(seed, workdir)
         elif args.train:

@@ -92,7 +92,11 @@ echo "== fleet chaos soak (K=3 replicas, SIGKILL mid-decode -> failover)"
 # zero requests (token-identical failover), the breaker walks
 # open -> half-open -> closed across a respawn; /fleetz aggregates the
 # fleet and a deadline-miss storm moves /sloz burn rates + latches the
-# breach; failures attach a merged cross-process trace
+# breach; failures attach a merged cross-process trace. Then the
+# disagg phase: a prefill-pool replica feeds two decode replicas via
+# KV-page migration — a SIGKILLed prefill replica and a corrupted
+# in-flight page both degrade to local recompute (token-identical,
+# zero pages leaked)
 python tools/chaos_soak.py --ci --fleet
 
 echo "== autoscale chaos soak (SLO-driven scale-out/in over a live fleet)"
@@ -138,6 +142,15 @@ echo "== fleet serving bench (prefix-affinity vs round-robin at K=3)"
 # asserts aggregate prefix-cache hit rate with affinity routing is
 # >= 1.5x round-robin on the shared-prefix workload
 python tools/llm_bench.py --ci --fleet
+
+echo "== disaggregated prefill/decode bench (unified K=3 vs 1P/2D)"
+# mixed storm on int8 pools: long uncached prompts migrate as
+# digest-verified KV-page runs to the decode pool — short-request
+# TTFT p99 must improve at equal aggregate slots, a single-replica
+# probe's p99 inter-token gap must be strictly lower with imported
+# pages than with local prefills, and generations stay
+# token-identical across fleets and probe passes
+python tools/llm_bench.py --ci --fleet --disagg
 
 echo "== fused train-loop parity smoke (K=1 vs K=4 bit-identical)"
 python tools/train_loop_smoke.py
