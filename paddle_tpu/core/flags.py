@@ -271,7 +271,8 @@ define_flag("goodput_observability", True,
             "Arm the wall-clock time ledger (observability/goodput.py):"
             " hot paths attribute every second since arming to one "
             "bucket (productive / compile / input_wait / ckpt_stall / "
-            "recovery / queue_wait, plus derived host_gap and an "
+            "recovery / migration / audit / queue_wait, plus derived "
+            "host_gap and an "
             "explicit unattributed residual) -> GET /goodputz, "
             "goodput_fraction / badput_seconds_total{cause} gauges, "
             "SLO-trip watermark forensics, fleet_goodput_fraction "
@@ -279,3 +280,32 @@ define_flag("goodput_observability", True,
             "check and records nothing (pinned like tracing/perf/mem; "
             "read at import — flip at runtime with "
             "observability.goodput.enable()/disable()).")
+define_flag("stream_audit", True,
+            "Arm the stream-integrity auditor (observability/audit.py):"
+            " every request carries a rolling blake2b chain over "
+            "(nonce, position, token_id) extended at the engine's "
+            "drain boundary and returned as stream_digest; the fleet "
+            "router verifies chains wherever token identity is "
+            "claimed (nonce-pinned failover/device-retry, migrated-"
+            "page decodes, sampled shadow re-executions) -> GET "
+            "/driftz, drift_verified_total / "
+            "drift_divergence_total{kind} counters (never-armed "
+            "process exports neither — federation reads the absence "
+            "as a HOLE), one-shot stream_divergence flight dumps. "
+            "Off: the drain path pays one module-flag check per "
+            "token and nothing else (pinned like tracing/perf/mem/"
+            "goodput; flip at runtime with "
+            "observability.audit.enable()/disable()).")
+define_flag("audit_shadow_rate", 0.0,
+            "Sampled SHADOW RE-EXECUTION rate for the stream auditor "
+            "(0.0-1.0): the fraction of verified router requests "
+            "re-executed off-path on the SAME replica under the SAME "
+            "nonce, chain diffed against the served stream "
+            "(drift_divergence_total{kind=shadow} on mismatch, with "
+            "the first divergent position). Sampling is a "
+            "deterministic hash of the request nonce, so a replayed "
+            "seed shadows the same requests. The shadow re-spends "
+            "the request's device time — its seconds land in the "
+            "'audit' badput bucket; see docs/OBSERVABILITY.md "
+            "('Stream integrity') for costing guidance. 0 disables "
+            "shadows (chain checks still run).", flag_type=float)

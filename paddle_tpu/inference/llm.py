@@ -33,6 +33,7 @@ keeps every gather/scatter shape static with no conditionals.
 
 from __future__ import annotations
 
+import hashlib
 import threading
 import time
 import weakref
@@ -46,6 +47,7 @@ import numpy as np
 
 from ..core import flags as _flags
 from ..nn.layer import Layer, functional_call, split_state
+from ..observability import audit as _audit
 from ..observability import goodput as _goodput
 from ..observability import memory as _memobs
 from ..observability import metrics as _obs
@@ -827,7 +829,7 @@ class _Request:
                  "n_cached", "n_reg_pages", "spans", "deadline",
                  "priority", "req_id", "admit_attempts",
                  "device_retries", "cancelled", "queued", "t_enqueued",
-                 "tenant")
+                 "tenant", "chain", "prior_chain", "prior_tokens")
 
     def __init__(self, prompt, max_new_tokens, temperature):
         self.prompt = list(map(int, prompt))
@@ -882,6 +884,14 @@ class _Request:
         self.t_enqueued = self.t_submit
         # served-FLOPs attribution label (router/serve_llm passthrough)
         self.tenant: Optional[str] = None
+        # stream-integrity chain (observability/audit.py): the rolling
+        # blake2b head over (nonce, position, token) extended at the
+        # drain boundary; prior_* snapshot the pre-device-retry stream
+        # so the nonce-pinned re-execution can be verified to extend
+        # the EXACT prefix the failed incarnation emitted
+        self.chain = b""
+        self.prior_chain: Optional[bytes] = None
+        self.prior_tokens: Optional[List[int]] = None
 
 
 def _engine_memory_provider(ref):
@@ -1488,6 +1498,30 @@ class LLMEngine:
 
         self._slab_fn = jax.jit(slab_fn, static_argnums=(7,),
                                 donate_argnums=(2,))
+
+        # ENGINE KNOB FINGERPRINT (stream auditor): the compact,
+        # deterministic identity of every knob that must match across
+        # siblings for "token-identical" to hold — kv_dtype, the
+        # speculative config, and a hash of the draft model's config
+        # + parameter tree structure. Host-side metadata only (no
+        # device sync); carried in result dicts / the X-Engine-Knobs
+        # header so the router DETECTS a mismatched sibling instead
+        # of documenting the hazard (docs/RELIABILITY.md).
+        draft_hash = None
+        if draft_net is not None:
+            fh = hashlib.blake2b(digest_size=8)
+            fh.update(repr(draft_net.cfg).encode())
+            fh.update(str(int(spec_tokens)).encode())
+            for leaf in jax.tree_util.tree_leaves(self._draft_params):
+                fh.update(str(getattr(leaf, "shape", ())).encode())
+                fh.update(str(getattr(leaf, "dtype", "")).encode())
+            draft_hash = fh.hexdigest()
+        self.knob_fingerprint = {
+            "kv_dtype": self.kv_dtype, "spec_k": self.spec_k,
+            "spec_slab": bool(self.spec_slab), "draft": draft_hash}
+        # scope the drift table files this engine's verdicts under
+        # (replica_main overrides it with the replica's fleet name)
+        self.audit_scope = "engine"
 
         if self.spec_k and not self.spec_slab:
             # LEGACY speculative engines keep the inline one-shot
@@ -2320,7 +2354,7 @@ class LLMEngine:
                 req.spans["root"].set_attr("tenant", req.tenant)
         self._end_request_spans(
             req, "truncated" if req.truncated else "completed")
-        req.future.set_result({
+        out = {
             "prompt_ids": req.prompt,
             "output_ids": req.tokens,
             "truncated": req.truncated,
@@ -2328,7 +2362,32 @@ class LLMEngine:
             "ttft_s": (req.t_first - req.t_submit)
             if req.t_first else None,
             "latency_s": req.t_done - req.t_submit,
-        })
+        }
+        if _audit.enabled():
+            # device-retry prefix verification: the nonce-pinned
+            # re-execution must have re-emitted the EXACT chain
+            # prefix the failed incarnation delivered — the first
+            # divergent link names the first wrong token
+            if req.prior_tokens is not None:
+                p = len(req.prior_tokens)
+                pos = _audit.first_divergence(req.prior_tokens,
+                                              req.tokens[:p])
+                _audit.record(
+                    self.audit_scope, "failover", pos is None,
+                    position=pos,
+                    chain_ours=_audit.chain_of(
+                        req.nonce, req.tokens[:p]),
+                    chain_theirs=req.prior_chain,
+                    request_id=req.req_id, nonce=req.nonce,
+                    knobs_ours=self.knob_fingerprint,
+                    knobs_theirs=self.knob_fingerprint,
+                    detail=f"device-retry prefix "
+                           f"({req.device_retries} retry/ies, "
+                           f"{p} prior token(s))")
+            out["stream_digest"] = req.chain.hex()
+            out["nonce"] = req.nonce
+            out["knobs"] = self.knob_fingerprint
+        req.future.set_result(out)
 
     def _begin_close(self, slot: int, accept_inflight: bool = False):
         """Stop issuing for this slot; pages stay held (in-flight steps
@@ -3072,10 +3131,19 @@ class LLMEngine:
             return False
         req.device_retries += 1
         self._m["device_retries"].inc()
+        # stream-integrity snapshot BEFORE the reset: the retry runs
+        # under the same nonce, so it must re-emit this exact prefix —
+        # _finish diffs the regenerated stream against it and files
+        # the verdict as drift kind "failover" (the device-retry leg
+        # of the nonce-pinned identity claim)
+        if _audit.enabled() and req.tokens:
+            req.prior_tokens = req.tokens
+            req.prior_chain = req.chain
         # reset generation state for a from-scratch re-admission; the
         # prompt hashes (digests) are kept — a retry may still hit the
         # prefix cache once it repopulates
         req.tokens = []
+        req.chain = b""
         req.slot = -1
         req.truncated = False
         req.t_first = None
@@ -3684,7 +3752,23 @@ class LLMEngine:
         first, span bookkeeping, EOS acceptance, length harvest.
         Shared by the per-tick and fused-slab drains so their
         emission semantics cannot drift."""
+        if _faults.enabled():
+            # audit.flip: corrupt THIS emitted token (seeded,
+            # replayable) — the corruption lands before the chain
+            # extension, so the corrupted stream is self-consistent
+            # and only a chain-vs-chain check (device-retry prefix,
+            # migration parity, shadow re-execution) can catch it,
+            # exactly like a real divergent replica
+            try:
+                _faults.check("audit.flip")
+            except _faults.FaultInjected:
+                tok = int(tok) ^ 1
         req.tokens.append(tok)
+        if _audit.enabled():
+            # one blake2b over host ints — the token is already
+            # fetched, so the chain costs zero extra device syncs
+            req.chain = _audit.extend(req.chain, req.nonce,
+                                      len(req.tokens) - 1, tok)
         self.n_tokens += 1
         if req.t_first is None:
             # async first token (chunked or inline prefill): admission
@@ -4012,6 +4096,12 @@ class LLMEngine:
             req = self._slots[slot]
             for tok in list(d[1:i + 1]) + [int(g[i])]:
                 req.tokens.append(int(tok))
+                if _audit.enabled():
+                    # legacy inline spec emits accepted runs here,
+                    # not through _deliver_token — same chain rule
+                    req.chain = _audit.extend(req.chain, req.nonce,
+                                              len(req.tokens) - 1,
+                                              int(tok))
                 self.n_tokens += 1
                 emitted += 1
                 if self._harvest(slot):
@@ -4180,6 +4270,19 @@ def serve_llm(engine, host: str = "127.0.0.1", port: int = 0):
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(payload)))
+            # stream-integrity contract: a generate response carries
+            # its chain head + the serving engine's knob fingerprint
+            # as headers too, so a caller can verify/compare without
+            # parsing the body (router-fronted responses relay the
+            # SERVING replica's values — they ride the result dict)
+            if code == 200 and isinstance(out, dict):
+                if out.get("stream_digest") is not None:
+                    self.send_header("X-Stream-Digest",
+                                     str(out["stream_digest"]))
+                if out.get("knobs"):
+                    self.send_header("X-Engine-Knobs",
+                                     json.dumps(out["knobs"],
+                                                sort_keys=True))
             self.end_headers()
             self.wfile.write(payload)
 

@@ -43,6 +43,7 @@ from .metrics import (BYTE_BUCKETS, DEFAULT_BUCKETS,  # noqa: F401
 from .exporters import (JSONLReporter, export_chrome_tracing,  # noqa: F401
                         prometheus_text, sample_device_memory,
                         write_prometheus)
+from . import audit  # noqa: F401
 from . import goodput  # noqa: F401
 from . import memory  # noqa: F401
 from . import perf  # noqa: F401

@@ -237,10 +237,15 @@ def get_flight_recorder() -> Optional[FlightRecorder]:
 
 
 def dump_flight_record(reason: str,
-                       extra: Optional[dict] = None) -> Optional[str]:
+                       extra: Optional[dict] = None,
+                       dedupe: bool = False) -> Optional[str]:
     """Dump through the installed recorder; harmless no-op when none
-    is installed (the elastic hook calls this unconditionally)."""
+    is installed (the elastic hook calls this unconditionally).
+    ``dedupe=True`` makes the dump one-shot per reason per process —
+    the near-OOM / stream-divergence forensics discipline (the first
+    incident is the interesting one; a divergence storm must not
+    grind the process writing dumps)."""
     rec = _installed
     if rec is None:
         return None
-    return rec.dump(reason, extra=extra)
+    return rec.dump(reason, extra=extra, dedupe=dedupe)

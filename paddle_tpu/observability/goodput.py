@@ -16,6 +16,13 @@ attributes every second since arming to exactly one bucket:
   device→host snapshot plus the emergency-flush barrier window;
 - ``recovery`` — RetryPolicy backoff sleeps, engine device-retry
   re-admissions, elastic restart backoff: time spent limping;
+- ``migration`` — disaggregated-fleet KV-page migration wall time
+  (prefill fill + export + verified import, success or fallback):
+  seconds a request spent waiting on a page transfer instead of
+  decoding;
+- ``audit`` — stream-integrity shadow re-executions
+  (``FLAGS.audit_shadow_rate``): the wall cost of proving the fleet's
+  determinism in production;
 - ``queue_wait`` — llm admission queue residency (wall-clock coverage,
   not per-request sums — see "tolerance" below);
 - ``host_gap`` — short uncovered gaps between attributed intervals
@@ -33,10 +40,15 @@ exact interval sweep: overlapping same-bucket intervals UNION (ten
 queued requests over one second are one second of queue_wait, not
 ten); cross-bucket overlap resolves by documented precedence —
 ``productive > compile > ckpt_stall > input_wait > recovery >
-queue_wait > host_gap`` (the device owning the second is the
-strongest claim; a queued request overlaps nearly everything, so its
-claim is nearly the weakest; a directly-noted drain sync yields to
-all). Every second is counted exactly once, by exactly one bucket.
+migration > audit > queue_wait > host_gap`` (the device owning the
+second is the strongest claim; migration — cross-replica KV-page
+transfer wall time — and audit — shadow re-execution wall time —
+beat queue_wait because their seconds have a NAMED cause, and a
+fleet drowning in page transfers or determinism proofs must not
+masquerade as queueing; a queued request overlaps nearly everything,
+so its claim is nearly the weakest; a directly-noted drain sync
+yields to all). Every second is counted exactly once, by exactly one
+bucket.
 
 TOLERANCE vs the histograms. Bucket totals are wall-clock coverage;
 the existing histograms (``train_loop_dispatch_seconds``,
@@ -79,8 +91,8 @@ from .metrics import default_registry
 # sync — a known host-overhead window — notes it directly, with the
 # weakest claim) and derived (short uncovered gaps classify into it)
 BUCKETS: Tuple[str, ...] = ("productive", "compile", "ckpt_stall",
-                            "input_wait", "recovery", "queue_wait",
-                            "host_gap")
+                            "input_wait", "recovery", "migration",
+                            "audit", "queue_wait", "host_gap")
 # derived only from uncovered timeline segments — the closing line
 DERIVED: Tuple[str, ...] = ("unattributed",)
 # every cause badput_seconds_total{cause=} exports (all but productive)

@@ -116,6 +116,12 @@ _slo_providers: Dict[str, Callable[[], Optional[dict]]] = {}
 # — no autoscaler runs in this process.
 _scale_providers: Dict[str, Callable[[], Optional[dict]]] = {}
 
+# name → callable returning the /driftz JSON payload (stream-integrity
+# chain tables: verified/diverged counts + last divergence per scope).
+# The audit module self-registers at first record; 404 when empty —
+# nothing in this process has audited a stream yet (hole, not zero).
+_drift_providers: Dict[str, Callable[[], Optional[dict]]] = {}
+
 _server: Optional["DebugServer"] = None
 _server_mu = threading.Lock()
 
@@ -195,6 +201,17 @@ def register_scale_provider(name: str,
 def unregister_scale_provider(name: str) -> None:
     with _providers_mu:
         _scale_providers.pop(name, None)
+
+
+def register_drift_provider(name: str,
+                            fn: Callable[[], Optional[dict]]) -> None:
+    with _providers_mu:
+        _drift_providers[name] = fn
+
+
+def unregister_drift_provider(name: str) -> None:
+    with _providers_mu:
+        _drift_providers.pop(name, None)
 
 
 def _collect_dict_providers(table: Dict[str, Callable[[], Optional[dict]]]
@@ -552,6 +569,15 @@ class DebugServer:
                              "registers one)"})
             else:
                 h._reply_json(200, {"autoscalers": scalers})
+        elif url.path == "/driftz":
+            drift = _collect_dict_providers(_drift_providers)
+            if not drift:
+                h._reply_json(404, {
+                    "error": "no stream auditor armed in this "
+                             "process (observability.audit "
+                             "registers at first record)"})
+            else:
+                h._reply_json(200, {"drift": drift})
         elif url.path == "/profilez":
             h._reply_json(200, {"armed": self._arm.status()})
         else:
@@ -560,7 +586,7 @@ class DebugServer:
                 "endpoints": ["/metrics", "/healthz", "/statusz",
                               "/tracez", "/perfz", "/memz",
                               "/goodputz", "/fleetz", "/sloz",
-                              "/scalez", "POST /profilez",
+                              "/scalez", "/driftz", "POST /profilez",
                               "POST /reset_health"]})
 
     def _post(self, h) -> None:

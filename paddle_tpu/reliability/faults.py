@@ -76,6 +76,17 @@ Named sites (the catalog; see docs/RELIABILITY.md):
                           loss AND grads non-finite on schedule
                           without retracing (requires the numeric
                           guard armed; see reliability/guard.py)
+``audit.flip``            engine: one token about to be delivered —
+                          injection XOR-flips its low bit BEFORE the
+                          stream's digest chain extends over it, so
+                          the corrupted stream is SELF-consistent
+                          (its own chain matches its own tokens) and
+                          only a chain-vs-chain check — device-retry
+                          prefix, migration parity, or a shadow
+                          re-execution — can catch it: the model of
+                          a silently divergent replica (requires the
+                          stream auditor armed; see
+                          observability/audit.py)
 ========================  ==================================================
 
 Stdlib-only by design: any module may import this without cycles.
@@ -110,6 +121,7 @@ SITES = (
     "replica.crash",
     "data.poison",
     "grad.nonfinite",
+    "audit.flip",
 )
 
 

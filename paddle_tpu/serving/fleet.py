@@ -154,13 +154,15 @@ class FleetScraper:
                         "llm_prompt_tokens", "llm_tokens_generated",
                         "llm_requests_completed", "perf_mfu",
                         "perf_flops_per_second", "mem_headroom_pages",
-                        "goodput_fraction")
+                        "goodput_fraction", "drift_verified_total",
+                        "drift_divergence_total")
 
     def __init__(self, registry: Optional[MetricRegistry] = None,
                  federate_prefixes: Tuple[str, ...] = ("llm_", "perf_",
                                                        "mem_",
                                                        "badput_",
-                                                       "kv_migrate_"),
+                                                       "kv_migrate_",
+                                                       "drift_"),
                  stale_after: float = 10.0):
         # NOTE: per-replica badput CAUSES federate
         # (fleet_badput_seconds_total{replica=,cause=}); the replica's
@@ -242,6 +244,26 @@ class FleetScraper:
             "fleet_goodput_fraction mean at the last scrape (the "
             "auditable hole-semantics denominator, like "
             "fleet_mfu_replicas)")
+        self._g_drift_ok = reg.gauge(
+            "fleet_drift_verified",
+            "stream-integrity checks that confirmed chain identity, "
+            "summed across UP replicas that export drift_* — a down "
+            "or never-armed replica is a HOLE in the sum, never a "
+            "zero (its streams went unverified, not verified-clean); "
+            "0 with fleet_drift_replicas=0 means no replica has "
+            "armed its auditor yet")
+        self._g_drift_bad = reg.gauge(
+            "fleet_drift_divergences",
+            "stream-integrity divergences summed across UP replicas "
+            "that export drift_* (same hole semantics as "
+            "fleet_drift_verified). ANY nonzero value is a fleet "
+            "determinism incident — per-kind detail federates as "
+            "fleet_drift_divergence_total{replica=,kind=}")
+        self._g_drift_n = reg.gauge(
+            "fleet_drift_replicas",
+            "replicas whose drift_* counters entered the fleet_drift_"
+            "sums at the last scrape (the auditable hole-semantics "
+            "denominator, like fleet_mfu_replicas)")
 
     # -- ingestion ------------------------------------------------------
     @staticmethod
@@ -316,6 +338,7 @@ class FleetScraper:
         up = self._snapshot_up()
         occ, kv, mfu, headroom, goodput = [], [], [], [], []
         hit_tok = prompt_tok = tokens = completed = fps = 0.0
+        drift_ok, drift_bad = [], []
         for st in up.values():
             fams = st["families"]
             # perf federation: only replicas that EXPORT perf_mfu
@@ -339,6 +362,21 @@ class FleetScraper:
                                "goodput_fraction")
             if gp is not None:
                 goodput.append(gp)
+            # drift federation, same hole semantics: a replica that
+            # never armed its stream auditor (the counters mint at
+            # FIRST record) exports no drift_* family at all and
+            # stays out of both sums and the denominator — an
+            # unverified fleet must read as unverified, not clean.
+            # drift_divergence_total is {kind}-labeled: sum every
+            # sample of the family, not just the first.
+            dv = _series_value(fams.get("drift_verified_total"),
+                               "drift_verified_total")
+            if dv is not None:
+                drift_ok.append(dv)
+                bad_fam = fams.get("drift_divergence_total")
+                drift_bad.append(sum(
+                    value for _n, _l, value
+                    in (bad_fam["samples"] if bad_fam else [])))
             fps += _series_value(fams.get("perf_flops_per_second"),
                                  "perf_flops_per_second") or 0.0
             o_sum = _series_value(fams.get("llm_batch_occupancy"),
@@ -379,6 +417,9 @@ class FleetScraper:
             "goodput_fraction": (sum(goodput) / len(goodput))
             if goodput else None,
             "goodput_replicas": len(goodput),
+            "drift_verified": sum(drift_ok) if drift_ok else None,
+            "drift_divergences": sum(drift_bad) if drift_ok else None,
+            "drift_replicas": len(drift_ok),
         }
         self._g_scraped.set(agg["replicas_scraped"])
         self._g_occ.set(agg["occupancy"])
@@ -393,6 +434,9 @@ class FleetScraper:
         self._g_headroom_n.set(agg["mem_headroom_replicas"])
         self._g_goodput.set(agg["goodput_fraction"] or 0.0)
         self._g_goodput_n.set(agg["goodput_replicas"])
+        self._g_drift_ok.set(agg["drift_verified"] or 0.0)
+        self._g_drift_bad.set(agg["drift_divergences"] or 0.0)
+        self._g_drift_n.set(agg["drift_replicas"])
         return agg
 
     def aggregates(self) -> dict:

@@ -380,6 +380,9 @@ def replica_main(spec: dict) -> int:
             interval=float(spec.get("jsonl_interval", 2.0)))
     _arm_faults(spec)
     eng = make_engine_from_spec(spec)
+    # drift verdicts this engine records (device-retry prefix checks)
+    # key the /driftz table by the replica's fleet name, not "engine"
+    eng.audit_scope = name
     srv = serve_llm(eng)
     host, port = srv.server_address[:2]
     dbg = debug.start_debug_server()
@@ -388,6 +391,7 @@ def replica_main(spec: dict) -> int:
             "healthz": f"{dbg.address}/healthz",
             "metrics": f"{dbg.address}/metrics",
             "tracez": f"{dbg.address}/tracez",
+            "driftz": f"{dbg.address}/driftz",
             "pid": os.getpid()}
     if spec.get("role"):
         # disaggregated pool membership ("prefill" / "decode"): rides

@@ -54,6 +54,7 @@ from typing import Dict, Optional
 from ..inference.llm import (AdmissionShed, EngineClosed,
                              RequestCancelled)
 from ..inference.prefix_cache import page_digests
+from ..observability import audit as _audit
 from ..observability import goodput as _goodput
 from ..observability import metrics as _obs
 from ..observability import propagation as _propagation
@@ -228,7 +229,8 @@ class _FleetRequest:
                  "priority", "tenant", "nonce", "future", "cancelled",
                  "span", "excluded", "t_submit", "failovers",
                  "affinity_key", "quota_held", "rr_slot", "slo_name",
-                 "had_deadline", "last_dispatch", "digests", "migrate")
+                 "had_deadline", "last_dispatch", "digests", "migrate",
+                 "prior_knobs")
 
     def __init__(self, prompt, max_new_tokens, temperature):
         self.prompt = list(map(int, prompt))
@@ -257,8 +259,13 @@ class _FleetRequest:
         # submit); drives both affinity and KV-page migration
         self.digests = []
         # result of a completed migration for this request, attached
-        # to the final result dict ({"seconds", "pages", "prefill"})
+        # to the final result dict ({"seconds", "pages", "prefill"},
+        # plus the fill's token-0 witness for chain verification)
         self.migrate = None
+        # knob fingerprint of the replica a failed attempt ran on
+        # (last known) — a failover sibling serving under DIFFERENT
+        # knobs is a detected drift, not a documented hazard
+        self.prior_knobs = None
 
 
 class Router:
@@ -294,7 +301,7 @@ class Router:
                  max_workers: int = 32,
                  scrape_metrics: bool = True,
                  federate_prefixes=("llm_", "perf_", "mem_",
-                                    "badput_", "kv_migrate_"),
+                                    "badput_", "kv_migrate_", "drift_"),
                  disagg_threshold_tokens: Optional[int] = None,
                  slo_windows=DEFAULT_WINDOWS,
                  slo_default_target: float = 0.99,
@@ -352,6 +359,12 @@ class Router:
         self.n_migrate_failed = 0
         self.n_pages_migrated = 0
         self.n_pages_rejected = 0
+        # -- stream-integrity auditor state --
+        # last-known engine knob fingerprint per replica (updated on
+        # every verified completion): failover verification compares
+        # the recovering sibling's knobs against the failed one's
+        self._knobs: Dict[str, dict] = {}
+        self.n_shadows = 0
         # optimistic per-replica digest residency: updated on every
         # completion/migration, dropped when the replica goes
         # unreachable (it may restart blank). Wrong-in-either-
@@ -816,7 +829,7 @@ class Router:
             # 1. fill: one-token generate on the prefill replica
             # under the request's own nonce — its pages are the exact
             # pages the decode replica would have computed
-            pst.client.submit(
+            fill = pst.client.submit(
                 req.prompt, max_new_tokens=1,
                 temperature=req.temperature,
                 deadline_s=(req.deadline.remaining()
@@ -845,13 +858,22 @@ class Router:
                     digs[:imported + dups])
             if _goodput.enabled():
                 # migration wall time is time this request spent
-                # waiting to start decoding — queue-side badput, not
-                # generate time
-                _goodput.note("queue_wait", dt)
+                # waiting to start decoding — its own badput bucket
+                # (not folded into queue_wait: a fleet drowning in
+                # page transfers must not masquerade as queueing)
+                _goodput.note("migration", dt)
             req.migrate = {"seconds": dt, "pages": imported,
                            "duplicates": dups,
                            "rejected": len(rejected),
                            "prefill": pst.name}
+            # the fill's token-0 witness: the prefill replica decoded
+            # one token under the request's own nonce, so its digest
+            # must be the exact chain the decode replica's stream
+            # starts with — checked in _verify_stream at resolution
+            if isinstance(fill, dict) and fill.get("output_ids"):
+                req.migrate["fill_token"] = int(fill["output_ids"][0])
+                req.migrate["fill_digest"] = fill.get("stream_digest")
+                req.migrate["fill_knobs"] = fill.get("knobs")
             if mspan is not None:
                 mspan.set_attr("pages", imported)
                 mspan.set_attr("duplicates", dups)
@@ -870,7 +892,7 @@ class Router:
                 self.n_migrate_failed += 1
             self._m["migrate_failed"].inc()
             if _goodput.enabled():
-                _goodput.note("queue_wait", time.monotonic() - t0)
+                _goodput.note("migration", time.monotonic() - t0)
             if mspan is not None:
                 mspan.set_attr("fallback", "local_recompute")
                 mspan.set_status("error") \
@@ -1121,7 +1143,13 @@ class Router:
                 continue
             except (ReplicaUnavailable, _faults.FaultInjected) as e:
                 # the crash path: charge the breaker, fail over with
-                # the SAME nonce while budget remains
+                # the SAME nonce while budget remains. Remember the
+                # failed replica's last-known knob fingerprint — the
+                # recovering sibling must be serving under the SAME
+                # engine configuration or the retried stream cannot
+                # be the stream the failed attempt was emitting
+                if _audit.enabled():
+                    req.prior_knobs = self._knobs.get(st.name)
                 st.breaker.record_failure()
                 st.health = "unreachable"
                 req.excluded.add(st.name)
@@ -1189,8 +1217,124 @@ class Router:
                 # /tracez?trace_id= on any fleet process pulls this
                 # request's spans
                 out["trace_id"] = req.span.trace_id
+            if _audit.enabled():
+                self._verify_stream(req, st, out)
             self._resolve(req, result=out)
             return
+
+    # -- stream-integrity verification --------------------------------------
+    def _verify_stream(self, req: _FleetRequest, st, out: dict) -> None:
+        """Check every identity claim this resolution makes. The chain
+        (``out["stream_digest"]``, folded over (nonce, position, token)
+        by the replica's engine) is the witness:
+
+        - ALWAYS: recompute the chain from the returned tokens under
+          the request's pinned nonce; a mismatch means the stream and
+          its digest disagree (corruption between engine and router).
+          Counted under the claim being made (failover / migration) —
+          or silently trusted when no claim is in play, because an
+          unclaimed stream has no reference to diverge FROM; shadows
+          provide that reference at ``audit_shadow_rate``.
+        - failover (``req.failovers > 0``): the recovering sibling
+          must also serve under the SAME engine-knob fingerprint as
+          the replica that failed — a mismatched kv_dtype / draft
+          sibling is a DETECTED divergence, not a doc caveat.
+        - migration (``req.migrate`` carries a fill witness): the
+          prefill's one-token fill ran under this request's nonce, so
+          its digest IS the expected chain at position 0; the decode
+          stream must extend it exactly.
+        - shadow: at the sampled rate, re-execute OFF-PATH on the
+          same replica under the same nonce and diff link by link.
+
+        Never raises — a verification failure is a recorded verdict,
+        not a request failure (the tokens already resolved)."""
+        try:
+            tokens = out.get("output_ids") or []
+            digest_hex = out.get("stream_digest")
+            knobs = out.get("knobs")
+            if digest_hex is None:
+                return              # replica predates the auditor
+            claimed = bytes.fromhex(digest_hex)
+            expected = _audit.chain_of(req.nonce, tokens)
+            intact = claimed == expected
+            with self._mu:
+                if knobs is not None:
+                    self._knobs[st.name] = knobs
+            if req.failovers > 0:
+                knob_ok = (req.prior_knobs is None
+                           or req.prior_knobs == knobs)
+                _audit.record(
+                    self.name, "failover", intact and knob_ok,
+                    position=None if intact else len(tokens),
+                    chain_ours=expected, chain_theirs=claimed,
+                    request_id=req.nonce, nonce=req.nonce,
+                    knobs_ours=knobs, knobs_theirs=req.prior_knobs,
+                    detail=(f"nonce-pinned failover to {st.name} "
+                            f"after {req.failovers} failover(s): "
+                            + ("chain intact" if intact else
+                               "returned digest does not match the "
+                               "returned tokens")
+                            + ("" if knob_ok else
+                               "; engine knob fingerprint differs "
+                               "from the failed sibling's")))
+            mig = req.migrate
+            if mig is not None and mig.get("fill_digest") and tokens:
+                fill_chain = bytes.fromhex(mig["fill_digest"])
+                ok = _audit.verify_prefix(req.nonce, tokens,
+                                          fill_chain, 1)
+                _audit.record(
+                    self.name, "migration", ok,
+                    position=None if ok else 0,
+                    chain_ours=_audit.chain_of(req.nonce, tokens[:1]),
+                    chain_theirs=fill_chain,
+                    request_id=req.nonce, nonce=req.nonce,
+                    knobs_ours=knobs,
+                    knobs_theirs=mig.get("fill_knobs"),
+                    detail=(f"migrated-pages decode on {st.name} vs "
+                            f"prefill fill on {mig['prefill']}: "
+                            "the decode stream must extend the "
+                            "fill's position-0 chain"))
+            if _audit.sampled(req.nonce, _audit.shadow_rate()):
+                # off-path: the caller's future resolves regardless;
+                # the shadow rides the dispatch pool
+                self.n_shadows += 1
+                self._pool.submit(self._shadow, req, st, dict(out))
+        except Exception:  # noqa: BLE001 — auditing must never
+            pass           # turn a served request into a failure
+
+    def _shadow(self, req: _FleetRequest, st, out: dict) -> None:
+        """Sampled shadow re-execution: re-run the request on the SAME
+        replica under the SAME nonce, directly against its client (not
+        :meth:`submit` — a shadow must not be re-shadowed, shed, or
+        failed over), and diff the chains link by link. The wall time
+        lands in the ``audit`` badput bucket — determinism proof is a
+        cost the goodput ledger must own, not hide."""
+        t0 = time.monotonic()
+        try:
+            ref = st.client.submit(
+                req.prompt, max_new_tokens=req.max_new_tokens,
+                temperature=req.temperature, nonce=req.nonce)
+            tokens = out.get("output_ids") or []
+            ref_tokens = ref.get("output_ids") or []
+            pos = _audit.first_divergence(tokens, ref_tokens)
+            ours = out.get("stream_digest")
+            theirs = ref.get("stream_digest")
+            _audit.record(
+                self.name, "shadow", pos is None and ours == theirs,
+                position=pos,
+                chain_ours=(bytes.fromhex(ours) if ours else None),
+                chain_theirs=(bytes.fromhex(theirs) if theirs
+                              else None),
+                request_id=req.nonce, nonce=req.nonce,
+                knobs_ours=out.get("knobs"),
+                knobs_theirs=ref.get("knobs"),
+                detail=(f"shadow re-execution on {st.name}: same "
+                        f"replica, same nonce, chain-vs-chain"))
+        except Exception:  # noqa: BLE001 — a failed shadow is a
+            pass           # missed sample, never an incident
+        finally:
+            if _goodput.enabled():
+                _goodput.note("audit", time.monotonic() - t0)
 
     # -- observability surfaces ---------------------------------------------
     def _status(self) -> Optional[dict]:
@@ -1212,6 +1356,8 @@ class Router:
                 "pages": self.n_pages_migrated,
                 "pages_rejected": self.n_pages_rejected,
             },
+            "drift": dict(_audit.instance().counts(),
+                          shadows=self.n_shadows),
             "replicas": {st.name: {
                 "health": st.health,
                 "breaker": st.breaker.state,
@@ -1319,6 +1465,8 @@ class Router:
                 "pages": self.n_pages_migrated,
                 "pages_rejected": self.n_pages_rejected,
             },
+            "drift": dict(_audit.instance().counts(),
+                          shadows=self.n_shadows),
         }
         if self.scraper is not None:
             out["aggregates"] = self.scraper.aggregates()

@@ -313,6 +313,30 @@ def test_serve_llm_bad_request_maps_to_400(llm_http):
     assert code == 400 and "error" in out
 
 
+def test_serve_llm_response_carries_stream_integrity_headers(llm_http):
+    """ISSUE 19 contract: a generate response carries its chain head
+    (X-Stream-Digest) and the serving engine's knob fingerprint
+    (X-Engine-Knobs) as headers, matching the body, so a caller can
+    verify the stream without parsing JSON."""
+    from paddle_tpu.observability import audit
+    _, base = llm_http
+    req = Request(base + "/generate",
+                  data=_json.dumps({"prompt_ids": [7, 8, 9],
+                                    "max_new_tokens": 4,
+                                    "nonce": 99}).encode(),
+                  headers={"Content-Type": "application/json"})
+    with urlopen(req, timeout=120) as r:
+        code, hdrs, out = r.status, dict(r.headers), \
+            _json.loads(r.read())
+    assert code == 200 and out["nonce"] == 99
+    # header == body == the chain recomputed from the tokens
+    assert hdrs["X-Stream-Digest"] == out["stream_digest"] == \
+        audit.chain_of(99, out["output_ids"]).hex()
+    knobs = _json.loads(hdrs["X-Engine-Knobs"])
+    assert knobs == out["knobs"]
+    assert set(knobs) == {"kv_dtype", "spec_k", "spec_slab", "draft"}
+
+
 # ---- real-plugin concurrency (skip-on-busy, like test_inference_native)
 
 

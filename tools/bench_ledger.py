@@ -69,7 +69,7 @@ BASELINE_WINDOW = 8
 # only — histogram percentiles would bloat every row)
 METRIC_PREFIXES = ("llm_", "perf_", "mem_", "host_rss_bytes",
                    "train_compile_count", "train_step_count", "fleet_",
-                   "goodput_", "badput_")
+                   "goodput_", "badput_", "drift_")
 
 
 def ledger_path(path: Optional[str] = None) -> Optional[str]:
@@ -177,6 +177,25 @@ def goodput_row_fields() -> Dict[str, object]:
         return {}
 
 
+def drift_row_fields() -> Dict[str, object]:
+    """The stream auditor's verdict on the current process — the
+    optional ``drift_divergences`` kwarg a bench row carries ({} when
+    the auditor is disabled or never armed, so rows keep the
+    hole-not-zero semantics: absent means "nobody was checking", 0
+    means "checked and clean"). Emitters splat this into
+    :func:`append` like :func:`goodput_row_fields`."""
+    try:
+        from paddle_tpu.observability import audit
+        if not audit.enabled():
+            return {}
+        counts = audit.instance().counts()
+        if not counts.get("verified") and not counts.get("diverged"):
+            return {}
+        return {"drift_divergences": int(counts.get("diverged", 0))}
+    except Exception:  # noqa: BLE001 — a row beats no row
+        return {}
+
+
 def make_row(tool: str, workload: str, value: float, unit: str,
              tokens_per_sec: Optional[float] = None,
              mfu: Optional[float] = None,
@@ -184,6 +203,7 @@ def make_row(tool: str, workload: str, value: float, unit: str,
              peak_mem_bytes: Optional[float] = None,
              goodput_fraction: Optional[float] = None,
              badput_top: Optional[str] = None,
+             drift_divergences: Optional[int] = None,
              backend: Optional[str] = None,
              direction: str = "higher",
              kv_dtype: Optional[str] = None,
@@ -203,7 +223,11 @@ def make_row(tool: str, workload: str, value: float, unit: str,
     run — the fraction of bench wall clock the device actually
     computed, and the dominant badput cause — so a throughput number
     bought by hiding stalls outside the timed region is visible IN
-    the trajectory row."""
+    the trajectory row. ``drift_divergences`` (optional, same
+    absent-field tolerance) carries the stream auditor's verdict —
+    how many audited streams diverged during the run — with hole
+    semantics: absent means the auditor never armed, 0 means it
+    checked the run and found it clean."""
     return {
         "schema": SCHEMA,
         "run_id": uuid.uuid4().hex[:12],
@@ -225,6 +249,8 @@ def make_row(tool: str, workload: str, value: float, unit: str,
         "goodput_fraction": (float(goodput_fraction)
                              if goodput_fraction is not None else None),
         "badput_top": str(badput_top) if badput_top is not None else None,
+        "drift_divergences": (int(drift_divergences)
+                              if drift_divergences is not None else None),
         "kv_dtype": str(kv_dtype) if kv_dtype is not None else None,
         "direction": direction,
         "metrics": metrics if metrics is not None else metrics_snapshot(),
@@ -339,6 +365,7 @@ def compare(rows: List[dict],
             "newest_peak_mem_bytes": newest.get("peak_mem_bytes"),
             "newest_goodput_fraction": newest.get("goodput_fraction"),
             "newest_badput_top": newest.get("badput_top"),
+            "newest_drift_divergences": newest.get("drift_divergences"),
         }
         if not prior:
             v.update(status="new", baseline=None, ratio=None)

@@ -1441,6 +1441,156 @@ def disagg_soak(seed: int, workdir: str) -> dict:
     return out
 
 
+def drift_soak(seed: int, workdir: str) -> dict:
+    """Scenario 5d (rides ``--fleet``, ISSUE 19): the stream-integrity
+    auditor under a drift storm. Asserts the acceptance invariants:
+    a fault-free shadow storm (audit_shadow_rate=1.0) verifies every
+    stream with ZERO divergences; a seeded ``audit.flip`` — one token
+    XOR-flipped BEFORE the digest chain extends over it, so the
+    corrupted stream is self-consistent and only chain-vs-chain
+    comparison can see it — is caught by the shadow re-execution at
+    the EXACT divergent position, with a one-shot stream_divergence
+    flight dump carrying both chain heads and both knob fingerprints;
+    the same flip under an engine device-retry is caught by the
+    retry's prefix check (``kind="failover"``, exact position); the
+    fault schedule replays from the seed; and a final clean storm
+    records zero NEW divergences (a tripped auditor must not keep
+    crying wolf)."""
+    from paddle_tpu.core import flags as flags_mod
+    from paddle_tpu.observability import audit, flight
+    from paddle_tpu.reliability import faults
+    from paddle_tpu.serving import (LocalReplica, Router,
+                                    make_engine_from_spec)
+
+    rng = np.random.RandomState(seed + 2)
+    faults.reset()
+    audit.reset()
+    audit.enable()
+    old_rate = flags_mod.get_flag("audit_shadow_rate")
+    flags_mod.set_flags({"audit_shadow_rate": 1.0})
+    fdir = os.path.join(workdir, "drift_flight")
+    rec = flight.FlightRecorder(fdir)
+    rec.install()
+    model = {"vocab": 97, "layers": 2, "hidden": 64, "heads": 4,
+             "max_pos": 96, "model_seed": 0}
+    engine_kw = {"max_seqs": 4, "page_size": 4, "num_pages": 64,
+                 "prefill_buckets": (32,), "seed": 0,
+                 "device_retry_budget": 2}
+    engs = [make_engine_from_spec(dict(model, engine=dict(engine_kw)))
+            for _ in range(2)]
+    router = Router({"a": LocalReplica(engs[0]),
+                     "b": LocalReplica(engs[1])},
+                    failover_budget=2, health_poll_interval=0.25)
+    out = {}
+
+    def counts():
+        return audit.instance().counts()
+
+    try:
+        # -- phase A: fault-free shadow storm — every served stream is
+        # re-executed off-path and chain-diffed; zero divergences
+        futs = [router.submit(rng.randint(0, 97, 12).tolist(),
+                              max_new_tokens=8, temperature=0.9)
+                for _ in range(6)]
+        for f in futs:
+            assert f.result(timeout=240)["stream_digest"]
+        _poll_until(lambda: counts()["verified"] >= 6, 120,
+                    "clean-storm shadows verifying")
+        assert counts()["diverged"] == 0, audit.driftz_payload()
+        out["clean"] = dict(counts())
+
+        # -- phase B: seeded audit.flip — flip the 4th delivered
+        # token; the served stream is self-consistent (its digest
+        # matches its tokens) so only the shadow's chain-vs-chain
+        # diff can catch it, at EXACTLY position 3 (0-based)
+        faults.enable(seed=seed)
+        faults.inject("audit.flip", nth=(4,), times=1)
+        r = router.submit(rng.randint(0, 97, 12).tolist(),
+                          max_new_tokens=8,
+                          temperature=0.9).result(timeout=240)
+        assert r["stream_digest"]          # self-consistent: served
+        _poll_until(lambda: counts()["diverged"] >= 1, 120,
+                    "shadow catching the flipped token")
+        div = audit.driftz_payload()["scopes"]["router"][
+            "last_divergence"]
+        assert div["kind"] == "shadow", div
+        assert div["position"] == 3, (
+            f"divergence not at the flipped token: {div}")
+        assert div["chain_ours"] != div["chain_theirs"], div
+        assert div["knobs_ours"] is not None, div
+        assert ("audit.flip", 4) in faults.injected_log(), \
+            faults.injected_log()
+        _assert_schedule_matches(faults, ("audit.flip",))
+        dumps = [f for f in os.listdir(fdir)
+                 if "stream_divergence" in f]
+        assert len(dumps) == 1, (
+            f"expected exactly one one-shot divergence dump: {dumps}")
+        rows = [json.loads(line)
+                for line in open(os.path.join(fdir, dumps[0]))]
+        extra = [x for x in rows if x.get("kind") == "extra"]
+        assert extra and extra[0]["divergence"]["position"] == 3, rows
+        out["flip"] = {"position": div["position"],
+                       "dump": dumps[0]}
+
+        # -- phase C: the flip under an engine device-retry — the
+        # retry re-admits with the same nonce and must re-emit the
+        # exact prefix the failed incarnation delivered; the flipped
+        # token #2 makes the prefixes differ at position 1
+        faults.reset()
+        faults.enable(seed=seed)
+        faults.inject("audit.flip", nth=(2,), times=1)
+        eng = engs[0]
+        real = eng._decode_fn
+        state = {"n": 0}
+
+        def flaky(*a, **kw):
+            state["n"] += 1
+            if state["n"] == 5:        # die after ~4 clean ticks
+                raise RuntimeError("transient PJRT failure")
+            return real(*a, **kw)
+
+        eng._decode_fn = flaky
+        try:
+            r = eng.submit([5, 6, 7, 8], max_new_tokens=8,
+                           temperature=0.8).result(timeout=240)
+        finally:
+            eng._decode_fn = real
+        assert r["output_ids"] and r["stream_digest"]
+        sc = audit.driftz_payload()["scopes"]
+        escope = next((s for n, s in sc.items() if n != "router"
+                       and s["by_kind"]["failover"]), None)
+        assert escope is not None, sc
+        ediv = escope["last_divergence"]
+        assert ediv["kind"] == "failover" and ediv["position"] == 1, \
+            ediv
+        _assert_schedule_matches(faults, ("audit.flip",))
+        faults.reset()
+        out["device_retry"] = {"position": ediv["position"]}
+
+        # -- phase D: clean storm after the incident — divergence
+        # counts must NOT move (the auditor detects drift, it does
+        # not manufacture it)
+        before = counts()["diverged"]
+        futs = [router.submit(rng.randint(0, 97, 12).tolist(),
+                              max_new_tokens=8, temperature=0.9)
+                for _ in range(4)]
+        for f in futs:
+            assert f.result(timeout=240)["stream_digest"]
+        _poll_until(
+            lambda: counts()["verified"] >= out["clean"]["verified"]
+            + 4, 120, "post-incident clean storm verifying")
+        assert counts()["diverged"] == before, audit.driftz_payload()
+        out["post_clean"] = dict(counts())
+    finally:
+        faults.reset()
+        flags_mod.set_flags({"audit_shadow_rate": old_rate})
+        rec.uninstall()
+        router.close()
+        for eng in engs:
+            eng.close()
+    return out
+
+
 def autoscale_soak(seed: int, workdir: str) -> dict:
     """Scenario 5b (``--autoscale``, ISSUE 13): the SLO-driven
     autoscaler over a LIVE subprocess fleet. Asserts the acceptance
@@ -2257,6 +2407,11 @@ def main(argv=None) -> int:
             # mid-pull, seeded router.migrate fault) — every mode
             # falls back to token-identical local recompute
             out["disagg"] = disagg_soak(seed, workdir)
+            # ISSUE 19: the stream-integrity auditor under a drift
+            # storm — seeded audit.flip caught at the exact divergent
+            # position (shadow + device-retry prefix), one-shot
+            # flight dump, clean storms record zero divergences
+            out["drift"] = drift_soak(seed, workdir)
         elif args.autoscale:
             out["autoscale"] = autoscale_soak(seed, workdir)
         elif args.train:

@@ -37,7 +37,10 @@ echo "   + one cross-process trace + disabled-tracing flag-check bound)"
 # series, a request's router.dispatch -> llm.request spans share ONE
 # trace_id over real HTTP (fetched back via /tracez?trace_id=),
 # trace_merge joins the tables, and disabled tracing still costs one
-# flag check (time-bounded)
+# flag check (time-bounded). ISSUE-19 rider: the stream auditor arms
+# on router traffic — /driftz 404s pre-arm, reports verified chains
+# post-traffic, and fleet_drift_* federates with hole-not-zero
+# semantics (a never-armed replica is a hole, not a clean zero)
 python tools/obs_smoke.py "$(mktemp -d)" --fleet
 
 echo "== llm serving smoke (prefix cache + chunked ragged prefill"
@@ -96,7 +99,14 @@ echo "== fleet chaos soak (K=3 replicas, SIGKILL mid-decode -> failover)"
 # disagg phase: a prefill-pool replica feeds two decode replicas via
 # KV-page migration — a SIGKILLed prefill replica and a corrupted
 # in-flight page both degrade to local recompute (token-identical,
-# zero pages leaked)
+# zero pages leaked). Then the ISSUE-19 drift storm: a seeded
+# audit.flip corrupts one emitted token BEFORE chain extension (the
+# corrupted stream is self-consistent, so only chain-vs-chain checks
+# catch it) — the shadow re-execution names the exact divergent
+# position, fires ONE flight dump carrying both digests + knob
+# fingerprints, a mid-decode device retry is verified prefix-intact,
+# clean storms report zero divergences, and the fault schedule
+# replays from seed
 python tools/chaos_soak.py --ci --fleet
 
 echo "== autoscale chaos soak (SLO-driven scale-out/in over a live fleet)"
@@ -166,7 +176,9 @@ PT_BENCH_FORCE_CPU=1 python bench.py
 echo "== perf ledger regression gate (BENCH_LEDGER.jsonl trajectory)"
 # the bench steps above appended this run's canonical rows; the gate
 # fails LOUDLY if the trajectory is empty/unreadable or any series
-# regressed past tolerance (wide on CPU, tight on real chips)
+# regressed past tolerance (wide on CPU, tight on real chips). Rows
+# carry the optional drift_divergences field when the stream auditor
+# armed during a bench (absent = nobody checked, 0 = checked clean)
 python tools/bench_ledger.py --ci
 
 echo "== wheel build + import smoke"

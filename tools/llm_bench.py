@@ -92,13 +92,15 @@ def _peak_mem_bytes():
         return None
 
 
-def _goodput_row_fields():
-    """The time ledger's verdict on this run — the optional
-    ``goodput_fraction`` + ``badput_top`` every ledger row carries
-    ({} when the ledger is disabled or never armed, the
-    ``_peak_mem_bytes`` discipline). Canonical implementation lives
+def _verdict_row_fields():
+    """The observability ledgers' verdicts on this run — the optional
+    ``goodput_fraction`` + ``badput_top`` (time ledger) and
+    ``drift_divergences`` (stream auditor) every ledger row carries
+    ({} per ledger when disabled or never armed, the
+    ``_peak_mem_bytes`` discipline). Canonical implementations live
     with the schema (tools/bench_ledger.py)."""
-    return _ledger.goodput_row_fields()
+    return {**_ledger.goodput_row_fields(),
+            **_ledger.drift_row_fields()}
 
 
 def _goodput_productive_s():
@@ -324,7 +326,7 @@ def fleet_main(args):
     # canonical trajectory row (PERF.md "The perf ledger")
     _ledger.append("llm_bench", row["metric"], row["value"],
                    row["unit"], peak_mem_bytes=_peak_mem_bytes(),
- **_goodput_row_fields(),
+ **_verdict_row_fields(),
                    extra={"affinity_hit_rate": aff["hit_rate"],
                           "round_robin_hit_rate": rr["hit_rate"],
                           "workload": row["workload"]})
@@ -639,7 +641,7 @@ def disagg_main(args, repeats=2):
             f.write(json.dumps(row) + "\n")
     _ledger.append("llm_bench", row["metric"], row["value"],
                    row["unit"], peak_mem_bytes=_peak_mem_bytes(),
-                   kv_dtype="int8", **_goodput_row_fields(),
+                   kv_dtype="int8", **_verdict_row_fields(),
                    extra={"unified_short_ttft_p99_s":
                               uni["short_ttft_p99_s"],
                           "disagg_short_ttft_p99_s":
@@ -652,7 +654,7 @@ def disagg_main(args, repeats=2):
                    "disagg_tick_p99_over_unified",
                    direction="lower", kv_dtype="int8",
                    peak_mem_bytes=_peak_mem_bytes(),
-                   **_goodput_row_fields(),
+                   **_verdict_row_fields(),
                    extra={"unified_tick_p99_s":
                               uni["decode_tick_p99_s"],
                           "disagg_tick_p99_s":
@@ -946,7 +948,7 @@ def storm_main(args):
     _ledger.append(
         "llm_bench", row["metric"], row["value"], row["unit"],
         peak_mem_bytes=_peak_mem_bytes(),
-        **_goodput_row_fields(),
+        **_verdict_row_fields(),
         extra={"replica_seconds_static": rs_static,
                "replica_seconds_autoscaled": rs_auto,
                # replica-seconds discounted to USEFUL seconds: each
@@ -1092,7 +1094,7 @@ def decode_ticks_main(args, net=None, assert_ci=False):
                    tokens_per_sec=n8_b1["tokens_per_sec"],
                    dispatches=n8_b1["host_dispatches_per_100_tokens"],
                    peak_mem_bytes=_peak_mem_bytes(),
-                   **_goodput_row_fields(),
+                   **_verdict_row_fields(),
                    extra={"ratios": ratios,
                           "workload": row["workload"]})
     if assert_ci:
@@ -1155,7 +1157,7 @@ def mixed_tick_main(args, net=None, assert_ci=False):
                    row["unit"],
                    dispatches=mixed["host_dispatches"],
                    peak_mem_bytes=_peak_mem_bytes(),
-                   **_goodput_row_fields(),
+                   **_verdict_row_fields(),
                    extra={"legacy_dispatches":
                               legacy["host_dispatches"],
                           "mixed_slabs": mixed["mixed_slabs"],
@@ -1301,7 +1303,7 @@ def spec_main(args, net=None, assert_ci=False):
                     dispatches=stats["host_dispatches_per_token"],
                     peak_mem_bytes=_peak_mem_bytes(),
                     kv_dtype=kv,
-                    **_goodput_row_fields(),
+                    **_verdict_row_fields(),
                     extra={"spec_tokens": K,
                            "accept_rate": stats["accept_rate"],
                            "prefix_cache": cache,
@@ -1335,7 +1337,7 @@ def spec_main(args, net=None, assert_ci=False):
                    row["unit"],
                    dispatches=slab4["host_dispatches_per_token"],
                    peak_mem_bytes=_peak_mem_bytes(),
-                   **_goodput_row_fields(),
+                   **_verdict_row_fields(),
                    extra={"legacy_dispatches_per_token":
                               legacy["host_dispatches_per_token"],
                           "slab_accept_rate": slab4["accept_rate"],
@@ -1466,7 +1468,7 @@ def kv_dtype_main(args, net=None, assert_ci=False):
                        "prefix_cache_resident_pages",
                        kv_dtype=kv,
                        peak_mem_bytes=_peak_mem_bytes(),
-                       **_goodput_row_fields(),
+                       **_verdict_row_fields(),
                        extra={"usable_pages": stats[kv][
                                   "usable_pages"],
                               "page_bytes": stats[kv]["page_bytes"],
@@ -1474,7 +1476,7 @@ def kv_dtype_main(args, net=None, assert_ci=False):
     _ledger.append("llm_bench", row["metric"], row["value"],
                    row["unit"], kv_dtype="int8",
                    peak_mem_bytes=_peak_mem_bytes(),
-                   **_goodput_row_fields(),
+                   **_verdict_row_fields(),
                    extra={"int8_greedy_agreement_vs_f32": agree,
                           "workload": row["workload"]})
     if assert_ci:
@@ -1591,7 +1593,7 @@ def main(argv=None):
                    row["unit"],
                    tokens_per_sec=on["e2e_tokens_per_sec"],
                    peak_mem_bytes=_peak_mem_bytes(),
-                   **_goodput_row_fields(),
+                   **_verdict_row_fields(),
                    extra={"ttft_p50_s": on["ttft_p50_s"],
                           "cache_off_ttft_p50_s": off["ttft_p50_s"],
                           "workload": row["workload"]})

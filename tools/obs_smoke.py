@@ -32,6 +32,7 @@ import json
 import os
 import subprocess
 import sys
+import urllib.error
 import urllib.request
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -406,6 +407,20 @@ def fleet_main(outdir: str = "/tmp/pt_obs_fleet_smoke") -> int:
             health_poll_interval=0.2, page_size=4, affinity_pages=2)
         srv = debug_server.DebugServer(port=0).start()
         base = f"http://127.0.0.1:{srv.port}"
+        # hole-not-zero over HTTP: before any stream verification this
+        # process has no drift table — /driftz must 404, not serve
+        # an all-zero (falsely clean) body
+        try:
+            _get_json(base + "/driftz")
+            raise AssertionError("/driftz answered before any stream "
+                                 "verification armed the auditor")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404, f"/driftz pre-arm status {e.code}"
+        # shadow every request below so the drift surfaces have data
+        # (the replicas themselves never record a verdict here — their
+        # /driftz stays a 404 hole, pinned further down)
+        from paddle_tpu.core import flags as _flags
+        _flags.set_flags({"audit_shadow_rate": 1.0})
         from paddle_tpu.serving.router import (affinity_key,
                                                rendezvous_pick)
         import numpy as np
@@ -501,6 +516,57 @@ def fleet_main(outdir: str = "/tmp/pt_obs_fleet_smoke") -> int:
         assert hole_agg["goodput_replicas"] == 1, hole_agg
         armed_frac = hole_agg["goodput_fraction"]
         assert armed_frac is not None and armed_frac > 0, hole_agg
+        # -- stream-integrity drift surfaces ----------------------------
+        # every request above was shadow re-executed (rate 1.0): the
+        # router-side drift table armed, /driftz serves it, and the
+        # fleet must prove itself CLEAN (zero divergences)
+        deadline = time.monotonic() + 90
+        dz = None
+        while time.monotonic() < deadline:
+            try:
+                _code, dz = _get_json(base + "/driftz")
+                if dz["drift"]["audit"]["totals"]["verified"] \
+                        >= len(outs):
+                    break
+            except urllib.error.HTTPError:
+                pass
+            time.sleep(0.2)
+        else:
+            raise AssertionError(
+                f"/driftz never accumulated {len(outs)} shadow "
+                f"verdicts: {dz}")
+        assert dz["drift"]["audit"]["enabled"] is True, dz
+        assert dz["drift"]["audit"]["totals"]["diverged"] == 0, dz
+        # the drift counters mint at first record and export locally…
+        with urllib.request.urlopen(base + "/metrics", timeout=30) as r:
+            scraped = r.read().decode()
+        assert "drift_verified_total" in scraped, \
+            "drift_verified_total missing after shadow verdicts"
+        # …but NEITHER replica ever recorded a verdict: their /driftz
+        # is a 404 and the fleet_drift_* aggregate reads them as holes
+        # (denominator 0), never as zero-divergence evidence
+        for n in names:
+            try:
+                _get_json(infos[n]["driftz"])
+                raise AssertionError(
+                    f"replica {n} served /driftz without recording")
+            except urllib.error.HTTPError as e:
+                assert e.code == 404, f"{n} /driftz status {e.code}"
+        assert "fleet_drift_replicas 0" in scraped, \
+            "never-armed replicas must be a hole in fleet_drift_*"
+        assert 'fleet_drift_verified_total{replica=' not in scraped, \
+            "replica exported drift series it never recorded"
+        # an ARMED replica's counters do federate — and a replica
+        # without them stays out of both sums and the denominator
+        fs2 = FleetScraper(registry=MetricRegistry())
+        fs2.record("armed", "drift_verified_total 5\n"
+                   'drift_divergence_total{kind="shadow"} 1\n')
+        fs2.record("hole", "llm_requests_completed 0\n")
+        agg2 = fs2.aggregates()
+        assert agg2["drift_replicas"] == 1, agg2
+        assert agg2["drift_verified"] == 5, agg2
+        assert agg2["drift_divergences"] == 1, agg2
+        _flags.set_flags({"audit_shadow_rate": 0.0})
         # -- ONE cross-process trace ------------------------------------
         out = outs[0]
         tid = out["trace_id"]
