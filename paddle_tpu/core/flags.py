@@ -271,7 +271,8 @@ define_flag("goodput_observability", True,
             "Arm the wall-clock time ledger (observability/goodput.py):"
             " hot paths attribute every second since arming to one "
             "bucket (productive / compile / input_wait / ckpt_stall / "
-            "recovery / migration / audit / queue_wait, plus derived "
+            "recovery / migration / audit / shed / queue_wait, plus "
+            "derived "
             "host_gap and an "
             "explicit unattributed residual) -> GET /goodputz, "
             "goodput_fraction / badput_seconds_total{cause} gauges, "

@@ -81,6 +81,29 @@ class AdmissionShed(RuntimeError):
         self.reason = reason
 
 
+class OverloadShed(AdmissionShed):
+    """The overload controller's typed admission verdict (PR 20): the
+    request was refused BEFORE any prefill work because either its
+    deadline is predicted unmeetable (``reason="hopeless"`` — shedding
+    a doomed request in 0.1 ms beats failing it after seconds of
+    stolen compute) or the brownout ladder admits protected classes
+    only (``reason="brownout"``). Subclasses :class:`AdmissionShed` so
+    every existing handler — serve_llm's 429 mapping, the router's
+    budget-free rebalance, HTTPReplica's error contract — treats it as
+    the shed it is; the extra fields make the verdict auditable:
+    ``predicted_s``/``deadline_s`` say WHY it was hopeless and
+    ``retry_after_s`` is the backoff the fleet wants clients to honor
+    (serve_llm forwards it as the ``Retry-After`` header)."""
+
+    def __init__(self, msg: str, reason: str = "hopeless",
+                 predicted_s=None, deadline_s=None,
+                 retry_after_s=None):
+        super().__init__(msg, reason=reason)
+        self.predicted_s = predicted_s
+        self.deadline_s = deadline_s
+        self.retry_after_s = retry_after_s
+
+
 class AdmissionTimeout(TimeoutError):
     """The admission retry budget ran out: the request waited in the
     ``"retry"`` cycle past the engine's ``admit_timeout`` without slots
@@ -1764,6 +1787,13 @@ class LLMEngine:
         self.device_retry_budget = int(device_retry_budget)
         self.degraded_after = int(degraded_after)
         self.drain_after = int(drain_after)
+        # engine-side brownout clamp (PR 20): when set, submit caps
+        # every request's max_new_tokens at this value — the L2
+        # degradation knob for a replica that should spend its decode
+        # budget on more requests rather than longer ones. None (the
+        # default) is a no-op; the overload controller (or an
+        # operator) sets it via set_overload_clamp().
+        self.overload_max_new_tokens: Optional[int] = None
         self._n_queued = 0            # submitted, not yet admitted
         self._by_id: dict = {}        # req_id → _Request (cancel handle)
         self._consec_device_errors = 0
@@ -1868,6 +1898,15 @@ class LLMEngine:
         self._m["health"].set(0)
         self._wake.set()
 
+    def set_overload_clamp(self, max_new_tokens: Optional[int]) -> None:
+        """Set (or clear, with None) the engine-side brownout clamp:
+        every subsequent submit's ``max_new_tokens`` is capped at this
+        value. Reversible by construction — clearing it restores full-
+        length decoding for NEW admissions (in-flight requests keep
+        the budget they were admitted with)."""
+        self.overload_max_new_tokens = (
+            None if max_new_tokens is None else int(max_new_tokens))
+
     def cancel(self, request_id: int) -> bool:
         """Cancel a submitted request by the ``request_id`` attribute
         of its future. Returns False if unknown or already resolved.
@@ -1906,6 +1945,11 @@ class LLMEngine:
         so the whole fleet shares one trace_id per request).
         Best-effort by contract: malformed context or disabled tracing
         degrade to a locally-rooted (or no) tree, never an error."""
+        cap = self.overload_max_new_tokens
+        if cap is not None and max_new_tokens > int(cap):
+            # brownout L2: the clamp is a degraded-mode admission
+            # verdict, not an error — the request runs, shorter
+            max_new_tokens = int(cap)
         if len(prompt_ids) + max_new_tokens > self.max_len:
             raise ValueError(
                 f"prompt {len(prompt_ids)} + max_new_tokens "
@@ -4186,8 +4230,21 @@ def serve_llm(engine, host: str = "127.0.0.1", port: int = 0):
                 # sending new admissions entirely.
                 code = 503 if getattr(e, "reason", "") == "draining" \
                     else 429
-                return code, {"error": str(e), "outcome": "shed",
-                              "reason": getattr(e, "reason", "")}
+                out = {"error": str(e), "outcome": "shed",
+                       "reason": getattr(e, "reason", "")}
+                # backpressure contract (PR 20): a shed tells clients
+                # WHEN to come back. The overload controller computes
+                # the value from its limiter/ladder state and attaches
+                # it to the verdict; a plain engine shed falls back to
+                # a nominal second. do_POST forwards it as the
+                # Retry-After header; an OverloadShed's prediction
+                # rides along so the refusal is auditable client-side.
+                ra = getattr(e, "retry_after_s", None)
+                out["retry_after_s"] = float(ra) if ra else 1.0
+                if getattr(e, "predicted_s", None) is not None:
+                    out["predicted_s"] = e.predicted_s
+                    out["deadline_s"] = e.deadline_s
+                return code, out
             except (DeadlineExceeded, AdmissionTimeout) as e:
                 return 504, {"error": str(e), "outcome": "deadline"}
             except RequestCancelled as e:
@@ -4196,7 +4253,7 @@ def serve_llm(engine, host: str = "127.0.0.1", port: int = 0):
                 # a closing replica is out of rotation, not a client
                 # error: 503 tells the router to rebalance budget-free
                 return 503, {"error": str(e), "outcome": "shed",
-                             "reason": "draining"}
+                             "reason": "draining", "retry_after_s": 1.0}
             except Exception as e:  # noqa: BLE001 — report to client
                 return 400, {"error": str(e)}
             out["request_id"] = getattr(fut, "request_id", None)
@@ -4270,6 +4327,13 @@ def serve_llm(engine, host: str = "127.0.0.1", port: int = 0):
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(payload)))
+            # 429/503 backpressure rides a standard header so ANY
+            # client — HTTPReplica, a curl, an external balancer —
+            # can honor the fleet's backoff without parsing the body
+            if code in (429, 503) and isinstance(out, dict) \
+                    and out.get("retry_after_s") is not None:
+                self.send_header("Retry-After",
+                                 str(out["retry_after_s"]))
             # stream-integrity contract: a generate response carries
             # its chain head + the serving engine's knob fingerprint
             # as headers too, so a caller can verify/compare without

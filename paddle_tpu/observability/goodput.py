@@ -23,6 +23,10 @@ attributes every second since arming to exactly one bucket:
 - ``audit`` — stream-integrity shadow re-executions
   (``FLAGS.audit_shadow_rate``): the wall cost of proving the fleet's
   determinism in production;
+- ``shed`` — time requests spent in the fleet before a shed verdict
+  resolved them (router quota/overload/brownout sheds): the wall cost
+  of refusing work, named so an overload event reads as SHED on the
+  ledger instead of vanishing into queue_wait;
 - ``queue_wait`` — llm admission queue residency (wall-clock coverage,
   not per-request sums — see "tolerance" below);
 - ``host_gap`` — short uncovered gaps between attributed intervals
@@ -40,11 +44,12 @@ exact interval sweep: overlapping same-bucket intervals UNION (ten
 queued requests over one second are one second of queue_wait, not
 ten); cross-bucket overlap resolves by documented precedence —
 ``productive > compile > ckpt_stall > input_wait > recovery >
-migration > audit > queue_wait > host_gap`` (the device owning the
-second is the strongest claim; migration — cross-replica KV-page
-transfer wall time — and audit — shadow re-execution wall time —
-beat queue_wait because their seconds have a NAMED cause, and a
-fleet drowning in page transfers or determinism proofs must not
+migration > audit > shed > queue_wait > host_gap`` (the device owning
+the second is the strongest claim; migration — cross-replica KV-page
+transfer wall time — audit — shadow re-execution wall time — and
+shed — time spent refusing doomed work — beat queue_wait because
+their seconds have a NAMED cause, and a fleet drowning in page
+transfers, determinism proofs, or load shedding must not
 masquerade as queueing; a queued request overlaps nearly everything,
 so its claim is nearly the weakest; a directly-noted drain sync
 yields to all). Every second is counted exactly once, by exactly one
@@ -92,7 +97,7 @@ from .metrics import default_registry
 # weakest claim) and derived (short uncovered gaps classify into it)
 BUCKETS: Tuple[str, ...] = ("productive", "compile", "ckpt_stall",
                             "input_wait", "recovery", "migration",
-                            "audit", "queue_wait", "host_gap")
+                            "audit", "shed", "queue_wait", "host_gap")
 # derived only from uncovered timeline segments — the closing line
 DERIVED: Tuple[str, ...] = ("unattributed",)
 # every cause badput_seconds_total{cause=} exports (all but productive)

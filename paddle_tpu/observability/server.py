@@ -31,9 +31,9 @@ profiler window):
 - ``GET /goodputz`` — the wall-clock time ledger
   (observability.goodput): every second since arming attributed to
   one bucket (productive / compile / input_wait / ckpt_stall /
-  recovery / queue_wait / host_gap) with an explicit unattributed
-  closing line, the goodput fraction, the top badput cause, and
-  SLO-trip watermark forensics.
+  recovery / shed / queue_wait / host_gap) with an explicit
+  unattributed closing line, the goodput fraction, the top badput
+  cause, and SLO-trip watermark forensics.
 - ``GET /fleetz``   — fleet view (registered by a serving Router):
   per-replica health/breaker/scrape digest + computed aggregates;
   404 when this process fronts no fleet.
@@ -42,6 +42,10 @@ profiler window):
 - ``GET /scalez``   — autoscaler view (registered by a serving
   Autoscaler): config, damping state, live fleet load, and the
   bounded decision log (inputs → action + reason); 404 when none.
+- ``GET /overloadz`` — overload brownout controller view (registered
+  by a Router constructed with ``overload=``): ladder level + bounded
+  transition log, AIMD per-replica limits, estimator state, shed
+  counts by reason; 404 when none.
 - ``POST /profilez`` — arm an on-demand profiler window:
   ``{"duration_s": 5, "log_dir": "/tmp/prof"}`` starts a
   ``profiler.Profiler`` and stops it after the window; 409 while one
@@ -115,6 +119,12 @@ _slo_providers: Dict[str, Callable[[], Optional[dict]]] = {}
 # Autoscaler's decision log + config + live load view). 404 when empty
 # — no autoscaler runs in this process.
 _scale_providers: Dict[str, Callable[[], Optional[dict]]] = {}
+
+# name → callable returning the /overloadz JSON payload (the overload
+# controller's ladder level, bounded transition log, AIMD limits,
+# estimator state, shed counts). 404 when empty — no controller is
+# bound in this process.
+_overload_providers: Dict[str, Callable[[], Optional[dict]]] = {}
 
 # name → callable returning the /driftz JSON payload (stream-integrity
 # chain tables: verified/diverged counts + last divergence per scope).
@@ -201,6 +211,18 @@ def register_scale_provider(name: str,
 def unregister_scale_provider(name: str) -> None:
     with _providers_mu:
         _scale_providers.pop(name, None)
+
+
+def register_overload_provider(name: str,
+                               fn: Callable[[], Optional[dict]]
+                               ) -> None:
+    with _providers_mu:
+        _overload_providers[name] = fn
+
+
+def unregister_overload_provider(name: str) -> None:
+    with _providers_mu:
+        _overload_providers.pop(name, None)
 
 
 def register_drift_provider(name: str,
@@ -569,6 +591,15 @@ class DebugServer:
                              "registers one)"})
             else:
                 h._reply_json(200, {"autoscalers": scalers})
+        elif url.path == "/overloadz":
+            ctrls = _collect_dict_providers(_overload_providers)
+            if not ctrls:
+                h._reply_json(404, {
+                    "error": "no overload controller bound in this "
+                             "process (a Router with overload= "
+                             "registers one)"})
+            else:
+                h._reply_json(200, {"overload": ctrls})
         elif url.path == "/driftz":
             drift = _collect_dict_providers(_drift_providers)
             if not drift:
@@ -586,7 +617,8 @@ class DebugServer:
                 "endpoints": ["/metrics", "/healthz", "/statusz",
                               "/tracez", "/perfz", "/memz",
                               "/goodputz", "/fleetz", "/sloz",
-                              "/scalez", "/driftz", "POST /profilez",
+                              "/scalez", "/overloadz", "/driftz",
+                              "POST /profilez",
                               "POST /reset_health"]})
 
     def _post(self, h) -> None:

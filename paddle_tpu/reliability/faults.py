@@ -87,6 +87,17 @@ Named sites (the catalog; see docs/RELIABILITY.md):
                           a silently divergent replica (requires the
                           stream auditor armed; see
                           observability/audit.py)
+``overload.estimate``     overload controller: one hopeless-shed
+                          service-time prediction — injection
+                          distorts the prediction 1000× (wildly
+                          wrong) instead of raising; the controller
+                          must degrade to visible shed/miss verdicts,
+                          never hangs (serving/overload.py)
+``overload.step``         overload controller: one brownout-ladder
+                          tick — injection forces a SPURIOUS one-level
+                          escalation, logged with the fault as its
+                          reason; the normal hysteresis must walk it
+                          back down once the live windows disagree
 ========================  ==================================================
 
 Stdlib-only by design: any module may import this without cycles.
@@ -122,6 +133,8 @@ SITES = (
     "data.poison",
     "grad.nonfinite",
     "audit.flip",
+    "overload.estimate",
+    "overload.step",
 )
 
 

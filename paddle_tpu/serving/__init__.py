@@ -19,13 +19,17 @@ from .autoscaler import (Autoscaler, SubprocessReplica,
                          make_subprocess_spawner)
 from .breaker import CircuitBreaker
 from .fleet import FleetScraper, parse_prometheus_text
+from .overload import (AIMDLimiter, BrownoutLadder, OverloadController,
+                       ServiceTimeEstimator)
 from .replica import (HTTPReplica, LocalReplica, ReplicaUnavailable,
                       build_net_from_spec, make_engine_from_spec,
                       spawn_replica, terminate_replica)
 from .router import Router, SLOClass, TenantQuota
 
 __all__ = [
+    "AIMDLimiter",
     "Autoscaler",
+    "BrownoutLadder",
     "SubprocessReplica",
     "make_subprocess_spawner",
     "CircuitBreaker",
@@ -33,9 +37,11 @@ __all__ = [
     "parse_prometheus_text",
     "HTTPReplica",
     "LocalReplica",
+    "OverloadController",
     "ReplicaUnavailable",
     "Router",
     "SLOClass",
+    "ServiceTimeEstimator",
     "TenantQuota",
     "build_net_from_spec",
     "make_engine_from_spec",

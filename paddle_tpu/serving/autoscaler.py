@@ -529,6 +529,7 @@ class Autoscaler:
         return None
 
     def _inputs(self, load: dict, burn: dict, tripped=()) -> dict:
+        ov = getattr(self.router, "overload", None)
         return {
             "burn": {cls: {w: st["windows"][w]["burn_rate"]
                            for w in st.get("windows", {})}
@@ -538,6 +539,11 @@ class Autoscaler:
             "ready": load.get("ready", 0),
             "warming": load.get("warming", 0),
             "draining": load.get("draining", 0),
+            # what the brownout controller was doing when this
+            # decision fired — the /scalez ↔ /overloadz join column
+            # (None: no controller bound; the ladder ENGAGES while
+            # replicas warm, it does not wait for capacity)
+            "brownout": None if ov is None else ov.level,
         }
 
     def _busy(self) -> bool:

@@ -155,14 +155,16 @@ class FleetScraper:
                         "llm_requests_completed", "perf_mfu",
                         "perf_flops_per_second", "mem_headroom_pages",
                         "goodput_fraction", "drift_verified_total",
-                        "drift_divergence_total")
+                        "drift_divergence_total", "brownout_level")
 
     def __init__(self, registry: Optional[MetricRegistry] = None,
                  federate_prefixes: Tuple[str, ...] = ("llm_", "perf_",
                                                        "mem_",
                                                        "badput_",
                                                        "kv_migrate_",
-                                                       "drift_"),
+                                                       "drift_",
+                                                       "brownout_",
+                                                       "overload_"),
                  stale_after: float = 10.0):
         # NOTE: per-replica badput CAUSES federate
         # (fleet_badput_seconds_total{replica=,cause=}); the replica's
@@ -264,6 +266,20 @@ class FleetScraper:
             "replicas whose drift_* counters entered the fleet_drift_"
             "sums at the last scrape (the auditable hole-semantics "
             "denominator, like fleet_mfu_replicas)")
+        self._g_brownout = reg.gauge(
+            "fleet_brownout_level",
+            "MAX brownout_level across UP replicas that export it — "
+            "the fleet is as degraded as its most-degraded member. A "
+            "down or never-armed replica (no overload controller "
+            "bound) is a HOLE, never a zero: 0 with "
+            "fleet_brownout_replicas=0 means no replica runs a "
+            "controller, not that the fleet is calm")
+        self._g_brownout_n = reg.gauge(
+            "fleet_brownout_replicas",
+            "replicas whose brownout_level entered the "
+            "fleet_brownout_level max at the last scrape (the "
+            "auditable hole-semantics denominator, like "
+            "fleet_mfu_replicas)")
 
     # -- ingestion ------------------------------------------------------
     @staticmethod
@@ -339,6 +355,7 @@ class FleetScraper:
         occ, kv, mfu, headroom, goodput = [], [], [], [], []
         hit_tok = prompt_tok = tokens = completed = fps = 0.0
         drift_ok, drift_bad = [], []
+        brownout = []
         for st in up.values():
             fams = st["families"]
             # perf federation: only replicas that EXPORT perf_mfu
@@ -369,6 +386,14 @@ class FleetScraper:
             # unverified fleet must read as unverified, not clean.
             # drift_divergence_total is {kind}-labeled: sum every
             # sample of the family, not just the first.
+            # brownout federation, same hole semantics: a replica with
+            # no overload controller bound exports no brownout_level
+            # family at all and stays OUT of the max and denominator —
+            # a fleet nobody governs must read as ungoverned, not calm
+            bl = _series_value(fams.get("brownout_level"),
+                               "brownout_level")
+            if bl is not None:
+                brownout.append(bl)
             dv = _series_value(fams.get("drift_verified_total"),
                                "drift_verified_total")
             if dv is not None:
@@ -420,6 +445,8 @@ class FleetScraper:
             "drift_verified": sum(drift_ok) if drift_ok else None,
             "drift_divergences": sum(drift_bad) if drift_ok else None,
             "drift_replicas": len(drift_ok),
+            "brownout_level": max(brownout) if brownout else None,
+            "brownout_replicas": len(brownout),
         }
         self._g_scraped.set(agg["replicas_scraped"])
         self._g_occ.set(agg["occupancy"])
@@ -437,6 +464,8 @@ class FleetScraper:
         self._g_drift_ok.set(agg["drift_verified"] or 0.0)
         self._g_drift_bad.set(agg["drift_divergences"] or 0.0)
         self._g_drift_n.set(agg["drift_replicas"])
+        self._g_brownout.set(agg["brownout_level"] or 0.0)
+        self._g_brownout_n.set(agg["brownout_replicas"])
         return agg
 
     def aggregates(self) -> dict:

@@ -144,6 +144,18 @@ class HTTPReplica:
                 payload = json.loads(e.read() or b"{}")
             except ValueError:
                 payload = {}
+            # backpressure contract (PR 20): a shedding replica's
+            # Retry-After header names its cooldown. Captured into
+            # the payload (headers win over any body field — the
+            # header is the standard surface) so submit() can attach
+            # it to the typed verdict and the router can honor it
+            # instead of blind-retrying into the same shed.
+            ra = e.headers.get("Retry-After") if e.headers else None
+            if ra is not None:
+                try:
+                    payload["retry_after_s"] = float(ra)
+                except ValueError:
+                    pass      # a malformed header is no header
             return e.code, payload
         except (URLError, OSError, ValueError) as e:
             # connection refused/reset, truncated response: the
@@ -177,10 +189,17 @@ class HTTPReplica:
             return out
         err = out.get("error", f"HTTP {code}")
         if code == 429:
-            raise AdmissionShed(err,
+            exc = AdmissionShed(err,
                                 reason=out.get("reason") or "queue_full")
+            # the replica's cooldown rides the verdict: the router's
+            # dispatch loop reads it off the exception and keeps the
+            # replica out of _route until it expires
+            exc.retry_after_s = out.get("retry_after_s")
+            raise exc
         if code == 503:
-            raise AdmissionShed(err, reason="draining")
+            exc = AdmissionShed(err, reason="draining")
+            exc.retry_after_s = out.get("retry_after_s")
+            raise exc
         if code == 504:
             raise DeadlineExceeded(err)
         if code == 499:

@@ -52,7 +52,7 @@ from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Dict, Optional
 
 from ..inference.llm import (AdmissionShed, EngineClosed,
-                             RequestCancelled)
+                             OverloadShed, RequestCancelled)
 from ..inference.prefix_cache import page_digests
 from ..observability import audit as _audit
 from ..observability import goodput as _goodput
@@ -230,7 +230,7 @@ class _FleetRequest:
                  "span", "excluded", "t_submit", "failovers",
                  "affinity_key", "quota_held", "rr_slot", "slo_name",
                  "had_deadline", "last_dispatch", "digests", "migrate",
-                 "prior_knobs")
+                 "prior_knobs", "predicted_s")
 
     def __init__(self, prompt, max_new_tokens, temperature):
         self.prompt = list(map(int, prompt))
@@ -266,6 +266,10 @@ class _FleetRequest:
         # (last known) — a failover sibling serving under DIFFERENT
         # knobs is a detected drift, not a documented hazard
         self.prior_knobs = None
+        # the overload controller's admission-time service estimate —
+        # the resolution latency is judged against it (the
+        # overload_estimate_error_ratio histogram)
+        self.predicted_s = None
 
 
 class Router:
@@ -301,12 +305,14 @@ class Router:
                  max_workers: int = 32,
                  scrape_metrics: bool = True,
                  federate_prefixes=("llm_", "perf_", "mem_",
-                                    "badput_", "kv_migrate_", "drift_"),
+                                    "badput_", "kv_migrate_", "drift_",
+                                    "brownout_", "overload_"),
                  disagg_threshold_tokens: Optional[int] = None,
                  slo_windows=DEFAULT_WINDOWS,
                  slo_default_target: float = 0.99,
                  slo_breach_threshold: float = 10.0,
                  slo_min_samples: int = 10,
+                 overload=None,
                  name: str = "router"):
         if policy not in ("affinity", "round_robin"):
             raise ValueError(f"unknown routing policy {policy!r}")
@@ -371,6 +377,16 @@ class Router:
         # direction is safe — a stale "resident" only re-migrates or
         # recomputes; verification on import keeps it exact.
         self._resident: Dict[str, set] = {}
+        # per-replica Retry-After cooldowns: a shed response carrying
+        # the header moves that replica to the back of the line until
+        # the cooldown lapses (only skipped while OTHER candidates
+        # exist — a cooldown must never make a fleet unroutable)
+        self._retry_until: Dict[str, float] = {}
+        # overload brownout controller (serving/overload.py): admission
+        # verdicts pre-dispatch, AIMD concurrency bounds in _route, the
+        # degradation ladder ticking on the health-poll cadence (bound
+        # below, after the debug surface exists)
+        self.overload = overload
         for rname, client in (replicas or {}).items():
             self.attach(rname, client)
         # TCPStore membership: poll the roster alongside health
@@ -420,6 +436,9 @@ class Router:
         if self.scraper is not None:
             _dbgsrv.register_scrape_provider(
                 self._status_name, self._render_federated)
+        if overload is not None:
+            overload.bind(self)
+            self.add_poll_hook(overload.tick)
 
     # -- membership ---------------------------------------------------------
     def attach(self, name: str, client, warming: bool = False,
@@ -541,9 +560,12 @@ class Router:
             self._replicas.pop(name, None)
             self._expect_warm.discard(name)
             self._resident.pop(name, None)
+            self._retry_until.pop(name, None)
             self._detached_at[name] = time.monotonic()
         if self.scraper is not None:
             self.scraper.forget(name)
+        if self.overload is not None:
+            self.overload.forget(name)
 
     # -- poll hooks ---------------------------------------------------------
     def add_poll_hook(self, fn) -> None:
@@ -704,9 +726,13 @@ class Router:
                             self.affinity_pages)
 
     def _route(self, req: _FleetRequest):
-        """(state, affinity_hit) or (None, all_draining)."""
+        """(state, affinity_hit) — or (None, verdict) where verdict is
+        True (every replica draining), False (none routable), or
+        ``"limited"`` (routable replicas exist but all sit at their
+        AIMD concurrency limit: wait, don't shed)."""
         with self._mu:
             states = dict(self._replicas)
+            retry_until = dict(self._retry_until)
         # role awareness: requests DECODE on non-prefill replicas.
         # Prefill-pool replicas only enter the candidate set when no
         # non-prefill replica could possibly serve (a degraded fleet
@@ -724,6 +750,30 @@ class Router:
                     if n not in req.excluded
                     and st.health != "draining"
                     and not st.warming and not st.admin_draining}
+        # Retry-After cooldowns: a replica that shed with the header
+        # goes to the back of the line — but only while OTHER
+        # candidates exist (a cooldown never makes a fleet unroutable)
+        if retry_until:
+            now = time.monotonic()
+            cooling = {n for n in eligible
+                       if retry_until.get(n, 0.0) > now}
+            if cooling and len(cooling) < len(eligible):
+                for n in cooling:
+                    eligible.pop(n)
+        # AIMD concurrency bound: replicas at their learned in-flight
+        # limit drop out; when that empties the candidate set the
+        # caller WAITS for a slot instead of shedding (the limiter
+        # bounds concurrency, not admission)
+        limited = False
+        if self.overload is not None and eligible:
+            lim = self.overload.limiter
+            with_room = {n: st for n, st in eligible.items()
+                         if lim.has_room(n, st.inflight)}
+            if with_room:
+                eligible = with_room
+            else:
+                limited = True
+                eligible = {}
         preferred_all = self._rendezvous(req.affinity_key, states) \
             if self.policy == "affinity" else None
         while eligible:
@@ -743,6 +793,8 @@ class Router:
             if st.breaker.allow():
                 return st, pick == preferred_all
             eligible.pop(pick)   # half-open probe budget spent
+        if limited:
+            return None, "limited"
         all_draining = bool(states) and all(
             st.health == "draining" for st in states.values())
         return None, all_draining
@@ -968,6 +1020,29 @@ class Router:
                     f"({cur}/{quota.max_inflight} in flight)",
                     reason="queue_full")
                 return req.future
+        # overload admission: the brownout controller may shed outright
+        # (hopeless prediction, gold-only floor) or clamp the request
+        # (bronze under L2) before any replica is woken. Gold never
+        # reaches either branch — admit() passes protected classes
+        # through untouched.
+        if self.overload is not None:
+            verdict = self.overload.admit(
+                slo, len(req.prompt), req.max_new_tokens,
+                req.deadline.remaining()
+                if req.deadline is not None else None)
+            shed = verdict.get("shed")
+            if shed is not None:
+                self._resolve_shed(req, str(shed), shed.reason,
+                                   exc=shed)
+                return req.future
+            req.predicted_s = verdict.get("predicted_s")
+            if "max_new_tokens" in verdict:
+                req.max_new_tokens = int(verdict["max_new_tokens"])
+            if req.deadline is not None \
+                    and "deadline_factor" in verdict:
+                req.deadline = as_deadline(
+                    req.deadline.remaining()
+                    * float(verdict["deadline_factor"]))
         with self._mu:
             self._by_id[req.nonce] = req
         self._pool.submit(self._run, req)
@@ -1027,10 +1102,16 @@ class Router:
             req.future.set_result(result)
 
     def _resolve_shed(self, req: _FleetRequest, why: str,
-                      reason: str) -> None:
+                      reason: str, exc=None) -> None:
         self.n_shed += 1
         self._m["shed"].inc()
-        self._resolve(req, exc=AdmissionShed(why, reason=reason),
+        if _goodput.enabled():
+            # a shed request's whole router residency was wasted wall
+            # — the ledger names it (precedence over the queue_wait it
+            # overlaps), so brownout cost is visible, not hidden
+            _goodput.note("shed", time.monotonic() - req.t_submit)
+        self._resolve(req,
+                      exc=exc or AdmissionShed(why, reason=reason),
                       outcome="shed")
 
     def _check_boundaries(self, req: _FleetRequest) -> bool:
@@ -1059,6 +1140,28 @@ class Router:
                 return
             st, flag = self._route(req)
             if st is None:
+                if flag == "limited":
+                    # routable replicas exist but every one sits at
+                    # its AIMD limit: hold the request (this pool
+                    # thread IS the queue slot) until a dispatch
+                    # completes — bounded by the deadline boundary
+                    # check above and the controller's max queue wait
+                    waited = time.monotonic() - req.t_submit
+                    if waited < self.overload.max_queue_wait_s:
+                        time.sleep(0.01)
+                        continue
+                    self._resolve_shed(
+                        req, f"concurrency-limited for {waited:.1f}s "
+                        f"(AIMD limits {self.overload.limiter.state()})",
+                        reason="limited",
+                        exc=OverloadShed(
+                            f"concurrency-limited for {waited:.1f}s: "
+                            "no replica slot freed within "
+                            f"{self.overload.max_queue_wait_s:.0f}s",
+                            reason="limited",
+                            retry_after_s=self.overload.retry_after_s(
+                                "limited")))
+                    return
                 self._resolve_shed(
                     req, "no routable replica "
                     f"(tried {sorted(req.excluded)}, "
@@ -1083,9 +1186,13 @@ class Router:
             # disaggregated fleets: long-uncached prompts detour
             # through the prefill pool before this dispatch. Only the
             # first attempt migrates — a failover retry goes straight
-            # to recompute (the fallback that cannot fail).
+            # to recompute (the fallback that cannot fail). Brownout
+            # L1+ pauses the detour: a migration is optional latency
+            # work, the first thing an overloaded fleet stops buying.
             if req.failovers == 0 and req.migrate is None \
-                    and not req.excluded:
+                    and not req.excluded \
+                    and (self.overload is None
+                         or self.overload.allow_optional_work()):
                 self._maybe_migrate(req, st, dspan)
             if self.policy == "affinity":
                 self._m["affinity_total"].inc()
@@ -1134,6 +1241,16 @@ class Router:
                 if isinstance(e, EngineClosed) or \
                         getattr(e, "reason", "") == "draining":
                     st.health = "draining"
+                # a shed response carrying Retry-After cools this
+                # replica: _route prefers siblings until it lapses
+                ra = getattr(e, "retry_after_s", None)
+                if ra:
+                    with self._mu:
+                        self._retry_until[st.name] = \
+                            time.monotonic() + float(ra)
+                if self.overload is not None:
+                    self.overload.on_outcome(st.name, "shed",
+                                             None, 0.0)
                 req.excluded.add(st.name)
                 self.n_rebalanced += 1
                 self._m["rebalanced"].inc()
@@ -1182,6 +1299,11 @@ class Router:
                            else "cancelled"
                            if isinstance(e, RequestCancelled)
                            else "error")
+                if self.overload is not None \
+                        and outcome == "deadline":
+                    self.overload.on_outcome(
+                        st.name, "deadline", req.predicted_s,
+                        time.monotonic() - req.t_submit)
                 self._resolve(req, exc=e, outcome=outcome)
                 return
             finally:
@@ -1189,6 +1311,10 @@ class Router:
                     st.inflight -= 1
                 self._m["inflight"].labels(st.name).set(st.inflight)
             st.breaker.record_success()
+            if self.overload is not None:
+                self.overload.on_outcome(
+                    st.name, "ok", req.predicted_s,
+                    time.monotonic() - req.t_submit)
             if dspan is not None:
                 dspan.set_attr("verdict", "ok").end()
             if req.cancelled:
@@ -1294,9 +1420,13 @@ class Router:
                             f"prefill fill on {mig['prefill']}: "
                             "the decode stream must extend the "
                             "fill's position-0 chain"))
-            if _audit.sampled(req.nonce, _audit.shadow_rate()):
+            if _audit.sampled(req.nonce, _audit.shadow_rate()) \
+                    and (self.overload is None
+                         or self.overload.allow_optional_work()):
                 # off-path: the caller's future resolves regardless;
-                # the shadow rides the dispatch pool
+                # the shadow rides the dispatch pool. Brownout L1+
+                # sheds the sample — determinism proof is optional
+                # work an overloaded fleet stops buying first.
                 self.n_shadows += 1
                 self._pool.submit(self._shadow, req, st, dict(out))
         except Exception:  # noqa: BLE001 — auditing must never
@@ -1477,6 +1607,9 @@ class Router:
         if self._closed:
             return
         self._closed = True
+        if self.overload is not None:
+            self.remove_poll_hook(self.overload.tick)
+            self.overload.unbind()
         _dbgsrv.unregister_status_provider(self._status_name)
         _dbgsrv.unregister_health_provider(self._status_name)
         _dbgsrv.unregister_health_provider(self._status_name + "_slo")

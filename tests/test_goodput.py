@@ -154,7 +154,8 @@ def test_three_way_overlap_follows_precedence_order():
 def test_precedence_is_the_documented_chain():
     assert goodput.BUCKETS == ("productive", "compile", "ckpt_stall",
                                "input_wait", "recovery", "migration",
-                               "audit", "queue_wait", "host_gap")
+                               "audit", "shed", "queue_wait",
+                               "host_gap")
     assert goodput.DERIVED == ("unattributed",)
 
 

@@ -1,0 +1,436 @@
+"""Overload brownout controller tests (ISSUE 20): AIMD limiter math
+on an injected clock, ladder hysteresis/dwell/flap bounds, estimator
+edges (a cold start never sheds), the gold-never-degraded pin, the
+router integration with /overloadz over real HTTP, and the goodput
+"shed" attribution.
+
+Everything here runs on stub replicas and injected clocks — no
+compiles; the seeded end-to-end storm lives in
+``tools/chaos_soak.py --ci --overload`` and the CI comparison gate in
+``tools/llm_bench.py --ci --overload``."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from paddle_tpu.inference.llm import AdmissionShed, OverloadShed
+from paddle_tpu.observability import goodput
+from paddle_tpu.observability.metrics import MetricRegistry
+from paddle_tpu.serving import (AIMDLimiter, BrownoutLadder,
+                                LocalReplica, OverloadController,
+                                Router, ServiceTimeEstimator, SLOClass)
+from paddle_tpu.serving.overload import LEVELS, TRANSITION_LOG_CAP
+
+
+def ticking(start=100.0):
+    """Injected monotonic clock: a one-cell list the test advances."""
+    t = [start]
+    return t, (lambda: t[0])
+
+
+# ---------------------------------------------------------------------------
+# AIMD limiter
+# ---------------------------------------------------------------------------
+
+
+def test_aimd_raise_cut_and_bounds():
+    t, clk = ticking()
+    lim = AIMDLimiter(floor=1, ceiling=8, initial=4, raise_step=1.0,
+                      cut_factor=0.5, cut_interval_s=0.25, clock=clk)
+    assert lim.limit("r0") == 4            # fresh name starts at initial
+    lim.on_success("r0")
+    assert lim.limit("r0") == 5
+    for _ in range(10):
+        lim.on_success("r0")
+    assert lim.limit("r0") == 8            # ceiling clamp
+    assert lim.on_miss("r0") is True
+    assert lim.limit("r0") == 4            # multiplicative cut
+    assert lim.has_room("r0", 3) and not lim.has_room("r0", 4)
+
+
+def test_aimd_miss_storm_is_one_congestion_signal():
+    t, clk = ticking()
+    lim = AIMDLimiter(floor=1, ceiling=32, cut_interval_s=0.25,
+                      clock=clk)
+    assert lim.on_miss("r0") is True
+    # 50 more misses inside the cooldown: the SAME overload event,
+    # priced exactly once (the TCP discipline)
+    assert not any(lim.on_miss("r0") for _ in range(50))
+    assert lim.limit("r0") == 16 and lim.n_cuts == 1
+    t[0] += 0.25                           # cooldown over → next cut
+    assert lim.on_miss("r0") is True
+    assert lim.limit("r0") == 8
+
+
+def test_aimd_sustained_misses_converge_to_floor_not_below():
+    t, clk = ticking()
+    lim = AIMDLimiter(floor=2, ceiling=32, cut_interval_s=0.1,
+                      clock=clk)
+    for _ in range(20):
+        t[0] += 0.2
+        lim.on_miss("r0")
+    assert lim.limit("r0") == 2            # floor, never 0: a floored
+    lim.on_success("r0")                   # replica still probes up
+    assert lim.limit("r0") == 3
+
+
+def test_aimd_forget_restarts_from_initial():
+    lim = AIMDLimiter(floor=1, ceiling=8)
+    lim.on_miss("r0")
+    assert lim.limit("r0") == 4
+    lim.forget("r0")
+    assert lim.limit("r0") == 8            # re-attached name re-earns
+    assert lim.state() == {}               # no phantom entries
+
+
+def test_aimd_rejects_bad_params():
+    with pytest.raises(ValueError):
+        AIMDLimiter(floor=4, ceiling=2)
+    with pytest.raises(ValueError):
+        AIMDLimiter(cut_factor=1.0)
+
+
+# ---------------------------------------------------------------------------
+# brownout ladder: one level per step, dwell, flap bound
+# ---------------------------------------------------------------------------
+
+
+def test_ladder_moves_one_level_per_dwell():
+    t, clk = ticking()
+    lad = BrownoutLadder(up_dwell_s=0.5, down_dwell_s=2.0, clock=clk)
+    assert lad.step(True) == 1             # first move is immediate
+    assert lad.step(True) == 1             # up dwell not served
+    t[0] += 0.5
+    assert lad.step(True) == 2
+    t[0] += 0.5
+    assert lad.step(True) == 3
+    t[0] += 10.0
+    assert lad.step(True) == 3             # max level, stays
+    # dwell is measured from the last TRANSITION: the long quiet
+    # stretch at max already served the flip backoff and down dwell,
+    # so recovery starts now — but still one deliberate level per step
+    assert lad.step(False) == 2
+    assert lad.step(False) == 2            # down dwell (2s) not served
+    t[0] += 1.0
+    assert lad.step(False) == 2
+    t[0] += 1.0
+    assert lad.step(False) == 1
+    t[0] += 2.0
+    assert lad.step(False) == 0
+    assert all(abs(e["to"] - e["from"]) == 1 for e in lad.transitions())
+
+
+def test_ladder_square_wave_flap_count_is_logarithmic():
+    t, clk = ticking()
+    lad = BrownoutLadder(up_dwell_s=0.1, down_dwell_s=0.1,
+                         backoff_base_s=1.0, backoff_cap_s=1e9,
+                         healthy_dwell_s=1e9, max_level=1, clock=clk)
+    # adversarial square wave: pressure toggles every tick for 200
+    # simulated seconds. On a 1-level ladder EVERY move is a direction
+    # flip; without the backoff curve this flaps ~2000 transitions —
+    # the doubling quiet time makes the count logarithmic in elapsed.
+    for i in range(2000):
+        t[0] += 0.1
+        lad.step(i % 2 == 0)
+    assert lad.n_transitions <= 12, lad.transitions()
+    assert all(abs(e["to"] - e["from"]) == 1 for e in lad.transitions())
+
+
+def test_ladder_healthy_dwell_forgives_flip_history():
+    t, clk = ticking()
+    lad = BrownoutLadder(up_dwell_s=0.1, down_dwell_s=0.1,
+                         backoff_base_s=0.5, backoff_cap_s=1e9,
+                         healthy_dwell_s=3.0, clock=clk)
+    lad.step(True)                         # 0 → 1
+    t[0] += 1.0
+    lad.step(False)                        # flip 1: 1 → 0
+    t[0] += 1.0
+    lad.step(True)                         # flip 2: 0 → 1
+    assert lad._flips == 2
+    t[0] += 10.0                           # quiet >> healthy_dwell
+    lad.step(True)                         # a NEW storm, not a flip:
+    assert lad._flips == 0                 # the streak is forgiven
+    assert lad.level == 2
+
+
+def test_ladder_force_clamps_and_walks_back():
+    t, clk = ticking()
+    lad = BrownoutLadder(up_dwell_s=0.1, down_dwell_s=0.1,
+                         backoff_base_s=0.1, backoff_cap_s=0.1,
+                         clock=clk)
+    assert lad.force(99, reason="op_override") == 3    # clamped
+    n = lad.n_transitions
+    assert lad.force(7, reason="again") == 3           # no-op: no record
+    assert lad.n_transitions == n
+    assert lad.force(-5, reason="floor") == 0          # clamped low
+    lad.force(2, reason="chaos")
+    for _ in range(8):                     # live signal disagrees →
+        t[0] += 1.0                        # hysteresis walks it back
+        lad.step(False)
+    assert lad.level == 0
+    reasons = [e["reason"] for e in lad.transitions()]
+    assert "op_override" in reasons and "chaos" in reasons
+
+
+def test_ladder_transition_log_is_bounded():
+    t, clk = ticking()
+    lad = BrownoutLadder(clock=clk)
+    for i in range(3 * TRANSITION_LOG_CAP):
+        lad.force(i % 2 + 1, reason=f"swing{i}")
+    assert len(lad.transitions()) == TRANSITION_LOG_CAP
+    assert lad.n_transitions == 3 * TRANSITION_LOG_CAP
+
+
+# ---------------------------------------------------------------------------
+# service-time estimator
+# ---------------------------------------------------------------------------
+
+
+def test_estimator_cold_start_never_sheds():
+    est = ServiceTimeEstimator(source=lambda: None)
+    assert est.predict(100, 100) is None
+    assert est.hopeless(None, 0.001) is False
+    assert est.hopeless(5.0, None) is False    # no deadline, no verdict
+
+
+def test_estimator_predict_math_and_safety_factor():
+    est = ServiceTimeEstimator(safety_factor=3.0,
+                               source=lambda: (100.0, 10.0))
+    p = est.predict(50, 20, queue_s=1.0)
+    assert p == pytest.approx(50 / 100 + 20 / 10 + 1.0)    # 3.5s
+    assert est.hopeless(p, 1.0) is True        # 3.5 > 3.0
+    assert est.hopeless(p, 1.2) is False       # 3.5 <= 3.6: conservative
+    assert ServiceTimeEstimator(
+        source=lambda: (0.0, 10.0)).predict(4, 4) is None
+
+
+def test_estimator_rejects_optimistic_safety_factor():
+    with pytest.raises(ValueError):
+        ServiceTimeEstimator(safety_factor=0.5)
+
+
+# ---------------------------------------------------------------------------
+# controller: admission verdicts, the gold pin, outcome feedback
+# ---------------------------------------------------------------------------
+
+
+def mk_ctrl(**kw):
+    t, clk = ticking()
+    kw.setdefault("clock", clk)
+    kw.setdefault("registry", MetricRegistry())
+    kw.setdefault("estimator", ServiceTimeEstimator(source=lambda: None))
+    return t, OverloadController(**kw)
+
+
+def test_gold_is_never_degraded_at_any_level():
+    _, ctrl = mk_ctrl(
+        estimator=ServiceTimeEstimator(source=lambda: (1.0, 1.0)))
+    for level in range(len(LEVELS)):
+        ctrl.ladder.force(level, reason="pin")
+        # gold with an absurd request and a hopeless deadline: still {}
+        assert ctrl.admit("gold", 10_000, 10_000, 0.001) == {}
+    assert ctrl.n_shed == {}
+
+
+def test_brownout_l3_sheds_bronze_with_escalating_retry_after():
+    _, ctrl = mk_ctrl(retry_after_base_s=0.1)
+    assert ctrl.retry_after_s() == pytest.approx(0.1)    # level 0
+    ctrl.ladder.force(3, reason="pin")
+    out = ctrl.admit("bronze", 8, 8, 10.0)
+    shed = out["shed"]
+    assert isinstance(shed, OverloadShed)
+    assert isinstance(shed, AdmissionShed)   # typed under the old base
+    assert shed.reason == "brownout"
+    assert shed.retry_after_s == pytest.approx(0.1 * 2 ** 3)
+    assert ctrl.n_shed == {"brownout": 1}
+
+
+def test_clamp_bronze_l2_trims_tokens_and_deadline():
+    _, ctrl = mk_ctrl(bronze_max_new_tokens=16,
+                      bronze_deadline_factor=0.5)
+    ctrl.ladder.force(2, reason="pin")
+    out = ctrl.admit("bronze", 8, 64, 10.0)
+    assert out["max_new_tokens"] == 16
+    assert out["deadline_factor"] == 0.5
+    assert "shed" not in out
+    # under the clamp cap: no clamp key, nothing to undo
+    assert "max_new_tokens" not in ctrl.admit("bronze", 8, 4, 10.0)
+
+
+def test_hopeless_shed_carries_prediction():
+    _, ctrl = mk_ctrl(
+        estimator=ServiceTimeEstimator(source=lambda: (100.0, 1.0)))
+    out = ctrl.admit("bronze", 100, 50, 1.0)   # predicted ~51s >> 3s
+    shed = out["shed"]
+    assert shed.reason == "hopeless"
+    assert shed.predicted_s == pytest.approx(51.0)
+    assert shed.deadline_s == pytest.approx(1.0)
+    # a feasible request sails through WITH its prediction attached
+    ok = ctrl.admit("bronze", 100, 50, 120.0)
+    assert "shed" not in ok and ok["predicted_s"] == pytest.approx(51.0)
+
+
+def test_on_outcome_drives_limiter_ewma_and_histogram():
+    reg = MetricRegistry()
+    _, ctrl = mk_ctrl(registry=reg,
+                      limiter=AIMDLimiter(floor=1, ceiling=8, initial=4))
+    ctrl.on_outcome("r0", "ok", predicted_s=2.0, latency_s=1.0)
+    assert ctrl.limiter.limit("r0") == 5
+    ctrl.on_outcome("r0", "deadline", predicted_s=2.0, latency_s=4.0)
+    assert ctrl.limiter.limit("r0") == 2
+    ctrl.on_outcome(None, "ok", predicted_s=None, latency_s=0.5)
+    h = reg.get("overload_estimate_error_ratio")
+    # two observations carried predictions (ok + deadline); the
+    # predictionless one is not the estimator's error to own
+    (child,) = h.children()
+    assert child.count == 2
+
+
+# ---------------------------------------------------------------------------
+# router integration: shed futures, cooldown, /overloadz over HTTP
+# ---------------------------------------------------------------------------
+
+
+class StubReplica:
+    """Echo replica for router-integration tests (no compiles)."""
+
+    def __init__(self):
+        self.calls = []
+        self._mu = threading.Lock()
+
+    def submit(self, prompt_ids, **kw):
+        with self._mu:
+            self.calls.append(dict(kw, prompt_ids=list(prompt_ids)))
+        return {"output_ids": [1] * kw.get("max_new_tokens", 1),
+                "prompt_ids": list(prompt_ids)}
+
+    def health(self):
+        return "healthy"
+
+    def cancel(self, request_id):
+        return False
+
+    def close(self):
+        pass
+
+
+def mk_router(replicas, **kw):
+    kw.setdefault("health_poll_interval", 0.05)
+    kw.setdefault("slo_classes", {
+        "gold": SLOClass("gold", deadline_s=30.0, target=0.999),
+        "bronze": SLOClass("bronze", deadline_s=30.0, target=0.9),
+    })
+    return Router(replicas, **kw)
+
+
+def _get(base, path):
+    with urllib.request.urlopen(base + path, timeout=30) as r:
+        return json.loads(r.read())
+
+
+def test_router_overload_end_to_end_with_overloadz_http():
+    from paddle_tpu.observability import server as dbg
+    _, ctrl = mk_ctrl()
+    stubs = {"r0": StubReplica(), "r1": StubReplica()}
+    srv = dbg.DebugServer(port=0).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        # no controller bound anywhere → explicit 404, never {}
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(base, "/overloadz")
+        assert ei.value.code == 404
+        with mk_router(stubs, overload=ctrl) as router:
+            ctrl.ladder.force(3, reason="test_pin")
+            out = router.submit([1, 2, 3], max_new_tokens=2,
+                                slo="gold", tenant="acme",
+                                deadline=30.0).result(timeout=30)
+            assert out["output_ids"] == [1, 1]     # gold rides through
+            fut = router.submit([4, 5, 6], max_new_tokens=2,
+                                slo="bronze", tenant="hobby",
+                                deadline=30.0)
+            with pytest.raises(OverloadShed) as shed:
+                fut.result(timeout=30)
+            assert shed.value.reason == "brownout"
+            assert shed.value.retry_after_s > 0
+            oz = _get(base, "/overloadz")
+            (payload,) = oz["overload"].values()
+            assert payload["level"] == 3
+            assert payload["level_name"] == "gold_only"
+            assert payload["shed"]["brownout"] >= 1
+            assert payload["protected_classes"] == ["gold"]
+            assert any(e["reason"] == "test_pin"
+                       for e in payload["transitions"])
+            # the poll hook is actually ticking the controller
+            deadline = time.monotonic() + 10
+            while ctrl.n_ticks == 0 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert ctrl.n_ticks > 0
+        # close() unbinds: the provider is gone and the page 404s again
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(base, "/overloadz")
+        assert ei.value.code == 404
+        assert ctrl._overloadz() is None
+    finally:
+        srv.stop()
+
+
+def test_router_honors_replica_retry_after_cooldown():
+    stubs = {"r0": StubReplica(), "r1": StubReplica()}
+    with mk_router(stubs) as router:
+        with router._mu:
+            router._retry_until["r0"] = time.monotonic() + 60.0
+        for i in range(6):
+            router.submit([i, i + 1, i + 2], max_new_tokens=1,
+                          slo="bronze", tenant="t").result(timeout=30)
+        assert stubs["r0"].calls == []     # cooling replica skipped
+        assert len(stubs["r1"].calls) == 6
+        # cooldown state dies with the fleet entry
+        router.detach("r0")
+        assert router._retry_until == {}
+
+
+def test_l2_clamp_applies_inside_router_submit():
+    _, ctrl = mk_ctrl()
+    ctrl.ladder.force(2, reason="pin")
+    stubs = {"r0": StubReplica()}
+    with mk_router(stubs, overload=ctrl) as router:
+        out = router.submit([1, 2, 3], max_new_tokens=64,
+                            slo="bronze", tenant="hobby",
+                            deadline=30.0).result(timeout=30)
+        assert len(out["output_ids"]) == ctrl.bronze_max_new_tokens
+        gold = router.submit([1, 2, 3], max_new_tokens=64,
+                             slo="gold", tenant="acme",
+                             deadline=30.0).result(timeout=30)
+        assert len(gold["output_ids"]) == 64   # gold never clamped
+
+
+# ---------------------------------------------------------------------------
+# goodput attribution: a shed is badput with a name
+# ---------------------------------------------------------------------------
+
+
+def test_shed_requests_attribute_goodput_shed_bucket():
+    assert "shed" in goodput.BUCKETS
+    goodput.reset()
+    was = goodput.enabled()
+    goodput.enable()
+    try:
+        _, ctrl = mk_ctrl()
+        ctrl.ladder.force(3, reason="pin")
+        with mk_router({"r0": StubReplica()}, overload=ctrl) as router:
+            fut = router.submit([9, 8, 7], max_new_tokens=2,
+                                slo="bronze", tenant="hobby",
+                                deadline=30.0)
+            with pytest.raises(OverloadShed):
+                fut.result(timeout=30)
+        totals = goodput.instance().totals()
+        # the shed interval is tiny (admission check, not service) but
+        # it is NOTED: badput with a name, never an unattributed hole
+        assert totals["shed"] > 0.0
+    finally:
+        goodput.reset()
+        (goodput.enable if was else goodput.disable)()

@@ -70,6 +70,18 @@ equals that schedule.
    ``tools/llm_bench.py --ci --storm`` — together they are the
    ISSUE-13 CI gate.)
 
+5c. OVERLOAD SOAK (``--overload``) — the brownout-controller gate
+   (ISSUE 20): an in-process two-replica fleet under a seeded 3×
+   burst storm of deadline-doomed bronze traffic plus protected gold.
+   Every future resolves TYPED (ok / deadline / shed — never error,
+   never a hang); gold loses ZERO requests at every ladder level; the
+   brownout ladder walks up under burn pressure (one level per
+   transition, dwell-bounded) and back to normal after the storm
+   drains; a seeded ``overload.estimate`` fault distorts predictions
+   1000× and degrades to visible hopeless-shed verdicts; a seeded
+   ``overload.step`` fault forces a spurious escalation the
+   hysteresis walks back; both sites replay from the seed.
+
 6b. POISONED-STREAM SOAK (rides ``--train``) — the numeric-guard gate
    (ISSUE 9): under a seeded ``data.poison`` / ``grad.nonfinite``
    schedule with the on-device NumericGuard armed (skip policy), the
@@ -121,6 +133,8 @@ CI:   python tools/chaos_soak.py --ci       # fixed seeds, ~30s budget
                                                 # ≤45s budget
       python tools/chaos_soak.py --ci --autoscale  # autoscaler soak,
                                                 # ≤90s budget
+      python tools/chaos_soak.py --ci --overload  # brownout soak,
+                                                # ≤60s budget
       python tools/chaos_soak.py --ci --train   # kill-anywhere train
                                                 # soak + poisoned-
                                                 # stream guard gate,
@@ -1841,6 +1855,176 @@ def autoscale_soak(seed: int, workdir: str) -> dict:
     return out
 
 
+def overload_soak(seed: int, workdir: str) -> dict:
+    """Scenario 5c (``--overload``, ISSUE 20): the brownout controller
+    under a seeded burst storm. Two in-process replicas behind a
+    Router with an :class:`OverloadController`; three rounds of
+    deadline-doomed bronze bursts (plus protected gold) trip the
+    bronze burn windows and walk the ladder up; the storm draining
+    walks it back to normal within its dwell bounds. Asserts: every
+    future resolves TYPED, gold loses zero requests, the ladder moves
+    one level per transition, the seeded ``overload.estimate``
+    distortion surfaces as hopeless-shed verdicts (never a hang), the
+    seeded ``overload.step`` escalation is walked back by hysteresis,
+    and both sites replay from the seed."""
+    from paddle_tpu.inference.llm import (AdmissionShed, LLMEngine,
+                                          OverloadShed)
+    from paddle_tpu.reliability import faults
+    from paddle_tpu.reliability.retry import DeadlineExceeded
+    from paddle_tpu.serving import (AIMDLimiter, BrownoutLadder,
+                                    LocalReplica, OverloadController,
+                                    Router, SLOClass,
+                                    ServiceTimeEstimator)
+
+    rng = np.random.RandomState(seed)
+    faults.reset()
+
+    def build_engine():
+        return LLMEngine(_tiny_gpt(), max_seqs=4, page_size=4,
+                         num_pages=96, prefill_buckets=(16,),
+                         max_pending=64, admit_timeout=60.0, seed=0)
+
+    engines = [build_engine(), build_engine()]
+    for e in engines:           # shared in-process compile warmup
+        e.generate([[1, 2, 3]], max_new_tokens=2)
+    # injected rate source: deterministic predictions (the perf-
+    # registry path is the bench's job; the soak pins CONTROL flow)
+    ctrl = OverloadController(
+        estimator=ServiceTimeEstimator(source=lambda: (4000.0, 800.0)),
+        limiter=AIMDLimiter(floor=1, ceiling=8),
+        ladder=BrownoutLadder(up_dwell_s=0.2, down_dwell_s=0.3,
+                              backoff_base_s=0.2, backoff_cap_s=1.0),
+        bronze_max_new_tokens=8)
+    router = Router({"r0": LocalReplica(engines[0]),
+                     "r1": LocalReplica(engines[1])},
+                    health_poll_interval=0.1, scrape_metrics=False,
+                    slo_classes={
+                        "gold": SLOClass("gold", deadline_s=60.0,
+                                         target=0.99),
+                        "bronze": SLOClass("bronze", deadline_s=0.08,
+                                           target=0.99)},
+                    slo_windows=(1.0, 4.0), slo_min_samples=4,
+                    slo_breach_threshold=5.0, overload=ctrl)
+    outcomes = {"ok": 0, "deadline": 0, "shed": 0, "error": 0}
+    gold_lost, max_level = [], [0]
+    stop_watch = threading.Event()
+
+    def watch_level():
+        while not stop_watch.is_set():
+            max_level[0] = max(max_level[0], ctrl.level)
+            time.sleep(0.02)
+
+    watcher = threading.Thread(target=watch_level, daemon=True)
+    watcher.start()
+
+    def tally(futs):
+        done, not_done = fut_wait([f for _s, f in futs],
+                                  timeout=FUTURE_TIMEOUT)
+        assert not not_done, (
+            f"{len(not_done)} futures never resolved — the overload "
+            f"controller hung the router")
+        for slo, f in futs:
+            exc = f.exception()
+            if exc is None:
+                outcomes["ok"] += 1
+            elif isinstance(exc, DeadlineExceeded):
+                outcomes["deadline"] += 1
+                if slo == "gold":
+                    gold_lost.append(("deadline", str(exc)))
+            elif isinstance(exc, AdmissionShed):
+                outcomes["shed"] += 1
+                if slo == "gold":
+                    gold_lost.append(("shed", str(exc)))
+            else:
+                outcomes["error"] += 1
+                gold_lost.append((type(exc).__name__, str(exc)))
+
+    try:
+        faults.enable(seed=seed)
+        # 2nd + 7th predictions distort 1000× (→ hopeless sheds); the
+        # overload.step escalation is armed LATER, once the ladder is
+        # back at normal — forced at max level it would clamp to a
+        # no-op and the walk-back assertion would test nothing
+        faults.inject("overload.estimate", nth=(2, 7))
+
+        # -- 3× burst storm: bronze is deadline-doomed (0.08 s for a
+        # 24-token decode), gold is generously budgeted and PROTECTED
+        for _round in range(3):
+            futs = [("bronze",
+                     router.submit(rng.randint(0, 97, 12).tolist(),
+                                   max_new_tokens=24, slo="bronze"))
+                    for _ in range(12)]
+            futs += [("gold",
+                      router.submit(rng.randint(0, 97, 8).tolist(),
+                                    max_new_tokens=4, slo="gold"))
+                     for _ in range(4)]
+            tally(futs)
+            time.sleep(0.3)     # let ticks see the burn windows
+        _poll_until(lambda: ctrl.level >= 1 or max_level[0] >= 1, 30,
+                    "ladder engaging under the bronze burn signal")
+
+        # -- quiet: bronze samples age out of the (1 s, 4 s) windows,
+        # the ladder walks back down one dwell-bounded level at a time
+        _poll_until(lambda: ctrl.level == 0, 60,
+                    "ladder walking back to normal after the storm")
+
+        # -- spurious escalation: force the ladder UP from normal on a
+        # seeded tick (2 calls out — ticks ride the 0.1 s poll, so the
+        # fault lands while the fleet is demonstrably calm) and assert
+        # the hysteresis walks it back without any real burn signal
+        faults.inject("overload.step",
+                      nth=(faults.call_count("overload.step") + 2,))
+        _poll_until(
+            lambda: any(t["reason"].startswith("fault_injected")
+                        for t in ctrl.ladder.transitions()), 30,
+            "seeded overload.step escalation landing")
+        _poll_until(lambda: ctrl.level == 0, 60,
+                    "hysteresis walking back the spurious escalation")
+        stop_watch.set()
+        watcher.join(timeout=5)
+
+        assert outcomes["error"] == 0, (
+            f"untyped resolutions under overload chaos: {outcomes}, "
+            f"first: {gold_lost[:3]}")
+        assert not gold_lost, (
+            f"gold lost {len(gold_lost)} request(s) — the protected "
+            f"class must never be shed or missed: {gold_lost[:3]}")
+        assert outcomes["shed"] + outcomes["deadline"] > 0, (
+            f"the storm was not a storm: {outcomes}")
+        shed_counts = dict(ctrl.n_shed)
+        assert shed_counts.get("hopeless", 0) >= 1, (
+            "the seeded overload.estimate distortion never surfaced "
+            f"as a hopeless shed: {shed_counts}")
+        trans = ctrl.ladder.transitions()
+        assert max_level[0] >= 1 and any(
+            t["to"] > t["from"] for t in trans), (
+            f"the ladder never engaged: max={max_level[0]}, {trans}")
+        assert all(abs(t["to"] - t["from"]) == 1
+                   for t in trans), (
+            f"a transition jumped more than one level: {trans}")
+        assert any(t["reason"].startswith("fault_injected")
+                   for t in trans), (
+            "the seeded overload.step escalation never landed: "
+            f"{trans}")
+        assert len(trans) <= 24, (
+            f"ladder flapped {len(trans)} transitions — hysteresis "
+            f"is not damping: {trans}")
+        assert ctrl.level == 0, f"ladder stuck at {ctrl.level}"
+
+        # -- determinism: both overload sites replay from the seed
+        _assert_schedule_matches(
+            faults, ("overload.estimate", "overload.step"))
+        return {"outcomes": outcomes, "max_level": max_level[0],
+                "transitions": len(trans), "shed": shed_counts,
+                "limits": ctrl.limiter.state()}
+    finally:
+        stop_watch.set()
+        faults.reset()
+        router.close()
+        for e in engines:
+            e.close()
+
+
 TRAIN_STEPS = 16          # 2 epochs × 8 steps (32 samples / batch 4)
 TRAIN_EPOCH_STEPS = TRAIN_STEPS // 2
 TRAIN_CKPT_FREQ = 5
@@ -2367,6 +2551,11 @@ def main(argv=None) -> int:
                          "tripped scale-out with a seeded spawn "
                          "fault, SIGKILL → replacement, fault-forced "
                          "straggler drain → token-identical failover)")
+    ap.add_argument("--overload", action="store_true",
+                    help="run ONLY the brownout scenario (3× burst "
+                         "storm, typed resolution, gold zero loss, "
+                         "dwell-bounded ladder walk, seeded "
+                         "overload.estimate/step faults)")
     ap.add_argument("--seed", type=int, default=1234)
     ap.add_argument("--workdir", default=None)
     ap.add_argument("--ckpt-worker", nargs=2, metavar=("DIR", "STEPS"),
@@ -2414,6 +2603,8 @@ def main(argv=None) -> int:
             out["drift"] = drift_soak(seed, workdir)
         elif args.autoscale:
             out["autoscale"] = autoscale_soak(seed, workdir)
+        elif args.overload:
+            out["overload"] = overload_soak(seed, workdir)
         elif args.train:
             out["train"] = train_soak(seed, workdir)
         elif args.slab:
@@ -2444,6 +2635,7 @@ def main(argv=None) -> int:
         replay = (f"python tools/chaos_soak.py --seed {seed}"
                   + (" --fleet" if args.fleet else "")
                   + (" --autoscale" if args.autoscale else "")
+                  + (" --overload" if args.overload else "")
                   + (" --train" if args.train else "")
                   + (" --slab" if args.slab else ""))
         print(f"CHAOS SOAK FAILED under fault seed {seed}\n"

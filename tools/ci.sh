@@ -122,6 +122,26 @@ echo "== autoscale chaos soak (SLO-driven scale-out/in over a live fleet)"
 # merged cross-process trace next to the seed + replay command.
 python tools/chaos_soak.py --ci --autoscale
 
+echo "== overload chaos soak (seeded 3x burst storm -> brownout ladder)"
+# the ISSUE-20 gate, half 1: a burst storm over a static K=2 fleet
+# engages the brownout ladder (level >= 1, one-level moves only),
+# bronze is shed TYPED (OverloadShed with retry_after_s) while gold
+# loses ZERO requests, a seeded overload.estimate fault turns a
+# wildly-wrong prediction into visible shed/miss verdicts (never a
+# hang), a seeded overload.step fault forces a spurious transition the
+# hysteresis walks back, and the ladder returns to level 0 after the
+# storm; both fault sites replay from seed
+python tools/chaos_soak.py --ci --overload
+
+echo "== overload bench (3x burst over static K=2: brownout off vs on)"
+# the ISSUE-20 gate, half 2: the same un-scalable burst tape with the
+# controller off and on — brownout must hold the gold deadline-hit
+# ratio at the UN-overloaded baseline (zero gold lost) and STRICTLY
+# cut the wasted-work fraction (deadline misses that burned full
+# service time, converted into cheap typed sheds); the comparison
+# lands in BENCH_LEDGER.jsonl as llm_overload_* rows
+python tools/llm_bench.py --ci --overload
+
 echo "== storm bench (diurnal+burst: static K=3 vs autoscaled fleet)"
 # the ISSUE-13 gate, half 2: the millions-of-users-shaped storm
 # (shared prefixes, mixed tenants/SLO classes) must trigger >=1

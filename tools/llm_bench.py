@@ -990,6 +990,224 @@ def storm_main(args):
     return 0
 
 
+# ---------------------------------------------------------------------------
+# overload mode: the brownout gate (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+
+def make_overload_schedules(vocab=97, seed=0):
+    """The brownout gate's two request tapes: an UN-OVERLOADED
+    baseline (one generous trough — the gold hit ratio the gate holds
+    the brownout run to) and the OVERLOAD tape — the storm bench's
+    burst, tripled back-to-back over a static fleet that cannot scale
+    out of it. Same families, tenants, and deadline structure as
+    :func:`make_storm_schedule`."""
+    rng = np.random.RandomState(seed)
+    families = [rng.randint(0, vocab, 32).tolist() for _ in range(3)]
+
+    def req(fam, tenant, slo, gen, deadline):
+        prompt = families[fam] + rng.randint(0, vocab, 8).tolist()
+        return {"prompt_ids": prompt, "max_new_tokens": gen,
+                "tenant": tenant, "slo": slo, "deadline": deadline}
+
+    def trough(sched, t0, dur, rate=1.6):
+        n = max(2, int(dur * rate))
+        for i in range(n):
+            fam = int(rng.randint(0, len(families)))
+            gold = i % 3 == 0
+            sched.append((t0 + dur * i / n, req(
+                fam, "acme" if gold else "hobby",
+                "gold" if gold else "bronze", 8, 20.0)))
+        return t0 + dur
+
+    def burst(sched, t0, dur=0.8, n_bronze=48, n_gold=8):
+        for _ in range(n_bronze):
+            sched.append((t0 + dur * rng.random(), req(
+                int(rng.randint(0, len(families))), "hobby",
+                "bronze", 48, 0.35)))
+        for _ in range(n_gold):
+            sched.append((t0 + dur * rng.random(), req(
+                int(rng.randint(0, len(families))), "acme",
+                "gold", 8, 25.0)))
+        return t0 + dur
+
+    baseline = []
+    trough(baseline, 0.0, 3.0)
+    overload = []
+    t = trough(overload, 0.0, 1.5)
+    for _ in range(3):               # 3× the storm burst, no sag
+        t = burst(overload, t)
+    trough(overload, t + 0.2, 1.5)
+    baseline.sort(key=lambda x: x[0])
+    overload.sort(key=lambda x: x[0])
+    return baseline, overload
+
+
+def run_overload(engines, schedule, brownout: bool):
+    """Replay ``schedule`` against a static fleet, optionally under an
+    :class:`OverloadController`. Counts outcomes with shed as its own
+    TYPED column (the storm bench's 'other = lost' rule would hide the
+    controller's entire mechanism) and returns the comparison row:
+    gold/bronze hit ratios plus the wasted-work fraction — deadline
+    misses burned full service cost and delivered nothing; sheds cost
+    one admission check."""
+    from paddle_tpu.inference.llm import AdmissionShed
+    from paddle_tpu.reliability.retry import DeadlineExceeded
+    from paddle_tpu.serving import LocalReplica, OverloadController
+
+    ctrl = OverloadController() if brownout else None
+    router = _storm_router(
+        {f"r{i}": LocalReplica(e) for i, e in enumerate(engines)},
+        **({"overload": ctrl} if ctrl is not None else {}))
+    outcomes = {"ok": 0, "deadline": 0, "shed": 0, "other": 0}
+    t0 = time.perf_counter()
+    futs = []
+    try:
+        for t_off, kw in schedule:
+            dt = t0 + t_off - time.perf_counter()
+            if dt > 0:
+                time.sleep(dt)
+            futs.append((kw["slo"], router.submit(**kw)))
+        gold_lost = 0
+        for slo, f in futs:
+            try:
+                out = f.result(timeout=600)
+                assert out["output_ids"] is not None
+                outcomes["ok"] += 1
+            except DeadlineExceeded:
+                outcomes["deadline"] += 1
+                gold_lost += slo == "gold"
+            except AdmissionShed:
+                outcomes["shed"] += 1
+                gold_lost += slo == "gold"
+            except Exception:  # noqa: BLE001 — untyped = lost
+                outcomes["other"] += 1
+                gold_lost += slo == "gold"
+        wall = time.perf_counter() - t0
+        report = router.slo.report()["classes"]
+        gold = report.get("gold", {})
+        bronze = report.get("bronze", {})
+    finally:
+        router.close()
+    served = outcomes["ok"] + outcomes["deadline"]
+    trans = ctrl.ladder.transitions() if ctrl is not None else []
+    return {
+        "mode": "brownout" if brownout else "uncontrolled",
+        "wall_s": round(wall, 2),
+        "outcomes": outcomes,
+        "gold_lost": gold_lost,
+        # of the requests that consumed full service time, the
+        # fraction whose tokens were thrown away at the deadline
+        "wasted_work_fraction": (round(outcomes["deadline"] / served, 4)
+                                 if served else 0.0),
+        "gold_deadline_hit_ratio": gold.get("deadline_hit_ratio"),
+        "bronze_deadline_hit_ratio": bronze.get("deadline_hit_ratio"),
+        "shed_reasons": dict(ctrl.n_shed) if ctrl is not None else {},
+        "max_brownout_level": max([t["to"] for t in trans] or [0]),
+        "transitions": len(trans),
+    }
+
+
+def overload_main(args):
+    """Un-overloaded baseline, then the 3× burst tape twice over the
+    same static K=2 fleet — brownout OFF vs ON. The gate: the
+    controller must hold gold at the baseline hit ratio AND strictly
+    cut the wasted-work fraction (misses converted to cheap typed
+    sheds)."""
+    import tempfile
+
+    jax.config.update("jax_compilation_cache_dir",
+                      tempfile.mkdtemp(prefix="pt_overload_xla_"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      0.0)
+    from paddle_tpu.inference.llm import LLMEngine
+
+    base_sched, over_sched = make_overload_schedules()
+    max_len = 32 + 8 + 48
+
+    def build_engine():
+        net = build_net(vocab=97, hidden=64, max_pos=96)
+        return LLMEngine(net, max_seqs=2, page_size=16,
+                         num_pages=3 * (-(-max_len // 16)) + 16,
+                         max_len=max_len, prefill_buckets=(40,),
+                         prefill_chunk=64, prefix_cache=True,
+                         max_pending=256, admit_timeout=120.0,
+                         seed=0)
+
+    runs = {}
+    for key, sched, brownout in (("baseline", base_sched, False),
+                                 ("off", over_sched, False),
+                                 ("on", over_sched, True)):
+        engines = [build_engine() for _ in range(2)]
+        for e in engines:
+            e.generate([[96, 95, 94]], max_new_tokens=2)
+        try:
+            runs[key] = run_overload(engines, sched, brownout)
+        finally:
+            for e in engines:
+                e.close()
+    w_off = runs["off"]["wasted_work_fraction"]
+    w_on = runs["on"]["wasted_work_fraction"]
+    row = {
+        "metric": "llm_overload_wasted_work_fraction",
+        "value": w_on,
+        "unit": "deadline_missed_fraction_of_served",
+        "device": "cpu",
+        "workload": {"requests": len(over_sched), "families": 3,
+                     "replicas": 2, "phases": "trough/burst x3/trough"},
+        "baseline": runs["baseline"],
+        "uncontrolled": runs["off"],
+        "brownout": runs["on"],
+    }
+    print(json.dumps(row))
+    if args.out:
+        with open(args.out, "a") as f:
+            f.write(json.dumps(row) + "\n")
+    _ledger.append(
+        "llm_bench", row["metric"], row["value"], row["unit"],
+        direction="lower", peak_mem_bytes=_peak_mem_bytes(),
+        **_verdict_row_fields(),
+        extra={"uncontrolled_wasted_work_fraction": w_off,
+               "shed_reasons": runs["on"]["shed_reasons"],
+               "max_brownout_level": runs["on"]["max_brownout_level"],
+               "workload": row["workload"]})
+    _ledger.append(
+        "llm_bench", "llm_overload_gold_hit_ratio",
+        runs["on"]["gold_deadline_hit_ratio"],
+        "gold_deadline_hit_ratio_brownout_on",
+        peak_mem_bytes=_peak_mem_bytes(),
+        **_verdict_row_fields(),
+        extra={"baseline_gold_hit_ratio":
+                   runs["baseline"]["gold_deadline_hit_ratio"],
+               "uncontrolled_gold_hit_ratio":
+                   runs["off"]["gold_deadline_hit_ratio"],
+               "workload": row["workload"]})
+    if args.ci:
+        base, off, on = runs["baseline"], runs["off"], runs["on"]
+        for r in runs.values():
+            assert r["outcomes"]["other"] == 0, (
+                f"untyped losses in {r['mode']}: {r['outcomes']}")
+        assert base["outcomes"]["shed"] == 0, (
+            f"the un-overloaded baseline shed: {base['outcomes']}")
+        g_base = base["gold_deadline_hit_ratio"]
+        g_on = on["gold_deadline_hit_ratio"]
+        assert g_base is not None and g_on is not None, runs
+        assert on["gold_lost"] == 0, (
+            f"brownout lost {on['gold_lost']} gold request(s) — the "
+            f"protected class must ride through the storm untouched")
+        assert g_on >= g_base, (
+            f"brownout dropped the gold SLO below the un-overloaded "
+            f"baseline: {g_on} vs {g_base}")
+        assert sum(on["shed_reasons"].values()) >= 1 \
+            and on["max_brownout_level"] >= 1, (
+            f"the controller never engaged under a 3× burst: {on}")
+        assert w_on < w_off, (
+            f"brownout must strictly cut the wasted-work fraction: "
+            f"{w_on} (on) vs {w_off} (off)")
+        print("LLM OVERLOAD BROWNOUT SMOKE OK")
+    return 0
+
+
 def run_decode_ticks(net, prompts, gen_len, n_ticks, temperature=0.0,
                      page_size=16):
     """One engine pass at ``decode_ticks_per_dispatch=n_ticks``:
@@ -1520,6 +1738,11 @@ def main(argv=None):
                     help="diurnal+burst autoscaling gate: static K=3 "
                          "vs Autoscaler min=1/max=3 — replica-seconds "
                          "and gold-class deadline-hit ratio")
+    ap.add_argument("--overload", action="store_true",
+                    help="brownout gate: 3x burst over static K=2, "
+                         "controller off vs on — gold hit ratio held "
+                         "at the un-overloaded baseline, wasted-work "
+                         "fraction strictly lower")
     ap.add_argument("--kv-dtype", action="store_true",
                     help="bf16 vs int8 KV pools at fixed pool HBM: "
                          "resident prefix-cache pages (>=1.8x gate) "
@@ -1550,6 +1773,8 @@ def main(argv=None):
         return fleet_main(args)
     if args.storm:
         return storm_main(args)
+    if args.overload:
+        return overload_main(args)
     if args.decode_ticks:
         return decode_ticks_main(args, assert_ci=args.ci)
     if args.kv_dtype:

@@ -566,6 +566,20 @@ def fleet_main(outdir: str = "/tmp/pt_obs_fleet_smoke") -> int:
         assert agg2["drift_replicas"] == 1, agg2
         assert agg2["drift_verified"] == 5, agg2
         assert agg2["drift_divergences"] == 1, agg2
+        # -- brownout federation is hole-not-zero ------------------------
+        # no replica in this smoke runs an overload controller, so the
+        # fleet MAX has an explicitly empty denominator — a fleet that
+        # exports level 0 here would be claiming "all clear" on the
+        # strength of replicas that never took the measurement
+        assert "fleet_brownout_replicas 0" in scraped, \
+            "controller-less replicas must be a hole in " \
+            "fleet_brownout_level, never level-0 evidence"
+        fs3 = FleetScraper(registry=MetricRegistry())
+        fs3.record("browned", "brownout_level 2\n")
+        fs3.record("hole", "llm_requests_completed 0\n")
+        agg3 = fs3.aggregates()
+        assert agg3["brownout_replicas"] == 1, agg3
+        assert agg3["brownout_level"] == 2, agg3   # MAX over UP, not mean
         _flags.set_flags({"audit_shadow_rate": 0.0})
         # -- ONE cross-process trace ------------------------------------
         out = outs[0]
